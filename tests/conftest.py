@@ -1,0 +1,54 @@
+"""Shared fixtures.
+
+Mirrors the reference's test strategy (SURVEY.md section 4): a real database
+per test (uniquely named, dropped after), and a virtual 8-device CPU mesh
+standing in for multi-chip TPU hardware
+(XLA_FLAGS=--xla_force_host_platform_device_count=8).
+"""
+
+import asyncio
+import os
+import uuid
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture
+def run(event_loop=None):
+    """Run a coroutine to completion from a sync test."""
+    loop = asyncio.new_event_loop()
+    try:
+        yield loop.run_until_complete
+    finally:
+        loop.close()
+
+
+@pytest.fixture
+def db_path(tmp_path):
+    """Unique on-disk database path per test (real-DB isolation)."""
+    return str(tmp_path / f"vlog_test_{uuid.uuid4().hex}.db")
+
+
+@pytest.fixture
+def db(run, db_path):
+    """Connected Database with the full schema applied."""
+    from vlog_tpu.db import Database, create_all
+
+    database = Database(f"sqlite:///{db_path}")
+    run(database.connect())
+    run(create_all(database))
+    yield database
+    run(database.disconnect())

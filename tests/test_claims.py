@@ -1,0 +1,196 @@
+"""Claim-protocol tests against a real database.
+
+Reference analog: test_transcoder_integration.py:977-1186 (claim contention,
+expired-claim reclaim, completed-job rejection) and
+test_worker_claim_expiration.py — distributed behavior tested as
+state-machine tests against the shared DB.
+"""
+
+import asyncio
+
+import pytest
+
+from vlog_tpu.db.core import now as db_now
+from vlog_tpu.enums import AcceleratorKind, JobKind
+from vlog_tpu.jobs import claims
+from vlog_tpu.jobs.state import JobStateError
+
+
+async def make_video(db, slug="vid"):
+    t = db_now()
+    return await db.execute(
+        "INSERT INTO videos (slug, title, created_at, updated_at)"
+        " VALUES (:s, :s, :t, :t)",
+        {"s": slug, "t": t},
+    )
+
+
+class TestClaim:
+    def test_claim_and_release_cycle(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            job_id = await claims.enqueue_job(db, vid)
+            job = await claims.claim_job(db, "w1")
+            assert job is not None and job["id"] == job_id
+            assert job["claimed_by"] == "w1"
+            assert job["attempt"] == 1
+            # second worker sees nothing
+            assert await claims.claim_job(db, "w2") is None
+            done = await claims.complete_job(db, job_id, "w1")
+            assert done["completed_at"] is not None
+            # completed job is not claimable
+            assert await claims.claim_job(db, "w2") is None
+
+        run(body())
+
+    def test_contention_two_workers_disjoint_jobs(self, db, run):
+        async def body():
+            for i in range(2):
+                vid = await make_video(db, f"v{i}")
+                await claims.enqueue_job(db, vid)
+            got = await asyncio.gather(
+                claims.claim_job(db, "w1"), claims.claim_job(db, "w2")
+            )
+            ids = {g["id"] for g in got if g is not None}
+            assert len(ids) == 2, "two workers must claim disjoint jobs"
+
+        run(body())
+
+    def test_priority_order(self, db, run):
+        async def body():
+            low = await make_video(db, "low")
+            high = await make_video(db, "high")
+            await claims.enqueue_job(db, low, priority=0)
+            hi_id = await claims.enqueue_job(db, high, priority=10)
+            job = await claims.claim_job(db, "w1")
+            assert job["id"] == hi_id
+
+        run(body())
+
+    def test_expired_claim_is_reclaimable(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            job_id = await claims.enqueue_job(db, vid)
+            job = await claims.claim_job(db, "w1", lease_s=0.0)
+            assert job["id"] == job_id
+            await asyncio.sleep(0.01)
+            job2 = await claims.claim_job(db, "w2")
+            assert job2 is not None and job2["id"] == job_id
+            assert job2["claimed_by"] == "w2"
+            assert job2["attempt"] == 2
+            # original owner lost the claim: progress must fail
+            with pytest.raises(JobStateError):
+                await claims.update_progress(db, job_id, "w1", progress=10)
+
+        run(body())
+
+    def test_progress_extends_lease(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            job_id = await claims.enqueue_job(db, vid)
+            await claims.claim_job(db, "w1", lease_s=1000.0)
+            before = await db.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
+            out = await claims.update_progress(
+                db, job_id, "w1", progress=42.0, current_step="ladder"
+            )
+            assert out["progress"] == 42.0
+            assert out["current_step"] == "ladder"
+            assert out["claim_expires_at"] > before["claim_expires_at"]
+
+        run(body())
+
+    def test_retry_budget_exhaustion(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            job_id = await claims.enqueue_job(db, vid, max_attempts=2)
+            for attempt in (1, 2):
+                job = await claims.claim_job(db, "w1")
+                assert job is not None and job["attempt"] == attempt
+                failed = await claims.fail_job(db, job_id, "w1", f"boom {attempt}")
+                if attempt < 2:
+                    assert failed["failed_at"] is None, "retry budget remains"
+                else:
+                    assert failed["failed_at"] is not None, "terminal after budget"
+            assert await claims.claim_job(db, "w1") is None
+
+        run(body())
+
+    def test_accelerator_gating(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            await claims.enqueue_job(
+                db, vid, required_accelerator=AcceleratorKind.TPU
+            )
+            assert await claims.claim_job(db, "cpu-w", accelerator=AcceleratorKind.CPU) is None
+            job = await claims.claim_job(db, "tpu-w", accelerator=AcceleratorKind.TPU)
+            assert job is not None
+
+        run(body())
+
+    def test_code_version_gating(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            job_id = await claims.enqueue_job(db, vid)
+            await db.execute(
+                "UPDATE jobs SET min_code_version='5' WHERE id=:id", {"id": job_id}
+            )
+            assert await claims.claim_job(db, "old", code_version="1") is None
+            assert (await claims.claim_job(db, "new", code_version="5")) is not None
+
+        run(body())
+
+    def test_enqueue_resets_terminal_job(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            job_id = await claims.enqueue_job(db, vid, max_attempts=1)
+            await claims.claim_job(db, "w1")
+            await claims.fail_job(db, job_id, "w1", "dead", permanent=True)
+            # re-enqueue (retranscode) resurrects the same row
+            again = await claims.enqueue_job(db, vid)
+            assert again == job_id
+            job = await claims.claim_job(db, "w1")
+            assert job is not None and job["id"] == job_id and job["attempt"] == 1
+
+        run(body())
+
+    def test_kind_filter(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            await claims.enqueue_job(db, vid, JobKind.SPRITE)
+            assert await claims.claim_job(db, "w", kinds=(JobKind.TRANSCODE,)) is None
+            job = await claims.claim_job(db, "w", kinds=(JobKind.SPRITE, JobKind.TRANSCODE))
+            assert job is not None and job["kind"] == "sprite"
+
+        run(body())
+
+    def test_quality_progress_roundtrip(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            job_id = await claims.enqueue_job(db, vid)
+            await claims.upsert_quality_progress(db, job_id, "720p", status="in_progress", progress=50)
+            await claims.upsert_quality_progress(db, job_id, "720p", status="completed", progress=100)
+            await claims.upsert_quality_progress(db, job_id, "360p", status="pending")
+            qp = await claims.get_quality_progress(db, job_id)
+            assert qp["720p"]["status"] == "completed"
+            assert qp["360p"]["status"] == "pending"
+
+        run(body())
+
+
+class TestSweep:
+    def test_sweep_releases_only_expired(self, db, run):
+        async def body():
+            v1 = await make_video(db, "a")
+            v2 = await make_video(db, "b")
+            j1 = await claims.enqueue_job(db, v1)
+            j2 = await claims.enqueue_job(db, v2)
+            await claims.claim_job(db, "w1", lease_s=3600)  # j1, stays live
+            await claims.claim_job(db, "w2", lease_s=0.0)   # j2, will expire
+            await asyncio.sleep(0.01)
+            released = await claims.sweep_expired_claims(db)
+            assert released == 1
+            rows = {r["id"]: r for r in await db.fetch_all("SELECT * FROM jobs")}
+            assert rows[j1]["claimed_by"] == "w1"
+            assert rows[j2]["claimed_by"] is None
+
+        run(body())

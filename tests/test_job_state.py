@@ -1,0 +1,134 @@
+"""Pure state-machine tests (reference analog: tests/test_job_state.py, 946 LoC)."""
+
+import pytest
+
+from vlog_tpu.enums import JobState
+from vlog_tpu.jobs import state as js
+
+NOW = 1_000_000.0
+
+
+def row(**kw):
+    base = {
+        "completed_at": None,
+        "failed_at": None,
+        "claimed_by": None,
+        "claimed_at": None,
+        "claim_expires_at": None,
+        "attempt": 0,
+        "max_attempts": 3,
+    }
+    base.update(kw)
+    return base
+
+
+class TestDeriveState:
+    def test_unclaimed(self):
+        assert js.derive_state(row(), now=NOW) is JobState.UNCLAIMED
+
+    def test_claimed(self):
+        r = row(claimed_by="w1", claim_expires_at=NOW + 60, attempt=1)
+        assert js.derive_state(r, now=NOW) is JobState.CLAIMED
+
+    def test_expired(self):
+        r = row(claimed_by="w1", claim_expires_at=NOW - 1, attempt=1)
+        assert js.derive_state(r, now=NOW) is JobState.EXPIRED
+
+    def test_expiry_boundary_is_expired(self):
+        r = row(claimed_by="w1", claim_expires_at=NOW, attempt=1)
+        assert js.derive_state(r, now=NOW) is JobState.EXPIRED
+
+    def test_retrying(self):
+        assert js.derive_state(row(attempt=1), now=NOW) is JobState.RETRYING
+
+    def test_completed_wins_over_claim(self):
+        r = row(completed_at=NOW - 5, claimed_by="w1", claim_expires_at=NOW + 60)
+        assert js.derive_state(r, now=NOW) is JobState.COMPLETED
+
+    def test_failed(self):
+        assert js.derive_state(row(failed_at=NOW - 5), now=NOW) is JobState.FAILED
+
+    def test_claimed_without_expiry_stays_claimed(self):
+        r = row(claimed_by="w1", attempt=1)
+        assert js.derive_state(r, now=NOW) is JobState.CLAIMED
+
+
+class TestGuards:
+    def test_claim_ok_unclaimed(self):
+        js.guard_claim(row(), now=NOW)
+
+    def test_claim_ok_expired(self):
+        js.guard_claim(row(claimed_by="w1", claim_expires_at=NOW - 1, attempt=1), now=NOW)
+
+    def test_claim_rejects_active_claim(self):
+        with pytest.raises(js.JobStateError):
+            js.guard_claim(row(claimed_by="w1", claim_expires_at=NOW + 60), now=NOW)
+
+    def test_claim_rejects_exhausted_budget(self):
+        with pytest.raises(js.JobStateError):
+            js.guard_claim(row(attempt=3, max_attempts=3), now=NOW)
+
+    def test_claim_rejects_completed(self):
+        with pytest.raises(js.JobStateError):
+            js.guard_claim(row(completed_at=NOW - 5), now=NOW)
+
+    def test_progress_requires_owner(self):
+        r = row(claimed_by="w1", claim_expires_at=NOW + 60, attempt=1)
+        js.guard_progress(r, "w1", now=NOW)
+        with pytest.raises(js.JobStateError):
+            js.guard_progress(r, "w2", now=NOW)
+
+    def test_progress_rejects_expired_claim(self):
+        r = row(claimed_by="w1", claim_expires_at=NOW - 1, attempt=1)
+        with pytest.raises(js.JobStateError):
+            js.guard_progress(r, "w1", now=NOW)
+
+    def test_complete_requires_owner(self):
+        r = row(claimed_by="w1", claim_expires_at=NOW + 60, attempt=1)
+        js.guard_complete(r, "w1", now=NOW)
+        with pytest.raises(js.JobStateError):
+            js.guard_complete(r, "w2", now=NOW)
+
+    def test_complete_rejects_double_complete(self):
+        with pytest.raises(js.JobStateError):
+            js.guard_complete(row(completed_at=NOW - 5), "w1", now=NOW)
+
+    def test_fail_rejects_terminal(self):
+        with pytest.raises(js.JobStateError):
+            js.guard_fail(row(failed_at=NOW - 5), "w1", now=NOW)
+
+    def test_fail_allows_unclaimed_sweeper(self):
+        # stale-job sweeps fail jobs nobody currently claims (worker=None)
+        js.guard_fail(row(attempt=2), None, now=NOW)
+
+
+class TestSqlFragments:
+    def test_claimable_matches_derive(self, db, run):
+        """The SQL conditions and the Python predicates must agree."""
+        import sqlite3
+
+        cases = [
+            row(),
+            row(attempt=1),
+            row(claimed_by="w", claim_expires_at=NOW + 60, attempt=1),
+            row(claimed_by="w", claim_expires_at=NOW - 60, attempt=1),
+            row(completed_at=NOW - 1),
+            row(failed_at=NOW - 1),
+        ]
+        conn = sqlite3.connect(":memory:")
+        conn.execute(
+            "CREATE TABLE jobs (completed_at REAL, failed_at REAL, claimed_by TEXT,"
+            " claimed_at REAL, claim_expires_at REAL, attempt INT, max_attempts INT)"
+        )
+        for c in cases:
+            conn.execute(
+                "INSERT INTO jobs VALUES (:completed_at,:failed_at,:claimed_by,"
+                ":claimed_at,:claim_expires_at,:attempt,:max_attempts)",
+                c,
+            )
+        got = conn.execute(
+            f"SELECT rowid FROM jobs WHERE {js.SQL_CLAIMABLE}", {"now": NOW}
+        ).fetchall()
+        sql_claimable = {r[0] - 1 for r in got}
+        py_claimable = {i for i, c in enumerate(cases) if js.is_claimable(c, now=NOW)}
+        assert sql_claimable == py_claimable

@@ -1,0 +1,26 @@
+"""vlog_tpu — a TPU-native self-hosted video platform framework.
+
+A ground-up rebuild of the capabilities of filthyrake/vlog (see SURVEY.md):
+upload -> HLS/CMAF adaptive-bitrate transcoding -> auto-transcription ->
+playback, with a distributed claim-lease worker fleet. The compute substrate
+is JAX/XLA/Pallas on TPU: decoded frames live in HBM, a one-pass multi-scale
+kernel emits the whole quality ladder, and batched Whisper-JAX produces
+captions on the same device mesh.
+
+Layer map (bottom-up), mirroring the reference layer map (SURVEY.md section 1):
+
+- ``config``        env-driven constants (reference: config.py)
+- ``db``            persistence: async DB facade + schema (reference: api/database.py)
+- ``jobs``          job state machine, claim protocol, queue (reference: api/job_state.py, api/job_queue.py)
+- ``media``         ISO-BMFF demux/mux, HLS/DASH manifests, probing (reference: ffmpeg/ffprobe subprocesses)
+- ``ops``           JAX/Pallas TPU kernels: colorspace, ladder resize, DCT/quant
+- ``codecs``        video codec implementations (H.264 intra encoder: JAX transform + host entropy coding)
+- ``parallel``      device mesh + sharding policies (reference: process/NCCL-free fleet parallelism)
+- ``models``        neural models (Whisper) in Flax
+- ``asr``           audio frontend, chunked transcription pipeline, WebVTT
+- ``worker``        accelerator backend boundary + worker runtimes (reference: worker/hwaccel.py, worker/transcoder.py)
+- ``httpd``         in-house asyncio HTTP framework (reference used FastAPI, unavailable here)
+- ``api``           worker/admin/public HTTP services (reference: api/worker_api.py, api/admin.py, api/public.py)
+"""
+
+__version__ = "0.1.0"

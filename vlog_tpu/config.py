@@ -1,0 +1,224 @@
+"""Environment-driven configuration.
+
+Reference parity: config.py:95-177 (validated env parsers with VLOG_* names),
+config.py:221-260 (quality ladder / segment / timeout envelope),
+config.py:317-321 (claim lease + heartbeat). We keep the same env-var names so
+an operator of the reference can point their deployment at this framework
+unchanged; the parsing/validation machinery is our own.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+class ConfigError(ValueError):
+    """Raised when an environment override fails validation."""
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def _env_int(name: str, default: int, *, lo: int | None = None, hi: int | None = None) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError as exc:
+        raise ConfigError(f"{name}={raw!r} is not an integer") from exc
+    if lo is not None and val < lo:
+        raise ConfigError(f"{name}={val} below minimum {lo}")
+    if hi is not None and val > hi:
+        raise ConfigError(f"{name}={val} above maximum {hi}")
+    return val
+
+
+def _env_float(name: str, default: float, *, lo: float | None = None, hi: float | None = None) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = float(raw)
+    except ValueError as exc:
+        raise ConfigError(f"{name}={raw!r} is not a number") from exc
+    if lo is not None and val < lo:
+        raise ConfigError(f"{name}={val} below minimum {lo}")
+    if hi is not None and val > hi:
+        raise ConfigError(f"{name}={val} above maximum {hi}")
+    return val
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    low = raw.strip().lower()
+    if low in ("1", "true", "yes", "on"):
+        return True
+    if low in ("0", "false", "no", "off"):
+        return False
+    raise ConfigError(f"{name}={raw!r} is not a boolean")
+
+
+def _env_path(name: str, default: str) -> Path:
+    return Path(os.environ.get(name, default)).expanduser()
+
+
+# --------------------------------------------------------------------------
+# Storage layout
+# --------------------------------------------------------------------------
+
+BASE_DIR: Path = _env_path("VLOG_BASE_DIR", "./data")
+UPLOAD_DIR: Path = _env_path("VLOG_UPLOAD_DIR", str(BASE_DIR / "uploads"))
+VIDEO_DIR: Path = _env_path("VLOG_VIDEO_DIR", str(BASE_DIR / "videos"))
+TMP_DIR: Path = _env_path("VLOG_TMP_DIR", str(BASE_DIR / "tmp"))
+
+DATABASE_URL: str = _env_str("VLOG_DATABASE_URL", f"sqlite:///{BASE_DIR / 'vlog.db'}")
+
+MAX_UPLOAD_SIZE_BYTES: int = _env_int(
+    "VLOG_MAX_UPLOAD_SIZE_GB", 50, lo=1, hi=1024
+) * 1024**3
+MIN_FREE_DISK_BYTES: int = _env_int("VLOG_MIN_FREE_DISK_GB", 10, lo=0) * 1024**3
+
+# --------------------------------------------------------------------------
+# Quality ladder (reference: README.md:201-212, config.py:221-228)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class QualityRung:
+    """One rung of the adaptive-bitrate ladder."""
+
+    name: str            # e.g. "1080p"
+    height: int          # frame height; width follows source aspect, mod-16
+    video_bitrate: int   # bits/sec target
+    audio_bitrate: int   # bits/sec target
+    # Base quantization parameter used by the rate controller as a starting
+    # point for this rung (tuned so all-intra H.264 lands near the bitrate
+    # target for typical content; refined per-segment at encode time).
+    base_qp: int = 30
+
+
+# Full 6-rung ladder matching the reference defaults.
+QUALITY_LADDER: tuple[QualityRung, ...] = (
+    QualityRung("2160p", 2160, 15_000_000, 192_000, base_qp=30),
+    QualityRung("1440p", 1440, 8_000_000, 192_000, base_qp=30),
+    QualityRung("1080p", 1080, 5_000_000, 192_000, base_qp=30),
+    QualityRung("720p", 720, 2_500_000, 128_000, base_qp=31),
+    QualityRung("480p", 480, 1_000_000, 128_000, base_qp=32),
+    QualityRung("360p", 360, 600_000, 96_000, base_qp=33),
+)
+
+LADDER_BY_NAME: dict[str, QualityRung] = {r.name: r for r in QUALITY_LADDER}
+
+
+def ladder_for_source(source_height: int) -> tuple[QualityRung, ...]:
+    """Rungs at or below the source height (never upscale), always >= 1 rung.
+
+    Reference behavior: qualities above source resolution are skipped
+    (transcoder.py quality filtering).
+    """
+    rungs = tuple(r for r in QUALITY_LADDER if r.height <= max(source_height, 360))
+    if not rungs:
+        rungs = (QUALITY_LADDER[-1],)
+    return rungs
+
+
+# --------------------------------------------------------------------------
+# Segmenting / formats (reference: config.py:234)
+# --------------------------------------------------------------------------
+
+SEGMENT_DURATION_S: float = _env_float("VLOG_SEGMENT_DURATION", 6.0, lo=1.0, hi=30.0)
+STREAMING_FORMAT: str = _env_str("VLOG_STREAMING_FORMAT", "cmaf")  # "cmaf" | "hls_ts"
+DEFAULT_VIDEO_CODEC: str = _env_str("VLOG_VIDEO_CODEC", "h264")
+
+# --------------------------------------------------------------------------
+# Job timeout envelope (reference: config.py:247-260)
+# --------------------------------------------------------------------------
+
+TRANSCODE_TIMEOUT_MULTIPLIER: float = _env_float("VLOG_TIMEOUT_MULTIPLIER", 2.0, lo=0.1)
+TIMEOUT_MIN_S: float = 300.0
+TIMEOUT_MAX_S: float = 4 * 3600.0
+MAX_VIDEO_DURATION_S: float = 7 * 24 * 3600.0  # 1-week cap (transcoder.py:110)
+
+# Resolution multipliers scale the timeout for heavier rungs
+_RESOLUTION_TIMEOUT_MULTIPLIERS: dict[str, float] = {
+    "360p": 1.0,
+    "480p": 1.2,
+    "720p": 1.5,
+    "1080p": 2.0,
+    "1440p": 2.5,
+    "2160p": 3.5,
+}
+
+
+def transcode_timeout_s(duration_s: float, rung_name: str) -> float:
+    """Timeout for one rung of one video (duration x global x resolution)."""
+    mult = _RESOLUTION_TIMEOUT_MULTIPLIERS.get(rung_name, 2.0)
+    raw = duration_s * TRANSCODE_TIMEOUT_MULTIPLIER * mult
+    return min(max(raw, TIMEOUT_MIN_S), TIMEOUT_MAX_S)
+
+
+# --------------------------------------------------------------------------
+# Claim / heartbeat protocol (reference: config.py:317-321)
+# --------------------------------------------------------------------------
+
+CLAIM_LEASE_S: int = _env_int("VLOG_CLAIM_LEASE_MINUTES", 30, lo=1) * 60
+HEARTBEAT_INTERVAL_S: int = _env_int("VLOG_HEARTBEAT_INTERVAL", 30, lo=5)
+WORKER_OFFLINE_THRESHOLD_S: int = _env_int("VLOG_WORKER_OFFLINE_THRESHOLD", 300, lo=30)
+MAX_JOB_ATTEMPTS: int = _env_int("VLOG_MAX_JOB_ATTEMPTS", 3, lo=1, hi=20)
+WORKER_POLL_INTERVAL_S: float = _env_float("VLOG_WORKER_POLL_INTERVAL", 5.0, lo=0.1)
+
+# --------------------------------------------------------------------------
+# Transcription (reference: config.py:263-267)
+# --------------------------------------------------------------------------
+
+WHISPER_MODEL: str = _env_str("VLOG_WHISPER_MODEL", "small")
+WHISPER_CHUNK_S: float = 30.0       # model window
+WHISPER_OVERLAP_S: float = 5.0      # chunk overlap for stitching
+TRANSCRIPTION_ENABLED: bool = _env_bool("VLOG_TRANSCRIPTION_ENABLED", True)
+
+# --------------------------------------------------------------------------
+# Sprites (reference: config.py:572-593)
+# --------------------------------------------------------------------------
+
+SPRITE_INTERVAL_S: float = _env_float("VLOG_SPRITE_INTERVAL", 10.0, lo=1.0)
+SPRITE_TILE_W: int = _env_int("VLOG_SPRITE_WIDTH", 160, lo=16)
+SPRITE_TILE_H: int = _env_int("VLOG_SPRITE_HEIGHT", 90, lo=16)
+SPRITE_GRID: int = 10  # 10x10 tiles per sheet
+SPRITE_MAX_SHEETS: int = _env_int("VLOG_SPRITE_MAX_SHEETS", 20, lo=1)
+
+# --------------------------------------------------------------------------
+# API services
+# --------------------------------------------------------------------------
+
+PUBLIC_PORT: int = _env_int("VLOG_PUBLIC_PORT", 9000, lo=1, hi=65535)
+ADMIN_PORT: int = _env_int("VLOG_ADMIN_PORT", 9001, lo=1, hi=65535)
+WORKER_API_PORT: int = _env_int("VLOG_WORKER_API_PORT", 9002, lo=1, hi=65535)
+WORKER_API_URL: str = _env_str("VLOG_WORKER_API_URL", f"http://127.0.0.1:{WORKER_API_PORT}")
+ADMIN_SECRET: str = _env_str("VLOG_ADMIN_SECRET", "")
+DOWNLOADS_ENABLED: bool = _env_bool("VLOG_DOWNLOADS_ENABLED", False)
+
+# --------------------------------------------------------------------------
+# TPU backend
+# --------------------------------------------------------------------------
+
+TPU_ENABLED: bool = _env_bool("VLOG_TPU_ENABLED", True)
+# Frames per device-batch staged to HBM per encode dispatch. GOP size for the
+# all-intra encoder is a packaging concept (segment boundary), so this is a
+# pure throughput/memory knob.
+TPU_FRAME_BATCH: int = _env_int("VLOG_TPU_FRAME_BATCH", 8, lo=1, hi=256)
+# Mesh axis layout, e.g. "data:8" or "data:4,chunk:2". Parsed by parallel.mesh.
+TPU_MESH_SPEC: str = _env_str("VLOG_TPU_MESH", "data:-1")
+
+CODE_VERSION: str = "1"
+
+
+def ensure_dirs() -> None:
+    """Create the storage tree (idempotent)."""
+    for p in (BASE_DIR, UPLOAD_DIR, VIDEO_DIR, TMP_DIR):
+        p.mkdir(parents=True, exist_ok=True)

@@ -1,0 +1,12 @@
+"""Persistence layer: async database facade + schema.
+
+Reference parity: api/database.py (SQLAlchemy Core + `databases` pool over
+Postgres). Neither is available in this environment, so this is an in-house
+async facade over sqlite3 (WAL mode, multi-process safe) with a driver seam a
+Postgres driver can plug into later.
+"""
+
+from vlog_tpu.db.core import Database, Transaction
+from vlog_tpu.db.schema import create_all, SCHEMA_VERSION
+
+__all__ = ["Database", "Transaction", "create_all", "SCHEMA_VERSION"]

@@ -1,0 +1,233 @@
+"""Schema DDL + migrations.
+
+Reference parity: api/database.py:137-942 (core tables) and migrations/
+(27 Alembic revisions). Here the schema is expressed as ordered DDL
+migrations applied through a ``schema_migrations`` ledger, so later rounds
+can evolve the schema the way the reference's Alembic history did.
+
+Timestamps are unix-epoch REAL seconds (``vlog_tpu.db.core.now``).
+JSON-valued columns are TEXT holding canonical JSON.
+"""
+
+from __future__ import annotations
+
+from vlog_tpu.db.core import Database, now
+
+SCHEMA_VERSION = 1
+
+# Each entry: (version, [statements]). Append-only.
+MIGRATIONS: list[tuple[int, list[str]]] = [
+    (
+        1,
+        [
+            # -- videos (reference: database.py videos table) --------------
+            """
+            CREATE TABLE IF NOT EXISTS videos (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                slug TEXT NOT NULL UNIQUE,
+                title TEXT NOT NULL,
+                description TEXT NOT NULL DEFAULT '',
+                original_filename TEXT,
+                source_path TEXT,
+                duration_s REAL,
+                width INTEGER,
+                height INTEGER,
+                fps REAL,
+                size_bytes INTEGER,
+                status TEXT NOT NULL DEFAULT 'pending',
+                streaming_format TEXT NOT NULL DEFAULT 'cmaf',
+                codec TEXT NOT NULL DEFAULT 'h264',
+                error TEXT,
+                thumbnail_path TEXT,
+                transcription_status TEXT NOT NULL DEFAULT 'pending',
+                category TEXT,
+                tags TEXT NOT NULL DEFAULT '[]',
+                created_at REAL NOT NULL,
+                updated_at REAL NOT NULL,
+                deleted_at REAL,
+                CHECK (status IN ('pending','processing','ready','failed','deleted'))
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS idx_videos_status ON videos(status)",
+            "CREATE INDEX IF NOT EXISTS idx_videos_created ON videos(created_at)",
+            # -- per-rung outputs (reference: video_qualities) --------------
+            """
+            CREATE TABLE IF NOT EXISTS video_qualities (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                video_id INTEGER NOT NULL REFERENCES videos(id) ON DELETE CASCADE,
+                name TEXT NOT NULL,
+                width INTEGER NOT NULL,
+                height INTEGER NOT NULL,
+                video_bitrate INTEGER,
+                audio_bitrate INTEGER,
+                codec TEXT NOT NULL DEFAULT 'h264',
+                playlist_path TEXT,
+                created_at REAL NOT NULL,
+                UNIQUE (video_id, name, codec)
+            )
+            """,
+            # -- unified job queue ------------------------------------------
+            # The reference spread transcode/sprite/reencode over separate
+            # tables+queues; one table with `kind` covers all of them and the
+            # claim protocol (job_state.py analog) applies uniformly.
+            """
+            CREATE TABLE IF NOT EXISTS jobs (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                video_id INTEGER NOT NULL REFERENCES videos(id) ON DELETE CASCADE,
+                kind TEXT NOT NULL DEFAULT 'transcode',
+                priority INTEGER NOT NULL DEFAULT 0,
+                payload TEXT NOT NULL DEFAULT '{}',
+                claimed_by TEXT,
+                claimed_at REAL,
+                claim_expires_at REAL,
+                started_at REAL,
+                completed_at REAL,
+                failed_at REAL,
+                error TEXT,
+                attempt INTEGER NOT NULL DEFAULT 0,
+                max_attempts INTEGER NOT NULL DEFAULT 3,
+                current_step TEXT,
+                last_checkpoint TEXT NOT NULL DEFAULT '{}',
+                progress REAL NOT NULL DEFAULT 0.0,
+                required_accelerator TEXT,
+                min_code_version TEXT,
+                created_at REAL NOT NULL,
+                updated_at REAL NOT NULL,
+                UNIQUE (video_id, kind),
+                CHECK (attempt >= 0),
+                CHECK (progress >= 0.0 AND progress <= 100.0)
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS idx_jobs_claim ON jobs(kind, completed_at, failed_at, claim_expires_at)",
+            # -- per-quality checkpoint rows (reference: quality_progress) --
+            """
+            CREATE TABLE IF NOT EXISTS quality_progress (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                job_id INTEGER NOT NULL REFERENCES jobs(id) ON DELETE CASCADE,
+                quality TEXT NOT NULL,
+                status TEXT NOT NULL DEFAULT 'pending',
+                progress REAL NOT NULL DEFAULT 0.0,
+                updated_at REAL NOT NULL,
+                UNIQUE (job_id, quality),
+                CHECK (status IN ('pending','in_progress','completed','failed'))
+            )
+            """,
+            # -- transcriptions ---------------------------------------------
+            """
+            CREATE TABLE IF NOT EXISTS transcriptions (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                video_id INTEGER NOT NULL UNIQUE REFERENCES videos(id) ON DELETE CASCADE,
+                language TEXT,
+                model TEXT,
+                vtt_path TEXT,
+                full_text TEXT,
+                status TEXT NOT NULL DEFAULT 'pending',
+                error TEXT,
+                created_at REAL NOT NULL,
+                completed_at REAL
+            )
+            """,
+            # -- worker fleet -----------------------------------------------
+            """
+            CREATE TABLE IF NOT EXISTS workers (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT NOT NULL UNIQUE,
+                kind TEXT NOT NULL DEFAULT 'remote',
+                accelerator TEXT NOT NULL DEFAULT 'cpu',
+                capabilities TEXT NOT NULL DEFAULT '{}',
+                code_version TEXT,
+                last_heartbeat_at REAL,
+                status TEXT NOT NULL DEFAULT 'active',
+                created_at REAL NOT NULL
+            )
+            """,
+            """
+            CREATE TABLE IF NOT EXISTS worker_api_keys (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                worker_name TEXT NOT NULL,
+                key_prefix TEXT NOT NULL,
+                key_hash TEXT NOT NULL,
+                hash_version INTEGER NOT NULL DEFAULT 2,
+                created_at REAL NOT NULL,
+                last_used_at REAL,
+                revoked_at REAL
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS idx_api_keys_prefix ON worker_api_keys(key_prefix)",
+            # -- settings (reference: settings table, settings_service) -----
+            """
+            CREATE TABLE IF NOT EXISTS settings (
+                key TEXT PRIMARY KEY,
+                value TEXT,
+                value_type TEXT NOT NULL DEFAULT 'str',
+                updated_at REAL NOT NULL
+            )
+            """,
+            # -- webhooks ---------------------------------------------------
+            """
+            CREATE TABLE IF NOT EXISTS webhooks (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                url TEXT NOT NULL,
+                secret TEXT,
+                events TEXT NOT NULL DEFAULT '[]',
+                active INTEGER NOT NULL DEFAULT 1,
+                created_at REAL NOT NULL
+            )
+            """,
+            """
+            CREATE TABLE IF NOT EXISTS webhook_deliveries (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                webhook_id INTEGER NOT NULL REFERENCES webhooks(id) ON DELETE CASCADE,
+                event TEXT NOT NULL,
+                payload TEXT NOT NULL,
+                status TEXT NOT NULL DEFAULT 'pending',
+                attempts INTEGER NOT NULL DEFAULT 0,
+                next_attempt_at REAL,
+                response_code INTEGER,
+                created_at REAL NOT NULL,
+                delivered_at REAL
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS idx_deliveries_pending ON webhook_deliveries(status, next_attempt_at)",
+            # -- playback analytics (reference: playback_sessions) ----------
+            """
+            CREATE TABLE IF NOT EXISTS playback_sessions (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                video_id INTEGER NOT NULL REFERENCES videos(id) ON DELETE CASCADE,
+                session_token TEXT NOT NULL UNIQUE,
+                started_at REAL NOT NULL,
+                last_heartbeat_at REAL NOT NULL,
+                ended_at REAL,
+                watch_time_s REAL NOT NULL DEFAULT 0.0
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS idx_sessions_video ON playback_sessions(video_id, started_at)",
+        ],
+    ),
+]
+
+
+async def create_all(db: Database) -> None:
+    """Apply all pending migrations (idempotent)."""
+    await db.execute(
+        """
+        CREATE TABLE IF NOT EXISTS schema_migrations (
+            version INTEGER PRIMARY KEY,
+            applied_at REAL NOT NULL
+        )
+        """
+    )
+    applied = {
+        r["version"]
+        for r in await db.fetch_all("SELECT version FROM schema_migrations")
+    }
+    for version, statements in MIGRATIONS:
+        if version in applied:
+            continue
+        async with db.transaction() as tx:
+            for stmt in statements:
+                await tx.execute(stmt)
+            await tx.execute(
+                "INSERT INTO schema_migrations (version, applied_at) VALUES (:v, :t)",
+                {"v": version, "t": now()},
+            )

@@ -1,0 +1,85 @@
+"""Domain enums.
+
+Reference parity: api/enums.py:9-159 and worker/hwaccel.py:32-54. Values are
+stored in the database as strings, so members are str-valued.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class VideoStatus(str, enum.Enum):
+    PENDING = "pending"          # uploaded, waiting for a worker
+    PROCESSING = "processing"    # claimed, transcode in flight
+    READY = "ready"              # ladder + manifests published
+    FAILED = "failed"            # permanent failure (attempts exhausted)
+    DELETED = "deleted"          # soft-deleted
+
+
+class JobKind(str, enum.Enum):
+    TRANSCODE = "transcode"
+    REENCODE = "reencode"
+    SPRITE = "sprite"
+    TRANSCRIPTION = "transcription"
+
+
+class JobState(str, enum.Enum):
+    """Derived job states (reference: api/job_state.py:48-96).
+
+    These are *derived* from nullable columns (claimed_by, claim_expires_at,
+    completed_at, failed_at, attempt) rather than stored, so the database can
+    never hold a contradictory state.
+    """
+
+    UNCLAIMED = "unclaimed"
+    CLAIMED = "claimed"
+    EXPIRED = "expired"      # claimed but lease lapsed
+    COMPLETED = "completed"
+    FAILED = "failed"        # terminally failed
+    RETRYING = "retrying"    # failed attempt, retry budget remains
+
+
+class VideoCodec(str, enum.Enum):
+    H264 = "h264"
+    HEVC = "hevc"
+    AV1 = "av1"
+
+
+class AudioCodec(str, enum.Enum):
+    AAC = "aac"
+    OPUS = "opus"
+    PCM = "pcm"
+    NONE = "none"
+
+
+class StreamingFormat(str, enum.Enum):
+    HLS_TS = "hls_ts"    # legacy MPEG-TS segments
+    CMAF = "cmaf"        # fMP4 segments, HLS + DASH from one set
+
+
+class AcceleratorKind(str, enum.Enum):
+    """Accelerator families a worker can advertise.
+
+    Reference: hwaccel.py HWAccelType (CPU/NVENC/QSV/VAAPI). TPU is the new
+    first-class member this framework exists for.
+    """
+
+    CPU = "cpu"
+    TPU = "tpu"
+    NVENC = "nvenc"
+    QSV = "qsv"
+    VAAPI = "vaapi"
+
+
+class WorkerKind(str, enum.Enum):
+    LOCAL = "local"
+    REMOTE = "remote"
+
+
+class TranscriptionStatus(str, enum.Enum):
+    PENDING = "pending"
+    IN_PROGRESS = "in_progress"
+    COMPLETED = "completed"
+    FAILED = "failed"
+    DISABLED = "disabled"

@@ -1,0 +1,6 @@
+"""Job plane: pure state machine, claim protocol, dispatch queue."""
+
+from vlog_tpu.jobs.state import derive_state, JobStateError
+from vlog_tpu.jobs import claims
+
+__all__ = ["derive_state", "JobStateError", "claims"]

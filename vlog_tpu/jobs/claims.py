@@ -1,0 +1,285 @@
+"""Claim protocol: atomic claim / progress / complete / fail over the DB.
+
+Reference parity: api/worker_api.py:1374-2074 — the claim transaction
+(expired-claim sweep + ``FOR UPDATE SKIP LOCKED`` select + claim write),
+lease extension on progress, and completion/failure with retry accounting.
+In sqlite the ``BEGIN IMMEDIATE`` transaction is the serialization point
+(single writer), so two workers can never claim the same row.
+
+All functions are pure DB logic — no HTTP, no media. The Worker API service
+wraps these; local in-process workers call them directly, mirroring how the
+reference's local transcoder bypassed the HTTP plane.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from vlog_tpu import config
+from vlog_tpu.db.core import Database, Row, now as db_now
+from vlog_tpu.enums import AcceleratorKind, JobKind
+from vlog_tpu.jobs import state as js
+
+
+async def enqueue_job(
+    db: Database,
+    video_id: int,
+    kind: JobKind = JobKind.TRANSCODE,
+    *,
+    priority: int = 0,
+    payload: dict[str, Any] | None = None,
+    max_attempts: int | None = None,
+    required_accelerator: AcceleratorKind | None = None,
+) -> int:
+    """Create (or reset) the job for a video+kind.
+
+    Reference parity: admin.py:719-832 ``create_or_reset_transcoding_job`` —
+    an upsert that resets a terminal/stale job back to claimable.
+    """
+    t = db_now()
+    async with db.transaction() as tx:
+        existing = await tx.fetch_one(
+            "SELECT * FROM jobs WHERE video_id=:v AND kind=:k",
+            {"v": video_id, "k": kind.value},
+        )
+        if existing is None:
+            return await tx.execute(
+                """
+                INSERT INTO jobs (video_id, kind, priority, payload, max_attempts,
+                                  required_accelerator, created_at, updated_at)
+                VALUES (:v, :k, :p, :pl, :ma, :ra, :t, :t)
+                """,
+                {
+                    "v": video_id,
+                    "k": kind.value,
+                    "p": priority,
+                    "pl": json.dumps(payload or {}),
+                    "ma": max_attempts or config.MAX_JOB_ATTEMPTS,
+                    "ra": required_accelerator.value if required_accelerator else None,
+                    "t": t,
+                },
+            )
+        # Reset: clear claim + terminal markers + progress, keep id stable.
+        await tx.execute(
+            """
+            UPDATE jobs SET priority=:p, payload=:pl, claimed_by=NULL, claimed_at=NULL,
+                claim_expires_at=NULL, started_at=NULL, completed_at=NULL,
+                failed_at=NULL, error=NULL, attempt=0, current_step=NULL,
+                last_checkpoint='{}', progress=0.0, updated_at=:t
+            WHERE id=:id
+            """,
+            {"p": priority, "pl": json.dumps(payload or {}), "t": t, "id": existing["id"]},
+        )
+        await tx.execute(
+            "DELETE FROM quality_progress WHERE job_id=:id", {"id": existing["id"]}
+        )
+        return int(existing["id"])
+
+
+async def sweep_expired_claims(db: Database) -> int:
+    """Release lapsed leases so their jobs become claimable again.
+
+    Reference parity: worker_api.py:1469-1491 (expired-claim sweep inside the
+    claim transaction). Each release increments nothing — the attempt counter
+    belongs to claim time.
+    """
+    t = db_now()
+    return await db.execute(
+        f"""
+        UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
+               updated_at=:now
+        WHERE {js.SQL_EXPIRED_CLAIM}
+        """,
+        {"now": t},
+    )
+
+
+async def claim_job(
+    db: Database,
+    worker_name: str,
+    *,
+    kinds: tuple[JobKind, ...] = (JobKind.TRANSCODE,),
+    accelerator: AcceleratorKind = AcceleratorKind.CPU,
+    code_version: str = config.CODE_VERSION,
+    lease_s: float | None = None,
+) -> Row | None:
+    """Atomically claim the best eligible job, or return None.
+
+    Ordering: priority DESC, then oldest first — matching the reference's
+    priority streams + FIFO recovery. Jobs demanding a specific accelerator
+    (``required_accelerator``) are only handed to matching workers; jobs
+    demanding a newer code version are skipped (worker_api.py:1398-1434).
+    """
+    t = db_now()
+    lease = lease_s if lease_s is not None else config.CLAIM_LEASE_S
+    kind_list = ",".join(f"'{k.value}'" for k in kinds)
+    async with db.transaction() as tx:
+        # sweep expired leases first so they are claimable below
+        await tx.execute(
+            f"""
+            UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
+                   updated_at=:now
+            WHERE {js.SQL_EXPIRED_CLAIM}
+            """,
+            {"now": t},
+        )
+        row = await tx.fetch_one(
+            f"""
+            SELECT * FROM jobs
+            WHERE {js.SQL_CLAIMABLE}
+              AND kind IN ({kind_list})
+              AND attempt < max_attempts
+              AND (required_accelerator IS NULL OR required_accelerator = :accel)
+              AND (min_code_version IS NULL OR min_code_version <= :cv)
+            ORDER BY priority DESC, created_at ASC
+            LIMIT 1
+            """,
+            {"now": t, "accel": accelerator.value, "cv": code_version},
+        )
+        if row is None:
+            return None
+        js.guard_claim(row, now=t)
+        await tx.execute(
+            """
+            UPDATE jobs SET claimed_by=:w, claimed_at=:t, claim_expires_at=:exp,
+                   started_at=COALESCE(started_at, :t), attempt=attempt+1,
+                   updated_at=:t
+            WHERE id=:id
+            """,
+            {"w": worker_name, "t": t, "exp": t + lease, "id": row["id"]},
+        )
+        claimed = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": row["id"]})
+        assert claimed is not None
+        return claimed
+
+
+async def update_progress(
+    db: Database,
+    job_id: int,
+    worker_name: str,
+    *,
+    progress: float | None = None,
+    current_step: str | None = None,
+    checkpoint: dict[str, Any] | None = None,
+    extend_lease: bool = True,
+) -> Row:
+    """Record progress and extend the claim lease.
+
+    Reference parity: worker_api.py:1747-1860 — every progress update renews
+    the lease, which is what keeps long jobs alive past the base lease.
+    Raises :class:`JobStateError` if the caller no longer holds the claim
+    (the 409-abort signal remote workers act on).
+    """
+    t = db_now()
+    async with db.transaction() as tx:
+        row = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
+        if row is None:
+            raise js.JobStateError(f"job {job_id} does not exist")
+        js.guard_progress(row, worker_name, now=t)
+        sets = ["updated_at=:t"]
+        params: dict[str, Any] = {"t": t, "id": job_id}
+        if progress is not None:
+            sets.append("progress=:p")
+            params["p"] = max(0.0, min(100.0, progress))
+        if current_step is not None:
+            sets.append("current_step=:s")
+            params["s"] = current_step
+        if checkpoint is not None:
+            sets.append("last_checkpoint=:c")
+            params["c"] = json.dumps(checkpoint)
+        if extend_lease:
+            sets.append("claim_expires_at=:exp")
+            params["exp"] = t + config.CLAIM_LEASE_S
+        await tx.execute(f"UPDATE jobs SET {', '.join(sets)} WHERE id=:id", params)
+        out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
+        assert out is not None
+        return out
+
+
+async def complete_job(db: Database, job_id: int, worker_name: str) -> Row:
+    """Mark a job completed (terminal). Reference: worker_api.py:1864-2070."""
+    t = db_now()
+    async with db.transaction() as tx:
+        row = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
+        if row is None:
+            raise js.JobStateError(f"job {job_id} does not exist")
+        js.guard_complete(row, worker_name, now=t)
+        await tx.execute(
+            """
+            UPDATE jobs SET completed_at=:t, progress=100.0, claimed_by=NULL,
+                   claim_expires_at=NULL, error=NULL, updated_at=:t
+            WHERE id=:id
+            """,
+            {"t": t, "id": job_id},
+        )
+        out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
+        assert out is not None
+        return out
+
+
+async def fail_job(
+    db: Database,
+    job_id: int,
+    worker_name: str | None,
+    error: str,
+    *,
+    permanent: bool = False,
+) -> Row:
+    """Record a failed attempt; terminal only when the retry budget is gone.
+
+    Reference parity: worker_api.py:2074-2190 + transcoder.py:2869-2933 —
+    a failure releases the claim; the job terminally fails when
+    ``attempt >= max_attempts`` (or ``permanent=True``), otherwise it returns
+    to the claimable pool as RETRYING.
+    """
+    t = db_now()
+    async with db.transaction() as tx:
+        row = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
+        if row is None:
+            raise js.JobStateError(f"job {job_id} does not exist")
+        js.guard_fail(row, worker_name, now=t)
+        exhausted = permanent or (row["attempt"] or 0) >= (row["max_attempts"] or 1)
+        await tx.execute(
+            """
+            UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
+                   failed_at=:failed_at, error=:err, updated_at=:t
+            WHERE id=:id
+            """,
+            {
+                "failed_at": t if exhausted else None,
+                "err": error[:2000],
+                "t": t,
+                "id": job_id,
+            },
+        )
+        out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
+        assert out is not None
+        return out
+
+
+async def upsert_quality_progress(
+    db: Database,
+    job_id: int,
+    quality: str,
+    *,
+    status: str,
+    progress: float = 0.0,
+) -> None:
+    """Per-rung checkpoint row (reference: database.py:209-248)."""
+    await db.execute(
+        """
+        INSERT INTO quality_progress (job_id, quality, status, progress, updated_at)
+        VALUES (:j, :q, :s, :p, :t)
+        ON CONFLICT (job_id, quality)
+        DO UPDATE SET status=:s, progress=:p, updated_at=:t
+        """,
+        {"j": job_id, "q": quality, "s": status, "p": progress, "t": db_now()},
+    )
+
+
+async def get_quality_progress(db: Database, job_id: int) -> dict[str, Row]:
+    rows = await db.fetch_all(
+        "SELECT * FROM quality_progress WHERE job_id=:j", {"j": job_id}
+    )
+    return {r["quality"]: r for r in rows}

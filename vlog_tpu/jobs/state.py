@@ -1,0 +1,121 @@
+"""Pure job state machine.
+
+Reference parity: api/job_state.py:48-616 — six states *derived* from
+nullable columns so the database can never hold a contradictory state, plus
+composable SQL fragments and transition guards used by the claim protocol.
+
+Column semantics (see db/schema.py `jobs` table):
+
+- ``completed_at`` set  -> COMPLETED (terminal)
+- ``failed_at`` set     -> FAILED (terminal)
+- ``claimed_by`` set and lease valid  -> CLAIMED
+- ``claimed_by`` set and lease lapsed -> EXPIRED (reclaimable)
+- ``claimed_by`` null, attempt > 0    -> RETRYING
+- ``claimed_by`` null, attempt == 0   -> UNCLAIMED
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+from vlog_tpu.enums import JobState
+
+
+class JobStateError(RuntimeError):
+    """An illegal transition was attempted (guard failure)."""
+
+
+def derive_state(row: Mapping[str, Any], *, now: float) -> JobState:
+    """Derive the state of a job row at time ``now``."""
+    if row.get("completed_at") is not None:
+        return JobState.COMPLETED
+    if row.get("failed_at") is not None:
+        return JobState.FAILED
+    if row.get("claimed_by") is not None:
+        expires = row.get("claim_expires_at")
+        if expires is not None and expires <= now:
+            return JobState.EXPIRED
+        return JobState.CLAIMED
+    if (row.get("attempt") or 0) > 0:
+        return JobState.RETRYING
+    return JobState.UNCLAIMED
+
+
+def is_terminal(state: JobState) -> bool:
+    return state in (JobState.COMPLETED, JobState.FAILED)
+
+
+def is_claimable(row: Mapping[str, Any], *, now: float) -> bool:
+    """A job is claimable when unclaimed/retrying or its claim lease lapsed."""
+    return derive_state(row, now=now) in (
+        JobState.UNCLAIMED,
+        JobState.RETRYING,
+        JobState.EXPIRED,
+    )
+
+
+# --------------------------------------------------------------------------
+# Composable SQL conditions (named-parameter style; caller supplies :now)
+# --------------------------------------------------------------------------
+
+SQL_NOT_TERMINAL = "(completed_at IS NULL AND failed_at IS NULL)"
+
+SQL_CLAIMABLE = (
+    f"{SQL_NOT_TERMINAL} AND "
+    "(claimed_by IS NULL OR (claim_expires_at IS NOT NULL AND claim_expires_at <= :now))"
+)
+
+SQL_ACTIVELY_CLAIMED = (
+    f"{SQL_NOT_TERMINAL} AND claimed_by IS NOT NULL AND "
+    "(claim_expires_at IS NULL OR claim_expires_at > :now)"
+)
+
+SQL_EXPIRED_CLAIM = (
+    f"{SQL_NOT_TERMINAL} AND claimed_by IS NOT NULL AND "
+    "claim_expires_at IS NOT NULL AND claim_expires_at <= :now"
+)
+
+
+# --------------------------------------------------------------------------
+# Transition guards — raise JobStateError on contract violations
+# --------------------------------------------------------------------------
+
+def guard_claim(row: Mapping[str, Any], *, now: float) -> None:
+    state = derive_state(row, now=now)
+    if state not in (JobState.UNCLAIMED, JobState.RETRYING, JobState.EXPIRED):
+        raise JobStateError(f"cannot claim job in state {state.value}")
+    if (row.get("attempt") or 0) >= (row.get("max_attempts") or 1):
+        raise JobStateError("retry budget exhausted")
+
+
+def guard_progress(row: Mapping[str, Any], worker: str, *, now: float) -> None:
+    state = derive_state(row, now=now)
+    if state is not JobState.CLAIMED:
+        raise JobStateError(f"progress update on job in state {state.value}")
+    if row.get("claimed_by") != worker:
+        raise JobStateError(
+            f"progress from {worker!r} but job is claimed by {row.get('claimed_by')!r}"
+        )
+
+
+def guard_complete(row: Mapping[str, Any], worker: str, *, now: float) -> None:
+    state = derive_state(row, now=now)
+    if state is JobState.COMPLETED:
+        raise JobStateError("job already completed")
+    if state is JobState.FAILED:
+        raise JobStateError("job already failed terminally")
+    if row.get("claimed_by") != worker:
+        raise JobStateError(
+            f"completion from {worker!r} but job is claimed by {row.get('claimed_by')!r}"
+        )
+
+
+def guard_fail(row: Mapping[str, Any], worker: str | None, *, now: float) -> None:
+    state = derive_state(row, now=now)
+    if is_terminal(state):
+        raise JobStateError(f"fail on job already in state {state.value}")
+    if worker is not None and row.get("claimed_by") not in (None, worker):
+        raise JobStateError(
+            f"failure from {worker!r} but job is claimed by {row.get('claimed_by')!r}"
+        )
