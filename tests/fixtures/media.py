@@ -1,0 +1,72 @@
+"""Byte-level media fixtures.
+
+Reference analog: tests/fixtures/sample_videos.py (hand-written minimal MP4
+atoms + synthetic HLS trees). Here fixtures are built with the package's own
+muxer where convenient, plus synthetic YUV content generators whose frames
+have known structure (gradients + moving blocks) so PSNR checks are
+meaningful.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from vlog_tpu.media.fmp4 import Sample, TrackConfig, progressive_mp4
+from vlog_tpu.media.y4m import write_y4m
+
+
+def synthetic_yuv_frames(
+    n: int, width: int, height: int, *, seed: int = 0
+) -> list[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Deterministic 4:2:0 frames: gradient background + moving square + noise."""
+    rng = np.random.default_rng(seed)
+    xx = np.linspace(0, 255, width, dtype=np.float32)[None, :]
+    yy = np.linspace(0, 255, height, dtype=np.float32)[:, None]
+    frames = []
+    for t in range(n):
+        y = (0.5 * xx + 0.5 * yy).astype(np.float32)
+        # moving bright square
+        bx = int((t * 17) % max(1, width - 64))
+        by = int((t * 11) % max(1, height - 64))
+        y[by : by + 64, bx : bx + 64] = 235.0
+        y += rng.normal(0, 2.0, size=y.shape).astype(np.float32)
+        y = np.clip(y, 0, 255).astype(np.uint8)
+        u = np.full((height // 2, width // 2), 96 + (t % 32), dtype=np.uint8)
+        v = np.full((height // 2, width // 2), 160 - (t % 32), dtype=np.uint8)
+        frames.append((y, u, v))
+    return frames
+
+
+def make_y4m(path: str | Path, *, n_frames: int = 12, width: int = 128,
+             height: int = 96, fps: int = 24, seed: int = 0) -> Path:
+    path = Path(path)
+    frames = synthetic_yuv_frames(n_frames, width, height, seed=seed)
+    write_y4m(path, frames, fps_num=fps, fps_den=1)
+    return path
+
+
+def make_fake_mp4(path: str | Path, *, n_samples: int = 10, width: int = 64,
+                  height: int = 48, timescale: int = 90_000, fps: int = 30) -> Path:
+    """Progressive MP4 whose 'h264' samples are opaque placeholder bytes.
+
+    Good for probe/demux tests (structure is real, payloads are not decodable),
+    mirroring the reference's create_minimal_mp4 trick.
+    """
+    from vlog_tpu.media.fmp4 import avc1_sample_entry, avcc_config
+
+    fake_sps = bytes([0x67, 0x42, 0xC0, 0x1E, 0x00])
+    fake_pps = bytes([0x68, 0xCE, 0x38, 0x80])
+    entry = avc1_sample_entry(width, height, avcc_config(fake_sps, fake_pps))
+    dur = timescale // fps
+    samples = [
+        Sample(data=bytes([i]) * (10 + i), duration=dur, is_sync=(i % 5 == 0))
+        for i in range(n_samples)
+    ]
+    track = TrackConfig(track_id=1, handler="vide", timescale=timescale,
+                        sample_entry=entry, width=width, height=height)
+    data = progressive_mp4(track, samples)
+    path = Path(path)
+    path.write_bytes(data)
+    return path

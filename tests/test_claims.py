@@ -194,3 +194,36 @@ class TestSweep:
             assert rows[j2]["claimed_by"] is None
 
         run(body())
+
+
+class TestEnqueueGuards:
+    def test_enqueue_rejects_reset_of_active_claim(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            await claims.enqueue_job(db, vid)
+            await claims.claim_job(db, "w1")
+            with pytest.raises(JobStateError, match="actively claimed"):
+                await claims.enqueue_job(db, vid)
+            # force path (admin retranscode) succeeds
+            await claims.enqueue_job(db, vid, force=True)
+            job = await db.fetch_one("SELECT * FROM jobs WHERE video_id=:v", {"v": vid})
+            assert job["claimed_by"] is None and job["attempt"] == 0
+
+        run(body())
+
+    def test_enqueue_reset_honors_new_constraints(self, db, run):
+        async def body():
+            from vlog_tpu.enums import AcceleratorKind
+
+            vid = await make_video(db)
+            job_id = await claims.enqueue_job(db, vid, max_attempts=1)
+            await claims.claim_job(db, "w1")
+            await claims.fail_job(db, job_id, "w1", "x", permanent=True)
+            await claims.enqueue_job(
+                db, vid, max_attempts=5, required_accelerator=AcceleratorKind.TPU
+            )
+            job = await db.fetch_one("SELECT * FROM jobs WHERE id=:i", {"i": job_id})
+            assert job["max_attempts"] == 5
+            assert job["required_accelerator"] == "tpu"
+
+        run(body())

@@ -1,0 +1,255 @@
+"""Media layer tests: bitstream, boxes, MP4 mux/demux, Y4M, HLS/DASH."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from tests.fixtures.media import make_fake_mp4, make_y4m, synthetic_yuv_frames
+from vlog_tpu.media import bitstream as bs
+from vlog_tpu.media import hls
+from vlog_tpu.media.fmp4 import (
+    Sample,
+    TrackConfig,
+    avc1_sample_entry,
+    avcc_config,
+    init_segment,
+    media_segment,
+)
+from vlog_tpu.media.mp4 import SampleReader, parse_mp4
+from vlog_tpu.media.probe import ProbeError, get_video_info
+from vlog_tpu.media.y4m import Y4mReader
+
+
+class TestBitstream:
+    def test_bits_roundtrip(self):
+        w = bs.BitWriter()
+        w.write_bits(0b1011, 4)
+        w.write_bits(0xFF, 8)
+        w.write_bits(0, 3)
+        w.write_bit(1)
+        data = w.getvalue()
+        r = bs.BitReader(data)
+        assert r.read_bits(4) == 0b1011
+        assert r.read_bits(8) == 0xFF
+        assert r.read_bits(3) == 0
+        assert r.read_bit() == 1
+
+    @pytest.mark.parametrize("value", [0, 1, 2, 3, 7, 8, 100, 2**16, 2**20 - 1])
+    def test_ue_roundtrip(self, value):
+        w = bs.BitWriter()
+        w.write_ue(value)
+        w.byte_align()
+        assert bs.BitReader(w.getvalue()).read_ue() == value
+
+    @pytest.mark.parametrize("value", [0, 1, -1, 2, -2, 17, -100, 2**15])
+    def test_se_roundtrip(self, value):
+        w = bs.BitWriter()
+        w.write_se(value)
+        w.byte_align()
+        assert bs.BitReader(w.getvalue()).read_se() == value
+
+    def test_known_ue_codes(self):
+        # H.264 table 9-1: 0->'1', 1->'010', 2->'011', 3->'00100'
+        for value, expected in [(0, "1"), (1, "010"), (2, "011"), (3, "00100")]:
+            w = bs.BitWriter()
+            w.write_ue(value)
+            got = "".join(
+                str((byte >> (7 - i)) & 1)
+                for byte in (w._bytes + bytes([w._cur << (8 - w._nbits)]) if w._nbits else w._bytes)
+                for i in range(8)
+            )[: w.bit_length]
+            assert got == expected
+
+    def test_emulation_escape_roundtrip(self):
+        payloads = [
+            b"\x00\x00\x00",          # needs escape
+            b"\x00\x00\x01\x02",      # start-code-like
+            b"\x00\x00\x03\x00\x00\x02",
+            bytes(range(256)) * 3,
+            b"\x00" * 64,
+        ]
+        for p in payloads:
+            escaped = bs.escape_emulation(p)
+            # no illegal sequence remains
+            for i in range(len(escaped) - 2):
+                assert not (
+                    escaped[i] == 0 and escaped[i + 1] == 0 and escaped[i + 2] <= 2
+                ), f"illegal sequence at {i} in {escaped!r}"
+            assert bs.unescape_emulation(escaped) == p
+
+
+class TestMp4Roundtrip:
+    def test_progressive_mux_demux(self, tmp_path):
+        p = make_fake_mp4(tmp_path / "t.mp4", n_samples=10, width=64, height=48, fps=30)
+        movie = parse_mp4(p)
+        video = movie.video
+        assert video is not None
+        assert video.width == 64 and video.height == 48
+        assert video.codec == "h264"
+        assert video.samples.count == 10
+        assert abs(video.fps - 30.0) < 0.01
+        assert abs(movie.duration_s - 10 / 30) < 0.01
+        assert video.codec_string().startswith("avc1.42C0")
+        # sync flags survived
+        assert video.samples.is_sync(0) and video.samples.is_sync(5)
+        assert not video.samples.is_sync(1)
+        # sample payloads roundtrip byte-exactly
+        with SampleReader(p, video) as reader:
+            for i in range(10):
+                assert reader.read_sample(i) == bytes([i]) * (10 + i)
+
+    def test_probe_mp4(self, tmp_path):
+        p = make_fake_mp4(tmp_path / "probe.mp4", n_samples=30, fps=30)
+        info = get_video_info(p)
+        assert info.container == "mp4"
+        assert info.video_codec == "h264"
+        assert info.frame_count == 30
+        assert abs(info.duration_s - 1.0) < 0.01
+
+    def test_probe_rejects_garbage(self, tmp_path):
+        p = tmp_path / "garbage.bin"
+        p.write_bytes(b"not a video at all" * 10)
+        with pytest.raises(ProbeError):
+            get_video_info(p)
+
+    def test_probe_rejects_empty(self, tmp_path):
+        p = tmp_path / "empty.mp4"
+        p.write_bytes(b"")
+        with pytest.raises(ProbeError):
+            get_video_info(p)
+
+
+class TestFragmented:
+    def test_init_segment_structure(self):
+        entry = avc1_sample_entry(128, 96, avcc_config(b"\x67\x42\xc0\x1e", b"\x68\xce"))
+        track = TrackConfig(1, "vide", 90_000, entry, 128, 96)
+        data = init_segment(track)
+        assert data[4:8] == b"ftyp"
+        assert hls._contains_top_level_box(data, b"moov")
+
+    def test_media_segment_structure(self):
+        entry = avc1_sample_entry(128, 96, avcc_config(b"\x67\x42\xc0\x1e", b"\x68\xce"))
+        track = TrackConfig(1, "vide", 90_000, entry, 128, 96)
+        samples = [Sample(b"x" * 50, 3000, True), Sample(b"y" * 30, 3000, False)]
+        seg = media_segment(track, 1, 0, samples)
+        assert hls._contains_top_level_box(seg, b"moof")
+        assert hls._contains_top_level_box(seg, b"mdat")
+        # trun data_offset must point exactly at the first sample byte
+        idx = seg.find(b"x" * 50)
+        moof_start = seg.find(b"moof") - 4
+        # locate data_offset inside trun: after trun fullbox hdr (12) + count (4)
+        trun_at = seg.find(b"trun") - 4
+        data_offset = struct.unpack(">i", seg[trun_at + 16 : trun_at + 20])[0]
+        assert moof_start + data_offset == idx
+
+
+class TestY4m:
+    def test_roundtrip(self, tmp_path):
+        p = make_y4m(tmp_path / "t.y4m", n_frames=5, width=64, height=48, fps=24)
+        with Y4mReader(p) as r:
+            assert r.info.width == 64 and r.info.height == 48
+            assert r.info.frame_count == 5
+            assert r.info.fps == 24
+            frames = synthetic_yuv_frames(5, 64, 48)
+            y, u, v = r.read_frame(3)
+            np.testing.assert_array_equal(y, frames[3][0])
+            np.testing.assert_array_equal(u, frames[3][1])
+            # random access then sequential
+            y0, _, _ = r.read_frame(0)
+            np.testing.assert_array_equal(y0, frames[0][0])
+
+    def test_probe_y4m(self, tmp_path):
+        p = make_y4m(tmp_path / "t.y4m", n_frames=24, width=64, height=48, fps=24)
+        info = get_video_info(p)
+        assert info.container == "y4m"
+        assert info.video_codec == "raw"
+        assert abs(info.duration_s - 1.0) < 1e-6
+
+
+class TestHls:
+    def _write_cmaf_rung(self, root, name="720p", n_segments=3):
+        entry = avc1_sample_entry(1280, 720, avcc_config(b"\x67\x42\xc0\x1f", b"\x68\xce"))
+        track = TrackConfig(1, "vide", 90_000, entry, 1280, 720)
+        rung = root / name
+        rung.mkdir(parents=True)
+        (rung / "init.mp4").write_bytes(init_segment(track))
+        segs = []
+        t = 0
+        for i in range(n_segments):
+            samples = [Sample(b"s" * 100, 3000, j == 0) for j in range(6)]
+            (rung / f"segment_{i + 1:05d}.m4s").write_bytes(
+                media_segment(track, i + 1, t, samples)
+            )
+            t += 6 * 3000
+            segs.append(hls.SegmentRef(f"segment_{i + 1:05d}.m4s", 6 * 3000 / 90_000))
+        (rung / "playlist.m3u8").write_text(
+            hls.media_playlist(segs, target_duration_s=6.0, init_uri="init.mp4")
+        )
+        return hls.VariantRef(name, f"{name}/playlist.m3u8", 2_500_000, 1280, 720, "avc1.42C01F", 30.0)
+
+    def test_cmaf_playlist_validates(self, tmp_path):
+        variant = self._write_cmaf_rung(tmp_path)
+        out = hls.validate_media_playlist(tmp_path / "720p" / "playlist.m3u8", expect_cmaf=True)
+        assert out["segments"] == 3
+        assert out["cmaf"] is True
+
+    def test_master_playlist_validates(self, tmp_path):
+        variants = [self._write_cmaf_rung(tmp_path, n) for n in ("720p", "360p")]
+        (tmp_path / "master.m3u8").write_text(hls.master_playlist(variants))
+        results = hls.validate_master_playlist(tmp_path / "master.m3u8")
+        assert set(results) == {"720p/playlist.m3u8", "360p/playlist.m3u8"}
+
+    def test_missing_segment_fails(self, tmp_path):
+        self._write_cmaf_rung(tmp_path)
+        (tmp_path / "720p" / "segment_00002.m4s").unlink()
+        with pytest.raises(hls.PlaylistValidationError, match="missing"):
+            hls.validate_media_playlist(tmp_path / "720p" / "playlist.m3u8")
+
+    def test_corrupt_segment_fails_moof_check(self, tmp_path):
+        self._write_cmaf_rung(tmp_path)
+        (tmp_path / "720p" / "segment_00002.m4s").write_bytes(b"\x00" * 500)
+        with pytest.raises(hls.PlaylistValidationError, match="moof"):
+            hls.validate_media_playlist(tmp_path / "720p" / "playlist.m3u8")
+
+    def test_truncated_playlist_fails(self, tmp_path):
+        self._write_cmaf_rung(tmp_path)
+        pl = tmp_path / "720p" / "playlist.m3u8"
+        pl.write_text(pl.read_text().replace("#EXT-X-ENDLIST\n", ""))
+        with pytest.raises(hls.PlaylistValidationError, match="ENDLIST"):
+            hls.validate_media_playlist(pl)
+
+    def test_dash_manifest_contains_representations(self, tmp_path):
+        variants = [
+            hls.VariantRef("720p", "720p/playlist.m3u8", 2_500_000, 1280, 720, "avc1.42C01F"),
+            hls.VariantRef("360p", "360p/playlist.m3u8", 600_000, 640, 360, "avc1.42C01E"),
+        ]
+        mpd = hls.dash_manifest(variants, duration_s=60.0, segment_duration_s=6.0)
+        assert '<Representation id="720p"' in mpd
+        assert 'media="360p/segment_$Number%05d$.m4s"' in mpd
+        assert 'mediaPresentationDuration="PT60.000S"' in mpd
+
+
+class TestRegressions:
+    def test_y4m_frame_markers_with_params(self, tmp_path):
+        """FRAME lines may carry parameters (legal Y4M); indexing must cope."""
+        frames = synthetic_yuv_frames(3, 32, 32)
+        p = tmp_path / "params.y4m"
+        with open(p, "wb") as fp:
+            fp.write(b"YUV4MPEG2 W32 H32 F25:1 C420\n")
+            for y, u, v in frames:
+                fp.write(b"FRAME Ip X=extra\n")
+                fp.write(y.tobytes() + u.tobytes() + v.tobytes())
+        with Y4mReader(p) as r:
+            assert r.info.frame_count == 3
+            y2, _, _ = r.read_frame(2)
+            np.testing.assert_array_equal(y2, frames[2][0])
+
+    def test_map_without_quoted_uri_raises_validation_error(self, tmp_path):
+        pl = tmp_path / "bad.m3u8"
+        pl.write_text(
+            "#EXTM3U\n#EXT-X-VERSION:7\n#EXT-X-TARGETDURATION:6\n"
+            "#EXT-X-MAP:URI=init.mp4\n#EXTINF:6.0,\nseg.m4s\n#EXT-X-ENDLIST\n"
+        )
+        with pytest.raises(hls.PlaylistValidationError, match="MAP"):
+            hls.validate_media_playlist(pl)

@@ -31,11 +31,15 @@ async def enqueue_job(
     payload: dict[str, Any] | None = None,
     max_attempts: int | None = None,
     required_accelerator: AcceleratorKind | None = None,
+    force: bool = False,
 ) -> int:
     """Create (or reset) the job for a video+kind.
 
     Reference parity: admin.py:719-832 ``create_or_reset_transcoding_job`` —
-    an upsert that resets a terminal/stale job back to claimable.
+    an upsert that resets a terminal/stale job back to claimable. Resetting a
+    job another worker is actively transcoding raises :class:`JobStateError`
+    unless ``force=True`` (the admin "retranscode anyway" path) — otherwise
+    two workers could write the same output tree concurrently.
     """
     t = db_now()
     async with db.transaction() as tx:
@@ -43,6 +47,13 @@ async def enqueue_job(
             "SELECT * FROM jobs WHERE video_id=:v AND kind=:k",
             {"v": video_id, "k": kind.value},
         )
+        params = {
+            "p": priority,
+            "pl": json.dumps(payload or {}),
+            "ma": max_attempts or config.MAX_JOB_ATTEMPTS,
+            "ra": required_accelerator.value if required_accelerator else None,
+            "t": t,
+        }
         if existing is None:
             return await tx.execute(
                 """
@@ -50,31 +61,38 @@ async def enqueue_job(
                                   required_accelerator, created_at, updated_at)
                 VALUES (:v, :k, :p, :pl, :ma, :ra, :t, :t)
                 """,
-                {
-                    "v": video_id,
-                    "k": kind.value,
-                    "p": priority,
-                    "pl": json.dumps(payload or {}),
-                    "ma": max_attempts or config.MAX_JOB_ATTEMPTS,
-                    "ra": required_accelerator.value if required_accelerator else None,
-                    "t": t,
-                },
+                {**params, "v": video_id, "k": kind.value},
+            )
+        if not force and js.derive_state(existing, now=t) is js.JobState.CLAIMED:
+            raise js.JobStateError(
+                f"job {existing['id']} is actively claimed by "
+                f"{existing['claimed_by']!r}; pass force=True to reset anyway"
             )
         # Reset: clear claim + terminal markers + progress, keep id stable.
         await tx.execute(
             """
-            UPDATE jobs SET priority=:p, payload=:pl, claimed_by=NULL, claimed_at=NULL,
+            UPDATE jobs SET priority=:p, payload=:pl, max_attempts=:ma,
+                required_accelerator=:ra, claimed_by=NULL, claimed_at=NULL,
                 claim_expires_at=NULL, started_at=NULL, completed_at=NULL,
                 failed_at=NULL, error=NULL, attempt=0, current_step=NULL,
                 last_checkpoint='{}', progress=0.0, updated_at=:t
             WHERE id=:id
             """,
-            {"p": priority, "pl": json.dumps(payload or {}), "t": t, "id": existing["id"]},
+            {**params, "id": existing["id"]},
         )
         await tx.execute(
             "DELETE FROM quality_progress WHERE job_id=:id", {"id": existing["id"]}
         )
         return int(existing["id"])
+
+
+# Shared by sweep_expired_claims and the sweep phase inside claim_job, so
+# lease-release semantics can never drift between the two paths.
+SWEEP_EXPIRED_SQL = f"""
+    UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
+           updated_at=:now
+    WHERE {js.SQL_EXPIRED_CLAIM}
+"""
 
 
 async def sweep_expired_claims(db: Database) -> int:
@@ -84,15 +102,7 @@ async def sweep_expired_claims(db: Database) -> int:
     claim transaction). Each release increments nothing — the attempt counter
     belongs to claim time.
     """
-    t = db_now()
-    return await db.execute(
-        f"""
-        UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
-               updated_at=:now
-        WHERE {js.SQL_EXPIRED_CLAIM}
-        """,
-        {"now": t},
-    )
+    return await db.execute(SWEEP_EXPIRED_SQL, {"now": db_now()})
 
 
 async def claim_job(
@@ -116,14 +126,7 @@ async def claim_job(
     kind_list = ",".join(f"'{k.value}'" for k in kinds)
     async with db.transaction() as tx:
         # sweep expired leases first so they are claimable below
-        await tx.execute(
-            f"""
-            UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
-                   updated_at=:now
-            WHERE {js.SQL_EXPIRED_CLAIM}
-            """,
-            {"now": t},
-        )
+        await tx.execute(SWEEP_EXPIRED_SQL, {"now": t})
         row = await tx.fetch_one(
             f"""
             SELECT * FROM jobs

@@ -1,0 +1,8 @@
+"""Media container layer: ISO-BMFF (MP4/fMP4) demux+mux, Y4M, HLS/DASH.
+
+This layer replaces what the reference delegated to ffmpeg/ffprobe
+subprocesses (SURVEY.md section 2: probe transcoder.py:706-813, packaging
+hwaccel.py:647-839, manifest generation transcoder.py:1264-1471) with
+first-party container code. Codec *compute* lives in vlog_tpu.codecs /
+vlog_tpu.ops; this package only moves and describes bytes.
+"""
