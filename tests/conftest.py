@@ -10,13 +10,22 @@ import asyncio
 import os
 import uuid
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax backends initialize. Force (not setdefault): the
+# driver environment exports JAX_PLATFORMS=axon (the real-TPU tunnel), and
+# the axon sitecustomize hook additionally overrides the jax_platforms
+# *config* programmatically at interpreter start — so we must win at the
+# config level too, not just the env var. Unit tests are hermetic on the
+# virtual 8-device CPU mesh; only bench.py touches the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

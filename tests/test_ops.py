@@ -1,0 +1,179 @@
+"""Unit tests for the TPU ops layer (colorspace, resize, transform).
+
+Transform tests check bit-exactness against independent scalar numpy
+reference implementations — the encoder/decoder agreement depends on it.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from vlog_tpu.ops import colorspace as cs
+from vlog_tpu.ops import resize as rz
+from vlog_tpu.ops import transform as tf
+
+
+class TestColorspace:
+    def test_gray_roundtrip(self):
+        rgb = np.full((2, 16, 16, 3), 0.5, dtype=np.float32)
+        y, u, v = cs.rgb_to_yuv420(rgb, standard="bt709")
+        assert y.shape == (2, 16, 16) and u.shape == (2, 8, 8)
+        # mid gray: Y ~ 16 + 0.5*219 = 125.5, chroma ~128
+        assert abs(int(y[0, 0, 0]) - 126) <= 1
+        assert abs(int(u[0, 0, 0]) - 128) <= 1
+        back = np.asarray(cs.yuv420_to_rgb(y, u, v, standard="bt709"))
+        assert np.abs(back - 0.5).max() < 0.01
+
+    def test_primary_colors_bt601(self):
+        # Pure red in BT.601 studio range: Y=81.5, Cb~90, Cr~240
+        rgb = np.zeros((1, 2, 2, 3), dtype=np.float32)
+        rgb[..., 0] = 1.0
+        y, u, v = cs.rgb_to_yuv420(rgb, standard="bt601")
+        assert abs(int(y[0, 0, 0]) - 82) <= 1
+        assert abs(int(v[0, 0, 0]) - 240) <= 1
+
+    def test_full_range(self):
+        rgb = np.ones((1, 2, 2, 3), dtype=np.float32)
+        y, _, _ = cs.rgb_to_yuv420(rgb, full_range=True)
+        assert int(y[0, 0, 0]) == 255
+        y2, _, _ = cs.rgb_to_yuv420(rgb, full_range=False)
+        assert int(y2[0, 0, 0]) == 235
+
+    def test_roundtrip_random(self):
+        rng = np.random.default_rng(0)
+        rgb = rng.random((1, 32, 32, 3), dtype=np.float32)
+        # smooth it so 4:2:0 subsampling loss is small
+        rgb = (rgb + np.roll(rgb, 1, 1) + np.roll(rgb, 1, 2)) / 3
+        y, u, v = cs.rgb_to_yuv420(rgb)
+        back = np.asarray(cs.yuv420_to_rgb(y, u, v))
+        assert np.abs(back - rgb).mean() < 0.1
+
+
+class TestResize:
+    def test_identity(self):
+        m = rz.resample_matrix(64, 64, "lanczos3")
+        assert np.allclose(m, np.eye(64), atol=1e-6)
+
+    def test_rows_normalized(self):
+        for f in ("lanczos3", "bilinear", "box"):
+            m = rz.resample_matrix(1080, 360, f)
+            assert np.allclose(m.sum(axis=1), 1.0, atol=1e-5)
+            m = rz.resample_matrix(360, 1080, f)
+            assert np.allclose(m.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_box_downscale_is_mean(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4)
+        out = rz.resize_plane(x, 2, 2, filter="box", out_dtype=jnp.float32)
+        expected = x.reshape(1, 2, 2, 2, 2).mean(axis=(2, 4))
+        assert np.allclose(np.asarray(out), expected, atol=1e-4)
+
+    def test_constant_preserved(self):
+        x = np.full((1, 720, 1280), 77, dtype=np.uint8)
+        out = rz.resize_plane(x, 360, 640)
+        assert np.all(np.asarray(out) == 77)
+
+    def test_ladder_shapes(self):
+        y = np.zeros((1, 64, 64), dtype=np.uint8)
+        u = np.zeros((1, 32, 32), dtype=np.uint8)
+        v = np.zeros((1, 32, 32), dtype=np.uint8)
+        rungs = ((32, 32), (16, 16))
+        out = rz.ladder_resize_yuv420(y, u, v, rungs)
+        assert set(out) == set(rungs)
+        yy, uu, vv = out[(32, 32)]
+        assert yy.shape == (1, 32, 32) and uu.shape == (1, 16, 16)
+
+    def test_upscale_smooth(self):
+        x = np.linspace(0, 255, 8, dtype=np.float32).reshape(1, 1, 8).repeat(8, axis=1)
+        out = rz.resize_plane(x, 16, 16, filter="bilinear", out_dtype=jnp.float32)
+        out = np.asarray(out)
+        # monotone gradient preserved along W
+        assert np.all(np.diff(out[0, 8]) >= -1e-3)
+
+
+def _ref_inverse_4x4(w):
+    """Scalar reference for spec 8.5.12.2 (independent of the JAX impl)."""
+    w = w.astype(np.int64)
+    tmp = np.zeros((4, 4), dtype=np.int64)
+    for i in range(4):  # rows
+        w0, w1, w2, w3 = w[i]
+        e0, e1 = w0 + w2, w0 - w2
+        e2, e3 = (w1 >> 1) - w3, w1 + (w3 >> 1)
+        tmp[i] = [e0 + e3, e1 + e2, e1 - e2, e0 - e3]
+    out = np.zeros((4, 4), dtype=np.int64)
+    for j in range(4):  # cols
+        w0, w1, w2, w3 = tmp[:, j]
+        e0, e1 = w0 + w2, w0 - w2
+        e2, e3 = (w1 >> 1) - w3, w1 + (w3 >> 1)
+        out[:, j] = [e0 + e3, e1 + e2, e1 - e2, e0 - e3]
+    return (out + 32) >> 6
+
+
+class TestTransform:
+    def test_forward_matches_matrix_def(self):
+        rng = np.random.default_rng(1)
+        x = rng.integers(-255, 256, (5, 4, 4), dtype=np.int32)
+        got = np.asarray(tf.core_transform(x))
+        for k in range(5):
+            expected = tf.CF @ x[k] @ tf.CF.T
+            assert np.array_equal(got[k], expected)
+
+    def test_inverse_matches_scalar_reference(self):
+        rng = np.random.default_rng(2)
+        # dequantized coefficient range at high QP can be large
+        w = rng.integers(-60000, 60000, (64, 4, 4)).astype(np.int32)
+        got = np.asarray(tf.inverse_core_transform(w))
+        for k in range(64):
+            assert np.array_equal(got[k], _ref_inverse_4x4(w[k])), k
+
+    @pytest.mark.parametrize("qp", [0, 10, 20, 28, 40, 51])
+    def test_quant_roundtrip_error_bounded(self, qp):
+        rng = np.random.default_rng(qp)
+        x = rng.integers(-200, 201, (32, 4, 4), dtype=np.int32)
+        w = tf.core_transform(x)
+        z = tf.quantize(w, qp=qp, intra=True)
+        wq = tf.dequantize(z, qp=qp)
+        res = np.asarray(tf.inverse_core_transform(wq))
+        # quantization step grows ~2x per 6 QP; reconstruction error bound
+        step = 2 ** (qp / 6.0)
+        err = np.abs(res - x).max()
+        assert err <= max(2, step), (qp, err)
+
+    def test_quant_zero_at_high_qp_small_resid(self):
+        x = np.ones((1, 4, 4), dtype=np.int32)
+        z = tf.quantize(tf.core_transform(x), qp=51, intra=True)
+        assert np.asarray(z)[0, 0, 0] == 0  # tiny residual quantizes away
+
+    @pytest.mark.parametrize("qp", [4, 16, 26, 37])
+    def test_intra16_luma_full_path(self, qp):
+        """Full Intra_16x16 luma path: core+DC-Hadamard fwd/quant, then the
+        decoder-side reconstruction, over a 16x16 residual block. This is
+        the contract the encoder and our decoder share."""
+        rng = np.random.default_rng(qp)
+        resid = rng.integers(-100, 101, (16, 16)).astype(np.int32)
+        blocks = tf.blocks_from_plane(resid)          # (4,4,4,4)
+        w = tf.core_transform(blocks)
+        dc = w[..., 0, 0]                             # (4,4)
+        dc_levels = tf.quantize_luma_dc(tf.hadamard4(dc), qp=qp)
+        ac_levels = tf.quantize(w, qp=qp, intra=True)
+        # decoder side
+        wd = np.asarray(tf.dequantize(ac_levels, qp=qp)).copy()
+        dcd = np.asarray(tf.dequantize_luma_dc(dc_levels, qp=qp))
+        wd[..., 0, 0] = dcd
+        recon = np.asarray(tf.plane_from_blocks(tf.inverse_core_transform(wd)))
+        step = 2 ** ((qp - 4) / 6.0)  # Qstep doubling per +6 QP, ~0.625@QP0
+        err = np.abs(recon - resid).max()
+        assert err <= max(3, 1.5 * step), (qp, err)
+
+    def test_chroma_dc_shapes(self):
+        dc = np.array([[[100, -50], [25, 0]]], dtype=np.int32)
+        z = tf.quantize_chroma_dc(dc, qp=26)
+        out = tf.dequantize_chroma_dc(z, qp=26)
+        assert out.shape == (1, 2, 2)
+
+    def test_block_tiling_roundtrip(self):
+        rng = np.random.default_rng(4)
+        p = rng.integers(0, 255, (2, 16, 24), dtype=np.int32)
+        b = tf.blocks_from_plane(p)
+        assert b.shape == (2, 4, 6, 4, 4)
+        assert np.array_equal(np.asarray(tf.plane_from_blocks(b)), p)

@@ -1,0 +1,12 @@
+"""First-party codec implementations.
+
+The reference shells out to ffmpeg's libx264/NVENC/VAAPI encoders
+(worker/hwaccel.py:647-839); this package is their TPU-native replacement:
+JAX does the DSP (prediction, transform, quantization — see vlog_tpu.ops)
+and a host-side entropy layer (Python reference + C++ fast path) emits
+standard bitstreams.
+
+- ``h264``: ITU-T H.264 / ISO 14496-10 encoder (Baseline intra subset:
+  I_PCM and Intra_16x16+CAVLC) and a matching decoder for verification and
+  for re-ingesting our own outputs.
+"""

@@ -1,0 +1,24 @@
+"""H.264/AVC encoder + verification decoder (Baseline intra subset).
+
+Architecture (one slice per macroblock row — see ``encoder``):
+
+- device (JAX, vlog_tpu.ops): colorspace, ladder resize, residual
+  computation, 4x4 integer transform, DC Hadamards, quantization, and the
+  bit-exact reconstruction used for left-neighbour DC prediction via
+  ``lax.scan`` along each MB row (rows/frames vmapped).
+- host: CAVLC entropy coding + NAL packing (Python reference here; C++
+  fast path in native/), one independent byte string per row-slice so
+  rows encode in parallel.
+
+Profile/level: Constrained Baseline, 4:2:0, 8-bit, frame (progressive)
+macroblocks, all-intra GOPs. Per-row slices both bound entropy-coding
+dependencies and make every row independently decodable.
+"""
+
+from vlog_tpu.codecs.h264.syntax import (  # noqa: F401
+    NalUnit,
+    make_sps,
+    make_pps,
+    annexb,
+    avcc_config,
+)

@@ -1,0 +1,26 @@
+"""TPU compute kernels for the media pipeline.
+
+This package is the substrate that replaces ffmpeg's libswscale/x264 DSP
+inner loops (reference: worker/hwaccel.py builds ffmpeg filter graphs like
+``scale=w:h:flags=lanczos`` + ``format=yuv420p``; transcoder.py:1006 runs one
+ffmpeg process per quality). Here the whole quality ladder is produced in one
+device pass:
+
+- ``colorspace``  — BT.601/BT.709 YUV420 <-> RGB, studio/full range
+- ``resize``      — separable resampling as matmuls (MXU-friendly); the
+                    multi-rung ladder shares one decoded source in HBM
+- ``transform``   — H.264 4x4/8x8 integer transforms + quantization (exact
+                    integer semantics, batched over macroblocks)
+
+Everything is pure-JAX traceable (works on CPU meshes for tests) with Pallas
+fusions layered on where profitable.
+"""
+
+from vlog_tpu.ops.colorspace import (  # noqa: F401
+    rgb_to_yuv420,
+    yuv420_to_rgb,
+    yuv420_to_yuv444,
+    yuv444_to_yuv420,
+)
+from vlog_tpu.ops.resize import resize_plane, resize_yuv420, ladder_resize_yuv420  # noqa: F401
+from vlog_tpu.ops import transform  # noqa: F401

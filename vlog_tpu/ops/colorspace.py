@@ -1,0 +1,131 @@
+"""Colorspace + chroma subsampling ops.
+
+Replaces ffmpeg's swscale colorspace stage (reference builds
+``format=yuv420p`` / ``format=nv12`` filter chains in
+worker/hwaccel.py:647-839). We keep frames planar:
+
+- luma  ``Y``: (..., H, W)
+- chroma ``U``/``V``: (..., H/2, W/2)  (4:2:0, MPEG chroma siting)
+
+Matrices follow BT.601 and BT.709 studio-range ("limited", Y in [16,235],
+C in [16,240]) and full-range variants. All math is float32 internally;
+entry/exit dtypes are uint8 frames or float [0,1] RGB.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Luma coefficients (Kr, Kb) per matrix standard.
+_KR_KB = {
+    "bt601": (0.299, 0.114),
+    "bt709": (0.2126, 0.0722),
+}
+
+
+def _matrices(standard: str):
+    try:
+        kr, kb = _KR_KB[standard]
+    except KeyError:
+        raise ValueError(f"unknown colorspace standard {standard!r}") from None
+    kg = 1.0 - kr - kb
+    # RGB -> YCbCr (analog, Y in [0,1], Cb/Cr in [-0.5, 0.5])
+    fwd = jnp.array(
+        [
+            [kr, kg, kb],
+            [-0.5 * kr / (1 - kb), -0.5 * kg / (1 - kb), 0.5],
+            [0.5, -0.5 * kg / (1 - kr), -0.5 * kb / (1 - kr)],
+        ],
+        dtype=jnp.float32,
+    )
+    inv = jnp.linalg.inv(fwd)
+    return fwd, inv
+
+
+def _quantize_ycbcr(y, cb, cr, full_range: bool):
+    if full_range:
+        yq = y * 255.0
+        cq_scale = 255.0
+    else:
+        yq = 16.0 + y * 219.0
+        cq_scale = 224.0
+    cbq = 128.0 + cb * cq_scale
+    crq = 128.0 + cr * cq_scale
+    return yq, cbq, crq
+
+
+def _dequantize_ycbcr(yq, cbq, crq, full_range: bool):
+    if full_range:
+        y = yq / 255.0
+        cscale = 255.0
+    else:
+        y = (yq - 16.0) / 219.0
+        cscale = 224.0
+    cb = (cbq - 128.0) / cscale
+    cr = (crq - 128.0) / cscale
+    return y, cb, cr
+
+
+def _to_uint8(x):
+    return jnp.clip(jnp.round(x), 0, 255).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("standard", "full_range"))
+def rgb_to_yuv420(rgb, *, standard: str = "bt709", full_range: bool = False):
+    """RGB float [0,1] (..., H, W, 3) -> planar uint8 (Y, U, V) 4:2:0.
+
+    H and W must be even. Chroma is downsampled with a 2x2 box filter
+    (MPEG-2 chroma siting approximation, matching swscale's default).
+    """
+    fwd, _ = _matrices(standard)
+    rgb = rgb.astype(jnp.float32)
+    ycc = jnp.einsum("...c,dc->...d", rgb, fwd)
+    y, cb, cr = ycc[..., 0], ycc[..., 1], ycc[..., 2]
+    yq, cbq, crq = _quantize_ycbcr(y, cb, cr, full_range)
+
+    def box2(p):
+        h, w = p.shape[-2], p.shape[-1]
+        p = p.reshape(*p.shape[:-2], h // 2, 2, w // 2, 2)
+        return p.mean(axis=(-3, -1))
+
+    return _to_uint8(yq), _to_uint8(box2(cbq)), _to_uint8(box2(crq))
+
+
+@functools.partial(jax.jit, static_argnames=("standard", "full_range"))
+def yuv420_to_rgb(y, u, v, *, standard: str = "bt709", full_range: bool = False):
+    """Planar uint8 YUV 4:2:0 -> RGB float [0,1] (..., H, W, 3).
+
+    Chroma is upsampled by nearest-neighbour doubling (sufficient for
+    thumbnail/sprite rendering; the encode path never round-trips RGB).
+    """
+    _, inv = _matrices(standard)
+    yf = y.astype(jnp.float32)
+    uf = jnp.repeat(jnp.repeat(u.astype(jnp.float32), 2, axis=-2), 2, axis=-1)
+    vf = jnp.repeat(jnp.repeat(v.astype(jnp.float32), 2, axis=-2), 2, axis=-1)
+    yl, cb, cr = _dequantize_ycbcr(yf, uf, vf, full_range)
+    ycc = jnp.stack([yl, cb, cr], axis=-1)
+    rgb = jnp.einsum("...c,dc->...d", ycc, inv)
+    return jnp.clip(rgb, 0.0, 1.0)
+
+
+@jax.jit
+def yuv420_to_yuv444(y, u, v):
+    """Upsample chroma to luma resolution (nearest)."""
+    u4 = jnp.repeat(jnp.repeat(u, 2, axis=-2), 2, axis=-1)
+    v4 = jnp.repeat(jnp.repeat(v, 2, axis=-2), 2, axis=-1)
+    return y, u4, v4
+
+
+@jax.jit
+def yuv444_to_yuv420(y, u, v):
+    """Downsample chroma with a 2x2 box filter."""
+
+    def box2(p):
+        h, w = p.shape[-2], p.shape[-1]
+        pf = p.astype(jnp.float32).reshape(*p.shape[:-2], h // 2, 2, w // 2, 2)
+        return pf.mean(axis=(-3, -1))
+
+    return y, _to_uint8(box2(u)), _to_uint8(box2(v))

@@ -1,0 +1,234 @@
+"""H.264 4x4 integer transform + quantization, batched, bit-exact.
+
+This is the device half of the encoder: the reference delegates these inner
+loops to x264/NVENC DSP inside ffmpeg (worker/hwaccel.py:647 builds the
+command; transcoder.py:426 runs it). Here they are JAX ops over arbitrary
+leading batch dimensions of 4x4 blocks, so one dispatch transforms every
+block of every macroblock of every frame in a GOP.
+
+Bit-exactness matters: the decoder reconstructs with integer arithmetic
+(shifts with floor semantics), so the encoder's reconstruction path must
+match exactly or per-row DC prediction drifts. All ops are int32.
+
+Spec references: ISO/IEC 14496-10 8.5 (transform), 8.5.12.2 (inverse core),
+Richardson "H.264 and MPEG-4 Video Compression" ch. 7 tables for MF/V.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Forward core transform Cf (applied as Cf @ X @ Cf.T).
+CF = np.array(
+    [
+        [1, 1, 1, 1],
+        [2, 1, -1, -2],
+        [1, -1, -1, 1],
+        [1, -2, 2, -1],
+    ],
+    dtype=np.int32,
+)
+
+# Quantization multiplier MF per QP%6 for coefficient classes (a, b, c):
+# a = positions (0,0),(0,2),(2,0),(2,2); b = (1,1),(1,3),(3,1),(3,3); c = rest.
+_MF_ABC = np.array(
+    [
+        [13107, 5243, 8066],
+        [11916, 4660, 7490],
+        [10082, 4194, 6554],
+        [9362, 3647, 5825],
+        [8192, 3355, 5243],
+        [7282, 2893, 4559],
+    ],
+    dtype=np.int32,
+)
+
+# Dequantization scale V per QP%6 for (a, b, c).
+_V_ABC = np.array(
+    [
+        [10, 16, 13],
+        [11, 18, 14],
+        [13, 20, 16],
+        [14, 23, 18],
+        [16, 25, 20],
+        [18, 29, 23],
+    ],
+    dtype=np.int32,
+)
+
+# Class index (0=a, 1=b, 2=c) per 4x4 position.
+_CLASS = np.array(
+    [
+        [0, 2, 0, 2],
+        [2, 1, 2, 1],
+        [0, 2, 0, 2],
+        [2, 1, 2, 1],
+    ],
+    dtype=np.int32,
+)
+
+
+@functools.lru_cache(maxsize=64)
+def _mf_table(qp_mod6: int) -> np.ndarray:
+    return _MF_ABC[qp_mod6][_CLASS]  # (4,4) int32
+
+
+@functools.lru_cache(maxsize=64)
+def _v_table(qp_mod6: int) -> np.ndarray:
+    return _V_ABC[qp_mod6][_CLASS]  # (4,4) int32
+
+
+def core_transform(blocks):
+    """Forward 4x4 core transform: Cf @ X @ Cf.T over (..., 4, 4) int32."""
+    cf = jnp.asarray(CF)
+    x = blocks.astype(jnp.int32)
+    return jnp.einsum("ij,...jk,lk->...il", cf, x, cf)
+
+
+@functools.partial(jax.jit, static_argnames=("qp", "intra"))
+def quantize(coeffs, *, qp: int, intra: bool = True):
+    """Quantize transformed coefficients (..., 4, 4) at a static QP.
+
+    Z = sign(W) * ((|W| * MF + f) >> qbits), qbits = 15 + QP//6,
+    f = 2^qbits/3 (intra) or /6 (inter).
+    """
+    qbits = 15 + qp // 6
+    mf = jnp.asarray(_mf_table(qp % 6))
+    f = (1 << qbits) // (3 if intra else 6)
+    # int32 is sufficient for 8-bit video: |W| <= 255*36 and MF <= 13107,
+    # so |W|*MF + f < 2^31. (JAX x64 is disabled by default.)
+    w = coeffs.astype(jnp.int32)
+    mag = (jnp.abs(w) * mf + f) >> qbits
+    return (jnp.sign(w) * mag).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("qp",))
+def dequantize(levels, *, qp: int):
+    """Dequantize: W' = Z * V * 2^(QP//6) over (..., 4, 4)."""
+    v = jnp.asarray(_v_table(qp % 6))
+    return (levels.astype(jnp.int32) * v) << (qp // 6)
+
+
+def inverse_core_transform(coeffs):
+    """Bit-exact inverse 4x4 transform (8.5.12.2) incl. final (x+32)>>6.
+
+    Input: dequantized coefficients (..., 4, 4) int32. Output: residual
+    (..., 4, 4) int32. Uses arithmetic shifts (floor), matching the spec's
+    ``>>`` on two's-complement values.
+    """
+    w = coeffs.astype(jnp.int32)
+
+    def onepass(m):
+        # operate on rows: m (..., 4, 4), transform last axis
+        w0, w1, w2, w3 = m[..., 0], m[..., 1], m[..., 2], m[..., 3]
+        e0 = w0 + w2
+        e1 = w0 - w2
+        e2 = (w1 >> 1) - w3
+        e3 = w1 + (w3 >> 1)
+        return jnp.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=-1)
+
+    h = onepass(w)  # horizontal (rows)
+    v = onepass(jnp.swapaxes(h, -1, -2))  # vertical (columns)
+    out = jnp.swapaxes(v, -1, -2)
+    return (out + 32) >> 6
+
+
+def hadamard4(blocks):
+    """4x4 Hadamard (for Intra_16x16 luma DC), H @ X @ H.T, no scaling."""
+    h = jnp.asarray(
+        np.array(
+            [[1, 1, 1, 1], [1, 1, -1, -1], [1, -1, -1, 1], [1, -1, 1, -1]],
+            dtype=np.int32,
+        )
+    )
+    x = blocks.astype(jnp.int32)
+    return jnp.einsum("ij,...jk,lk->...il", h, x, h)
+
+
+@functools.partial(jax.jit, static_argnames=("qp",))
+def quantize_luma_dc(dc, *, qp: int):
+    """Quantize the 4x4 luma DC Hadamard output (Intra_16x16 path).
+
+    Z = sign * ((|Y| * MF(0,0) + f2) >> (qbits+2)). The +2 (vs the AC
+    path's qbits) compensates the un-normalized 4x4 Hadamard pair's x16
+    gain against the spec decoder's 8.5.10 scaling; x264 equivalently
+    folds a >>1 into its forward dct4x4dc. Derivation: decoder gain is
+    V*2^(qp/6-2) per f-coefficient and f = 16*dc*MF/2^(qbits+2) here,
+    giving unity end-to-end (4*dc into the inverse core's /64).
+    """
+    qbits2 = 15 + qp // 6 + 2
+    mf00 = int(_MF_ABC[qp % 6][0])
+    f2 = (1 << qbits2) // 3
+    # |DC| <= 255*16 per block, Hadamard gain 16 -> |Y| <= 65280;
+    # 65280 * 13107 < 2^31, int32 safe.
+    w = dc.astype(jnp.int32)
+    mag = (jnp.abs(w) * mf00 + f2) >> qbits2
+    return (jnp.sign(w) * mag).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("qp",))
+def dequantize_luma_dc(levels, *, qp: int):
+    """Inverse Hadamard + dequant for luma DC (spec 8.5.10 decoder side).
+
+    Input quantized DC (..., 4, 4); output the DC values to place back at
+    position (0,0) of each dequantized 4x4 AC block before the inverse core
+    transform.
+    """
+    f = hadamard4(levels)
+    v00 = int(_V_ABC[qp % 6][0])
+    # Spec 8.5.10 with LevelScale4x4 = 16*V folded into our V table:
+    # qP>=36 branch <<(qP/6-6) becomes <<(qP/6-2); the rounding branch
+    # (f*16V + 2^(5-qP/6)) >> (6-qP/6) becomes offsets 2^(1-qP/6).
+    if qp >= 12:
+        out = (f * v00) << (qp // 6 - 2)
+    else:
+        out = (f * v00 + (1 << (1 - qp // 6))) >> (2 - qp // 6)
+    return out
+
+
+def hadamard2x2(dc):
+    """2x2 Hadamard for chroma DC: H2 @ X @ H2, H2 = [[1,1],[1,-1]]."""
+    h = jnp.asarray(np.array([[1, 1], [1, -1]], dtype=np.int32))
+    x = dc.astype(jnp.int32)
+    return jnp.einsum("ij,...jk,lk->...il", h, x, h)
+
+
+@functools.partial(jax.jit, static_argnames=("qp",))
+def quantize_chroma_dc(dc, *, qp: int):
+    """Quantize 2x2 chroma DC (spec 8.5.11 encoder mirror)."""
+    qbits = 15 + qp // 6
+    mf00 = int(_MF_ABC[qp % 6][0])
+    f = (1 << qbits) // 3
+    w = dc.astype(jnp.int32)
+    mag = (jnp.abs(w) * mf00 + 2 * f) >> (qbits + 1)
+    return (jnp.sign(w) * mag).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("qp",))
+def dequantize_chroma_dc(levels, *, qp: int):
+    """Inverse 2x2 Hadamard + dequant for chroma DC (spec 8.5.11).
+
+    Spec: ((f * LevelScale(0,0)) << (qP/6)) >> 5 with LevelScale = 16*V,
+    which in our V units is >> 1. Truncating shift, per spec.
+    """
+    f = hadamard2x2(levels)
+    v00 = int(_V_ABC[qp % 6][0])
+    return ((f * v00) << (qp // 6)) >> 1
+
+
+def blocks_from_plane(plane, block: int = 4):
+    """(..., H, W) -> (..., H//b, W//b, b, b) tiling."""
+    *lead, h, w = plane.shape
+    x = plane.reshape(*lead, h // block, block, w // block, block)
+    return jnp.swapaxes(x, -3, -2)
+
+
+def plane_from_blocks(blocks):
+    """Inverse of :func:`blocks_from_plane`."""
+    *lead, nh, nw, b, b2 = blocks.shape
+    x = jnp.swapaxes(blocks, -3, -2)
+    return x.reshape(*lead, nh * b, nw * b2)
