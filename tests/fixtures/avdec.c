@@ -1,0 +1,67 @@
+/* Test-only H.264 -> raw I420 oracle decoder against system libavcodec.
+ *
+ * Usage: avdec <in.h264 (annex-b)> <out.yuv>
+ * Decodes every frame and appends Y, U, V planes (tightly packed) to the
+ * output. Used by tests to validate that bitstreams from our TPU encoder
+ * reconstruct bit-exactly in a third-party spec decoder (same role ffmpeg
+ * verification passes play in the reference: worker/transcoder.py:2565).
+ */
+#include <libavcodec/avcodec.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static void die(const char *msg) { fprintf(stderr, "%s\n", msg); exit(1); }
+
+static void dump(AVFrame *f, FILE *out) {
+    for (int p = 0; p < 3; p++) {
+        int h = p ? (f->height + 1) / 2 : f->height;
+        int w = p ? (f->width + 1) / 2 : f->width;
+        for (int y = 0; y < h; y++)
+            fwrite(f->data[p] + (size_t)y * f->linesize[p], 1, w, out);
+    }
+}
+
+int main(int argc, char **argv) {
+    if (argc != 3) die("usage: avdec <in.h264> <out.yuv>");
+    FILE *in = fopen(argv[1], "rb");
+    if (!in) die("cannot open input");
+    FILE *out = fopen(argv[2], "wb");
+    if (!out) die("cannot open output");
+
+    const AVCodec *codec = avcodec_find_decoder(AV_CODEC_ID_H264);
+    if (!codec) die("no h264 decoder");
+    AVCodecParserContext *parser = av_parser_init(codec->id);
+    AVCodecContext *ctx = avcodec_alloc_context3(codec);
+    if (avcodec_open2(ctx, codec, NULL) < 0) die("open failed");
+
+    AVPacket *pkt = av_packet_alloc();
+    AVFrame *frame = av_frame_alloc();
+    uint8_t buf[65536 + AV_INPUT_BUFFER_PADDING_SIZE];
+    int eof = 0;
+    while (!eof) {
+        size_t n = fread(buf, 1, 65536, in);
+        memset(buf + n, 0, AV_INPUT_BUFFER_PADDING_SIZE);
+        eof = (n == 0);
+        uint8_t *data = buf;
+        size_t left = n;
+        do {
+            uint8_t *obuf; int osize;
+            int used = av_parser_parse2(parser, ctx, &obuf, &osize,
+                                        data, (int)left,
+                                        AV_NOPTS_VALUE, AV_NOPTS_VALUE, 0);
+            if (used < 0) die("parse error");
+            data += used; left -= used;
+            if (osize) {
+                pkt->data = obuf; pkt->size = osize;
+                if (avcodec_send_packet(ctx, pkt) < 0) die("send failed");
+                while (avcodec_receive_frame(ctx, frame) == 0) dump(frame, out);
+            }
+        } while (left > 0);
+    }
+    /* flush */
+    avcodec_send_packet(ctx, NULL);
+    while (avcodec_receive_frame(ctx, frame) == 0) dump(frame, out);
+    fclose(out);
+    return 0;
+}

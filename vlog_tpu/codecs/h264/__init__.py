@@ -1,18 +1,20 @@
-"""H.264/AVC encoder + verification decoder (Baseline intra subset).
+"""H.264/AVC encoder (Constrained Baseline intra subset), TPU-native.
 
-Architecture (one slice per macroblock row — see ``encoder``):
+Architecture (single slice per frame — see ``encoder``):
 
-- device (JAX, vlog_tpu.ops): colorspace, ladder resize, residual
-  computation, 4x4 integer transform, DC Hadamards, quantization, and the
-  bit-exact reconstruction used for left-neighbour DC prediction via
-  ``lax.scan`` along each MB row (rows/frames vmapped).
-- host: CAVLC entropy coding + NAL packing (Python reference here; C++
-  fast path in native/), one independent byte string per row-slice so
-  rows encode in parallel.
+- device (JAX, vlog_tpu.ops): prediction, residual, 4x4 integer
+  transform, DC Hadamards, quantization, bit-exact reconstruction. MB
+  row 0 is a small ``lax.scan`` over columns (left-neighbour DC
+  prediction is sequential by construction); every other MB row uses
+  Intra_16x16 *vertical* prediction so the whole row vectorizes and the
+  frame is one ``lax.scan`` over rows, vmapped across the GOP.
+- host: CAVLC entropy coding + NAL packing (``cavlc``; numpy/python
+  reference implementation, C++ fast path planned) — frames are
+  independent so a GOP entropy-codes on a thread pool.
 
-Profile/level: Constrained Baseline, 4:2:0, 8-bit, frame (progressive)
-macroblocks, all-intra GOPs. Per-row slices both bound entropy-coding
-dependencies and make every row independently decodable.
+Profile/level: Constrained Baseline, 4:2:0, 8-bit, progressive, all-intra.
+Correctness is enforced by decoding every test stream bit-exactly with the
+system libavcodec (tests/test_h264_oracle.py).
 """
 
 from vlog_tpu.codecs.h264.syntax import (  # noqa: F401

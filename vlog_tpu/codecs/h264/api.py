@@ -1,0 +1,126 @@
+"""High-level H.264 encoding API: frames in, packaged samples out.
+
+This is the object the backend layer (vlog_tpu.backends) drives per
+quality rung; it owns parameter sets and frame numbering, delegates DSP to
+``encoder`` (JAX, batched per GOP) and entropy coding to ``cavlc``.
+
+Reference parity: the (codec, width, height, bitrate) →  command-line
+mapping lived in worker/hwaccel.py:647-731; here it is an encoder object
+whose output plugs straight into media.fmp4 segments.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from vlog_tpu.codecs.h264 import syntax
+from vlog_tpu.codecs.h264.cavlc import encode_slice
+from vlog_tpu.codecs.h264.encoder import (
+    FrameLevels,
+    encode_gop,
+    pad_to_mb,
+)
+
+
+@dataclass
+class EncodedFrame:
+    """One access unit, ready for MP4/fMP4 sample tables."""
+
+    avcc: bytes          # 4-byte-length-prefixed NALs (AVCC sample format)
+    annexb: bytes        # start-code framed (for .h264 dumps / TS)
+    is_idr: bool
+    psnr_y: float
+
+
+@dataclass
+class H264Encoder:
+    """Stateful per-rung encoder: call :meth:`encode` with GOP batches.
+
+    All-intra (every frame IDR-capable); ``idr_period`` controls how often
+    IDR + recovery points are marked (non-IDR frames are still I slices).
+    """
+
+    width: int
+    height: int
+    fps_num: int = 30
+    fps_den: int = 1
+    qp: int = 26
+    idr_period: int = 1          # every frame IDR by default
+    entropy_threads: int = 8
+    _frame_index: int = field(default=0, init=False)
+    _idr_pic_id: int = field(default=0, init=False)
+
+    def __post_init__(self):
+        self.sps = syntax.make_sps(
+            syntax.SpsConfig(
+                width=self.width, height=self.height,
+                fps_num=self.fps_num, fps_den=self.fps_den,
+            )
+        )
+        self.pps = syntax.make_pps(init_qp=self.qp)
+
+    # ---- stream metadata -------------------------------------------------
+    @property
+    def avcc_config(self) -> bytes:
+        return syntax.avcc_config(self.sps, self.pps)
+
+    @property
+    def codec_string(self) -> str:
+        return syntax.codec_string(self.sps)
+
+    def headers_annexb(self) -> bytes:
+        return syntax.annexb([self.sps, self.pps])
+
+    # ---- encoding --------------------------------------------------------
+    def encode(self, y: np.ndarray, u: np.ndarray, v: np.ndarray
+               ) -> list[EncodedFrame]:
+        """Encode a GOP batch: y (N, H, W), u/v (N, H/2, W/2) uint8.
+
+        One XLA dispatch for the whole batch, then entropy coding on host
+        threads (one frame per task; numpy-heavy sections drop the GIL).
+        """
+        n = y.shape[0]
+        y = pad_to_mb(y)
+        u = pad_to_mb(u, 8)
+        v = pad_to_mb(v, 8)
+        out = encode_gop(y, u, v, qp=self.qp)
+        recon_y = np.asarray(out["recon_y"])
+        luma_dc = np.asarray(out["luma_dc"])
+        luma_ac = np.asarray(out["luma_ac"])
+        chroma_dc = np.asarray(out["chroma_dc"])
+        chroma_ac = np.asarray(out["chroma_ac"])
+
+        frame_ids = list(range(self._frame_index, self._frame_index + n))
+        self._frame_index += n
+
+        def pack(i: int) -> EncodedFrame:
+            fi = frame_ids[i]
+            idr = (fi % self.idr_period) == 0
+            lv = FrameLevels(luma_dc[i], luma_ac[i],
+                             chroma_dc[i], chroma_ac[i], self.qp)
+            nal = encode_slice(
+                lv, qp=self.qp, init_qp=self.qp,
+                # frame_num counts reference frames since the last IDR.
+                frame_num=(fi % self.idr_period) % 256,
+                idr=idr, idr_pic_id=fi % 2,
+            )
+            raw = nal.to_bytes()
+            prefix = [self.sps, self.pps] if idr else []
+            avcc = b"".join(
+                len(p.to_bytes()).to_bytes(4, "big") + p.to_bytes()
+                for p in prefix
+            ) + len(raw).to_bytes(4, "big") + raw
+            annexb = syntax.annexb(prefix + [nal])
+            err = (recon_y[i].astype(np.int64) - y[i].astype(np.int64))
+            mse = float(np.mean(err * err))
+            psnr = 99.0 if mse < 1e-9 else 10 * np.log10(255 ** 2 / mse)
+            return EncodedFrame(avcc=avcc, annexb=annexb, is_idr=idr,
+                                psnr_y=psnr)
+
+        if n == 1 or self.entropy_threads <= 1:
+            return [pack(i) for i in range(n)]
+        with ThreadPoolExecutor(self.entropy_threads) as pool:
+            return list(pool.map(pack, range(n)))

@@ -1,0 +1,253 @@
+"""CAVLC entropy coding + slice assembly for the I_16x16 stream shape.
+
+Host-side half of the encoder: the device (encoder.py) emits quantized
+levels for every block of every MB in one XLA dispatch; this module turns
+them into spec-compliant slice_data bits. The reference delegated this to
+x264 inside ffmpeg (worker/hwaccel.py:647); entropy coding is inherently
+sequential bit-packing, so it lives on the host — first as this
+numpy/python implementation, with a C++ packer planned behind the same
+interface.
+
+Spec: ITU-T H.264 7.3.5 (macroblock layer), 7.4.5, 9.2 (CAVLC).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vlog_tpu.media.bitstream import BitWriter
+from vlog_tpu.codecs.h264 import syntax
+from vlog_tpu.codecs.h264.cavlc_tables import (
+    CHROMA_DC_COEFF_TOKEN_BITS,
+    CHROMA_DC_COEFF_TOKEN_LEN,
+    CHROMA_DC_TOTAL_ZEROS_BITS,
+    CHROMA_DC_TOTAL_ZEROS_LEN,
+    COEFF_TOKEN_BITS,
+    COEFF_TOKEN_LEN,
+    LUMA_BLOCK_ORDER,
+    RUN_BEFORE_BITS,
+    RUN_BEFORE_LEN,
+    TOTAL_ZEROS_BITS,
+    TOTAL_ZEROS_LEN,
+    ZIGZAG_4x4,
+    coeff_token_table,
+)
+
+_ZZ_R = np.array([r for r, _ in ZIGZAG_4x4])
+_ZZ_C = np.array([c for _, c in ZIGZAG_4x4])
+
+
+def zigzag(block: np.ndarray) -> np.ndarray:
+    """(4,4) -> (16,) in zigzag scan order."""
+    return block[_ZZ_R, _ZZ_C]
+
+
+def encode_residual_block(
+    w: BitWriter, coeffs: np.ndarray, nc: int
+) -> int:
+    """residual_block_cavlc (spec 9.2). ``coeffs`` in scan order.
+
+    ``nc`` is the decoded-neighbour context (-1 selects the chroma DC
+    table). Returns TotalCoeff (the caller records it for later nC
+    derivation).
+    """
+    max_coeff = len(coeffs)
+    nz_idx = [i for i, c in enumerate(coeffs) if c != 0]
+    total_coeff = len(nz_idx)
+
+    # Trailing ones: |1| coefficients at the high-frequency end, max 3.
+    trailing = 0
+    for i in reversed(nz_idx):
+        if abs(int(coeffs[i])) == 1 and trailing < 3:
+            trailing += 1
+        else:
+            break
+
+    # coeff_token
+    idx = 4 * total_coeff + trailing
+    if nc == -1:
+        w.write_bits(int(CHROMA_DC_COEFF_TOKEN_BITS[idx]),
+                     int(CHROMA_DC_COEFF_TOKEN_LEN[idx]))
+    else:
+        tbl = coeff_token_table(nc)
+        w.write_bits(int(COEFF_TOKEN_BITS[tbl][idx]),
+                     int(COEFF_TOKEN_LEN[tbl][idx]))
+    if total_coeff == 0:
+        return 0
+
+    # Trailing one signs, high frequency first.
+    for i in reversed(nz_idx[total_coeff - trailing:]):
+        w.write_bit(1 if coeffs[i] < 0 else 0)
+
+    # Remaining levels, high frequency first.
+    suffix_len = 1 if (total_coeff > 10 and trailing < 3) else 0
+    first = True
+    for i in reversed(nz_idx[: total_coeff - trailing]):
+        level = int(coeffs[i])
+        code = 2 * level - 2 if level > 0 else -2 * level - 1
+        if first and trailing < 3:
+            code -= 2
+        first = False
+        if suffix_len == 0:
+            if code < 14:
+                w.write_bits(1, code + 1)           # prefix zeros + 1
+            elif code < 30:
+                w.write_bits(1, 15)                 # level_prefix 14
+                w.write_bits(code - 14, 4)
+            else:
+                w.write_bits(1, 16)                 # level_prefix 15
+                w.write_bits(code - 30, 12)
+        else:
+            if code < (15 << suffix_len):
+                w.write_bits(1, (code >> suffix_len) + 1)
+                w.write_bits(code & ((1 << suffix_len) - 1), suffix_len)
+            else:
+                w.write_bits(1, 16)                 # level_prefix 15
+                rem = code - (15 << suffix_len)
+                if rem >= 1 << 12:
+                    raise ValueError(f"level {level} too large for CAVLC escape")
+                w.write_bits(rem, 12)
+        if suffix_len == 0:
+            suffix_len = 1
+        if abs(level) > (3 << (suffix_len - 1)) and suffix_len < 6:
+            suffix_len += 1
+
+    # total_zeros
+    total_zeros = nz_idx[-1] + 1 - total_coeff
+    if total_coeff < max_coeff:
+        if nc == -1:
+            w.write_bits(int(CHROMA_DC_TOTAL_ZEROS_BITS[total_coeff - 1][total_zeros]),
+                         int(CHROMA_DC_TOTAL_ZEROS_LEN[total_coeff - 1][total_zeros]))
+        else:
+            w.write_bits(int(TOTAL_ZEROS_BITS[total_coeff - 1][total_zeros]),
+                         int(TOTAL_ZEROS_LEN[total_coeff - 1][total_zeros]))
+
+    # run_before for each coefficient except the lowest-frequency one.
+    zeros_left = total_zeros
+    for k in range(total_coeff - 1, 0, -1):
+        if zeros_left <= 0:
+            break
+        run = nz_idx[k] - nz_idx[k - 1] - 1
+        tbl = min(zeros_left, 7) - 1
+        w.write_bits(int(RUN_BEFORE_BITS[tbl][run]),
+                     int(RUN_BEFORE_LEN[tbl][run]))
+        zeros_left -= run
+    return total_coeff
+
+
+def _nc(avail_a: bool, na: int, avail_b: bool, nb: int) -> int:
+    """Neighbour context (spec 9.2.1): nA left, nB above."""
+    if avail_a and avail_b:
+        return (na + nb + 1) >> 1
+    if avail_a:
+        return na
+    if avail_b:
+        return nb
+    return 0
+
+
+class SliceEncoder:
+    """Encodes one frame's levels into slice_data bits (single slice).
+
+    Tracks per-4x4-block TotalCoeff grids for nC derivation across MB
+    boundaries. Designed so a batch of frames can be encoded in parallel
+    host threads (no shared state between instances).
+    """
+
+    def __init__(self, mbh: int, mbw: int):
+        self.mbh = mbh
+        self.mbw = mbw
+        # TotalCoeff per luma 4x4 block, global grid.
+        self.nz_luma = np.zeros((mbh * 4, mbw * 4), np.int32)
+        # Per chroma component, 2x2 blocks per MB.
+        self.nz_chroma = np.zeros((2, mbh * 2, mbw * 2), np.int32)
+
+    def encode_macroblock(
+        self, w: BitWriter, levels, my: int, mx: int
+    ) -> None:
+        """macroblock_layer for I_16x16 (spec 7.3.5)."""
+        luma_dc = levels.luma_dc[my, mx]          # (4,4) Hadamard domain
+        luma_ac = levels.luma_ac[my, mx]          # (4,4,4,4)
+        chroma_dc = levels.chroma_dc[:, my, mx]   # (2,2,2)
+        chroma_ac = levels.chroma_ac[:, my, mx]   # (2,2,2,4,4)
+
+        cbp_luma = 15 if np.any(luma_ac) else 0
+        if np.any(chroma_ac):
+            cbp_chroma = 2
+        elif np.any(chroma_dc):
+            cbp_chroma = 1
+        else:
+            cbp_chroma = 0
+
+        # Prediction modes: row 0 DC (no neighbours), else Vertical.
+        luma_mode = 2 if my == 0 else 0       # Intra_16x16: 0=V, 2=DC
+        chroma_mode = 0 if my == 0 else 2     # chroma: 0=DC, 2=V
+
+        mb_type = 1 + luma_mode + 4 * cbp_chroma + 12 * (1 if cbp_luma else 0)
+        w.write_ue(mb_type)
+        w.write_ue(chroma_mode)               # intra_chroma_pred_mode
+        w.write_se(0)                         # mb_qp_delta (constant QP)
+
+        # --- Intra16x16DCLevel: nC from luma 4x4 block (0,0) neighbours.
+        gy, gx = my * 4, mx * 4
+        nc = _nc(gx > 0, int(self.nz_luma[gy, gx - 1]),
+                 gy > 0, int(self.nz_luma[gy - 1, gx]))
+        encode_residual_block(w, zigzag(luma_dc), nc)
+
+        # --- Luma AC blocks in coding order.
+        if cbp_luma:
+            for by, bx in LUMA_BLOCK_ORDER:
+                y, x = gy + by, gx + bx
+                nc = _nc(x > 0, int(self.nz_luma[y, x - 1]),
+                         y > 0, int(self.nz_luma[y - 1, x]))
+                tc = encode_residual_block(
+                    w, zigzag(luma_ac[by, bx])[1:], nc)
+                self.nz_luma[y, x] = tc
+        # else: grid entries stay 0 (AC all zero).
+
+        # --- Chroma DC (nC = -1), Cb then Cr.
+        if cbp_chroma > 0:
+            for comp in range(2):
+                dc = chroma_dc[comp]
+                encode_residual_block(
+                    w, dc.reshape(-1), -1)  # 2x2 raster scan (spec 8.5.11 order)
+
+        # --- Chroma AC, Cb then Cr, 2x2 raster block order.
+        if cbp_chroma == 2:
+            cy, cx = my * 2, mx * 2
+            for comp in range(2):
+                for by in range(2):
+                    for bx in range(2):
+                        y, x = cy + by, cx + bx
+                        nc = _nc(x > 0, int(self.nz_chroma[comp, y, x - 1]),
+                                 y > 0, int(self.nz_chroma[comp, y - 1, x]))
+                        tc = encode_residual_block(
+                            w, zigzag(chroma_ac[comp, by, bx])[1:], nc)
+                        self.nz_chroma[comp, y, x] = tc
+
+
+def encode_slice(
+    levels,
+    *,
+    qp: int,
+    init_qp: int,
+    frame_num: int = 0,
+    idr: bool = True,
+    idr_pic_id: int = 0,
+    log2_max_frame_num: int = 8,
+) -> syntax.NalUnit:
+    """Full slice NAL (header + slice_data) for one frame's levels."""
+    mbh, mbw = levels.mb_height, levels.mb_width
+    w = BitWriter()
+    syntax.write_slice_header(
+        w, first_mb=0, slice_qp=qp, init_qp=init_qp, idr=idr,
+        frame_num=frame_num, idr_pic_id=idr_pic_id,
+        log2_max_frame_num=log2_max_frame_num,
+    )
+    enc = SliceEncoder(mbh, mbw)
+    for my in range(mbh):
+        for mx in range(mbw):
+            enc.encode_macroblock(w, levels, my, mx)
+    w.rbsp_trailing_bits()
+    return syntax.NalUnit(
+        syntax.NAL_IDR if idr else syntax.NAL_SLICE, 3, w.getvalue())

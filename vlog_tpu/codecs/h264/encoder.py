@@ -1,0 +1,296 @@
+"""H.264 all-intra frame encoder — the TPU compute core.
+
+Replaces the x264/NVENC encode the reference runs as an ffmpeg subprocess
+per quality rung (worker/hwaccel.py:647 builds the command,
+worker/transcoder.py:426-537 runs and monitors it). Here the whole encode
+is one XLA program: a ``lax.scan`` over macroblock rows with every MB in a
+row processed in parallel, ``vmap``-batched over the frames of a GOP.
+
+The design choice that makes this map onto the TPU instead of a scalar
+CPU loop: H.264 intra prediction normally chains left+top reconstructed
+neighbours, serializing MBs along a wavefront. We restrict the encoder to
+prediction modes with *only vertical* dependence:
+
+- MB row 0:   Intra_16x16 DC with no neighbours (pred = 128), chroma DC.
+- MB rows >0: Intra_16x16 Vertical (mode 0), chroma Vertical (mode 2).
+
+Rows then vectorize perfectly (one (mbw, ...) tensor op per row) and the
+row-to-row dependence — the reconstructed bottom pixel line — is a scan
+carry of shape (W,). Compression cost vs full mode search is a few percent
+at ladder bitrates; throughput gain is the whole point of the port.
+
+Everything here is bit-exact integer math (see ops/transform.py); the
+decoder reconstructs the same pixels, which tests/test_h264_oracle.py
+asserts by decoding our streams with the system libavcodec.
+
+Spec: ITU-T H.264 8.3.3 (Intra_16x16 prediction), 8.3.4 (chroma), 8.5
+(transform/quant). Reference parity: worker/hwaccel.py:454-552 encoder
+selection — this module is the ``device=tpu`` encoder those seams select.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vlog_tpu.ops.transform import (
+    core_transform,
+    dequantize,
+    dequantize_chroma_dc,
+    dequantize_luma_dc,
+    hadamard2x2,
+    hadamard4,
+    inverse_core_transform,
+    quantize,
+    quantize_chroma_dc,
+    quantize_luma_dc,
+)
+
+# Table 8-15: QPc as a function of qPI (chroma_qp_index_offset = 0).
+_CHROMA_QP = np.concatenate(
+    [
+        np.arange(30),
+        np.array(
+            [29, 30, 31, 32, 32, 33, 34, 34, 35, 35, 36, 36, 37, 37, 37, 38,
+             38, 38, 39, 39, 39, 39],
+        ),
+    ]
+).astype(np.int32)
+
+
+def chroma_qp(qp: int) -> int:
+    """QPc from luma QP (spec table 8-15, zero index offset)."""
+    return int(_CHROMA_QP[min(max(qp, 0), 51)])
+
+
+@dataclass
+class FrameLevels:
+    """Quantized levels for one frame (or a leading batch of frames).
+
+    Shapes (without batch dims), for an mbh x mbw macroblock grid:
+      luma_dc:   (mbh, mbw, 4, 4)        Hadamard-domain DC levels
+      luma_ac:   (mbh, mbw, 4, 4, 4, 4)  per 4x4 block (grid y, x), (0,0)==0
+      chroma_dc: (2, mbh, mbw, 2, 2)     U then V
+      chroma_ac: (2, mbh, mbw, 2, 2, 4, 4)  (0,0) position zeroed
+    """
+
+    luma_dc: np.ndarray
+    luma_ac: np.ndarray
+    chroma_dc: np.ndarray
+    chroma_ac: np.ndarray
+    qp: int
+
+    @property
+    def mb_height(self) -> int:
+        return self.luma_dc.shape[-4]
+
+    @property
+    def mb_width(self) -> int:
+        return self.luma_dc.shape[-3]
+
+
+def _luma_encode(y_row, pred, qp: int):
+    """Encode one MB row of luma. y_row (16, W) int32, pred (16, W).
+
+    Returns (dc_levels (mbw,4,4), ac_levels (mbw,4,4,4,4), recon (16, W)).
+    """
+    w = y_row.shape[-1]
+    mbw = w // 16
+    pred = pred.astype(jnp.int32)
+    resid = y_row.astype(jnp.int32) - pred
+    # (16, W) -> (mbw, 16, 16) -> 4x4 blocks (mbw, 4, 4, 4, 4)
+    mb = jnp.swapaxes(resid.reshape(16, mbw, 16), 0, 1)
+    blocks = jnp.swapaxes(mb.reshape(mbw, 4, 4, 4, 4), 2, 3)
+    coefs = core_transform(blocks)
+    dc = coefs[..., 0, 0]                        # (mbw, 4, 4)
+    dc_levels = quantize_luma_dc(hadamard4(dc), qp=qp)
+    ac_levels = quantize(coefs, qp=qp, intra=True)
+    ac_levels = ac_levels.at[..., 0, 0].set(0)
+    # Reconstruction (decoder mirror)
+    dc_rec = dequantize_luma_dc(dc_levels, qp=qp)  # (mbw, 4, 4)
+    ac_rec = dequantize(ac_levels, qp=qp)
+    full = ac_rec.at[..., 0, 0].set(dc_rec)
+    resid_rec = inverse_core_transform(full)       # (mbw, 4, 4, 4, 4)
+    mb_rec = jnp.swapaxes(resid_rec, 2, 3).reshape(mbw, 16, 16)
+    row_rec = jnp.swapaxes(mb_rec, 0, 1).reshape(16, w)
+    recon = jnp.clip(pred + row_rec, 0, 255)
+    return dc_levels, ac_levels, recon
+
+
+def _chroma_encode(c_row, pred, qpc: int):
+    """Encode one MB row of one chroma plane. c_row (8, Wc), pred (8, Wc)."""
+    wc = c_row.shape[-1]
+    mbw = wc // 8
+    pred = pred.astype(jnp.int32)
+    resid = c_row.astype(jnp.int32) - pred
+    mb = jnp.swapaxes(resid.reshape(8, mbw, 8), 0, 1)       # (mbw, 8, 8)
+    blocks = jnp.swapaxes(mb.reshape(mbw, 2, 4, 2, 4), 2, 3)  # (mbw,2,2,4,4)
+    coefs = core_transform(blocks)
+    dc = coefs[..., 0, 0]                                   # (mbw, 2, 2)
+    dc_levels = quantize_chroma_dc(hadamard2x2(dc), qp=qpc)
+    ac_levels = quantize(coefs, qp=qpc, intra=True)
+    ac_levels = ac_levels.at[..., 0, 0].set(0)
+    dc_rec = dequantize_chroma_dc(dc_levels, qp=qpc)
+    ac_rec = dequantize(ac_levels, qp=qpc)
+    full = ac_rec.at[..., 0, 0].set(dc_rec)
+    resid_rec = inverse_core_transform(full)
+    mb_rec = jnp.swapaxes(resid_rec, 2, 3).reshape(mbw, 8, 8)
+    row_rec = jnp.swapaxes(mb_rec, 0, 1).reshape(8, wc)
+    recon = jnp.clip(pred + row_rec, 0, 255)
+    return dc_levels, ac_levels, recon
+
+
+def _encode_row0(y_row, u_row, v_row, qp: int, qpc: int):
+    """Encode MB row 0 as a scan over MB columns (Intra_16x16 DC mode).
+
+    The decoder's DC prediction uses the *left* neighbour when present
+    (spec 8.3.3.3: left-only pred = (sum(left_col) + 8) >> 4), so row 0 is
+    inherently sequential along x. It is a tiny fraction of the frame
+    (1/mbh); every other row is the fully parallel vertical-mode path.
+
+    Chroma DC mode predicts per 4x4 quadrant (8.3.4.2): with only the left
+    MB available, the top-half quadrants use left rows 0..3 and the
+    bottom-half quadrants left rows 4..7.
+    """
+    w = y_row.shape[-1]
+    mbw = w // 16
+    y_mbs = jnp.swapaxes(y_row.reshape(16, mbw, 16), 0, 1)   # (mbw, 16, 16)
+    u_mbs = jnp.swapaxes(u_row.reshape(8, mbw, 8), 0, 1)
+    v_mbs = jnp.swapaxes(v_row.reshape(8, mbw, 8), 0, 1)
+    first = jnp.zeros((mbw,), jnp.bool_).at[0].set(True)
+
+    def chroma_dc_pred(left_col, is_first):
+        top = (jnp.sum(left_col[:4]) + 2) >> 2
+        bot = (jnp.sum(left_col[4:]) + 2) >> 2
+        col = jnp.concatenate([jnp.full((4,), top), jnp.full((4,), bot)])
+        col = jnp.where(is_first, 128, col)
+        return jnp.broadcast_to(col[:, None], (8, 8))
+
+    def step(carry, xs):
+        ly, lu, lv = carry                 # left MB's recon right columns
+        y_mb, u_mb, v_mb, is_first = xs
+        pred_dc = jnp.where(is_first, 128, (jnp.sum(ly) + 8) >> 4)
+        pred_y = jnp.full((16, 16), pred_dc)
+        ydc, yac, yrec = _luma_encode(y_mb, pred_y, qp)
+        udc, uac, urec = _chroma_encode(u_mb, chroma_dc_pred(lu, is_first), qpc)
+        vdc, vac, vrec = _chroma_encode(v_mb, chroma_dc_pred(lv, is_first), qpc)
+        carry = (yrec[:, -1], urec[:, -1], vrec[:, -1])
+        out = (ydc[0], yac[0], udc[0], uac[0], vdc[0], vac[0],
+               yrec, urec, vrec)
+        return carry, out
+
+    init = (jnp.full((16,), 128, jnp.int32), jnp.full((8,), 128, jnp.int32),
+            jnp.full((8,), 128, jnp.int32))
+    _, (ydc, yac, udc, uac, vdc, vac, yrec, urec, vrec) = jax.lax.scan(
+        step, init, (y_mbs, u_mbs, v_mbs, first)
+    )
+    # (mbw, 16, 16) -> (16, W)
+    yrec = jnp.swapaxes(yrec, 0, 1).reshape(16, w)
+    urec = jnp.swapaxes(urec, 0, 1).reshape(8, w // 2)
+    vrec = jnp.swapaxes(vrec, 0, 1).reshape(8, w // 2)
+    return ydc, yac, udc, uac, vdc, vac, yrec, urec, vrec
+
+
+@functools.partial(jax.jit, static_argnames=("qp",))
+def encode_frame(y, u, v, *, qp: int):
+    """Encode one 4:2:0 frame to quantized levels + reconstruction.
+
+    y: (H, W), u/v: (H/2, W/2), integer dtypes, H and W multiples of 16
+    (pad with edge replication upstream; SPS cropping trims on decode).
+
+    Returns dict of levels arrays (see :class:`FrameLevels`) plus
+    ``recon_y/u/v`` for PSNR and debugging. jit-compiled per (shape, qp).
+    """
+    h, w = y.shape
+    mbh = h // 16
+    qpc = chroma_qp(qp)
+
+    y32 = y.astype(jnp.int32)
+    u32 = u.astype(jnp.int32)
+    v32 = v.astype(jnp.int32)
+
+    # --- MB row 0: DC modes, sequential along x (left-neighbour pred).
+    r0 = _encode_row0(y32[:16], u32[:8], v32[:8], qp, qpc)
+    (ydc0, yac0, udc0, uac0, vdc0, vac0, yrec0, urec0, vrec0) = r0
+
+    if mbh == 1:
+        return {
+            "luma_dc": ydc0[None], "luma_ac": yac0[None],
+            "chroma_dc": jnp.stack([udc0[None], vdc0[None]]),
+            "chroma_ac": jnp.stack([uac0[None], vac0[None]]),
+            "recon_y": yrec0.astype(jnp.uint8),
+            "recon_u": urec0.astype(jnp.uint8),
+            "recon_v": vrec0.astype(jnp.uint8),
+        }
+
+    # --- MB rows 1..mbh-1: vertical modes, whole row in parallel.
+    y_rows = y32[16:].reshape(mbh - 1, 16, w)
+    u_rows = u32[8:].reshape(mbh - 1, 8, w // 2)
+    v_rows = v32[8:].reshape(mbh - 1, 8, w // 2)
+
+    def vert(pred_line, n):
+        return jnp.broadcast_to(pred_line[None, :], (n, pred_line.shape[0]))
+
+    def step(carry, xs):
+        prev_y, prev_u, prev_v = carry
+        y_row, u_row, v_row = xs
+        ydc, yac, yrec = _luma_encode(y_row, vert(prev_y, 16), qp)
+        udc, uac, urec = _chroma_encode(u_row, vert(prev_u, 8), qpc)
+        vdc, vac, vrec = _chroma_encode(v_row, vert(prev_v, 8), qpc)
+        new_carry = (yrec[-1, :], urec[-1, :], vrec[-1, :])
+        return new_carry, (ydc, yac, udc, uac, vdc, vac, yrec, urec, vrec)
+
+    init = (yrec0[-1, :], urec0[-1, :], vrec0[-1, :])
+    _, (ydc, yac, udc, uac, vdc, vac, yrec, urec, vrec) = jax.lax.scan(
+        step, init, (y_rows, u_rows, v_rows)
+    )
+    return {
+        "luma_dc": jnp.concatenate([ydc0[None], ydc]),    # (mbh, mbw, 4, 4)
+        "luma_ac": jnp.concatenate([yac0[None], yac]),    # (mbh, mbw, 4,4,4,4)
+        "chroma_dc": jnp.stack([
+            jnp.concatenate([udc0[None], udc]),
+            jnp.concatenate([vdc0[None], vdc]),
+        ]),                                               # (2, mbh, mbw, 2, 2)
+        "chroma_ac": jnp.stack([
+            jnp.concatenate([uac0[None], uac]),
+            jnp.concatenate([vac0[None], vac]),
+        ]),                                               # (2, mbh, mbw, 2,2,4,4)
+        "recon_y": jnp.concatenate(
+            [yrec0, yrec.reshape((mbh - 1) * 16, w)]).astype(jnp.uint8),
+        "recon_u": jnp.concatenate(
+            [urec0, urec.reshape((mbh - 1) * 8, w // 2)]).astype(jnp.uint8),
+        "recon_v": jnp.concatenate(
+            [vrec0, vrec.reshape((mbh - 1) * 8, w // 2)]).astype(jnp.uint8),
+    }
+
+
+# Batched over a GOP: (N, H, W) / (N, H/2, W/2). One dispatch per rung.
+@functools.partial(jax.jit, static_argnames=("qp",))
+def encode_gop(y, u, v, *, qp: int):
+    return jax.vmap(lambda a, b, c: encode_frame(a, b, c, qp=qp))(y, u, v)
+
+
+def pad_to_mb(plane: np.ndarray, mb: int = 16) -> np.ndarray:
+    """Edge-replicate pad H/W up to a multiple of ``mb`` (host-side)."""
+    h, w = plane.shape[-2:]
+    ph = (-h) % mb
+    pw = (-w) % mb
+    if ph == 0 and pw == 0:
+        return plane
+    pad = [(0, 0)] * (plane.ndim - 2) + [(0, ph), (0, pw)]
+    return np.pad(plane, pad, mode="edge")
+
+
+def frame_levels(out: dict, qp: int) -> FrameLevels:
+    """Device output dict -> host FrameLevels (numpy)."""
+    return FrameLevels(
+        luma_dc=np.asarray(out["luma_dc"]),
+        luma_ac=np.asarray(out["luma_ac"]),
+        chroma_dc=np.asarray(out["chroma_dc"]),
+        chroma_ac=np.asarray(out["chroma_ac"]),
+        qp=qp,
+    )
