@@ -1,0 +1,131 @@
+"""Decoder round-trip: our decoder must reproduce the encoder's
+reconstruction bit-exactly (the encoder's recon IS the decoded output —
+no deblocking). Complements tests/test_h264_oracle.py, which checks the
+same property against libavcodec when available; this file needs no
+external tooling, so the decode path is always covered.
+"""
+
+import numpy as np
+import pytest
+
+from vlog_tpu.codecs.h264 import syntax
+from vlog_tpu.codecs.h264.api import H264Encoder
+from vlog_tpu.codecs.h264.decoder import (
+    H264Decoder,
+    UnsupportedStream,
+    decode_annexb,
+    parse_pps,
+    parse_sps,
+    split_annexb,
+)
+from vlog_tpu.codecs.h264.encoder import encode_frame, pad_to_mb
+
+
+def synth(rng, h, w):
+    yy, xx = np.mgrid[0:h, 0:w]
+    y = (((yy * 5 + xx * 3) % 256) * 0.5 + rng.integers(0, 128, (h, w))).astype(np.uint8)
+    u = rng.integers(0, 256, (h // 2, w // 2)).astype(np.uint8)
+    v = ((xx[: h // 2, : w // 2] * 7) % 256).astype(np.uint8)
+    return y, u, v
+
+
+def test_sps_pps_roundtrip():
+    cfg = syntax.SpsConfig(width=1918, height=1078, fps_num=30000, fps_den=1001)
+    sps_nal = syntax.make_sps(cfg)
+    sps = parse_sps(sps_nal.rbsp)
+    assert sps.profile_idc == syntax.PROFILE_BASELINE
+    assert sps.mb_width == cfg.mb_width and sps.mb_height == cfg.mb_height
+    assert sps.width == 1918 and sps.height == 1078
+    pps = parse_pps(syntax.make_pps(init_qp=30).rbsp)
+    assert pps.init_qp == 30
+    assert pps.entropy_coding_mode == 0
+
+
+@pytest.mark.parametrize("size", [(16, 16), (48, 64), (144, 176), (34, 50)])
+@pytest.mark.parametrize("qp", [12, 26, 40])
+def test_annexb_roundtrip_bit_exact(size, qp):
+    h, w = size
+    rng = np.random.default_rng(h * 131 + w + qp)
+    y, u, v = synth(rng, h, w)
+    enc = H264Encoder(width=w, height=h, qp=qp)
+    frames = enc.encode(y[None], u[None], v[None])
+    # Reference reconstruction straight from the encoder.
+    out = encode_frame(pad_to_mb(y), pad_to_mb(u, 8), pad_to_mb(v, 8), qp=qp)
+    decoded, sps = decode_annexb(frames[0].annexb)
+    assert len(decoded) == 1
+    assert sps.width == w and sps.height == h
+    np.testing.assert_array_equal(decoded[0].y, np.asarray(out["recon_y"])[:h, :w])
+    np.testing.assert_array_equal(decoded[0].u, np.asarray(out["recon_u"])[: h // 2, : w // 2])
+    np.testing.assert_array_equal(decoded[0].v, np.asarray(out["recon_v"])[: h // 2, : w // 2])
+
+
+def test_avcc_sample_decode_batch():
+    h, w, qp = 64, 80, 28
+    rng = np.random.default_rng(7)
+    n = 4
+    ys = np.stack([synth(rng, h, w)[0] for _ in range(n)])
+    us = rng.integers(0, 256, (n, h // 2, w // 2)).astype(np.uint8)
+    vs = rng.integers(0, 256, (n, h // 2, w // 2)).astype(np.uint8)
+    enc = H264Encoder(width=w, height=h, qp=qp)
+    encoded = enc.encode(ys, us, vs)
+    dec = H264Decoder(avcc_config=enc.avcc_config)
+    frames = dec.decode_samples([f.avcc for f in encoded])
+    assert len(frames) == n
+    outs = [
+        encode_frame(pad_to_mb(ys[i]), pad_to_mb(us[i], 8), pad_to_mb(vs[i], 8), qp=qp)
+        for i in range(n)
+    ]
+    for i, fr in enumerate(frames):
+        np.testing.assert_array_equal(fr.y, np.asarray(outs[i]["recon_y"])[:h, :w])
+        np.testing.assert_array_equal(fr.u, np.asarray(outs[i]["recon_u"]))
+        np.testing.assert_array_equal(fr.v, np.asarray(outs[i]["recon_v"]))
+
+
+def test_single_sample_decode():
+    h, w, qp = 32, 32, 20
+    rng = np.random.default_rng(3)
+    y, u, v = synth(rng, h, w)
+    enc = H264Encoder(width=w, height=h, qp=qp)
+    [ef] = enc.encode(y[None], u[None], v[None])
+    dec = H264Decoder(avcc_config=enc.avcc_config)
+    fr = dec.decode_sample(ef.avcc)
+    out = encode_frame(y, u, v, qp=qp)
+    np.testing.assert_array_equal(fr.y, np.asarray(out["recon_y"]))
+
+
+def test_split_annexb_finds_all_nals():
+    enc = H264Encoder(width=32, height=32)
+    rng = np.random.default_rng(1)
+    y, u, v = synth(rng, 32, 32)
+    [ef] = enc.encode(y[None], u[None], v[None])
+    nals = split_annexb(ef.annexb)
+    assert [t for t, _, _ in nals] == [syntax.NAL_SPS, syntax.NAL_PPS, syntax.NAL_IDR]
+
+
+def test_cabac_stream_rejected():
+    from vlog_tpu.media.bitstream import BitWriter
+
+    w = BitWriter()
+    w.write_ue(0)   # pps_id
+    w.write_ue(0)   # sps_id
+    w.write_bit(1)  # entropy_coding_mode: CABAC
+    w.write_bit(0)
+    w.write_ue(0)
+    w.rbsp_trailing_bits()
+    with pytest.raises(UnsupportedStream):
+        parse_pps(w.getvalue())
+
+
+def test_flat_frame_roundtrip():
+    """All-flat frame: every AC level zero exercises the cbp=0 path."""
+    h = w = 48
+    y = np.full((h, w), 117, np.uint8)
+    u = np.full((h // 2, w // 2), 60, np.uint8)
+    v = np.full((h // 2, w // 2), 200, np.uint8)
+    enc = H264Encoder(width=w, height=h, qp=30)
+    [ef] = enc.encode(y[None], u[None], v[None])
+    decoded, _ = decode_annexb(ef.annexb)
+    out = encode_frame(y, u, v, qp=30)
+    np.testing.assert_array_equal(decoded[0].y, np.asarray(out["recon_y"]))
+    np.testing.assert_array_equal(decoded[0].u, np.asarray(out["recon_u"]))
+    np.testing.assert_array_equal(decoded[0].v, np.asarray(out["recon_v"]))

@@ -108,13 +108,14 @@ class H264Encoder:
                 idr=idr, idr_pic_id=fi % 2,
             )
             raw = nal.to_bytes()
+            # avc1 tracks carry parameter sets only in avcC (ISO 14496-15
+            # 5.3.3); the Annex-B dump repeats them in-band at each IDR.
             prefix = [self.sps, self.pps] if idr else []
-            avcc = b"".join(
-                len(p.to_bytes()).to_bytes(4, "big") + p.to_bytes()
-                for p in prefix
-            ) + len(raw).to_bytes(4, "big") + raw
+            avcc = len(raw).to_bytes(4, "big") + raw
             annexb = syntax.annexb(prefix + [nal])
-            err = (recon_y[i].astype(np.int64) - y[i].astype(np.int64))
+            vh, vw = self.height, self.width
+            err = (recon_y[i, :vh, :vw].astype(np.int64)
+                   - y[i, :vh, :vw].astype(np.int64))
             mse = float(np.mean(err * err))
             psnr = 99.0 if mse < 1e-9 else 10 * np.log10(255 ** 2 / mse)
             return EncodedFrame(avcc=avcc, annexb=annexb, is_idr=idr,

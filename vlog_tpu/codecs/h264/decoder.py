@@ -1,0 +1,686 @@
+"""H.264 intra decoder: CAVLC parse on host, reconstruction in JAX.
+
+The decode half of the transcode pipeline. The reference shells out to
+ffmpeg for decode (worker/transcoder.py:1006 runs one ffmpeg per quality,
+which internally decodes the source once per process); here decode is a
+first-party stage: NAL/slice parsing and CAVLC entropy decode run on the
+host (sequential bit work), and pixel reconstruction — dequantize, inverse
+transforms, intra prediction — runs as one XLA program per frame batch,
+the mirror image of ``encoder.encode_gop``.
+
+Scope: Constrained Baseline, all-intra, CAVLC, 4:2:0, frame MBs, the
+prediction-mode layout our encoder emits (MB row 0: Intra_16x16 DC +
+chroma DC; rows below: Intra_16x16 Vertical + chroma Vertical), deblocking
+off. Streams outside this envelope raise :class:`UnsupportedStream` — the
+backend layer treats that the way the reference treats an input ffmpeg
+cannot decode (transcoder.py:706-758 error path).
+
+Spec: ITU-T H.264 7.3 (syntax), 9.1 (Exp-Golomb), 9.2 (CAVLC), 8.3
+(intra prediction), 8.5 (transforms).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vlog_tpu.media.bitstream import BitReader, unescape_emulation
+from vlog_tpu.codecs.h264 import syntax
+from vlog_tpu.codecs.h264.cavlc_tables import (
+    CHROMA_DC_COEFF_TOKEN_BITS,
+    CHROMA_DC_COEFF_TOKEN_LEN,
+    CHROMA_DC_TOTAL_ZEROS_BITS,
+    CHROMA_DC_TOTAL_ZEROS_LEN,
+    COEFF_TOKEN_BITS,
+    COEFF_TOKEN_LEN,
+    LUMA_BLOCK_ORDER,
+    RUN_BEFORE_BITS,
+    RUN_BEFORE_LEN,
+    TOTAL_ZEROS_BITS,
+    TOTAL_ZEROS_LEN,
+    coeff_token_table,
+)
+from vlog_tpu.codecs.h264.cavlc import _ZZ_C, _ZZ_R, _nc
+from vlog_tpu.codecs.h264.encoder import chroma_qp
+from vlog_tpu.ops.transform import (
+    dequantize,
+    dequantize_chroma_dc,
+    dequantize_luma_dc,
+    inverse_core_transform,
+)
+
+
+class DecodeError(ValueError):
+    """Malformed bitstream."""
+
+
+class UnsupportedStream(DecodeError):
+    """Valid H.264, but outside this decoder's envelope."""
+
+
+# --------------------------------------------------------------------------
+# Inverse VLC tables: {(length, bits): value}, built once at import.
+# --------------------------------------------------------------------------
+
+def _invert(bits: np.ndarray, lens: np.ndarray) -> dict[tuple[int, int], int]:
+    out: dict[tuple[int, int], int] = {}
+    flat_b = np.asarray(bits).reshape(-1)
+    flat_l = np.asarray(lens).reshape(-1)
+    for idx in range(flat_b.shape[0]):
+        ln = int(flat_l[idx])
+        if ln > 0:
+            out[(ln, int(flat_b[idx]))] = idx
+    return out
+
+_COEFF_TOKEN_INV = [_invert(COEFF_TOKEN_BITS[t], COEFF_TOKEN_LEN[t]) for t in range(4)]
+_CHROMA_DC_COEFF_TOKEN_INV = _invert(CHROMA_DC_COEFF_TOKEN_BITS, CHROMA_DC_COEFF_TOKEN_LEN)
+_TOTAL_ZEROS_INV = [_invert(TOTAL_ZEROS_BITS[i], TOTAL_ZEROS_LEN[i]) for i in range(16)]
+_CHROMA_DC_TOTAL_ZEROS_INV = [
+    _invert(CHROMA_DC_TOTAL_ZEROS_BITS[i], CHROMA_DC_TOTAL_ZEROS_LEN[i]) for i in range(3)
+]
+_RUN_BEFORE_INV = [_invert(RUN_BEFORE_BITS[i], RUN_BEFORE_LEN[i]) for i in range(7)]
+
+
+def _read_vlc(r: BitReader, table: dict[tuple[int, int], int], what: str,
+              max_len: int = 16) -> int:
+    """Read one prefix-free codeword by extending bit by bit."""
+    bits = 0
+    for ln in range(1, max_len + 1):
+        bits = (bits << 1) | r.read_bit()
+        hit = table.get((ln, bits))
+        if hit is not None:
+            return hit
+    raise DecodeError(f"no {what} codeword within {max_len} bits")
+
+
+# --------------------------------------------------------------------------
+# High-level syntax parsing (inverse of syntax.py writers)
+# --------------------------------------------------------------------------
+
+def split_annexb(data: bytes) -> list[tuple[int, int, bytes]]:
+    """Annex-B stream -> [(nal_type, nal_ref_idc, rbsp)] (unescaped)."""
+    nals = []
+    i = 0
+    n = len(data)
+    starts = []
+    while i + 3 <= n:
+        if data[i:i + 3] == b"\x00\x00\x01":
+            starts.append(i + 3)
+            i += 3
+        else:
+            i += 1
+    for k, s in enumerate(starts):
+        end = n
+        if k + 1 < len(starts):
+            end = starts[k + 1] - 3
+            # 4-byte start codes leave one extra trailing zero
+            if end > s and data[end - 1] == 0:
+                end -= 1
+        raw = data[s:end]
+        if not raw:
+            continue
+        header = raw[0]
+        nals.append((header & 0x1F, (header >> 5) & 3, unescape_emulation(raw[1:])))
+    return nals
+
+
+def split_avcc(sample: bytes, length_size: int = 4) -> list[tuple[int, int, bytes]]:
+    """Length-prefixed (AVCC) sample -> [(nal_type, ref_idc, rbsp)]."""
+    nals = []
+    pos = 0
+    n = len(sample)
+    while pos + length_size <= n:
+        ln = int.from_bytes(sample[pos:pos + length_size], "big")
+        pos += length_size
+        if ln == 0 or pos + ln > n:
+            raise DecodeError("bad AVCC length field")
+        raw = sample[pos:pos + ln]
+        pos += ln
+        header = raw[0]
+        nals.append((header & 0x1F, (header >> 5) & 3, unescape_emulation(raw[1:])))
+    return nals
+
+
+@dataclass(frozen=True)
+class Sps:
+    profile_idc: int
+    level_idc: int
+    sps_id: int
+    log2_max_frame_num: int
+    pic_order_cnt_type: int
+    mb_width: int
+    mb_height: int
+    crop_left: int
+    crop_right: int
+    crop_top: int
+    crop_bottom: int
+
+    @property
+    def width(self) -> int:
+        return self.mb_width * 16 - 2 * (self.crop_left + self.crop_right)
+
+    @property
+    def height(self) -> int:
+        return self.mb_height * 16 - 2 * (self.crop_top + self.crop_bottom)
+
+
+@dataclass(frozen=True)
+class Pps:
+    pps_id: int
+    sps_id: int
+    entropy_coding_mode: int
+    init_qp: int
+    chroma_qp_index_offset: int
+    deblocking_filter_control_present: bool
+
+
+def parse_sps(rbsp: bytes) -> Sps:
+    r = BitReader(rbsp)
+    profile = r.read_bits(8)
+    r.read_bits(8)  # constraint flags + reserved
+    level = r.read_bits(8)
+    sps_id = r.read_ue()
+    if profile in (100, 110, 122, 244, 44, 83, 86, 118, 128):
+        chroma_format = r.read_ue()
+        if chroma_format == 3:
+            r.read_bit()
+        r.read_ue()  # bit_depth_luma_minus8
+        r.read_ue()  # bit_depth_chroma_minus8
+        r.read_bit()  # qpprime_y_zero_transform_bypass
+        if r.read_bit():  # seq_scaling_matrix_present
+            raise UnsupportedStream("scaling matrices not supported")
+        if chroma_format != 1:
+            raise UnsupportedStream("only 4:2:0 supported")
+    log2_mfn = r.read_ue() + 4
+    poc_type = r.read_ue()
+    if poc_type == 0:
+        r.read_ue()  # log2_max_pic_order_cnt_lsb_minus4
+    elif poc_type == 1:
+        r.read_bit()
+        r.read_se()
+        r.read_se()
+        for _ in range(r.read_ue()):
+            r.read_se()
+    r.read_ue()   # max_num_ref_frames
+    r.read_bit()  # gaps_in_frame_num_value_allowed
+    mbw = r.read_ue() + 1
+    mbh_units = r.read_ue() + 1
+    frame_mbs_only = r.read_bit()
+    if not frame_mbs_only:
+        raise UnsupportedStream("interlaced (field) coding not supported")
+    mbh = mbh_units
+    r.read_bit()  # direct_8x8_inference
+    crop = [0, 0, 0, 0]
+    if r.read_bit():
+        crop = [r.read_ue() for _ in range(4)]  # l, r, t, b
+    return Sps(profile, level, sps_id, log2_mfn, poc_type, mbw, mbh,
+               crop[0], crop[1], crop[2], crop[3])
+
+
+def parse_pps(rbsp: bytes) -> Pps:
+    r = BitReader(rbsp)
+    pps_id = r.read_ue()
+    sps_id = r.read_ue()
+    entropy = r.read_bit()
+    if entropy:
+        raise UnsupportedStream("CABAC not supported (CAVLC only)")
+    r.read_bit()  # bottom_field_pic_order_in_frame_present
+    if r.read_ue() != 0:
+        raise UnsupportedStream("slice groups not supported")
+    r.read_ue()   # num_ref_idx_l0
+    r.read_ue()   # num_ref_idx_l1
+    r.read_bit()  # weighted_pred
+    r.read_bits(2)
+    init_qp = r.read_se() + 26
+    r.read_se()   # pic_init_qs
+    chroma_qp_off = r.read_se()
+    if chroma_qp_off != 0:
+        raise UnsupportedStream("chroma_qp_index_offset != 0 not supported")
+    deblock_ctrl = bool(r.read_bit())
+    r.read_bit()  # constrained_intra_pred_flag (no effect on all-intra)
+    if r.read_bit():
+        raise UnsupportedStream("redundant_pic_cnt_present_flag not supported")
+    return Pps(pps_id, sps_id, entropy, init_qp, chroma_qp_off, deblock_ctrl)
+
+
+@dataclass
+class SliceHeader:
+    first_mb: int
+    slice_type: int
+    pps_id: int
+    frame_num: int
+    idr: bool
+    qp: int
+
+
+def parse_slice_header(r: BitReader, sps: Sps, pps: Pps, nal_type: int,
+                       nal_ref_idc: int) -> SliceHeader:
+    first_mb = r.read_ue()
+    slice_type = r.read_ue()
+    if slice_type % 5 != 2:
+        raise UnsupportedStream(f"only I slices supported (slice_type {slice_type})")
+    pps_id = r.read_ue()
+    frame_num = r.read_bits(sps.log2_max_frame_num)
+    idr = nal_type == syntax.NAL_IDR
+    if idr:
+        r.read_ue()  # idr_pic_id
+    if sps.pic_order_cnt_type != 2:
+        raise UnsupportedStream(
+            f"pic_order_cnt_type {sps.pic_order_cnt_type} not supported")
+    if nal_ref_idc != 0:
+        if idr:
+            r.read_bit()  # no_output_of_prior_pics
+            r.read_bit()  # long_term_reference
+        else:
+            if r.read_bit():
+                raise UnsupportedStream("adaptive ref pic marking not supported")
+    qp = pps.init_qp + r.read_se()
+    if pps.deblocking_filter_control_present:
+        idc = r.read_ue()
+        if idc != 1:
+            raise UnsupportedStream("in-loop deblocking not supported")
+    return SliceHeader(first_mb, slice_type, pps_id, frame_num, idr, qp)
+
+
+# --------------------------------------------------------------------------
+# CAVLC residual decode (inverse of cavlc.encode_residual_block)
+# --------------------------------------------------------------------------
+
+def decode_residual_block(r: BitReader, nc: int, max_coeff: int) -> np.ndarray:
+    """residual_block_cavlc (spec 9.2) -> coefficients in scan order."""
+    coeffs = np.zeros(max_coeff, np.int32)
+    if nc == -1:
+        idx = _read_vlc(r, _CHROMA_DC_COEFF_TOKEN_INV, "chroma coeff_token", 8)
+        total_coeff, trailing = idx >> 2, idx & 3
+    else:
+        tbl = coeff_token_table(nc)
+        idx = _read_vlc(r, _COEFF_TOKEN_INV[tbl], "coeff_token", 16)
+        total_coeff, trailing = idx >> 2, idx & 3
+    if total_coeff == 0:
+        return coeffs
+    if total_coeff > max_coeff:
+        raise DecodeError("TotalCoeff exceeds block size")
+
+    # Values, highest frequency first: trailing ±1s then coded levels.
+    values: list[int] = []
+    for _ in range(trailing):
+        values.append(-1 if r.read_bit() else 1)
+    suffix_len = 1 if (total_coeff > 10 and trailing < 3) else 0
+    for i in range(total_coeff - trailing):
+        prefix = 0
+        while r.read_bit() == 0:
+            prefix += 1
+            if prefix > 32:
+                raise DecodeError("level_prefix overflow")
+        if prefix <= 15:
+            if suffix_len == 0:
+                if prefix < 14:
+                    code = prefix
+                elif prefix == 14:
+                    code = 14 + r.read_bits(4)
+                else:
+                    code = 30 + r.read_bits(12)
+            else:
+                if prefix < 15:
+                    code = (prefix << suffix_len) + r.read_bits(suffix_len)
+                else:
+                    code = (15 << suffix_len) + r.read_bits(12)
+        else:
+            # spec 9.2.2.1: prefix >= 16 extends the escape range
+            code = (15 << max(suffix_len, 1)) + r.read_bits(prefix - 3)
+            code += (1 << (prefix - 3)) - 4096
+        if i == 0 and trailing < 3:
+            code += 2
+        level = (code + 2) >> 1 if code % 2 == 0 else -((code + 1) >> 1)
+        values.append(level)
+        if suffix_len == 0:
+            suffix_len = 1
+        if abs(level) > (3 << (suffix_len - 1)) and suffix_len < 6:
+            suffix_len += 1
+
+    # Positions: total_zeros + run_before.
+    if total_coeff < max_coeff:
+        if nc == -1:
+            total_zeros = _read_vlc(
+                r, _CHROMA_DC_TOTAL_ZEROS_INV[total_coeff - 1], "chroma total_zeros", 8)
+        else:
+            total_zeros = _read_vlc(
+                r, _TOTAL_ZEROS_INV[total_coeff - 1], "total_zeros", 9)
+    else:
+        total_zeros = 0
+
+    pos = total_coeff - 1 + total_zeros          # scan index of highest-freq coeff
+    zeros_left = total_zeros
+    for k, val in enumerate(values):
+        coeffs[pos] = val
+        if k == total_coeff - 1:
+            break
+        if zeros_left > 0:
+            run = _read_vlc(r, _RUN_BEFORE_INV[min(zeros_left, 7) - 1],
+                            "run_before", 11)
+        else:
+            run = 0
+        pos -= run + 1
+        zeros_left -= run
+        if pos < 0:
+            raise DecodeError("run_before underflow")
+    return coeffs
+
+
+def _unzigzag(scan: np.ndarray) -> np.ndarray:
+    block = np.zeros((4, 4), np.int32)
+    block[_ZZ_R, _ZZ_C] = scan
+    return block
+
+
+# --------------------------------------------------------------------------
+# Slice decode -> levels arrays (mirror of cavlc.SliceEncoder)
+# --------------------------------------------------------------------------
+
+# Intra16x16 pred modes by position in our layout (see encoder.py docstring)
+_ROW0_LUMA_MODE, _ROW0_CHROMA_MODE = 2, 0       # DC
+_BODY_LUMA_MODE, _BODY_CHROMA_MODE = 0, 2       # Vertical
+
+
+def decode_slice_data(r: BitReader, sps: Sps, header: SliceHeader) -> dict:
+    """Decode one full-frame I slice into levels arrays.
+
+    Verifies the prediction-mode layout matches the vertical-scan envelope
+    the JAX reconstruction implements.
+    """
+    mbh, mbw = sps.mb_height, sps.mb_width
+    if header.first_mb != 0:
+        raise UnsupportedStream("multi-slice pictures not supported")
+    luma_dc = np.zeros((mbh, mbw, 4, 4), np.int32)
+    luma_ac = np.zeros((mbh, mbw, 4, 4, 4, 4), np.int32)
+    chroma_dc = np.zeros((2, mbh, mbw, 2, 2), np.int32)
+    chroma_ac = np.zeros((2, mbh, mbw, 2, 2, 4, 4), np.int32)
+    nz_luma = np.zeros((mbh * 4, mbw * 4), np.int32)
+    nz_chroma = np.zeros((2, mbh * 2, mbw * 2), np.int32)
+    nc_of = _nc
+
+    for my in range(mbh):
+        for mx in range(mbw):
+            mb_type = r.read_ue()
+            if not 1 <= mb_type <= 24:
+                raise UnsupportedStream(f"mb_type {mb_type} (not I_16x16)")
+            t = mb_type - 1
+            luma_mode = t % 4
+            cbp_chroma = (t // 4) % 3
+            cbp_luma = 15 if t >= 12 else 0
+            chroma_mode = r.read_ue()
+            exp_luma = _ROW0_LUMA_MODE if my == 0 else _BODY_LUMA_MODE
+            exp_chroma = _ROW0_CHROMA_MODE if my == 0 else _BODY_CHROMA_MODE
+            if luma_mode != exp_luma or chroma_mode != exp_chroma:
+                raise UnsupportedStream(
+                    f"prediction layout mismatch at MB ({my},{mx}): "
+                    f"luma {luma_mode}/{exp_luma} chroma {chroma_mode}/{exp_chroma}")
+            if r.read_se() != 0:
+                raise UnsupportedStream("mb_qp_delta != 0 not supported")
+
+            gy, gx = my * 4, mx * 4
+            nc = nc_of(gx > 0, int(nz_luma[gy, gx - 1]),
+                       gy > 0, int(nz_luma[gy - 1, gx]))
+            luma_dc[my, mx] = _unzigzag(decode_residual_block(r, nc, 16))
+
+            if cbp_luma:
+                for by, bx in LUMA_BLOCK_ORDER:
+                    y, x = gy + by, gx + bx
+                    nc = nc_of(x > 0, int(nz_luma[y, x - 1]),
+                               y > 0, int(nz_luma[y - 1, x]))
+                    scan15 = decode_residual_block(r, nc, 15)
+                    full = np.zeros(16, np.int32)
+                    full[1:] = scan15
+                    luma_ac[my, mx, by, bx] = _unzigzag(full)
+                    nz_luma[y, x] = int(np.count_nonzero(scan15))
+
+            if cbp_chroma > 0:
+                for comp in range(2):
+                    dc = decode_residual_block(r, -1, 4)
+                    chroma_dc[comp, my, mx] = dc.reshape(2, 2)
+
+            if cbp_chroma == 2:
+                cy, cx = my * 2, mx * 2
+                for comp in range(2):
+                    for by in range(2):
+                        for bx in range(2):
+                            y, x = cy + by, cx + bx
+                            nc = nc_of(x > 0, int(nz_chroma[comp, y, x - 1]),
+                                       y > 0, int(nz_chroma[comp, y - 1, x]))
+                            scan15 = decode_residual_block(r, nc, 15)
+                            full = np.zeros(16, np.int32)
+                            full[1:] = scan15
+                            chroma_ac[comp, my, mx, by, bx] = _unzigzag(full)
+                            nz_chroma[comp, y, x] = int(np.count_nonzero(scan15))
+    return {
+        "luma_dc": luma_dc, "luma_ac": luma_ac,
+        "chroma_dc": chroma_dc, "chroma_ac": chroma_ac,
+    }
+
+
+# --------------------------------------------------------------------------
+# Reconstruction (JAX) — mirror of encoder.encode_frame's recon path
+# --------------------------------------------------------------------------
+
+def _luma_resid(dc_levels, ac_levels, qp: int):
+    """Levels -> spatial residual rows. dc (mbh,mbw,4,4), ac (mbh,mbw,4,4,4,4)."""
+    dc_rec = dequantize_luma_dc(dc_levels, qp=qp)
+    ac_rec = dequantize(ac_levels, qp=qp)
+    full = ac_rec.at[..., 0, 0].set(dc_rec)
+    resid = inverse_core_transform(full)               # (mbh, mbw, 4, 4, 4, 4)
+    mbh, mbw = resid.shape[0], resid.shape[1]
+    mb = jnp.swapaxes(resid, 3, 4).reshape(mbh, mbw, 16, 16)
+    return jnp.swapaxes(mb, 1, 2).reshape(mbh, 16, mbw * 16)   # (mbh, 16, W)
+
+
+def _chroma_resid(dc_levels, ac_levels, qpc: int):
+    """dc (mbh,mbw,2,2), ac (mbh,mbw,2,2,4,4) -> (mbh, 8, Wc)."""
+    dc_rec = dequantize_chroma_dc(dc_levels, qp=qpc)
+    ac_rec = dequantize(ac_levels, qp=qpc)
+    full = ac_rec.at[..., 0, 0].set(dc_rec)
+    resid = inverse_core_transform(full)               # (mbh, mbw, 2, 2, 4, 4)
+    mbh, mbw = resid.shape[0], resid.shape[1]
+    mb = jnp.swapaxes(resid, 3, 4).reshape(mbh, mbw, 8, 8)
+    return jnp.swapaxes(mb, 1, 2).reshape(mbh, 8, mbw * 8)
+
+
+@functools.partial(jax.jit, static_argnames=("qp",))
+def reconstruct_frame(levels: dict, *, qp: int):
+    """Levels dict (numpy/jnp arrays) -> (y, u, v) uint8 planes (padded size)."""
+    qpc = chroma_qp(qp)
+    luma_dc = jnp.asarray(levels["luma_dc"], jnp.int32)
+    luma_ac = jnp.asarray(levels["luma_ac"], jnp.int32)
+    chroma_dc = jnp.asarray(levels["chroma_dc"], jnp.int32)
+    chroma_ac = jnp.asarray(levels["chroma_ac"], jnp.int32)
+    mbh, mbw = luma_dc.shape[0], luma_dc.shape[1]
+    w = mbw * 16
+
+    y_resid = _luma_resid(luma_dc, luma_ac, qp)                  # (mbh, 16, W)
+    u_resid = _chroma_resid(chroma_dc[0], chroma_ac[0], qpc)     # (mbh, 8, W/2)
+    v_resid = _chroma_resid(chroma_dc[1], chroma_ac[1], qpc)
+
+    # --- Row 0: DC prediction with left-neighbour carry (scan over x).
+    def row0_step(carry, xs):
+        ly, lu, lv = carry
+        yr, ur, vr, is_first = xs                 # per-MB residual slabs
+        pred_dc = jnp.where(is_first, 128, (jnp.sum(ly) + 8) >> 4)
+        yrec = jnp.clip(pred_dc + yr, 0, 255)
+        top = (jnp.sum(lu[:4]) + 2) >> 2
+        bot = (jnp.sum(lu[4:]) + 2) >> 2
+        ucol = jnp.where(is_first, 128,
+                         jnp.concatenate([jnp.full((4,), top), jnp.full((4,), bot)]))
+        urec = jnp.clip(ucol[:, None] + ur, 0, 255)
+        topv = (jnp.sum(lv[:4]) + 2) >> 2
+        botv = (jnp.sum(lv[4:]) + 2) >> 2
+        vcol = jnp.where(is_first, 128,
+                         jnp.concatenate([jnp.full((4,), topv), jnp.full((4,), botv)]))
+        vrec = jnp.clip(vcol[:, None] + vr, 0, 255)
+        return (yrec[:, -1], urec[:, -1], vrec[:, -1]), (yrec, urec, vrec)
+
+    y0_mbs = jnp.swapaxes(y_resid[0].reshape(16, mbw, 16), 0, 1)
+    u0_mbs = jnp.swapaxes(u_resid[0].reshape(8, mbw, 8), 0, 1)
+    v0_mbs = jnp.swapaxes(v_resid[0].reshape(8, mbw, 8), 0, 1)
+    first = jnp.zeros((mbw,), jnp.bool_).at[0].set(True)
+    init = (jnp.full((16,), 128, jnp.int32), jnp.full((8,), 128, jnp.int32),
+            jnp.full((8,), 128, jnp.int32))
+    _, (y0, u0, v0) = jax.lax.scan(row0_step, init, (y0_mbs, u0_mbs, v0_mbs, first))
+    y0 = jnp.swapaxes(y0, 0, 1).reshape(16, w)
+    u0 = jnp.swapaxes(u0, 0, 1).reshape(8, w // 2)
+    v0 = jnp.swapaxes(v0, 0, 1).reshape(8, w // 2)
+
+    if mbh == 1:
+        return (y0.astype(jnp.uint8), u0.astype(jnp.uint8), v0.astype(jnp.uint8))
+
+    # --- Rows 1..mbh-1: vertical prediction, scan over rows.
+    def body_step(carry, xs):
+        py, pu, pv = carry
+        yr, ur, vr = xs
+        yrec = jnp.clip(py[None, :] + yr, 0, 255)
+        urec = jnp.clip(pu[None, :] + ur, 0, 255)
+        vrec = jnp.clip(pv[None, :] + vr, 0, 255)
+        return (yrec[-1], urec[-1], vrec[-1]), (yrec, urec, vrec)
+
+    init = (y0[-1], u0[-1], v0[-1])
+    _, (yb, ub, vb) = jax.lax.scan(
+        body_step, init, (y_resid[1:], u_resid[1:], v_resid[1:]))
+    y = jnp.concatenate([y0, yb.reshape((mbh - 1) * 16, w)])
+    u = jnp.concatenate([u0, ub.reshape((mbh - 1) * 8, w // 2)])
+    v = jnp.concatenate([v0, vb.reshape((mbh - 1) * 8, w // 2)])
+    return (y.astype(jnp.uint8), u.astype(jnp.uint8), v.astype(jnp.uint8))
+
+
+# Batched reconstruction over a GOP of frames (stacked levels arrays).
+@functools.partial(jax.jit, static_argnames=("qp",))
+def reconstruct_gop(levels: dict, *, qp: int):
+    return jax.vmap(lambda l: reconstruct_frame(l, qp=qp))(levels)
+
+
+# --------------------------------------------------------------------------
+# Decoder object
+# --------------------------------------------------------------------------
+
+@dataclass
+class DecodedFrame:
+    y: np.ndarray
+    u: np.ndarray
+    v: np.ndarray
+
+
+class H264Decoder:
+    """Stateful decoder: feed NALs (AnnexB chunks or AVCC samples), get frames.
+
+    Cropping from the SPS is applied; output planes are (h, w), (h/2, w/2).
+    """
+
+    def __init__(self, avcc_config: bytes | None = None):
+        self.sps: Sps | None = None
+        self.pps: Pps | None = None
+        self._length_size = 4
+        if avcc_config:
+            self._parse_avcc_config(avcc_config)
+
+    def _parse_avcc_config(self, cfg: bytes) -> None:
+        """AVCDecoderConfigurationRecord (ISO 14496-15 5.3.3.1)."""
+        if len(cfg) < 7 or cfg[0] != 1:
+            raise DecodeError("bad avcC")
+        self._length_size = (cfg[4] & 3) + 1
+        pos = 5
+        n_sps = cfg[pos] & 0x1F
+        pos += 1
+        for _ in range(n_sps):
+            ln = int.from_bytes(cfg[pos:pos + 2], "big")
+            pos += 2
+            self._handle_nal(cfg[pos] & 0x1F, unescape_emulation(cfg[pos + 1:pos + ln]))
+            pos += ln
+        n_pps = cfg[pos]
+        pos += 1
+        for _ in range(n_pps):
+            ln = int.from_bytes(cfg[pos:pos + 2], "big")
+            pos += 2
+            self._handle_nal(cfg[pos] & 0x1F, unescape_emulation(cfg[pos + 1:pos + ln]))
+            pos += ln
+
+    def _handle_nal(self, nal_type: int, rbsp: bytes) -> None:
+        if nal_type == syntax.NAL_SPS:
+            self.sps = parse_sps(rbsp)
+        elif nal_type == syntax.NAL_PPS:
+            self.pps = parse_pps(rbsp)
+
+    def _decode_slice_nal(self, nal_type: int, ref_idc: int, rbsp: bytes) -> dict:
+        if self.sps is None or self.pps is None:
+            raise DecodeError("slice before SPS/PPS")
+        r = BitReader(rbsp)
+        header = parse_slice_header(r, self.sps, self.pps, nal_type, ref_idc)
+        levels = decode_slice_data(r, self.sps, header)
+        levels["qp"] = header.qp
+        return levels
+
+    def decode_sample_levels(self, sample: bytes) -> dict | None:
+        """AVCC sample -> levels dict (host arrays), or None if no slice."""
+        for nal_type, ref_idc, rbsp in split_avcc(sample, self._length_size):
+            if nal_type in (syntax.NAL_SLICE, syntax.NAL_IDR):
+                return self._decode_slice_nal(nal_type, ref_idc, rbsp)
+            self._handle_nal(nal_type, rbsp)
+        return None
+
+    def _crop(self, y, u, v) -> DecodedFrame:
+        sps = self.sps
+        w, h = sps.width, sps.height
+        return DecodedFrame(
+            np.asarray(y)[:h, :w],
+            np.asarray(u)[:h // 2, :w // 2],
+            np.asarray(v)[:h // 2, :w // 2],
+        )
+
+    def decode_sample(self, sample: bytes) -> DecodedFrame | None:
+        levels = self.decode_sample_levels(sample)
+        if levels is None:
+            return None
+        qp = levels.pop("qp")
+        y, u, v = reconstruct_frame(levels, qp=qp)
+        return self._crop(y, u, v)
+
+    def decode_samples(self, samples: list[bytes]) -> list[DecodedFrame]:
+        """Batched decode: CAVLC parse per sample on host, one device
+        dispatch reconstructs the whole batch (frames must share QP)."""
+        all_levels = []
+        for s in samples:
+            lv = self.decode_sample_levels(s)
+            if lv is not None:
+                all_levels.append(lv)
+        if not all_levels:
+            return []
+        qps = {lv["qp"] for lv in all_levels}
+        if len(qps) == 1:
+            qp = qps.pop()
+            stacked = {
+                k: np.stack([lv[k] for lv in all_levels])
+                for k in ("luma_dc", "luma_ac", "chroma_dc", "chroma_ac")
+            }
+            ys, us, vs = reconstruct_gop(stacked, qp=qp)
+            return [self._crop(ys[i], us[i], vs[i]) for i in range(len(all_levels))]
+        return [
+            self._crop(*reconstruct_frame(
+                {k: lv[k] for k in ("luma_dc", "luma_ac", "chroma_dc", "chroma_ac")},
+                qp=lv["qp"]))
+            for lv in all_levels
+        ]
+
+
+def decode_annexb(data: bytes) -> tuple[list[DecodedFrame], Sps | None]:
+    """Decode a full Annex-B elementary stream (e.g. a .h264 dump)."""
+    dec = H264Decoder()
+    frames: list[DecodedFrame] = []
+    for nal_type, ref_idc, rbsp in split_annexb(data):
+        if nal_type in (syntax.NAL_SLICE, syntax.NAL_IDR):
+            levels = dec._decode_slice_nal(nal_type, ref_idc, rbsp)
+            qp = levels.pop("qp")
+            y, u, v = reconstruct_frame(levels, qp=qp)
+            frames.append(dec._crop(y, u, v))
+        else:
+            dec._handle_nal(nal_type, rbsp)
+    return frames, dec.sps
