@@ -1,0 +1,10 @@
+"""Baseline JFIF JPEG encoder (thumbnails, sprite sheets).
+
+The reference produced thumbnails and sprite tiles with ffmpeg's mjpeg
+encoder (worker/transcoder.py:2247-2259 thumbnail, worker/
+sprite_generator.py:306-421 ``tile=10x10`` sprite pass); here the DCT +
+quantization run batched on the TPU and Huffman entropy coding runs on
+the host.
+"""
+
+from vlog_tpu.codecs.jpeg.encoder import encode_jpeg_rgb, encode_jpeg_yuv420  # noqa: F401
