@@ -1,0 +1,179 @@
+"""Accelerator boundary: backend protocol, capability model, registry.
+
+This is the keystone seam of the framework — the analog of the
+reference's ``worker/hwaccel.py`` (detect_gpu_capabilities:412,
+select_encoder:454, build_transcode_command:647). Where the reference
+maps (codec, resolution) to an ffmpeg command line for NVENC/VAAPI/CPU,
+here a :class:`Backend` maps a source + ladder to an executable plan and
+runs it. Registering a new accelerator is one ``register_backend`` call;
+the worker runtime, job gating, and APIs never import a concrete backend.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Protocol
+
+from vlog_tpu import config
+from vlog_tpu.media.probe import VideoInfo
+
+
+@dataclass(frozen=True)
+class Capabilities:
+    """What an accelerator can do (reference: GPUCapabilities hwaccel.py:67
+    + get_worker_capabilities:1050)."""
+
+    backend: str                       # registry name, e.g. "jax"
+    device_kind: str                   # "tpu" | "cpu" | "gpu"
+    device_count: int
+    codecs: tuple[str, ...]            # encodeable codecs
+    decode_codecs: tuple[str, ...]     # decodeable codecs
+    max_parallel_jobs: int = 1
+    memory_bytes: int | None = None
+    details: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "backend": self.backend,
+            "device_kind": self.device_kind,
+            "device_count": self.device_count,
+            "codecs": list(self.codecs),
+            "decode_codecs": list(self.decode_codecs),
+            "max_parallel_jobs": self.max_parallel_jobs,
+            "memory_bytes": self.memory_bytes,
+            **self.details,
+        }
+
+
+@dataclass(frozen=True)
+class PlannedRung:
+    """One ladder rung with resolved output geometry."""
+
+    name: str
+    width: int
+    height: int
+    video_bitrate: int
+    qp: int
+    codec: str = "h264"
+
+
+@dataclass
+class ExecutionPlan:
+    """Everything the backend needs to run one transcode job.
+
+    The analog of the ffmpeg command lines built by
+    build_cmaf_transcode_command (hwaccel.py:732) — but as data, so it can
+    be inspected, checkpointed, and resumed.
+    """
+
+    source: VideoInfo
+    rungs: tuple[PlannedRung, ...]
+    out_dir: Path
+    segment_duration_s: float = 6.0
+    frame_batch: int = 8
+    fps_num: int = 30
+    fps_den: int = 1
+    total_frames: int = 0
+    streaming_format: str = "cmaf"     # "cmaf" (fMP4) for now
+    thumbnail: bool = True
+
+
+@dataclass
+class RungResult:
+    name: str
+    width: int
+    height: int
+    codec_string: str
+    segment_count: int
+    bytes_written: int
+    mean_psnr_y: float
+    achieved_bitrate: int
+    playlist_path: str
+
+
+@dataclass
+class RunResult:
+    rungs: list[RungResult]
+    frames_processed: int
+    duration_s: float
+    thumbnail_path: str | None = None
+    wall_s: float = 0.0
+
+
+# progress_cb(frames_done, frames_total, message)
+ProgressFn = Callable[[int, int, str], None]
+
+
+class Backend(Protocol):
+    """Accelerator backend protocol (hwaccel.py:412-839 analog)."""
+
+    name: str
+
+    def detect(self) -> Capabilities: ...
+
+    def plan(self, source: VideoInfo, rungs, out_dir: Path, **opts) -> ExecutionPlan: ...
+
+    def run(self, plan: ExecutionPlan, progress_cb: ProgressFn | None = None,
+            *, resume: bool = True) -> RunResult: ...
+
+
+# --------------------------------------------------------------------------
+# Registry (hwaccel.py:454 select_encoder analog)
+# --------------------------------------------------------------------------
+
+_REGISTRY: dict[str, Callable[[], Backend]] = {}
+
+
+def register_backend(name: str, factory: Callable[[], Backend]) -> None:
+    _REGISTRY[name] = factory
+
+
+def available_backends() -> list[str]:
+    return list(_REGISTRY)
+
+
+def get_backend(name: str) -> Backend:
+    try:
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown backend {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+    return factory()
+
+
+def select_backend(preference: str | None = None) -> Backend:
+    """Pick the best available backend.
+
+    Preference order mirrors the reference's GPU-over-CPU encoder
+    selection (hwaccel.py:454-481): explicit preference, then whichever
+    registered backend reports TPU devices, then anything.
+    """
+    if preference:
+        return get_backend(preference)
+    best = None
+    for name in _REGISTRY:
+        b = get_backend(name)
+        caps = b.detect()
+        if caps.device_kind == "tpu":
+            return b
+        if best is None:
+            best = b
+    if best is None:
+        raise RuntimeError("no backends registered")
+    return best
+
+
+def plan_rung_geometry(src_w: int, src_h: int, rung: config.QualityRung,
+                      codec: str = "h264") -> PlannedRung:
+    """Resolve output geometry for one rung: height from the ladder, width
+    follows the source aspect ratio, rounded to even (mod-2, as the
+    reference's scale filters do)."""
+    h = min(rung.height, src_h if src_h % 2 == 0 else src_h - 1)
+    h = h - (h % 2)
+    w = round(src_w * h / src_h / 2) * 2 if src_h else h * 16 // 9
+    return PlannedRung(
+        name=rung.name, width=max(w, 2), height=max(h, 2),
+        video_bitrate=rung.video_bitrate, qp=rung.base_qp, codec=codec,
+    )

@@ -1,0 +1,331 @@
+"""The JAX/XLA ladder backend — decode once, emit every rung in one pass.
+
+This is the ``device=tpu`` encoder the accelerator boundary selects,
+replacing the reference's one-ffmpeg-process-per-rung scheme
+(worker/transcoder.py:2528-2559 parallel batches; worker/hwaccel.py:647
+command builder). Pipeline per frame batch:
+
+  host decode (source.py) -> device: ladder resize (MXU matmuls,
+  ops/resize.py) -> device: per-rung intra encode (encoder.encode_gop)
+  -> host: CAVLC entropy + fMP4 packaging (threads, overlapped with the
+  next batch's device work)
+
+Segments are cut at whole-second boundaries (all frames are IDR-capable,
+so any boundary is a valid CMAF chunk start). Output layout per rung:
+
+    {out}/{rung}/init.mp4
+    {out}/{rung}/segment_%05d.m4s
+    {out}/{rung}/playlist.m3u8
+
+matching what media.hls.dash_manifest expects and what the reference's
+validate_hls_playlist checks (transcoder.py:816-947).
+
+Resume: an interrupted run restarts at the first segment index any rung
+is missing (quality_progress semantics, reference database.py:209-248) —
+GOP-chunked execution keeps checkpoint granularity even though a single
+XLA dispatch is not interruptible (SURVEY.md section 7 hard part #3).
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import numpy as np
+
+from vlog_tpu import config
+from vlog_tpu.backends.base import (
+    Capabilities,
+    ExecutionPlan,
+    PlannedRung,
+    ProgressFn,
+    RungResult,
+    RunResult,
+    plan_rung_geometry,
+    register_backend,
+)
+from vlog_tpu.backends.source import open_source
+from vlog_tpu.codecs.h264.api import H264Encoder
+from vlog_tpu.codecs.jpeg import encode_jpeg_yuv420
+from vlog_tpu.media import hls
+from vlog_tpu.media.fmp4 import Sample, TrackConfig, avc1_sample_entry, init_segment, media_segment
+from vlog_tpu.media.probe import VideoInfo
+from vlog_tpu.ops.colorspace import yuv420_to_rgb
+from vlog_tpu.ops.resize import resize_yuv420
+
+
+class JaxBackend:
+    """Runs the one-pass ladder on whatever devices JAX exposes."""
+
+    name = "jax"
+
+    def detect(self) -> Capabilities:
+        import jax
+
+        devices = jax.devices()
+        kind = devices[0].platform if devices else "cpu"
+        if kind not in ("cpu", "gpu", "tpu"):
+            # experimental platform names (e.g. the axon TPU tunnel) still
+            # expose TPU-class devices
+            kind = "tpu" if "tpu" in str(devices[0]).lower() else kind
+        mem = None
+        try:
+            stats = devices[0].memory_stats()
+            if stats:
+                mem = stats.get("bytes_limit")
+        except Exception:
+            pass
+        return Capabilities(
+            backend=self.name,
+            device_kind=kind,
+            device_count=len(devices),
+            codecs=("h264",),
+            decode_codecs=("h264", "raw"),
+            max_parallel_jobs=1,
+            memory_bytes=mem,
+            details={"devices": [str(d) for d in devices]},
+        )
+
+    # ------------------------------------------------------------------
+    def plan(self, source: VideoInfo, rungs=None, out_dir: Path | str = ".",
+             **opts) -> ExecutionPlan:
+        if rungs is None:
+            rungs = config.ladder_for_source(source.height)
+        planned = tuple(
+            plan_rung_geometry(source.width, source.height, r) for r in rungs
+        )
+        from vlog_tpu.media.y4m import fps_to_fraction
+
+        fps_num, fps_den = fps_to_fraction(source.fps or 30.0)
+        return ExecutionPlan(
+            source=source,
+            rungs=planned,
+            out_dir=Path(out_dir),
+            segment_duration_s=opts.get("segment_duration_s", config.SEGMENT_DURATION_S),
+            frame_batch=opts.get("frame_batch", config.TPU_FRAME_BATCH),
+            fps_num=fps_num,
+            fps_den=fps_den,
+            total_frames=source.frame_count,
+            thumbnail=opts.get("thumbnail", True),
+        )
+
+    # ------------------------------------------------------------------
+    def run(self, plan: ExecutionPlan, progress_cb: ProgressFn | None = None,
+            *, resume: bool = True) -> RunResult:
+        t0 = time.monotonic()
+        out = plan.out_dir
+        out.mkdir(parents=True, exist_ok=True)
+
+        fps = plan.fps_num / plan.fps_den
+        frames_per_seg = max(1, round(plan.segment_duration_s * fps))
+        timescale = plan.fps_num * 1000
+        frame_dur = plan.fps_den * 1000
+
+        encoders: dict[str, H264Encoder] = {}
+        tracks: dict[str, TrackConfig] = {}
+        seg_counts: dict[str, int] = {}
+        seg_durs: dict[str, list[float]] = {}
+        bytes_written: dict[str, int] = {}
+        psnr_acc: dict[str, list[float]] = {}
+        for rung in plan.rungs:
+            enc = H264Encoder(width=rung.width, height=rung.height,
+                              fps_num=plan.fps_num, fps_den=plan.fps_den,
+                              qp=rung.qp)
+            encoders[rung.name] = enc
+            tracks[rung.name] = TrackConfig(
+                track_id=1, handler="vide", timescale=timescale,
+                sample_entry=avc1_sample_entry(rung.width, rung.height,
+                                               enc.avcc_config),
+                width=rung.width, height=rung.height,
+            )
+            rdir = out / rung.name
+            rdir.mkdir(parents=True, exist_ok=True)
+            (rdir / "init.mp4").write_bytes(init_segment(tracks[rung.name]))
+            seg_counts[rung.name] = 0
+            seg_durs[rung.name] = []
+            bytes_written[rung.name] = 0
+            psnr_acc[rung.name] = []
+
+        # --- resume point: first segment index any rung is missing.
+        start_segment = 0
+        if resume:
+            per_rung = {r.name: self._existing_segments(out / r.name)
+                        for r in plan.rungs}
+            start_segment = min(len(d) for d in per_rung.values())
+            for rung in plan.rungs:
+                durs = per_rung[rung.name][:start_segment]
+                seg_counts[rung.name] = start_segment
+                seg_durs[rung.name] = [d / timescale for d in durs]
+                for i in range(start_segment):
+                    seg = out / rung.name / f"segment_{i + 1:05d}.m4s"
+                    bytes_written[rung.name] += seg.stat().st_size
+        start_frame = start_segment * frames_per_seg
+
+        src = open_source(plan.source.path)
+        total = src.frame_count
+        pending: dict[str, list[Sample]] = {r.name: [] for r in plan.rungs}
+        frames_done = start_frame
+        thumb_path = None
+
+        # Entropy/packaging pool: overlaps host bit-packing of rung A with
+        # device compute of rung B (the reference's pipeline parallelism,
+        # SURVEY.md 2d.3).
+        pool = ThreadPoolExecutor(max_workers=max(4, len(plan.rungs)))
+        try:
+            for by, bu, bv in src.read_batches(plan.frame_batch, start_frame):
+                n = by.shape[0]
+                # Thumbnail from the first batch (reference grabs an early
+                # frame, transcoder.py:2247).
+                if plan.thumbnail and thumb_path is None:
+                    thumb_path = str(out / "thumbnail.jpg")
+                    self._write_thumbnail(by[0], bu[0], bv[0], thumb_path)
+
+                futures = []
+                for rung in plan.rungs:
+                    ry, ru, rv = resize_yuv420(
+                        by, bu, bv, rung.height, rung.width)
+                    enc = encoders[rung.name]
+                    futures.append((rung, pool.submit(
+                        enc.encode, np.asarray(ry), np.asarray(ru),
+                        np.asarray(rv))))
+                for rung, fut in futures:
+                    for ef in fut.result():
+                        pending[rung.name].append(
+                            Sample(data=ef.avcc, duration=frame_dur,
+                                   is_sync=ef.is_idr))
+                        psnr_acc[rung.name].append(ef.psnr_y)
+                    while len(pending[rung.name]) >= frames_per_seg:
+                        chunk = pending[rung.name][:frames_per_seg]
+                        pending[rung.name] = pending[rung.name][frames_per_seg:]
+                        self._write_segment(out, rung, tracks[rung.name],
+                                            seg_counts, seg_durs,
+                                            bytes_written, chunk, timescale)
+                frames_done += n
+                if progress_cb:
+                    progress_cb(frames_done, total,
+                                f"encoded {frames_done}/{total} frames")
+            # Flush trailing partial segments.
+            for rung in plan.rungs:
+                if pending[rung.name]:
+                    self._write_segment(out, rung, tracks[rung.name],
+                                        seg_counts, seg_durs, bytes_written,
+                                        pending[rung.name], timescale)
+                    pending[rung.name] = []
+        finally:
+            pool.shutdown(wait=True)
+            src.close()
+
+        duration_s = total / fps if fps else 0.0
+        results = []
+        variants = []
+        for rung in plan.rungs:
+            name = rung.name
+            enc = encoders[name]
+            playlist = hls.media_playlist(
+                [hls.SegmentRef(uri=f"segment_{i + 1:05d}.m4s",
+                                duration_s=seg_durs[name][i])
+                 for i in range(seg_counts[name])],
+                target_duration_s=plan.segment_duration_s,
+                init_uri="init.mp4",
+            )
+            ppath = out / name / "playlist.m3u8"
+            ppath.write_text(playlist)
+            total_dur = sum(seg_durs[name])
+            achieved = int(bytes_written[name] * 8 / total_dur) if total_dur else 0
+            results.append(RungResult(
+                name=name, width=rung.width, height=rung.height,
+                codec_string=enc.codec_string,
+                segment_count=seg_counts[name],
+                bytes_written=bytes_written[name],
+                mean_psnr_y=float(np.mean(psnr_acc[name])) if psnr_acc[name] else 0.0,
+                achieved_bitrate=achieved,
+                playlist_path=str(ppath),
+            ))
+            variants.append(hls.VariantRef(
+                name=name, uri=f"{name}/playlist.m3u8",
+                bandwidth=max(achieved, 1), width=rung.width,
+                height=rung.height, codecs=enc.codec_string,
+                frame_rate=fps,
+            ))
+        (out / "master.m3u8").write_text(hls.master_playlist(variants))
+        (out / "manifest.mpd").write_text(hls.dash_manifest(
+            variants, duration_s=duration_s,
+            segment_duration_s=plan.segment_duration_s))
+
+        return RunResult(
+            rungs=results, frames_processed=frames_done,
+            duration_s=duration_s, thumbnail_path=thumb_path,
+            wall_s=time.monotonic() - t0,
+        )
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _existing_segments(rdir: Path) -> list[int]:
+        """Timescale durations of contiguous valid segments (resume state).
+
+        A segment counts only if its moof parses and carries samples —
+        the on-disk-validation analog of validate_hls_playlist's fMP4
+        ``moof`` check (reference transcoder.py:930-941).
+        """
+        from vlog_tpu.media.boxes import parse_box_tree
+
+        durations: list[int] = []
+        if not (rdir / "init.mp4").exists():
+            return durations
+        i = 0
+        while True:
+            seg = rdir / f"segment_{i + 1:05d}.m4s"
+            if not seg.exists() or seg.stat().st_size < 16:
+                break
+            try:
+                with open(seg, "rb") as fp:
+                    tree = parse_box_tree(fp)
+                moof = next(b for b in tree if b.type == "moof")
+                trun = moof.find("traf", "trun")
+                n = int.from_bytes(trun.payload[4:8], "big")
+                if n == 0:
+                    break
+                # trun payload: ver/flags, count, data_offset, then
+                # (duration, size, flags, cts) per sample
+                dur = sum(
+                    int.from_bytes(trun.payload[12 + 16 * k:16 + 16 * k], "big")
+                    for k in range(n)
+                )
+            except (StopIteration, AttributeError, ValueError, IndexError):
+                break  # torn write
+            durations.append(dur)
+            i += 1
+        return durations
+
+    def _write_segment(self, out, rung: PlannedRung, track: TrackConfig,
+                       seg_counts, seg_durs, bytes_written,
+                       samples: list[Sample], timescale: int) -> None:
+        name = rung.name
+        idx = seg_counts[name]
+        # base decode time = sum of durations of all prior segments
+        base_time = int(round(sum(seg_durs[name]) * timescale))
+        data = media_segment(track, idx + 1, base_time, samples)
+        path = out / name / f"segment_{idx + 1:05d}.m4s"
+        tmp = path.with_suffix(".m4s.tmp")
+        tmp.write_bytes(data)
+        tmp.rename(path)           # atomic publish (sprite_generator parity)
+        seg_counts[name] = idx + 1
+        seg_durs[name].append(sum(s.duration for s in samples) / timescale)
+        bytes_written[name] += len(data)
+
+    @staticmethod
+    def _write_thumbnail(y, u, v, path: str, max_width: int = 1280) -> None:
+        h, w = y.shape
+        if w > max_width:
+            th = max(2, round(h * max_width / w / 2) * 2)
+            y, u, v = resize_yuv420(y[None], u[None], v[None], th, max_width)
+            y, u, v = np.asarray(y[0]), np.asarray(u[0]), np.asarray(v[0])
+        rgb = np.asarray(yuv420_to_rgb(y, u, v, standard="bt709"))
+        from vlog_tpu.codecs.jpeg import encode_jpeg_rgb
+
+        Path(path).write_bytes(
+            encode_jpeg_rgb((rgb * 255).astype(np.uint8), quality=85))
+
+
+register_backend("jax", JaxBackend)

@@ -1,0 +1,127 @@
+"""Frame sources: uniform GOP-batch iteration over supported containers.
+
+The decode stage of the pipeline. The reference leaves decode to ffmpeg
+inside each transcode subprocess (worker/transcoder.py:1006 — every rung
+re-decodes the source); here the source is decoded ONCE per frame batch
+and every rung is scaled/encoded from that single in-memory copy.
+
+Supported inputs: Y4M (raw 4:2:0) and progressive MP4 with our H.264
+intra envelope (see codecs/h264/decoder.py). Anything else raises
+UnsupportedSource, the analog of the reference's ffprobe-failure path
+(transcoder.py:706-758).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+from vlog_tpu.codecs.h264.decoder import H264Decoder, UnsupportedStream
+from vlog_tpu.media import mp4 as mp4mod
+from vlog_tpu.media import y4m
+from vlog_tpu.media.probe import VideoInfo, get_video_info, sniff_container
+
+
+class UnsupportedSource(ValueError):
+    """Container/codec outside the first-party decode envelope."""
+
+
+class FrameSource:
+    """Iterate (y, u, v) uint8 numpy batches of up to ``batch`` frames."""
+
+    info: VideoInfo
+    frame_count: int
+    fps_num: int
+    fps_den: int
+
+    def read_batches(self, batch: int, start_frame: int = 0
+                     ) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Y4mFrameSource(FrameSource):
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.info = get_video_info(path)
+        self._reader = y4m.Y4mReader(path)
+        self.frame_count = self._reader.info.frame_count
+        self.fps_num = self._reader.info.fps_num
+        self.fps_den = self._reader.info.fps_den
+
+    def read_batches(self, batch: int, start_frame: int = 0):
+        n = self.frame_count
+        i = start_frame
+        while i < n:
+            count = min(batch, n - i)
+            ys, us, vs = [], [], []
+            for j in range(i, i + count):
+                y, u, v = self._reader.read_frame(j)
+                ys.append(y)
+                us.append(u)
+                vs.append(v)
+            yield np.stack(ys), np.stack(us), np.stack(vs)
+            i += count
+
+    def close(self):
+        self._reader.close()
+
+
+class Mp4H264FrameSource(FrameSource):
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.info = get_video_info(path)
+        movie = mp4mod.parse_mp4(path)
+        track = movie.video
+        if track is None:
+            raise UnsupportedSource(f"{path}: no video track")
+        if track.codec != "h264":
+            raise UnsupportedSource(
+                f"{path}: codec {track.codec!r} has no first-party decoder")
+        self._track = track
+        self._reader = mp4mod.SampleReader(path, track)
+        self._decoder = H264Decoder(avcc_config=track.codec_config)
+        self.frame_count = track.samples.count
+        fps = track.fps or 30.0
+        self.fps_num, self.fps_den = y4m.fps_to_fraction(fps)
+
+    def read_batches(self, batch: int, start_frame: int = 0):
+        n = self.frame_count
+        i = start_frame
+        while i < n:
+            count = min(batch, n - i)
+            samples = self._reader.read_range(i, count)
+            try:
+                frames = self._decoder.decode_samples(samples)
+            except UnsupportedStream as exc:
+                raise UnsupportedSource(f"{self.path}: {exc}") from exc
+            if len(frames) != count:
+                raise UnsupportedSource(
+                    f"{self.path}: sample {i}+ produced no frame")
+            yield (np.stack([f.y for f in frames]),
+                   np.stack([f.u for f in frames]),
+                   np.stack([f.v for f in frames]))
+            i += count
+
+    def close(self):
+        self._reader.close()
+
+
+def open_source(path: str | Path) -> FrameSource:
+    """Sniff the container and return the right FrameSource."""
+    kind = sniff_container(path)
+    if kind == "y4m":
+        return Y4mFrameSource(path)
+    if kind == "mp4":
+        return Mp4H264FrameSource(path)
+    raise UnsupportedSource(f"{path}: unsupported container {kind!r}")

@@ -104,15 +104,12 @@ def _read_vlc(r: BitReader, table: dict[tuple[int, int], int], what: str,
 def split_annexb(data: bytes) -> list[tuple[int, int, bytes]]:
     """Annex-B stream -> [(nal_type, nal_ref_idc, rbsp)] (unescaped)."""
     nals = []
-    i = 0
     n = len(data)
     starts = []
-    while i + 3 <= n:
-        if data[i:i + 3] == b"\x00\x00\x01":
-            starts.append(i + 3)
-            i += 3
-        else:
-            i += 1
+    i = data.find(b"\x00\x00\x01")
+    while i != -1:
+        starts.append(i + 3)
+        i = data.find(b"\x00\x00\x01", i + 3)
     for k, s in enumerate(starts):
         end = n
         if k + 1 < len(starts):
@@ -591,18 +588,29 @@ class H264Decoder:
         pos = 5
         n_sps = cfg[pos] & 0x1F
         pos += 1
-        for _ in range(n_sps):
-            ln = int.from_bytes(cfg[pos:pos + 2], "big")
-            pos += 2
-            self._handle_nal(cfg[pos] & 0x1F, unescape_emulation(cfg[pos + 1:pos + ln]))
-            pos += ln
-        n_pps = cfg[pos]
-        pos += 1
-        for _ in range(n_pps):
-            ln = int.from_bytes(cfg[pos:pos + 2], "big")
-            pos += 2
-            self._handle_nal(cfg[pos] & 0x1F, unescape_emulation(cfg[pos + 1:pos + ln]))
-            pos += ln
+        try:
+            for _ in range(n_sps):
+                ln = int.from_bytes(cfg[pos:pos + 2], "big")
+                pos += 2
+                if ln == 0 or pos + ln > len(cfg):
+                    raise DecodeError("truncated avcC SPS")
+                self._handle_nal(cfg[pos] & 0x1F,
+                                 unescape_emulation(cfg[pos + 1:pos + ln]))
+                pos += ln
+            n_pps = cfg[pos]
+            pos += 1
+            for _ in range(n_pps):
+                ln = int.from_bytes(cfg[pos:pos + 2], "big")
+                pos += 2
+                if ln == 0 or pos + ln > len(cfg):
+                    raise DecodeError("truncated avcC PPS")
+                self._handle_nal(cfg[pos] & 0x1F,
+                                 unescape_emulation(cfg[pos + 1:pos + ln]))
+                pos += ln
+        except IndexError as exc:
+            raise DecodeError("truncated avcC") from exc
+        if self.sps is None or self.pps is None:
+            raise DecodeError("avcC carries no SPS/PPS")
 
     def _handle_nal(self, nal_type: int, rbsp: bytes) -> None:
         if nal_type == syntax.NAL_SPS:

@@ -22,6 +22,7 @@ class VideoInfo:
     """What the upload pipeline needs to know about a source file."""
 
     container: str            # "mp4" | "y4m"
+    path: str                 # source file path (decode stage re-opens it)
     duration_s: float
     width: int
     height: int
@@ -57,6 +58,7 @@ def get_video_info(path: str | Path) -> VideoInfo:
         info = y4mlib.probe_y4m(path)
         return VideoInfo(
             container="y4m",
+            path=str(path),
             duration_s=info.frame_count / info.fps if info.fps else 0.0,
             width=info.width,
             height=info.height,
@@ -74,6 +76,7 @@ def get_video_info(path: str | Path) -> VideoInfo:
         raise ProbeError(f"{path}: MP4 has no playable tracks")
     return VideoInfo(
         container="mp4",
+        path=str(path),
         duration_s=movie.duration_s,
         width=video.width if video else 0,
         height=video.height if video else 0,

@@ -1,0 +1,3 @@
+"""Worker runtime: the hot path (SURVEY.md section 2a)."""
+
+from vlog_tpu.worker.pipeline import ProcessResult, process_video  # noqa: F401

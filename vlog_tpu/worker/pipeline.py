@@ -1,0 +1,119 @@
+"""The per-video processing pipeline (process_video_resumable analog).
+
+Reference: worker/transcoder.py:2126-2935 — probe, thumbnail, original
+remux, ladder transcode, verification passes, manifests, finalize. Here
+the ladder+thumbnail+manifests collapse into one backend run (decode
+once, every rung in one device pass), and verification uses the
+first-party validators instead of re-probing with ffprobe.
+
+Steps (checkpointable by inspecting the output directory):
+  1. probe         — media.probe.get_video_info
+  2. original      — copy the upload next to the renditions
+  3. ladder        — backend.run (thumbnail + segments + playlists)
+  4. verify        — validate master/media playlists + segment atoms
+  5. finalize      — summary dict for the DB/webhook layer
+"""
+
+from __future__ import annotations
+
+import shutil
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from vlog_tpu.backends import Backend, RunResult, select_backend
+from vlog_tpu.backends.base import ProgressFn
+from vlog_tpu.media import hls
+from vlog_tpu.media.probe import VideoInfo, get_video_info
+
+
+class VerificationError(RuntimeError):
+    """Output failed post-transcode validation (reference: the up-to-3
+    verification passes in transcoder.py:2565-2717)."""
+
+
+@dataclass
+class ProcessResult:
+    source: VideoInfo
+    run: RunResult
+    out_dir: Path
+    original_path: str | None
+    master_playlist: str
+    dash_manifest: str
+    qualities: list[dict] = field(default_factory=list)
+
+    def to_db_rows(self) -> list[dict]:
+        """Rows for the video_qualities table (reference database.py)."""
+        return [
+            {
+                "quality": r.name,
+                "width": r.width,
+                "height": r.height,
+                "codec_string": r.codec_string,
+                "bitrate": r.achieved_bitrate,
+                "segment_count": r.segment_count,
+                "bytes": r.bytes_written,
+                "mean_psnr_y": round(r.mean_psnr_y, 2),
+            }
+            for r in self.run.rungs
+        ]
+
+
+def process_video(
+    source_path: str | Path,
+    out_dir: str | Path,
+    *,
+    backend: Backend | None = None,
+    progress_cb: ProgressFn | None = None,
+    keep_original: bool = True,
+    resume: bool = True,
+    rungs=None,
+    **plan_opts,
+) -> ProcessResult:
+    """Run the full pipeline for one video. Blocking & compute-heavy —
+    callers run it in a thread/process (worker loop) and drive
+    checkpoints via ``progress_cb``."""
+    source_path = Path(source_path)
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    # Step 1: probe
+    info = get_video_info(source_path)
+
+    # Step 2: original passthrough (reference keeps a "-c copy" remux,
+    # transcoder.py:1194; our containers are already progressive MP4/Y4M
+    # so a byte copy preserves everything)
+    original = None
+    if keep_original:
+        dst = out_dir / f"original{source_path.suffix.lower()}"
+        if not (resume and dst.exists()
+                and dst.stat().st_size == source_path.stat().st_size):
+            tmp = dst.with_suffix(dst.suffix + ".tmp")
+            shutil.copyfile(source_path, tmp)
+            tmp.rename(dst)
+        original = str(dst)
+
+    # Step 3: ladder (+ thumbnail + per-rung playlists + master/DASH)
+    be = backend or select_backend()
+    plan = be.plan(info, rungs, out_dir, **plan_opts)
+    run = be.run(plan, progress_cb, resume=resume)
+
+    # Step 4: verification (validate_hls_playlist analog)
+    master = out_dir / "master.m3u8"
+    try:
+        variant_results = hls.validate_master_playlist(master)
+        for uri, res in variant_results.items():
+            if not res["cmaf"]:
+                raise VerificationError(f"{uri}: expected CMAF variant")
+    except (hls.PlaylistValidationError, OSError) as exc:
+        raise VerificationError(str(exc)) from exc
+
+    result = ProcessResult(
+        source=info,
+        run=run,
+        out_dir=out_dir,
+        original_path=original,
+        master_playlist=str(master),
+        dash_manifest=str(out_dir / "manifest.mpd"),
+    )
+    result.qualities = result.to_db_rows()
+    return result
