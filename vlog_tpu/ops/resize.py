@@ -82,8 +82,70 @@ def resize_plane(plane, dst_h: int, dst_w: int, *, filter: str = "lanczos3", out
     src_h, src_w = plane.shape[-2], plane.shape[-1]
     a_h = jnp.asarray(resample_matrix(src_h, dst_h, filter))
     a_w = jnp.asarray(resample_matrix(src_w, dst_w, filter))
+    return apply_resize_matrices(plane, a_h, a_w, out_dtype)
+
+
+def resize_yuv420(y, u, v, dst_h: int, dst_w: int, *, filter: str = "lanczos3"):
+    """Resize a planar 4:2:0 frame batch; dst_h/dst_w must be even.
+
+    Identity resizes are skipped (the top rung of a ladder usually equals
+    the source size — no work, and no giant identity matrix baked into
+    the program).
+    """
+    if dst_h % 2 or dst_w % 2:
+        raise ValueError("4:2:0 target dimensions must be even")
+    if (y.shape[-2], y.shape[-1]) == (dst_h, dst_w):
+        if y.dtype != jnp.uint8:   # keep the uint8 output contract
+            return (jnp.clip(jnp.round(y), 0, 255).astype(jnp.uint8),
+                    jnp.clip(jnp.round(u), 0, 255).astype(jnp.uint8),
+                    jnp.clip(jnp.round(v), 0, 255).astype(jnp.uint8))
+        return y, u, v
+    return (
+        resize_plane(y, dst_h, dst_w, filter=filter),
+        resize_plane(u, dst_h // 2, dst_w // 2, filter=filter),
+        resize_plane(v, dst_h // 2, dst_w // 2, filter=filter),
+    )
+
+
+# --------------------------------------------------------------------------
+# Matrices-as-arguments variant.
+#
+# Inside a jit trace, `resample_matrix` constants are baked into the HLO;
+# for big ladders (4K sources) that bloats the program past what remote
+# compile services accept and duplicates data per-compile. These helpers
+# thread the matrices through as runtime arguments instead: build them
+# once host-side with `plan_ladder_matrices`, pass the pytree to the
+# traced function, apply with `resize_yuv420_with`.
+# --------------------------------------------------------------------------
+
+def plan_ladder_matrices(src_h: int, src_w: int,
+                         rungs_hw: tuple[tuple[int, int], ...],
+                         filter: str = "lanczos3") -> dict:
+    """{(h, w): ((A_h, A_w), (A_h_c, A_w_c)) | None} for every rung.
+
+    None marks an identity (source-size) rung. Chroma matrices are the
+    half-resolution pair.
+    """
+    if src_h % 2 or src_w % 2:
+        raise ValueError("4:2:0 source dimensions must be even")
+    mats = {}
+    for (h, w) in rungs_hw:
+        if h % 2 or w % 2:
+            raise ValueError(f"4:2:0 rung dimensions must be even: {(h, w)}")
+        if (h, w) == (src_h, src_w):
+            mats[(h, w)] = None
+            continue
+        mats[(h, w)] = (
+            (resample_matrix(src_h, h, filter), resample_matrix(src_w, w, filter)),
+            (resample_matrix(src_h // 2, h // 2, filter),
+             resample_matrix(src_w // 2, w // 2, filter)),
+        )
+    return mats
+
+
+def apply_resize_matrices(plane, a_h, a_w, out_dtype=jnp.uint8):
+    """(..., H, W) x (h, H) x (w, W) -> (..., h, w). Pure/traced."""
     x = plane.astype(jnp.float32)
-    # (dst_h, src_h) @ (..., src_h, src_w) @ (src_w, dst_w)
     x = jnp.einsum("hH,...Hw->...hw", a_h, x, precision=jax.lax.Precision.HIGHEST)
     x = jnp.einsum("...hw,Ww->...hW", x, a_w, precision=jax.lax.Precision.HIGHEST)
     if out_dtype == jnp.uint8:
@@ -91,14 +153,15 @@ def resize_plane(plane, dst_h: int, dst_w: int, *, filter: str = "lanczos3", out
     return x.astype(out_dtype)
 
 
-def resize_yuv420(y, u, v, dst_h: int, dst_w: int, *, filter: str = "lanczos3"):
-    """Resize a planar 4:2:0 frame batch; dst_h/dst_w must be even."""
-    if dst_h % 2 or dst_w % 2:
-        raise ValueError("4:2:0 target dimensions must be even")
+def resize_yuv420_with(y, u, v, rung_mats):
+    """Resize with prebuilt matrices (None = identity rung)."""
+    if rung_mats is None:
+        return y, u, v
+    (a_h, a_w), (c_h, c_w) = rung_mats
     return (
-        resize_plane(y, dst_h, dst_w, filter=filter),
-        resize_plane(u, dst_h // 2, dst_w // 2, filter=filter),
-        resize_plane(v, dst_h // 2, dst_w // 2, filter=filter),
+        apply_resize_matrices(y, a_h, a_w),
+        apply_resize_matrices(u, c_h, c_w),
+        apply_resize_matrices(v, c_h, c_w),
     )
 
 

@@ -1,0 +1,21 @@
+"""Multi-chip parallelism: mesh layout + sharded pipeline steps.
+
+The reference's parallelism is process/fleet-level (SURVEY.md section 2d);
+on TPU the intra-pod analog is XLA collectives over ICI driven by
+``jax.sharding``. This package owns the mesh and the sharded versions of
+the hot pipeline steps; the worker runtime stays mesh-agnostic.
+"""
+
+from vlog_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    make_mesh,
+    parse_mesh_spec,
+    shard_frames,
+)
+from vlog_tpu.parallel.ladder import (  # noqa: F401
+    ladder_local,
+    ladder_matrices,
+    sharded_ladder_levels,
+    sharded_ladder_step,
+    single_chip_ladder,
+)

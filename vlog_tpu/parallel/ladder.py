@@ -1,0 +1,147 @@
+"""Sharded one-pass ladder: the multi-chip version of the hot loop.
+
+``shard_map`` over the mesh's "data" axis: every device holds a shard of
+the GOP's frames and produces quantized H.264 levels for EVERY rung of
+its local frames — resize + transform + quantize fused into one XLA
+program per device, zero collectives in steady state (all-intra frames
+are independent; the only cross-device traffic is the initial scatter and
+final gather over ICI).
+
+Resize matrices are threaded as runtime arguments (replicated across the
+mesh), not trace-time constants — at 4K the ladder's dense matrices are
+~100MB, which must live in HBM once, not inside the serialized program
+(ops/resize.py `plan_ladder_matrices`).
+
+This is the step __graft_entry__.dryrun_multichip exercises and the
+unit the v5e-8 worker dispatches per frame batch (SURVEY.md section 2d
+item 5: DP across chips over frame batches).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from vlog_tpu.codecs.h264.encoder import encode_frame
+from vlog_tpu.ops.resize import plan_ladder_matrices, resize_yuv420_with
+
+# Static description of one rung: (name, height, width, qp)
+RungSpec = tuple[str, int, int, int]
+
+
+def _pad_mb(y, u, v):
+    """Edge-pad a (n, H, W) YUV420 batch to macroblock alignment (traced;
+    SPS cropping restores display size downstream)."""
+    h, w = y.shape[-2], y.shape[-1]
+    ph, pw = (-h) % 16, (-w) % 16
+    if ph or pw:
+        y = jnp.pad(y, ((0, 0), (0, ph), (0, pw)), mode="edge")
+        u = jnp.pad(u, ((0, 0), (0, ph // 2), (0, pw // 2)), mode="edge")
+        v = jnp.pad(v, ((0, 0), (0, ph // 2), (0, pw // 2)), mode="edge")
+    return y, u, v
+
+
+def ladder_matrices(rungs: tuple[RungSpec, ...], src_h: int, src_w: int) -> dict:
+    """{rung name: resize-matrix pytree (or None for identity)}."""
+    by_hw = plan_ladder_matrices(src_h, src_w, tuple((h, w) for _, h, w, _ in rungs))
+    return {name: by_hw[(h, w)] for name, h, w, _ in rungs}
+
+
+def _encode_rung(y, u, v, rung_mats, qp: int):
+    """Shared per-rung body: resize -> MB-pad -> batch intra encode.
+
+    Returns (levels, resized_y) — resized_y is the display-size luma used
+    for quality stats.
+    """
+    ry, ru, rv = resize_yuv420_with(y, u, v, rung_mats)
+    py, pu, pv = _pad_mb(ry, ru, rv)
+    levels = jax.vmap(lambda a, b, c: encode_frame(a, b, c, qp=qp))(py, pu, pv)
+    return levels, ry
+
+
+def ladder_local(y, u, v, mats: dict, rungs: tuple[RungSpec, ...]):
+    """Device-local body: frames (n, H, W) -> levels for every rung."""
+    return {name: _encode_rung(y, u, v, mats[name], qp)[0]
+            for name, h, w, qp in rungs}
+
+
+def single_chip_ladder(rungs: tuple[RungSpec, ...], src_h: int, src_w: int
+                       ) -> tuple[Callable, dict]:
+    """Jitted one-device ladder step + its matrices pytree.
+
+    Returns (fn, mats): call ``fn(y, u, v, mats)``.
+    """
+    fn = jax.jit(functools.partial(ladder_local, rungs=rungs))
+    return fn, ladder_matrices(rungs, src_h, src_w)
+
+
+def sharded_ladder_levels(mesh: Mesh, rungs: tuple[RungSpec, ...],
+                          src_h: int, src_w: int) -> tuple[Callable, dict]:
+    """Sharded ladder step for one mesh + rung set + source geometry.
+
+    Returns (fn, mats). ``fn(y, u, v, mats)``: leading frame axis must
+    divide by the data-axis size; outputs are sharded on "data"; ``mats``
+    is replicated.
+    """
+    fn = jax.shard_map(
+        functools.partial(ladder_local, rungs=rungs),
+        mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data"), P()),
+        out_specs=P("data"),
+        # encode_frame's row scans start from constant (replicated) carries
+        # that become device-varying after the first step; skip the VMA
+        # type check rather than pcast every carry init.
+        check_vma=False,
+    )
+    mats = ladder_matrices(rungs, src_h, src_w)
+    mats = jax.device_put(mats, NamedSharding(mesh, P()))
+    return jax.jit(fn), mats
+
+
+def sharded_ladder_step(mesh: Mesh, rungs: tuple[RungSpec, ...],
+                        src_h: int, src_w: int) -> tuple[Callable, dict]:
+    """Ladder step + per-rung quality stats (the "training step" analog).
+
+    Besides the levels, computes mean PSNR-Y per rung against the resized
+    source — an all-device ``psum`` over ICI, exercising the collective
+    path the way a training step's gradient reduction would.
+
+    The returned fn takes ``(y, u, v, mats, valid)`` where ``valid`` is a
+    (n,) float32 0/1 mask sharded like the frames: pad_batch's duplicated
+    flush frames get 0 so they never bias the quality stats.
+    """
+    def local(y, u, v, mats, valid):
+        out = {}
+        stats = {}
+        for name, h, w, qp in rungs:
+            levels, ry = _encode_rung(y, u, v, mats[name], qp)
+            # PSNR over the display region only (padding is replicated edge)
+            err = (levels["recon_y"][:, :h, :w].astype(jnp.float32)
+                   - ry.astype(jnp.float32))
+            local_mse = jnp.sum(valid * jnp.mean(err * err, axis=(1, 2)))
+            total_mse = jax.lax.psum(local_mse, "data")
+            total_n = jax.lax.psum(jnp.sum(valid), "data")
+            mse = total_mse / jnp.maximum(total_n, 1.0)
+            stats[name] = 10.0 * jnp.log10(255.0 ** 2 / jnp.maximum(mse, 1e-6))
+            out[name] = levels
+        return out, stats
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data"), P(), P("data")),
+        out_specs=(P("data"), P()),
+        check_vma=False,
+    )
+    mats = ladder_matrices(rungs, src_h, src_w)
+    mats = jax.device_put(mats, NamedSharding(mesh, P()))
+    return jax.jit(fn), mats
+
+
+def valid_mask(n_total: int, n_real: int):
+    """0/1 mask marking pad_batch's duplicated trailing frames invalid."""
+    return (jnp.arange(n_total) < n_real).astype(jnp.float32)

@@ -1,0 +1,91 @@
+"""Device mesh construction (config: VLOG_TPU_MESH, e.g. "data:-1").
+
+One axis ("data") covers the media pipeline: frames of a GOP batch and
+Whisper audio windows shard across it (all-intra encode and 30s ASR
+windows have no cross-item dependence, so data parallelism over ICI is
+the whole story; SURVEY.md section 2d item 5). The spec syntax allows
+more axes ("data:4,model:2") for the Whisper TP variant later.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from vlog_tpu import config
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    axes: tuple[tuple[str, int], ...]   # (name, size); -1 = all remaining
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+
+def parse_mesh_spec(spec: str | None = None) -> MeshSpec:
+    spec = spec or config.TPU_MESH_SPEC
+    axes = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size = part.partition(":")
+        axes.append((name.strip(), int(size) if size else -1))
+    if not axes:
+        axes = [("data", -1)]
+    return MeshSpec(tuple(axes))
+
+
+def make_mesh(spec: str | MeshSpec | None = None,
+              devices: list | None = None) -> Mesh:
+    """Build a Mesh from a spec string; -1 axes absorb remaining devices."""
+    if not isinstance(spec, MeshSpec):
+        spec = parse_mesh_spec(spec)
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    sizes = [s for _, s in spec.axes]
+    wild = [i for i, s in enumerate(sizes) if s == -1]
+    fixed = int(np.prod([s for s in sizes if s != -1])) if sizes else 1
+    if len(wild) > 1:
+        raise ValueError(f"at most one -1 axis allowed in mesh spec {spec}")
+    if wild:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {fixed}")
+        sizes[wild[0]] = n // fixed
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {sizes} needs {total} devices, have {n}")
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, spec.axis_names)
+
+
+def shard_frames(mesh: Mesh, *arrays, axis: str = "data"):
+    """Place (N, ...) arrays with N sharded over ``axis`` (rest replicated).
+
+    N must divide by the axis size — callers pad GOP batches to the mesh
+    (see pad_batch).
+    """
+    sharding = NamedSharding(mesh, P(axis))
+    return tuple(jax.device_put(a, sharding) for a in arrays)
+
+
+def pad_batch(n_devices: int, *arrays):
+    """Edge-pad the leading (frame) axis up to a multiple of n_devices.
+
+    Returns (padded_arrays, real_count). Padding frames are encode work
+    that gets thrown away — bounded by n_devices-1 frames per flush.
+    """
+    n = arrays[0].shape[0]
+    pad = (-n) % n_devices
+    if pad == 0:
+        return arrays, n
+    out = []
+    for a in arrays:
+        reps = np.repeat(a[-1:], pad, axis=0)
+        out.append(np.concatenate([a, reps], axis=0))
+    return tuple(out), n
