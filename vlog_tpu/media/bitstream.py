@@ -106,8 +106,13 @@ def escape_emulation(rbsp: bytes) -> bytes:
     """Insert emulation-prevention bytes (0x000000/01/02/03 -> 0x000003xx).
 
     H.264 7.4.1: within a NAL unit payload, any 0x0000 followed by a byte
-    <= 0x03 must be broken with an 0x03.
+    <= 0x03 must be broken with an 0x03. Large payloads (slice data) take
+    the native fast path when available.
     """
+    if len(rbsp) > 4096:
+        escaped = _escape_native(rbsp)
+        if escaped is not None:
+            return escaped
     out = bytearray()
     zeros = 0
     for b in rbsp:
@@ -117,6 +122,26 @@ def escape_emulation(rbsp: bytes) -> bytes:
         out.append(b)
         zeros = zeros + 1 if b == 0 else 0
     return bytes(out)
+
+
+def _escape_native(rbsp: bytes) -> bytes | None:
+    try:
+        from vlog_tpu.native import get_lib
+    except ImportError:
+        return None
+    lib = get_lib()
+    if lib is None:
+        return None
+    import ctypes
+
+    import numpy as np
+
+    src = np.frombuffer(rbsp, np.uint8)
+    out = np.empty(len(rbsp) * 3 // 2 + 4, np.uint8)
+    n = lib.vt_escape_emulation(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(rbsp),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out[:n].tobytes()
 
 
 def unescape_emulation(ebsp: bytes) -> bytes:

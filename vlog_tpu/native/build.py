@@ -1,0 +1,92 @@
+"""Build + load the native entropy-coding library (ctypes).
+
+Compiled on demand with the system toolchain into
+``vlog_tpu/native/_build/`` and cached by source mtime. No pip/pybind11
+required (environment constraint); pure C ABI via ctypes. All entry
+points release the GIL for the duration of the call (ctypes semantics),
+so the worker's per-frame thread pool scales across cores.
+
+Disable with VLOG_NATIVE=0 (callers fall back to the Python coders).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_DIR = Path(__file__).parent
+_BUILD = _DIR / "_build"
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _compile() -> Path:
+    _BUILD.mkdir(exist_ok=True)
+    src = _DIR / "cavlc.c"
+    so = _BUILD / "libvtnative.so"
+    from vlog_tpu.codecs.h264 import cavlc_tables
+
+    stamp_inputs = [src, _DIR / "gen_tables.py",
+                    Path(cavlc_tables.__file__)]   # real input of gen_tables
+    if so.exists() and all(so.stat().st_mtime >= p.stat().st_mtime
+                           for p in stamp_inputs):
+        return so
+    from vlog_tpu.native.gen_tables import generate
+
+    # Per-process scratch names: multiple worker processes may race the
+    # first build; each builds privately and os.replace publishes
+    # atomically (last writer wins, all writers produce identical bits).
+    pid = os.getpid()
+    inc = _BUILD / f"cavlc_tables.{pid}.inc"
+    inc.write_text(generate())
+    tmp_so = _BUILD / f"libvtnative.{pid}.so.tmp"
+    cc = os.environ.get("CC", "g++")
+    cmd = [cc, "-O3", "-fPIC", "-shared", "-x", "c++",
+           f"-DVT_TABLES_INC=\"{inc.name}\"", str(src),
+           "-I", str(_BUILD), "-o", str(tmp_so)]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(f"native build failed: {proc.stderr[:2000]}")
+    os.replace(tmp_so, so)
+    inc.rename(_BUILD / "cavlc_tables.inc")        # for reference/debugging
+    return so
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded library, or None (build failure / disabled)."""
+    global _LIB, _TRIED
+    if os.environ.get("VLOG_NATIVE", "1") in ("0", "false", "no"):
+        return None
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            so = _compile()
+            lib = ctypes.CDLL(str(so))
+        except (NativeBuildError, OSError):
+            _LIB = None
+            return None
+        i8 = ctypes.POINTER(ctypes.c_uint8)
+        i32 = ctypes.POINTER(ctypes.c_int32)
+        lib.vt_cavlc_encode_slice.restype = ctypes.c_int64
+        lib.vt_cavlc_encode_slice.argtypes = [
+            i32, i32, i32, i32,                      # levels arrays
+            ctypes.c_int, ctypes.c_int,              # mbh, mbw
+            i8, ctypes.c_int64,                      # header bytes
+            ctypes.c_uint32, ctypes.c_int,           # header tail bits
+            i32,                                     # nz scratch
+            i8, ctypes.c_int64,                      # out buffer
+        ]
+        lib.vt_escape_emulation.restype = ctypes.c_int64
+        lib.vt_escape_emulation.argtypes = [i8, ctypes.c_int64, i8]
+        _LIB = lib
+        return _LIB
