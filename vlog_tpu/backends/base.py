@@ -87,9 +87,12 @@ class RungResult:
     codec_string: str
     segment_count: int
     bytes_written: int
-    mean_psnr_y: float
+    # None = not measured this run (e.g. fully-resumed run encoded nothing),
+    # never a fabricated 0.0.
+    mean_psnr_y: float | None
     achieved_bitrate: int
     playlist_path: str
+    target_bitrate: int = 0      # the ladder's ask; 0 = constant-QP run
 
 
 @dataclass

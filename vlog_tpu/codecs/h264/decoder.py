@@ -114,8 +114,9 @@ def split_annexb(data: bytes) -> list[tuple[int, int, bytes]]:
         end = n
         if k + 1 < len(starts):
             end = starts[k + 1] - 3
-            # 4-byte start codes leave one extra trailing zero
-            if end > s and data[end - 1] == 0:
+            # Strip all trailing_zero_8bits before the next start code
+            # (safe: rbsp_trailing_bits guarantees a nonzero final byte).
+            while end > s and data[end - 1] == 0:
                 end -= 1
         raw = data[s:end]
         if not raw:

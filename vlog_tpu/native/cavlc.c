@@ -110,6 +110,7 @@ static int encode_residual(BitWriter *w, const int32_t *coeffs, int n,
                 bw_put(w, 1, 15);
                 bw_put(w, (uint32_t)(code - 14), 4);
             } else {
+                if (code - 30 >= (1 << 12)) { w->overflow = 2; return total; }
                 bw_put(w, 1, 16);
                 bw_put(w, (uint32_t)(code - 30), 12);
             }

@@ -156,7 +156,13 @@ def apply_resize_matrices(plane, a_h, a_w, out_dtype=jnp.uint8):
 def resize_yuv420_with(y, u, v, rung_mats):
     """Resize with prebuilt matrices (None = identity rung)."""
     if rung_mats is None:
-        return y, u, v
+        # Same clamp/cast contract as the matrix path: float inputs must
+        # not flow unclamped into the encode.
+        def _to_u8(p):
+            if p.dtype == jnp.uint8:
+                return p
+            return jnp.clip(jnp.round(p.astype(jnp.float32)), 0, 255).astype(jnp.uint8)
+        return _to_u8(y), _to_u8(u), _to_u8(v)
     (a_h, a_w), (c_h, c_w) = rung_mats
     return (
         apply_resize_matrices(y, a_h, a_w),

@@ -52,7 +52,8 @@ class ProcessResult:
                 "bitrate": r.achieved_bitrate,
                 "segment_count": r.segment_count,
                 "bytes": r.bytes_written,
-                "mean_psnr_y": round(r.mean_psnr_y, 2),
+                "mean_psnr_y": (None if r.mean_psnr_y is None
+                                else round(r.mean_psnr_y, 2)),
             }
             for r in self.run.rungs
         ]
