@@ -1,0 +1,64 @@
+"""Multi-chip dry run body: the FULL sharded ladder step on n devices.
+
+Run as ``python -m vlog_tpu.parallel.dryrun N`` in a subprocess whose
+environment pins ``JAX_PLATFORMS=cpu`` and
+``--xla_force_host_platform_device_count=N`` — the platform decision must
+happen before any backend is touched (round-1 lesson: calling
+``jax.devices()`` first opens the TPU tunnel and can hang for minutes).
+
+The body is the real multi-chip path the TPU worker dispatches per frame
+batch: ``shard_map`` over a data mesh, per-device resize + full intra
+H.264 DSP for every rung, cross-device ``psum`` PSNR reduction over ICI
+(SURVEY.md §2d.5).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def run(n_devices: int) -> None:
+    import jax
+
+    # Belt-and-suspenders vs the axon sitecustomize: the env already says
+    # cpu, but an explicit config update beats any import-time override.
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from vlog_tpu.parallel import make_mesh, sharded_ladder_step, shard_frames
+    from vlog_tpu.parallel.ladder import valid_mask
+    from vlog_tpu.parallel.mesh import pad_batch
+
+    devices = jax.devices()[:n_devices]
+    assert len(devices) == n_devices, (
+        f"need {n_devices} cpu devices, have {len(jax.devices())} "
+        "(xla_force_host_platform_device_count not honored?)")
+    mesh = make_mesh("data:-1", devices=devices)
+
+    # Full sharded step on tiny shapes: per-device resize+encode of its
+    # frame shard for every rung + psum PSNR over the mesh.
+    rungs = (("64p", 64, 96, 28), ("32p", 32, 48, 30))
+    n, h, w = n_devices, 96, 128          # one frame per device
+    step, mats = sharded_ladder_step(mesh, rungs, h, w)
+
+    rng = np.random.default_rng(1)
+    y = rng.integers(0, 256, (n, h, w)).astype(np.uint8)
+    u = rng.integers(0, 256, (n, h // 2, w // 2)).astype(np.uint8)
+    v = rng.integers(0, 256, (n, h // 2, w // 2)).astype(np.uint8)
+    (y, u, v), real = pad_batch(n_devices, y, u, v)
+    ys, us, vs = shard_frames(mesh, y, u, v)
+    (valid,) = shard_frames(mesh, np.asarray(valid_mask(y.shape[0], real)))
+
+    out, stats = step(ys, us, vs, mats, valid)
+    jax.block_until_ready(out)
+    for name, _, _, _ in rungs:
+        psnr = float(stats[name])
+        assert 10.0 < psnr < 99.0, f"rung {name}: implausible PSNR {psnr}"
+        assert out[name]["luma_ac"].shape[0] == n_devices
+    print(f"dryrun ok: {n_devices} devices, rungs "
+          f"{[(r[0], round(float(stats[r[0]]), 2)) for r in rungs]}")
+
+
+if __name__ == "__main__":
+    run(int(sys.argv[1]) if len(sys.argv) > 1 else 8)
