@@ -29,7 +29,6 @@ XLA dispatch is not interruptible (SURVEY.md section 7 hard part #3).
 from __future__ import annotations
 
 import time
-from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
 import numpy as np
@@ -45,6 +44,7 @@ from vlog_tpu.backends.base import (
     plan_rung_geometry,
     register_backend,
 )
+from vlog_tpu.backends.rate_control import RateController
 from vlog_tpu.backends.source import open_source
 from vlog_tpu.codecs.h264.api import H264Encoder
 from vlog_tpu.codecs.jpeg import encode_jpeg_yuv420
@@ -168,43 +168,104 @@ class JaxBackend:
         frames_done = start_frame
         thumb_path = None
 
-        # Entropy/packaging pool: overlaps host bit-packing of rung A with
-        # device compute of rung B (the reference's pipeline parallelism,
-        # SURVEY.md 2d.3).
-        pool = ThreadPoolExecutor(max_workers=max(4, len(plan.rungs)))
+        # --- the one-pass ladder program: ONE dispatch per GOP batch
+        # emits quantized levels for EVERY rung (SURVEY §2d.2); frames
+        # shard over the device mesh when >1 chip (§2d.5).
+        import jax
+
+        from vlog_tpu.parallel.ladder import ladder_encode_program
+        from vlog_tpu.parallel.mesh import make_mesh, shard_frames
+
+        src_h, src_w = plan.source.height, plan.source.width
+        rungs_spec = tuple((r.name, r.height, r.width, r.qp)
+                           for r in plan.rungs)
+        n_dev = len(jax.devices())
+        mesh = make_mesh() if n_dev > 1 else None
+        fn, mats = ladder_encode_program(rungs_spec, src_h, src_w, mesh)
+        # Fixed staged batch size (single compile; mesh-divisible).
+        batch_n = max(plan.frame_batch, n_dev)
+        batch_n += (-batch_n) % max(n_dev, 1)
+
+        # Closed-loop VBR toward each rung's ladder bitrate.
+        controllers = {
+            r.name: RateController(target_bps=r.video_bitrate, fps=fps,
+                                   init_qp=r.qp)
+            for r in plan.rungs
+        }
+        npix = {r.name: r.height * r.width for r in plan.rungs}
+
+        def dispatch(by, bu, bv):
+            n_real = by.shape[0]
+            if n_real < batch_n:   # tail: replicate last frame, drop later
+                reps = batch_n - n_real
+                by = np.concatenate([by, np.repeat(by[-1:], reps, axis=0)])
+                bu = np.concatenate([bu, np.repeat(bu[-1:], reps, axis=0)])
+                bv = np.concatenate([bv, np.repeat(bv[-1:], reps, axis=0)])
+            qps = {r.name: np.full(batch_n, controllers[r.name].qp, np.int32)
+                   for r in plan.rungs}
+            if mesh is not None:
+                by, bu, bv = shard_frames(mesh, by, bu, bv)
+                qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
+            return fn(by, bu, bv, mats, qps), n_real, qps
+
+        def consume(outs, n_real, qps):
+            nonlocal frames_done
+            for rung in plan.rungs:
+                name = rung.name
+                ro = outs[name]
+                levels = {k: np.asarray(ro[k])[:n_real] for k in
+                          ("luma_dc", "luma_ac", "chroma_dc", "chroma_ac")}
+                sse = np.asarray(ro["sse_y"])[:n_real]
+                mse = np.maximum(sse / npix[name], 1e-12)
+                psnrs = np.where(mse < 1e-9, 99.0,
+                                 10 * np.log10(255 ** 2 / mse))
+                q_used = np.asarray(qps[name])[:n_real]
+                frames = encoders[name].encode_levels(levels, q_used, psnrs)
+                batch_bytes = 0
+                for ef in frames:
+                    pending[name].append(
+                        Sample(data=ef.avcc, duration=frame_dur,
+                               is_sync=ef.is_idr))
+                    psnr_acc[name].append(ef.psnr_y)
+                    batch_bytes += len(ef.avcc)
+                controllers[name].observe(batch_bytes, n_real)
+                while len(pending[name]) >= frames_per_seg:
+                    chunk = pending[name][:frames_per_seg]
+                    pending[name] = pending[name][frames_per_seg:]
+                    self._write_segment(out, rung, tracks[name],
+                                        seg_counts, seg_durs,
+                                        bytes_written, chunk, timescale)
+            frames_done += n_real
+            if progress_cb:
+                progress_cb(frames_done, total,
+                            f"encoded {frames_done}/{total} frames")
+
+        inflight = None
+        first = True
         try:
-            for by, bu, bv in src.read_batches(plan.frame_batch, start_frame):
-                n = by.shape[0]
+            for by, bu, bv in src.read_batches(batch_n, start_frame):
                 # Thumbnail from the first batch (reference grabs an early
                 # frame, transcoder.py:2247).
                 if plan.thumbnail and thumb_path is None:
                     thumb_path = str(out / "thumbnail.jpg")
                     self._write_thumbnail(by[0], bu[0], bv[0], thumb_path)
-
-                futures = []
-                for rung in plan.rungs:
-                    ry, ru, rv = resize_yuv420(
-                        by, bu, bv, rung.height, rung.width)
-                    enc = encoders[rung.name]
-                    futures.append((rung, pool.submit(
-                        enc.encode, np.asarray(ry), np.asarray(ru),
-                        np.asarray(rv))))
-                for rung, fut in futures:
-                    for ef in fut.result():
-                        pending[rung.name].append(
-                            Sample(data=ef.avcc, duration=frame_dur,
-                                   is_sync=ef.is_idr))
-                        psnr_acc[rung.name].append(ef.psnr_y)
-                    while len(pending[rung.name]) >= frames_per_seg:
-                        chunk = pending[rung.name][:frames_per_seg]
-                        pending[rung.name] = pending[rung.name][frames_per_seg:]
-                        self._write_segment(out, rung, tracks[rung.name],
-                                            seg_counts, seg_durs,
-                                            bytes_written, chunk, timescale)
-                frames_done += n
-                if progress_cb:
-                    progress_cb(frames_done, total,
-                                f"encoded {frames_done}/{total} frames")
+                staged = dispatch(by, bu, bv)
+                if first:
+                    # Calibration batch: consume synchronously so the rate
+                    # controllers' full-jump correction lands before batch
+                    # 2 is staged (costs one batch of overlap, once).
+                    consume(*staged)
+                    first = False
+                    continue
+                # Consume the PREVIOUS batch while this one computes: host
+                # entropy/packaging overlaps device work (the reference's
+                # pipeline parallelism, SURVEY §2d.3) with one batch in
+                # flight — JAX async dispatch does the rest.
+                if inflight is not None:
+                    consume(*inflight)
+                inflight = staged
+            if inflight is not None:
+                consume(*inflight)
             # Flush trailing partial segments.
             for rung in plan.rungs:
                 if pending[rung.name]:
@@ -213,7 +274,6 @@ class JaxBackend:
                                         pending[rung.name], timescale)
                     pending[rung.name] = []
         finally:
-            pool.shutdown(wait=True)
             src.close()
 
         duration_s = total / fps if fps else 0.0
@@ -238,9 +298,10 @@ class JaxBackend:
                 codec_string=enc.codec_string,
                 segment_count=seg_counts[name],
                 bytes_written=bytes_written[name],
-                mean_psnr_y=float(np.mean(psnr_acc[name])) if psnr_acc[name] else 0.0,
+                mean_psnr_y=float(np.mean(psnr_acc[name])) if psnr_acc[name] else None,
                 achieved_bitrate=achieved,
                 playlist_path=str(ppath),
+                target_bitrate=rung.video_bitrate,
             ))
             variants.append(hls.VariantRef(
                 name=name, uri=f"{name}/playlist.m3u8",

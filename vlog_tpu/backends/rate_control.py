@@ -1,0 +1,70 @@
+"""Closed-loop rate control: per-batch QP adaptation toward a bitrate.
+
+The reference hits ladder bitrate targets by delegating VBR to
+x264/NVENC (`-b:v`/`-maxrate`, worker/hwaccel.py:660-731). Here the
+control loop is explicit: observe achieved bits after each GOP batch,
+step QP toward the target. The DSP takes QP as a *traced* per-frame
+value (ops/transform.py), so stepping costs no recompile.
+
+The plant model is the standard H.264 rule of thumb: bits halve per +6
+QP, i.e. log2(bits) is linear in QP with slope -1/6. A damped
+proportional step on that log scale converges in a few batches and
+cannot oscillate for damping <= 1.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass
+class RateController:
+    """One per rung. ``observe()`` after each batch; read ``qp`` before
+    the next."""
+
+    target_bps: int            # 0 = constant-QP mode (no adaptation)
+    fps: float
+    init_qp: int
+    min_qp: int = 10
+    max_qp: int = 48
+    damping: float = 0.6       # fraction of the full log-domain correction
+    max_step: int = 4          # per-batch QP step clamp
+    ema_alpha: float = 0.6     # weight of the newest batch in the bpf EMA
+
+    qp: int = field(init=False)
+    _ema_bpf: float | None = field(default=None, init=False)
+    _calibrating: bool = field(default=True, init=False)
+
+    def __post_init__(self) -> None:
+        self.qp = self.init_qp
+
+    @property
+    def target_bytes_per_frame(self) -> float:
+        return self.target_bps / 8.0 / self.fps if self.fps else 0.0
+
+    def observe(self, bytes_out: int, n_frames: int) -> int:
+        """Feed achieved bytes for ``n_frames`` frames; returns next QP."""
+        if self.target_bps <= 0 or n_frames <= 0 or self.fps <= 0:
+            return self.qp
+        bpf = bytes_out / n_frames
+        if self._ema_bpf is None:
+            self._ema_bpf = bpf
+        else:
+            self._ema_bpf += self.ema_alpha * (bpf - self._ema_bpf)
+        ratio = max(self._ema_bpf, 1e-9) / max(self.target_bytes_per_frame, 1e-9)
+        # +6 QP ~ half the bits -> full correction is 6*log2(ratio).
+        if self._calibrating:
+            # First real observation: jump the whole way (the init QP is a
+            # ladder-wide default, often far off for this content).
+            self._calibrating = False
+            step = round(6.0 * math.log2(ratio))
+        else:
+            step = 6.0 * math.log2(ratio) * self.damping
+            step = max(-self.max_step, min(self.max_step, round(step)))
+        if step:
+            self.qp = max(self.min_qp, min(self.max_qp, self.qp + step))
+            # A QP move invalidates the EMA's operating point; restart it
+            # so stale samples don't fight the next correction.
+            self._ema_bpf = None
+        return self.qp

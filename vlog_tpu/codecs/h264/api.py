@@ -75,6 +75,52 @@ class H264Encoder:
         return syntax.annexb([self.sps, self.pps])
 
     # ---- encoding --------------------------------------------------------
+    def _pack_one(self, frame_id: int, lv: FrameLevels, frame_qp: int,
+                  psnr: float) -> EncodedFrame:
+        idr = (frame_id % self.idr_period) == 0
+        nal = encode_slice(
+            lv, qp=frame_qp, init_qp=self.qp,
+            # frame_num counts reference frames since the last IDR.
+            frame_num=(frame_id % self.idr_period) % 256,
+            idr=idr, idr_pic_id=frame_id % 2,
+        )
+        raw = nal.to_bytes()
+        # avc1 tracks carry parameter sets only in avcC (ISO 14496-15
+        # 5.3.3); the Annex-B dump repeats them in-band at each IDR.
+        prefix = [self.sps, self.pps] if idr else []
+        avcc = len(raw).to_bytes(4, "big") + raw
+        annexb = syntax.annexb(prefix + [nal])
+        return EncodedFrame(avcc=avcc, annexb=annexb, is_idr=idr, psnr_y=psnr)
+
+    def encode_levels(self, levels: dict, qps: np.ndarray,
+                      psnrs: np.ndarray | None = None,
+                      n: int | None = None) -> list[EncodedFrame]:
+        """Entropy-code device outputs already on host.
+
+        ``levels`` holds numpy ``luma_dc/luma_ac/chroma_dc/chroma_ac``
+        with leading frame axis (the fused ladder program's per-rung
+        output); ``qps`` is the per-frame QP the DSP actually used. The
+        backend calls this while the *next* batch's dispatch is already
+        in flight, so host bit-packing overlaps device compute (frames
+        within the call are threaded here).
+        """
+        total = levels["luma_dc"].shape[0]
+        n = total if n is None else min(n, total)
+        frame_ids = list(range(self._frame_index, self._frame_index + n))
+        self._frame_index += n
+
+        def pack(i: int) -> EncodedFrame:
+            lv = FrameLevels(levels["luma_dc"][i], levels["luma_ac"][i],
+                             levels["chroma_dc"][i], levels["chroma_ac"][i],
+                             int(qps[i]))
+            psnr = float(psnrs[i]) if psnrs is not None else float("nan")
+            return self._pack_one(frame_ids[i], lv, int(qps[i]), psnr)
+
+        if n == 1 or self.entropy_threads <= 1:
+            return [pack(i) for i in range(n)]
+        with ThreadPoolExecutor(self.entropy_threads) as pool:
+            return list(pool.map(pack, range(n)))
+
     def encode(self, y: np.ndarray, u: np.ndarray, v: np.ndarray
                ) -> list[EncodedFrame]:
         """Encode a GOP batch: y (N, H, W), u/v (N, H/2, W/2) uint8.
@@ -88,40 +134,12 @@ class H264Encoder:
         v = pad_to_mb(v, 8)
         out = encode_gop(y, u, v, qp=self.qp)
         recon_y = np.asarray(out["recon_y"])
-        luma_dc = np.asarray(out["luma_dc"])
-        luma_ac = np.asarray(out["luma_ac"])
-        chroma_dc = np.asarray(out["chroma_dc"])
-        chroma_ac = np.asarray(out["chroma_ac"])
-
-        frame_ids = list(range(self._frame_index, self._frame_index + n))
-        self._frame_index += n
-
-        def pack(i: int) -> EncodedFrame:
-            fi = frame_ids[i]
-            idr = (fi % self.idr_period) == 0
-            lv = FrameLevels(luma_dc[i], luma_ac[i],
-                             chroma_dc[i], chroma_ac[i], self.qp)
-            nal = encode_slice(
-                lv, qp=self.qp, init_qp=self.qp,
-                # frame_num counts reference frames since the last IDR.
-                frame_num=(fi % self.idr_period) % 256,
-                idr=idr, idr_pic_id=fi % 2,
-            )
-            raw = nal.to_bytes()
-            # avc1 tracks carry parameter sets only in avcC (ISO 14496-15
-            # 5.3.3); the Annex-B dump repeats them in-band at each IDR.
-            prefix = [self.sps, self.pps] if idr else []
-            avcc = len(raw).to_bytes(4, "big") + raw
-            annexb = syntax.annexb(prefix + [nal])
-            vh, vw = self.height, self.width
-            err = (recon_y[i, :vh, :vw].astype(np.int64)
-                   - y[i, :vh, :vw].astype(np.int64))
-            mse = float(np.mean(err * err))
-            psnr = 99.0 if mse < 1e-9 else 10 * np.log10(255 ** 2 / mse)
-            return EncodedFrame(avcc=avcc, annexb=annexb, is_idr=idr,
-                                psnr_y=psnr)
-
-        if n == 1 or self.entropy_threads <= 1:
-            return [pack(i) for i in range(n)]
-        with ThreadPoolExecutor(self.entropy_threads) as pool:
-            return list(pool.map(pack, range(n)))
+        levels = {k: np.asarray(out[k]) for k in
+                  ("luma_dc", "luma_ac", "chroma_dc", "chroma_ac")}
+        vh, vw = self.height, self.width
+        err = (recon_y[:, :vh, :vw].astype(np.int64)
+               - y[:, :vh, :vw].astype(np.int64))
+        mse = np.mean(err.astype(np.float64) ** 2, axis=(1, 2))
+        psnrs = np.where(mse < 1e-9, 99.0,
+                         10 * np.log10(255 ** 2 / np.maximum(mse, 1e-12)))
+        return self.encode_levels(levels, np.full(n, self.qp), psnrs)

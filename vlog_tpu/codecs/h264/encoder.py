@@ -30,7 +30,6 @@ selection — this module is the ``device=tpu`` encoder those seams select.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 
 import jax
@@ -62,9 +61,15 @@ _CHROMA_QP = np.concatenate(
 ).astype(np.int32)
 
 
-def chroma_qp(qp: int) -> int:
-    """QPc from luma QP (spec table 8-15, zero index offset)."""
-    return int(_CHROMA_QP[min(max(qp, 0), 51)])
+def chroma_qp(qp):
+    """QPc from luma QP (spec table 8-15, zero index offset).
+
+    Accepts a Python int (returns int) or a traced int32 scalar (returns
+    the traced lookup — per-frame rate-controlled QP).
+    """
+    if isinstance(qp, (int, np.integer)):
+        return int(_CHROMA_QP[min(max(qp, 0), 51)])
+    return jnp.asarray(_CHROMA_QP)[jnp.clip(qp, 0, 51)]
 
 
 @dataclass
@@ -93,7 +98,7 @@ class FrameLevels:
         return self.luma_dc.shape[-3]
 
 
-def _luma_encode(y_row, pred, qp: int):
+def _luma_encode(y_row, pred, qp):
     """Encode one MB row of luma. y_row (16, W) int32, pred (16, W).
 
     Returns (dc_levels (mbw,4,4), ac_levels (mbw,4,4,4,4), recon (16, W)).
@@ -121,7 +126,7 @@ def _luma_encode(y_row, pred, qp: int):
     return dc_levels, ac_levels, recon
 
 
-def _chroma_encode(c_row, pred, qpc: int):
+def _chroma_encode(c_row, pred, qpc):
     """Encode one MB row of one chroma plane. c_row (8, Wc), pred (8, Wc)."""
     wc = c_row.shape[-1]
     mbw = wc // 8
@@ -144,7 +149,7 @@ def _chroma_encode(c_row, pred, qpc: int):
     return dc_levels, ac_levels, recon
 
 
-def _encode_row0(y_row, u_row, v_row, qp: int, qpc: int):
+def _encode_row0(y_row, u_row, v_row, qp, qpc):
     """Encode MB row 0 as a scan over MB columns (Intra_16x16 DC mode).
 
     The decoder's DC prediction uses the *left* neighbour when present
@@ -195,15 +200,17 @@ def _encode_row0(y_row, u_row, v_row, qp: int, qpc: int):
     return ydc, yac, udc, uac, vdc, vac, yrec, urec, vrec
 
 
-@functools.partial(jax.jit, static_argnames=("qp",))
-def encode_frame(y, u, v, *, qp: int):
+@jax.jit
+def encode_frame(y, u, v, *, qp):
     """Encode one 4:2:0 frame to quantized levels + reconstruction.
 
     y: (H, W), u/v: (H/2, W/2), integer dtypes, H and W multiples of 16
     (pad with edge replication upstream; SPS cropping trims on decode).
+    ``qp`` is a *traced* int32 scalar (or Python int) — one compile
+    serves every QP, so closed-loop rate control is free.
 
     Returns dict of levels arrays (see :class:`FrameLevels`) plus
-    ``recon_y/u/v`` for PSNR and debugging. jit-compiled per (shape, qp).
+    ``recon_y/u/v`` for PSNR and debugging. jit-compiled per shape.
     """
     h, w = y.shape
     mbh = h // 16
@@ -269,9 +276,16 @@ def encode_frame(y, u, v, *, qp: int):
 
 
 # Batched over a GOP: (N, H, W) / (N, H/2, W/2). One dispatch per rung.
-@functools.partial(jax.jit, static_argnames=("qp",))
-def encode_gop(y, u, v, *, qp: int):
-    return jax.vmap(lambda a, b, c: encode_frame(a, b, c, qp=qp))(y, u, v)
+# ``qp`` may be a scalar (all frames) or a (N,) per-frame vector — the
+# rate controller steps QP between frames without recompiling.
+@jax.jit
+def _encode_gop_vec(y, u, v, qps):
+    return jax.vmap(lambda a, b, c, q: encode_frame(a, b, c, qp=q))(y, u, v, qps)
+
+
+def encode_gop(y, u, v, *, qp):
+    qps = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (y.shape[0],))
+    return _encode_gop_vec(y, u, v, qps)
 
 
 def pad_to_mb(plane: np.ndarray, mb: int = 16) -> np.ndarray:

@@ -16,8 +16,6 @@ Richardson "H.264 and MPEG-4 Video Compression" ch. 7 tables for MF/V.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -72,14 +70,19 @@ _CLASS = np.array(
 )
 
 
-@functools.lru_cache(maxsize=64)
-def _mf_table(qp_mod6: int) -> np.ndarray:
-    return _MF_ABC[qp_mod6][_CLASS]  # (4,4) int32
+# Full (6, 4, 4) tables so a *traced* QP can select its row in-graph —
+# rate control varies QP per frame without recompiling (closed-loop VBR,
+# reference analog: x264/NVENC -b:v in hwaccel.py:660-731).
+_MF_44 = _MF_ABC[:, _CLASS]   # (6, 4, 4)
+_V_44 = _V_ABC[:, _CLASS]     # (6, 4, 4)
 
 
-@functools.lru_cache(maxsize=64)
-def _v_table(qp_mod6: int) -> np.ndarray:
-    return _V_ABC[qp_mod6][_CLASS]  # (4,4) int32
+def _mf_table(qp_mod6):
+    return jnp.asarray(_MF_44)[qp_mod6]  # (4,4) int32; qp_mod6 may be traced
+
+
+def _v_table(qp_mod6):
+    return jnp.asarray(_V_44)[qp_mod6]  # (4,4) int32
 
 
 def core_transform(blocks):
@@ -89,16 +92,17 @@ def core_transform(blocks):
     return jnp.einsum("ij,...jk,lk->...il", cf, x, cf)
 
 
-@functools.partial(jax.jit, static_argnames=("qp", "intra"))
-def quantize(coeffs, *, qp: int, intra: bool = True):
-    """Quantize transformed coefficients (..., 4, 4) at a static QP.
+def quantize(coeffs, *, qp, intra: bool = True):
+    """Quantize transformed coefficients (..., 4, 4).
 
     Z = sign(W) * ((|W| * MF + f) >> qbits), qbits = 15 + QP//6,
-    f = 2^qbits/3 (intra) or /6 (inter).
+    f = 2^qbits/3 (intra) or /6 (inter). ``qp`` may be a Python int or a
+    traced int32 scalar (per-frame rate control).
     """
+    qp = jnp.asarray(qp, jnp.int32)
     qbits = 15 + qp // 6
-    mf = jnp.asarray(_mf_table(qp % 6))
-    f = (1 << qbits) // (3 if intra else 6)
+    mf = _mf_table(qp % 6)
+    f = jnp.left_shift(jnp.int32(1), qbits) // (3 if intra else 6)
     # int32 is sufficient for 8-bit video: |W| <= 255*36 and MF <= 13107,
     # so |W|*MF + f < 2^31. (JAX x64 is disabled by default.)
     w = coeffs.astype(jnp.int32)
@@ -106,10 +110,10 @@ def quantize(coeffs, *, qp: int, intra: bool = True):
     return (jnp.sign(w) * mag).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("qp",))
-def dequantize(levels, *, qp: int):
+def dequantize(levels, *, qp):
     """Dequantize: W' = Z * V * 2^(QP//6) over (..., 4, 4)."""
-    v = jnp.asarray(_v_table(qp % 6))
+    qp = jnp.asarray(qp, jnp.int32)
+    v = _v_table(qp % 6)
     return (levels.astype(jnp.int32) * v) << (qp // 6)
 
 
@@ -149,8 +153,7 @@ def hadamard4(blocks):
     return jnp.einsum("ij,...jk,lk->...il", h, x, h)
 
 
-@functools.partial(jax.jit, static_argnames=("qp",))
-def quantize_luma_dc(dc, *, qp: int):
+def quantize_luma_dc(dc, *, qp):
     """Quantize the 4x4 luma DC Hadamard output (Intra_16x16 path).
 
     Z = sign * ((|Y| * MF(0,0) + f2) >> (qbits+2)). The +2 (vs the AC
@@ -160,9 +163,10 @@ def quantize_luma_dc(dc, *, qp: int):
     V*2^(qp/6-2) per f-coefficient and f = 16*dc*MF/2^(qbits+2) here,
     giving unity end-to-end (4*dc into the inverse core's /64).
     """
+    qp = jnp.asarray(qp, jnp.int32)
     qbits2 = 15 + qp // 6 + 2
-    mf00 = int(_MF_ABC[qp % 6][0])
-    f2 = (1 << qbits2) // 3
+    mf00 = jnp.asarray(_MF_ABC)[qp % 6, 0]
+    f2 = jnp.left_shift(jnp.int32(1), qbits2) // 3
     # |DC| <= 255*16 per block, Hadamard gain 16 -> |Y| <= 65280;
     # 65280 * 13107 < 2^31, int32 safe.
     w = dc.astype(jnp.int32)
@@ -170,24 +174,25 @@ def quantize_luma_dc(dc, *, qp: int):
     return (jnp.sign(w) * mag).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("qp",))
-def dequantize_luma_dc(levels, *, qp: int):
+def dequantize_luma_dc(levels, *, qp):
     """Inverse Hadamard + dequant for luma DC (spec 8.5.10 decoder side).
 
     Input quantized DC (..., 4, 4); output the DC values to place back at
     position (0,0) of each dequantized 4x4 AC block before the inverse core
     transform.
     """
+    qp = jnp.asarray(qp, jnp.int32)
     f = hadamard4(levels)
-    v00 = int(_V_ABC[qp % 6][0])
+    v00 = jnp.asarray(_V_ABC)[qp % 6, 0]
     # Spec 8.5.10 with LevelScale4x4 = 16*V folded into our V table:
     # qP>=36 branch <<(qP/6-6) becomes <<(qP/6-2); the rounding branch
     # (f*16V + 2^(5-qP/6)) >> (6-qP/6) becomes offsets 2^(1-qP/6).
-    if qp >= 12:
-        out = (f * v00) << (qp // 6 - 2)
-    else:
-        out = (f * v00 + (1 << (1 - qp // 6))) >> (2 - qp // 6)
-    return out
+    # Both branches computed with clamped (non-negative) shift amounts so
+    # a traced QP selects via where.
+    hi = (f * v00) << jnp.maximum(qp // 6 - 2, 0)
+    lo = (f * v00 + jnp.left_shift(jnp.int32(1), jnp.maximum(1 - qp // 6, 0))
+          ) >> jnp.maximum(2 - qp // 6, 0)
+    return jnp.where(qp >= 12, hi, lo)
 
 
 def hadamard2x2(dc):
@@ -197,26 +202,26 @@ def hadamard2x2(dc):
     return jnp.einsum("ij,...jk,lk->...il", h, x, h)
 
 
-@functools.partial(jax.jit, static_argnames=("qp",))
-def quantize_chroma_dc(dc, *, qp: int):
+def quantize_chroma_dc(dc, *, qp):
     """Quantize 2x2 chroma DC (spec 8.5.11 encoder mirror)."""
+    qp = jnp.asarray(qp, jnp.int32)
     qbits = 15 + qp // 6
-    mf00 = int(_MF_ABC[qp % 6][0])
-    f = (1 << qbits) // 3
+    mf00 = jnp.asarray(_MF_ABC)[qp % 6, 0]
+    f = jnp.left_shift(jnp.int32(1), qbits) // 3
     w = dc.astype(jnp.int32)
     mag = (jnp.abs(w) * mf00 + 2 * f) >> (qbits + 1)
     return (jnp.sign(w) * mag).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("qp",))
-def dequantize_chroma_dc(levels, *, qp: int):
+def dequantize_chroma_dc(levels, *, qp):
     """Inverse 2x2 Hadamard + dequant for chroma DC (spec 8.5.11).
 
     Spec: ((f * LevelScale(0,0)) << (qP/6)) >> 5 with LevelScale = 16*V,
     which in our V units is >> 1. Truncating shift, per spec.
     """
+    qp = jnp.asarray(qp, jnp.int32)
     f = hadamard2x2(levels)
-    v00 = int(_V_ABC[qp % 6][0])
+    v00 = jnp.asarray(_V_ABC)[qp % 6, 0]
     return ((f * v00) << (qp // 6)) >> 1
 
 

@@ -51,22 +51,75 @@ def ladder_matrices(rungs: tuple[RungSpec, ...], src_h: int, src_w: int) -> dict
     return {name: by_hw[(h, w)] for name, h, w, _ in rungs}
 
 
-def _encode_rung(y, u, v, rung_mats, qp: int):
+def _encode_rung(y, u, v, rung_mats, qp):
     """Shared per-rung body: resize -> MB-pad -> batch intra encode.
 
-    Returns (levels, resized_y) — resized_y is the display-size luma used
-    for quality stats.
+    ``qp`` is a scalar or a (n,) per-frame vector (traced — rate control
+    steps QP without recompiling). Returns (levels, resized_y) —
+    resized_y is the display-size luma used for quality stats.
     """
     ry, ru, rv = resize_yuv420_with(y, u, v, rung_mats)
     py, pu, pv = _pad_mb(ry, ru, rv)
-    levels = jax.vmap(lambda a, b, c: encode_frame(a, b, c, qp=qp))(py, pu, pv)
+    qv = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (py.shape[0],))
+    levels = jax.vmap(
+        lambda a, b, c, q: encode_frame(a, b, c, qp=q))(py, pu, pv, qv)
     return levels, ry
 
 
-def ladder_local(y, u, v, mats: dict, rungs: tuple[RungSpec, ...]):
-    """Device-local body: frames (n, H, W) -> levels for every rung."""
-    return {name: _encode_rung(y, u, v, mats[name], qp)[0]
+def ladder_local(y, u, v, mats: dict, rungs: tuple[RungSpec, ...], qps=None):
+    """Device-local body: frames (n, H, W) -> levels for every rung.
+
+    ``qps`` optionally maps rung name -> per-frame QP vector; rungs'
+    static QP is the default.
+    """
+    return {name: _encode_rung(y, u, v, mats[name],
+                               qp if qps is None else qps[name])[0]
             for name, h, w, qp in rungs}
+
+
+def ladder_encode_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
+                          mesh: Mesh | None = None) -> tuple[Callable, dict]:
+    """The production one-pass ladder step the backend dispatches per batch.
+
+    Returns (fn, mats) with ``fn(y, u, v, mats, qps)`` where ``qps`` maps
+    rung name -> (n,) int32 per-frame QP. Output per rung: the four
+    quantized-levels arrays (what host CAVLC needs) plus ``sse_y`` (n,)
+    float32 over the display region — recon planes never leave the
+    device, saving the dominant HBM->host transfer.
+
+    With a mesh, the batch axis is shard_mapped over "data" (frames are
+    independent in all-intra; zero steady-state collectives) — the
+    multi-chip path of SURVEY.md §2d.5. Without one, a plain jit.
+    """
+    def local(y, u, v, mats, qps):
+        out = {}
+        for name, h, w, qp in rungs:
+            levels, ry = _encode_rung(y, u, v, mats[name], qps[name])
+            err = (levels["recon_y"][:, :h, :w].astype(jnp.float32)
+                   - ry.astype(jnp.float32))
+            out[name] = {
+                "luma_dc": levels["luma_dc"],
+                "luma_ac": levels["luma_ac"],
+                "chroma_dc": levels["chroma_dc"],
+                "chroma_ac": levels["chroma_ac"],
+                "sse_y": jnp.sum(err * err, axis=(1, 2)),
+            }
+        return out
+
+    if mesh is None:
+        fn = jax.jit(local)
+        # Stage the (up to ~100MB at 4K) matrix pytree to HBM once — jit
+        # would otherwise re-upload host numpy args every batch.
+        return fn, jax.device_put(ladder_matrices(rungs, src_h, src_w))
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data"), P(), P("data")),
+        out_specs=P("data"),
+        check_vma=False,
+    )
+    mats = ladder_matrices(rungs, src_h, src_w)
+    mats = jax.device_put(mats, NamedSharding(mesh, P()))
+    return jax.jit(fn), mats
 
 
 def single_chip_ladder(rungs: tuple[RungSpec, ...], src_h: int, src_w: int
