@@ -1,0 +1,130 @@
+"""Regenerate vlog_tpu/codecs/aac/tables.py from the system libavcodec.
+
+The AAC Huffman codebooks and scalefactor-band tables are *normative
+constants* of ISO/IEC 14496-3 (Tables 4.6.x and 4.A.2-4.A.12) — every
+conforming codec carries byte-identical copies, the same way every H.264
+codec carries the CAVLC tables (see gen_tables.py). Rather than
+transcribing ~1000 numbers by hand (and risking a silent bitstream
+corruption), this script extracts them from the system libavcodec
+static archive's ``aactab.o`` and emits them as Python, with this
+provenance recorded in the generated header.
+
+Usage: python -m vlog_tpu.native.gen_aac_tables  (rewrites tables.py)
+"""
+
+from __future__ import annotations
+
+import subprocess
+import tempfile
+from pathlib import Path
+
+_ARCHIVE = "/usr/lib/x86_64-linux-gnu/libavcodec.a"
+
+_DUMP_C = r"""
+#include <stdio.h>
+#include <stdint.h>
+
+extern const uint8_t  ff_aac_num_swb_1024[];
+extern const uint8_t  ff_aac_num_swb_128[];
+extern const uint16_t * const ff_swb_offset_1024[];
+extern const uint16_t * const ff_swb_offset_128[];
+extern const uint32_t ff_aac_scalefactor_code[121];
+extern const uint8_t  ff_aac_scalefactor_bits[121];
+extern const uint16_t * const ff_aac_spectral_codes[11];
+extern const uint8_t  * const ff_aac_spectral_bits[11];
+extern const uint16_t ff_aac_spectral_sizes[11];
+extern const uint8_t  ff_tns_max_bands_1024[];
+extern const uint8_t  ff_tns_max_bands_128[];
+
+/* satisfy aactab.o's window-init helpers (never called here) */
+void ff_kbd_window_init(float *w, float a, int n) { (void)w;(void)a;(void)n; }
+void ff_init_ff_sine_windows(int x) { (void)x; }
+
+#define NUM_SR 13
+
+int main(void) {
+    int i, j;
+    printf("NUM_SAMPLE_RATES = %d\n\n", NUM_SR);
+    printf("NUM_SWB_1024 = [");
+    for (i = 0; i < NUM_SR; i++) printf("%d, ", ff_aac_num_swb_1024[i]);
+    printf("]\n\nNUM_SWB_128 = [");
+    for (i = 0; i < NUM_SR; i++) printf("%d, ", ff_aac_num_swb_128[i]);
+    printf("]\n\n");
+    printf("SWB_OFFSET_1024 = [\n");
+    for (i = 0; i < NUM_SR; i++) {
+        printf("    [");
+        for (j = 0; j <= ff_aac_num_swb_1024[i]; j++)
+            printf("%d, ", ff_swb_offset_1024[i][j]);
+        printf("],\n");
+    }
+    printf("]\n\nSWB_OFFSET_128 = [\n");
+    for (i = 0; i < NUM_SR; i++) {
+        printf("    [");
+        for (j = 0; j <= ff_aac_num_swb_128[i]; j++)
+            printf("%d, ", ff_swb_offset_128[i][j]);
+        printf("],\n");
+    }
+    printf("]\n\n");
+    printf("SCALEFACTOR_BITS = [");
+    for (i = 0; i < 121; i++) printf("%d, ", ff_aac_scalefactor_bits[i]);
+    printf("]\n\nSCALEFACTOR_CODE = [");
+    for (i = 0; i < 121; i++) printf("%u, ", ff_aac_scalefactor_code[i]);
+    printf("]\n\n");
+    printf("SPECTRAL_SIZES = [");
+    for (i = 0; i < 11; i++) printf("%d, ", ff_aac_spectral_sizes[i]);
+    printf("]\n\nSPECTRAL_BITS = [\n");
+    for (i = 0; i < 11; i++) {
+        printf("    [");
+        for (j = 0; j < ff_aac_spectral_sizes[i]; j++)
+            printf("%d, ", ff_aac_spectral_bits[i][j]);
+        printf("],\n");
+    }
+    printf("]\n\nSPECTRAL_CODES = [\n");
+    for (i = 0; i < 11; i++) {
+        printf("    [");
+        for (j = 0; j < ff_aac_spectral_sizes[i]; j++)
+            printf("%u, ", ff_aac_spectral_codes[i][j]);
+        printf("],\n");
+    }
+    printf("]\n\n");
+    printf("TNS_MAX_BANDS_1024 = [");
+    for (i = 0; i < NUM_SR; i++) printf("%d, ", ff_tns_max_bands_1024[i]);
+    printf("]\n\nTNS_MAX_BANDS_128 = [");
+    for (i = 0; i < NUM_SR; i++) printf("%d, ", ff_tns_max_bands_128[i]);
+    printf("]\n");
+    return 0;
+}
+"""
+
+_HEADER = '''\
+"""AAC constant tables — normative ISO/IEC 14496-3 data.
+
+Scalefactor-band offsets (Tables 4.6.x), spectral Huffman codebooks 1-11
+(Tables 4.A.2-4.A.12), the scalefactor codebook (Table 4.A.1) and TNS
+band limits. These are spec constants every conforming codec embeds
+byte-identically; extracted from the system libavcodec archive by
+vlog_tpu/native/gen_aac_tables.py (see its docstring for why). Do not
+edit by hand — regenerate.
+"""
+
+# fmt: off
+'''
+
+
+def generate() -> str:
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        (td / "dump.c").write_text(_DUMP_C)
+        subprocess.run(["ar", "x", _ARCHIVE, "aactab.o"], cwd=td, check=True)
+        subprocess.run(["gcc", "-O0", "dump.c", "aactab.o", "-o", "dump"],
+                       cwd=td, check=True)
+        out = subprocess.run([str(td / "dump")], cwd=td, check=True,
+                             capture_output=True, text=True).stdout
+    return _HEADER + out
+
+
+if __name__ == "__main__":
+    dst = Path(__file__).resolve().parent.parent / "codecs" / "aac" / "tables.py"
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(generate())
+    print(f"wrote {dst}")
