@@ -1,0 +1,64 @@
+/* Minimal AAC(ADTS) -> raw float PCM decoder using the system libavcodec.
+ *
+ * Oracle for the first-party AAC codec (vlog_tpu/codecs/aac): proves our
+ * encoder's bitstreams are spec-valid to an independent decoder and gives a
+ * reference decode to score our own decoder against.  Built on demand by
+ * tests/test_aac.py (like avdec.c for H.264).
+ *
+ * Usage: aacdec <in.adts> <out.f32>   (interleaved float32 PCM)
+ * Prints "channels rate frames" on stdout.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <libavcodec/avcodec.h>
+
+int main(int argc, char **argv) {
+    if (argc != 3) { fprintf(stderr, "usage: %s in.adts out.f32\n", argv[0]); return 2; }
+    FILE *fi = fopen(argv[1], "rb");
+    if (!fi) { perror("in"); return 2; }
+    fseek(fi, 0, SEEK_END); long sz = ftell(fi); fseek(fi, 0, SEEK_SET);
+    uint8_t *buf = malloc(sz + AV_INPUT_BUFFER_PADDING_SIZE);
+    if (fread(buf, 1, sz, fi) != (size_t)sz) { perror("read"); return 2; }
+    memset(buf + sz, 0, AV_INPUT_BUFFER_PADDING_SIZE);
+    fclose(fi);
+
+    const AVCodec *codec = avcodec_find_decoder(AV_CODEC_ID_AAC);
+    AVCodecContext *ctx = avcodec_alloc_context3(codec);
+    if (avcodec_open2(ctx, codec, NULL) < 0) { fprintf(stderr, "open fail\n"); return 1; }
+    AVCodecParserContext *parser = av_parser_init(AV_CODEC_ID_AAC);
+    AVPacket *pkt = av_packet_alloc();
+    AVFrame *frame = av_frame_alloc();
+    FILE *fo = fopen(argv[2], "wb");
+    long pos = 0; int nframes = 0; int channels = 0; int rate = 0;
+
+    while (pos < sz) {
+        int n = av_parser_parse2(parser, ctx, &pkt->data, &pkt->size,
+                                 buf + pos, sz - pos, AV_NOPTS_VALUE,
+                                 AV_NOPTS_VALUE, 0);
+        if (n < 0) { fprintf(stderr, "parse fail\n"); return 1; }
+        pos += n;
+        if (!pkt->size) continue;
+        if (avcodec_send_packet(ctx, pkt) < 0) { fprintf(stderr, "send fail\n"); return 1; }
+        while (avcodec_receive_frame(ctx, frame) == 0) {
+            channels = ctx->ch_layout.nb_channels;
+            rate = ctx->sample_rate;
+            /* fltp planar -> interleave */
+            for (int i = 0; i < frame->nb_samples; i++)
+                for (int c = 0; c < channels; c++)
+                    fwrite(frame->extended_data[c] + 4 * i, 4, 1, fo);
+            nframes++;
+        }
+    }
+    /* flush */
+    avcodec_send_packet(ctx, NULL);
+    while (avcodec_receive_frame(ctx, frame) == 0) {
+        for (int i = 0; i < frame->nb_samples; i++)
+            for (int c = 0; c < ctx->ch_layout.nb_channels; c++)
+                fwrite(frame->extended_data[c] + 4 * i, 4, 1, fo);
+        nframes++;
+    }
+    fclose(fo);
+    printf("%d %d %d\n", channels, rate, nframes);
+    return nframes > 0 ? 0 : 1;
+}

@@ -1,0 +1,211 @@
+"""AAC codec tests: Huffman semantics, self round-trip, libavcodec oracle.
+
+Mirrors the H.264 oracle strategy (tests/test_h264_oracle.py): our
+encoder's bitstreams must decode correctly in the system libavcodec,
+and our decoder must agree with libavcodec's decode of the same stream.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from vlog_tpu.codecs.aac import (
+    AacConfig,
+    AacDecoder,
+    AacEncoder,
+    decode_adts,
+    split_adts,
+)
+from vlog_tpu.codecs.aac import huffman as H
+from vlog_tpu.codecs.aac import tables as T
+from vlog_tpu.media.bitstream import BitReader, BitWriter
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def music_like(sr: int, seconds: float, seed: int = 7) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    n = int(sr * seconds)
+    sig = np.zeros(n)
+    for f0, a in [(220, 0.2), (523, 0.15), (1310, 0.1), (3300, 0.05)]:
+        sig += a * np.sin(2 * np.pi * f0 * np.arange(n) / sr + rng.uniform(0, 6))
+    env = 0.5 + 0.5 * np.sin(2 * np.pi * 2 * np.arange(n) / sr)
+    return sig * env + 0.01 * rng.normal(0, 1, n)
+
+
+# ---------------------------------------------------------------------------
+# Huffman layer
+# ---------------------------------------------------------------------------
+
+def test_book_index_roundtrip():
+    for book, (dim, signed, lav) in H.BOOK_INFO.items():
+        size = T.SPECTRAL_SIZES[book - 1]
+        for idx in range(size):
+            vals = H.book_values(book, idx)
+            assert len(vals) == dim
+            assert H.book_index(book, vals) == idx
+            top = 16 if book == H.ESC_HCB else lav
+            assert all(abs(v) <= top for v in vals)
+
+
+@pytest.mark.parametrize("book", list(range(1, 12)))
+def test_spectral_write_read_roundtrip(book):
+    rng = np.random.default_rng(book)
+    dim, signed, lav = H.BOOK_INFO[book]
+    top = 40 if book == H.ESC_HCB else lav
+    groups = []
+    for _ in range(200):
+        vals = tuple(int(v) for v in rng.integers(-top, top + 1, dim))
+        groups.append(vals)
+    w = BitWriter()
+    for g in groups:
+        H.write_group(w, book, g)
+    w.byte_align()
+    r = BitReader(w.getvalue())
+    for g in groups:
+        assert H.read_group(r, book) == g
+
+
+def test_scalefactor_roundtrip():
+    w = BitWriter()
+    deltas = list(range(-60, 61))
+    for d in deltas:
+        H.write_scalefactor(w, d)
+    w.byte_align()
+    r = BitReader(w.getvalue())
+    for d in deltas:
+        assert H.read_scalefactor(r) == d
+
+
+def test_group_bits_matches_write():
+    rng = np.random.default_rng(0)
+    for book in range(1, 12):
+        dim, signed, lav = H.BOOK_INFO[book]
+        top = 100 if book == H.ESC_HCB else lav
+        for _ in range(50):
+            vals = tuple(int(v) for v in rng.integers(-top, top + 1, dim))
+            w = BitWriter()
+            H.write_group(w, book, vals)
+            assert w.bit_length == H.group_bits(book, vals)
+
+
+# ---------------------------------------------------------------------------
+# Codec round trips
+# ---------------------------------------------------------------------------
+
+def test_adts_framing_roundtrip():
+    cfg = AacConfig(sample_rate=48000, channels=2)
+    from vlog_tpu.codecs.aac import adts_header
+
+    payloads = [b"\x01\x02\x03", b"\xff" * 100, b"x" * 5000]
+    stream = b"".join(adts_header(cfg, len(p)) + p for p in payloads)
+    cfg2, out = split_adts(stream)
+    assert cfg2.sample_rate == 48000 and cfg2.channels == 2
+    assert out == payloads
+
+
+@pytest.mark.parametrize("channels", [1, 2])
+def test_self_roundtrip_snr(channels):
+    sr = 48000
+    sig = music_like(sr, 1.5)
+    pcm = np.stack([sig] * channels) * (1.0 if channels == 1 else
+                                        np.array([[1.0], [0.8]]))
+    enc = AacEncoder(sample_rate=sr, channels=channels, bitrate=128_000)
+    adts = enc.encode_adts(pcm)
+    cfg, out = decode_adts(adts)
+    assert cfg.channels == channels
+    d = 1024
+    n = min(out.shape[1] - d, pcm.shape[1])
+    err = out[:, d:d + n] - pcm[:, :n]
+    snr = 10 * np.log10(np.mean(pcm[:, :n] ** 2) / np.mean(err ** 2))
+    assert snr > 15.0, f"self round-trip SNR {snr:.1f} dB"
+
+
+def test_bitrate_tracking():
+    sr = 48000
+    pcm = np.stack([music_like(sr, 3.0), music_like(sr, 3.0, seed=9)])
+    for target in (96_000, 192_000):
+        enc = AacEncoder(sample_rate=sr, channels=2, bitrate=target)
+        adts = enc.encode_adts(pcm)
+        achieved = len(adts) * 8 / 3.0
+        assert abs(achieved - target) / target < 0.25, (target, achieved)
+
+
+def test_higher_bitrate_higher_snr():
+    sr = 48000
+    pcm = music_like(sr, 1.5)[None]
+
+    def snr_at(bps):
+        enc = AacEncoder(sample_rate=sr, channels=1, bitrate=bps)
+        _, out = decode_adts(enc.encode_adts(pcm))
+        n = min(out.shape[1] - 1024, pcm.shape[1])
+        err = out[:, 1024:1024 + n] - pcm[:, :n]
+        return 10 * np.log10(np.mean(pcm[:, :n] ** 2) / np.mean(err ** 2))
+
+    assert snr_at(160_000) > snr_at(64_000) + 3.0
+
+
+# ---------------------------------------------------------------------------
+# libavcodec oracle
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="session")
+def aacdec(tmp_path_factory):
+    cc = shutil.which("cc") or shutil.which("gcc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    exe = tmp_path_factory.mktemp("aacdec") / "aacdec"
+    r = subprocess.run(
+        [cc, "-O2", "-o", str(exe), str(FIXTURES / "aacdec.c"),
+         "-lavcodec", "-lavutil"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"libavcodec unavailable: {r.stderr[:200]}")
+    return exe
+
+
+def _oracle_decode(aacdec, adts: bytes, tmp_path) -> np.ndarray:
+    src = tmp_path / "in.adts"
+    dst = tmp_path / "out.f32"
+    src.write_bytes(adts)
+    out = subprocess.run([str(aacdec), str(src), str(dst)], check=True,
+                         capture_output=True, text=True)
+    ch, rate, frames = (int(x) for x in out.stdout.split())
+    data = np.fromfile(dst, np.float32)
+    return data.reshape(-1, ch).T
+
+
+@pytest.mark.parametrize("sr,channels", [(48000, 2), (44100, 2), (48000, 1),
+                                         (16000, 1)])
+def test_oracle_decodes_our_streams(aacdec, tmp_path, sr, channels):
+    sig = music_like(sr, 1.0)
+    pcm = np.stack([sig] * channels)
+    enc = AacEncoder(sample_rate=sr, channels=channels, bitrate=96_000)
+    adts = enc.encode_adts(pcm)
+    dec = _oracle_decode(aacdec, adts, tmp_path)
+    assert dec.shape[0] == channels
+    d = 1024
+    n = min(dec.shape[1] - d, pcm.shape[1])
+    assert n > sr // 2
+    err = dec[:, d:d + n] - pcm[:, :n]
+    snr = 10 * np.log10(np.mean(pcm[:, :n] ** 2) / np.mean(err ** 2))
+    assert snr > 15.0, f"oracle SNR {snr:.1f} dB"
+
+
+def test_our_decoder_matches_oracle(aacdec, tmp_path):
+    """Decode the identical stream with both decoders: near-identical
+    output (float rounding only)."""
+    sr = 48000
+    pcm = np.stack([music_like(sr, 1.0), music_like(sr, 1.0, seed=3)])
+    enc = AacEncoder(sample_rate=sr, channels=2, bitrate=128_000)
+    adts = enc.encode_adts(pcm)
+    _, ours = decode_adts(adts)
+    ref = _oracle_decode(aacdec, adts, tmp_path)
+    n = min(ours.shape[1], ref.shape[1])
+    err = ours[:, :n] - ref[:, :n]
+    denom = np.mean(ref[:, :n] ** 2) + 1e-20
+    snr = 10 * np.log10(denom / (np.mean(err ** 2) + 1e-20))
+    assert snr > 80.0, f"decoder agreement only {snr:.1f} dB"

@@ -1,0 +1,206 @@
+"""Audio ingest + rendition-group pipeline tests.
+
+Round-1 VERDICT item #2: output CMAF must carry audio. These build an
+A/V MP4 with the package's own muxer/codecs, run the full pipeline, and
+assert the audio group exists, validates, plays back (decodes) and is
+referenced from master/DASH.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from vlog_tpu import config
+from vlog_tpu.codecs.aac import AacEncoder
+from vlog_tpu.codecs.h264.api import H264Encoder
+from vlog_tpu.media import hls
+from vlog_tpu.media.audio import (
+    AudioData,
+    extract_audio,
+    read_wav,
+    resample,
+    to_mono,
+    write_wav,
+)
+from vlog_tpu.media.fmp4 import (
+    Sample,
+    TrackConfig,
+    avc1_sample_entry,
+    mp4a_sample_entry,
+    progressive_mp4_multi,
+)
+from vlog_tpu.worker import process_video
+
+
+def tone(sr: int, seconds: float, freq: float = 440.0) -> np.ndarray:
+    t = np.arange(int(sr * seconds)) / sr
+    return 0.4 * np.sin(2 * np.pi * freq * t)
+
+
+def make_av_mp4(path: Path, *, seconds: float = 2.0, fps: int = 12,
+                w: int = 96, h: int = 64, sr: int = 48000) -> Path:
+    """A/V MP4: our H.264 intra video + our AAC audio."""
+    n = int(seconds * fps)
+    yy, xx = np.mgrid[0:h, 0:w]
+    ys = np.stack([((yy * 2 + xx * 3 + t * 7) % 256).astype(np.uint8)
+                   for t in range(n)])
+    us = np.stack([np.full((h // 2, w // 2), 110, np.uint8)] * n)
+    vs = np.stack([np.full((h // 2, w // 2), 150, np.uint8)] * n)
+    venc = H264Encoder(width=w, height=h, qp=24, fps_num=fps)
+    vsamples = [Sample(data=f.avcc, duration=1000, is_sync=True)
+                for f in venc.encode(ys, us, vs)]
+    vtrack = TrackConfig(track_id=1, handler="vide", timescale=fps * 1000,
+                         sample_entry=avc1_sample_entry(w, h, venc.avcc_config),
+                         width=w, height=h)
+
+    pcm = np.stack([tone(sr, seconds, 440), tone(sr, seconds, 660)])
+    aenc = AacEncoder(sample_rate=sr, channels=2, bitrate=128_000)
+    asamples = [Sample(data=p, duration=1024, is_sync=True)
+                for p in aenc.encode_frames(pcm)]
+    atrack = TrackConfig(
+        track_id=2, handler="soun", timescale=sr,
+        sample_entry=mp4a_sample_entry(
+            2, sr, aenc.config.audio_specific_config()))
+    path.write_bytes(progressive_mp4_multi(
+        [(vtrack, vsamples), (atrack, asamples)]))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Ingest
+# ---------------------------------------------------------------------------
+
+def test_wav_roundtrip(tmp_path):
+    sr = 22050
+    a = AudioData(pcm=np.stack([tone(sr, 0.5), tone(sr, 0.5, 880)]),
+                  sample_rate=sr)
+    write_wav(tmp_path / "t.wav", a)
+    b = read_wav(tmp_path / "t.wav")
+    assert b.sample_rate == sr and b.channels == 2
+    assert np.max(np.abs(b.pcm - a.pcm)) < 1e-3
+
+
+def test_resample_and_mono():
+    sr = 48000
+    a = AudioData(pcm=np.stack([tone(sr, 0.5)]), sample_rate=sr)
+    b = resample(a, 16000)
+    assert b.sample_rate == 16000
+    assert abs(b.pcm.shape[1] - a.pcm.shape[1] / 3) < 4
+    # tone survives resampling
+    spec = np.abs(np.fft.rfft(b.pcm[0]))
+    peak_hz = np.argmax(spec) * 16000 / b.pcm.shape[1]
+    assert abs(peak_hz - 440) < 5
+    st = AudioData(pcm=np.stack([tone(sr, 0.1), -tone(sr, 0.1)]),
+                   sample_rate=sr)
+    assert np.max(np.abs(to_mono(st).pcm)) < 1e-9
+
+
+def test_extract_mp4_audio_roundtrip(tmp_path):
+    src = make_av_mp4(tmp_path / "av.mp4", seconds=1.0)
+    audio = extract_audio(src)
+    assert audio is not None
+    assert audio.sample_rate == 48000 and audio.channels == 2
+    # decode-back correlates strongly with the original tone
+    ref = tone(48000, 1.0, 440)
+    n = min(audio.pcm.shape[1], ref.shape[0])
+    c = np.corrcoef(audio.pcm[0, :n], ref[:n])[0, 1]
+    assert c > 0.95, f"correlation {c}"
+
+
+def test_extract_audio_none_for_y4m(tmp_path):
+    from vlog_tpu.media import y4m
+
+    frames = [(np.zeros((16, 16), np.uint8), np.zeros((8, 8), np.uint8),
+               np.zeros((8, 8), np.uint8))]
+    y4m.write_y4m(tmp_path / "v.y4m", frames, fps_num=1)
+    assert extract_audio(tmp_path / "v.y4m") is None
+
+
+# ---------------------------------------------------------------------------
+# Pipeline with audio
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def av_pipeline(tmp_path_factory):
+    td = tmp_path_factory.mktemp("avpipe")
+    src = make_av_mp4(td / "av.mp4", seconds=2.0)
+    out = td / "out"
+    rungs = (config.LADDER_BY_NAME["360p"], config.LADDER_BY_NAME["480p"])
+    result = process_video(src, out, rungs=rungs, segment_duration_s=1.0,
+                           frame_batch=8, thumbnail=False)
+    return result, out
+
+
+def test_audio_renditions_emitted(av_pipeline):
+    result, out = av_pipeline
+    names = {a["name"] for a in result.audio_renditions}
+    # 360p pairs 96k, 480p pairs 128k (config ladder audio rates)
+    assert names == {"audio_96k", "audio_128k"}
+    for a in result.audio_renditions:
+        res = hls.validate_media_playlist(out / a["uri"], expect_cmaf=True)
+        assert res["segments"] >= 2
+        assert abs(res["duration_s"] - 2.0) < 0.2
+
+
+def test_master_references_audio(av_pipeline):
+    result, out = av_pipeline
+    master = (out / "master.m3u8").read_text()
+    assert "#EXT-X-MEDIA:TYPE=AUDIO" in master
+    assert 'GROUP-ID="aud96"' in master and 'GROUP-ID="aud128"' in master
+    assert 'AUDIO="aud96"' in master and 'AUDIO="aud128"' in master
+    assert "mp4a.40.2" in master
+    # recursive validation covers the audio playlists too
+    results = hls.validate_master_playlist(out / "master.m3u8")
+    assert any("audio_96k" in uri for uri in results)
+
+
+def test_dash_has_audio_adaptation_set(av_pipeline):
+    result, out = av_pipeline
+    mpd = (out / "manifest.mpd").read_text()
+    assert 'mimeType="audio/mp4"' in mpd
+    assert "audio_128k/segment_$Number%05d$.m4s" in mpd
+
+
+def test_audio_segments_decode(av_pipeline):
+    """Audio rendition segments must decode back to the source tone."""
+    from vlog_tpu.codecs.aac.adts import AacConfig
+    from vlog_tpu.codecs.aac.decoder import AacDecoder
+    from vlog_tpu.media.boxes import parse_box_tree
+
+    result, out = av_pipeline
+    rdir = out / "audio_128k"
+    dec = AacDecoder(AacConfig(sample_rate=48000, channels=2))
+    pcm = []
+    for seg in sorted(rdir.glob("segment_*.m4s")):
+        data = seg.read_bytes()
+        with open(seg, "rb") as fp:
+            tree = parse_box_tree(fp)
+        mdat = next(b for b in tree if b.type == "mdat")
+        payload = data[mdat.offset + 8: mdat.offset + mdat.size]
+        trun = next(b for b in tree if b.type == "moof").find("traf", "trun")
+        cnt = int.from_bytes(trun.payload[4:8], "big")
+        sizes = [int.from_bytes(trun.payload[12 + 16 * k + 4:16 + 16 * k + 4],
+                                "big") for k in range(cnt)]
+        off = 0
+        for sz in sizes:
+            pcm.append(dec.decode_frame(payload[off:off + sz]))
+            off += sz
+    audio = np.concatenate(pcm, axis=1)
+    ref = tone(48000, 2.0, 440)
+    n = min(audio.shape[1], ref.shape[0])
+    # skip the fade-in region from the dropped priming frame
+    c = np.corrcoef(audio[0, 2048:n], ref[2048:n])[0, 1]
+    assert c > 0.9, f"correlation {c}"
+
+
+def test_resume_skips_complete_audio(av_pipeline, tmp_path):
+    """Re-running the pipeline must not re-encode finished audio."""
+    result, out = av_pipeline
+    seg = out / "audio_128k" / "segment_00001.m4s"
+    before = seg.stat().st_mtime_ns
+    src = out.parent / "av.mp4"
+    rungs = (config.LADDER_BY_NAME["360p"], config.LADDER_BY_NAME["480p"])
+    process_video(src, out, rungs=rungs, segment_duration_s=1.0,
+                  frame_batch=8, thumbnail=False)
+    assert seg.stat().st_mtime_ns == before

@@ -56,6 +56,7 @@ class PlannedRung:
     video_bitrate: int
     qp: int
     codec: str = "h264"
+    audio_bitrate: int = 0     # paired AAC rendition rate (0 = video-only)
 
 
 @dataclass
@@ -102,6 +103,11 @@ class RunResult:
     duration_s: float
     thumbnail_path: str | None = None
     wall_s: float = 0.0
+    # master-playlist variant refs (media.hls.VariantRef) so the pipeline
+    # can re-emit manifests once audio renditions exist
+    variants: list = field(default_factory=list)
+    fps: float = 0.0
+    segment_duration_s: float = 0.0
 
 
 # progress_cb(frames_done, frames_total, message)
@@ -179,4 +185,5 @@ def plan_rung_geometry(src_w: int, src_h: int, rung: config.QualityRung,
     return PlannedRung(
         name=rung.name, width=max(w, 2), height=max(h, 2),
         video_bitrate=rung.video_bitrate, qp=rung.base_qp, codec=codec,
+        audio_bitrate=getattr(rung, "audio_bitrate", 0),
     )

@@ -308,6 +308,8 @@ class JaxBackend:
                 bandwidth=max(achieved, 1), width=rung.width,
                 height=rung.height, codecs=enc.codec_string,
                 frame_rate=fps,
+                audio_group=(f"aud{rung.audio_bitrate // 1000}"
+                             if rung.audio_bitrate else ""),
             ))
         (out / "master.m3u8").write_text(hls.master_playlist(variants))
         (out / "manifest.mpd").write_text(hls.dash_manifest(
@@ -318,6 +320,8 @@ class JaxBackend:
             rungs=results, frames_processed=frames_done,
             duration_s=duration_s, thumbnail_path=thumb_path,
             wall_s=time.monotonic() - t0,
+            variants=variants, fps=fps,
+            segment_duration_s=plan.segment_duration_s,
         )
 
     # ------------------------------------------------------------------

@@ -1,0 +1,18 @@
+"""First-party AAC-LC codec: TPU-batched MDCT encoder + host decoder.
+
+Replaces the reference's delegation of all audio to ffmpeg's aac codec
+(worker/hwaccel.py:700-706 encode; transcription.py:259-299 decode).
+"""
+
+from vlog_tpu.codecs.aac.adts import AacConfig, adts_header, split_adts
+from vlog_tpu.codecs.aac.decoder import AacDecoder, decode_adts
+from vlog_tpu.codecs.aac.encoder import AacEncoder
+
+__all__ = [
+    "AacConfig",
+    "AacDecoder",
+    "AacEncoder",
+    "adts_header",
+    "decode_adts",
+    "split_adts",
+]

@@ -1,0 +1,94 @@
+"""MDCT / IMDCT + AAC window shapes (ISO/IEC 14496-3 4.6.11).
+
+TPU-first design: the MDCT is a dense (N/2, N) cosine-basis matmul —
+for 48 kHz audio a whole 30 s chunk is a (1407, 2048) x (2048, 1024)
+batched matmul, exactly the shape the MXU wants. No FFT factorization
+needed at these sizes; the matrix is 8 MB and lives in HBM.
+
+The decoder's IMDCT mirrors it host-side in numpy (ingest is not the
+hot path).
+
+Conventions (calibrated against the libavcodec AAC decoder, see
+tests/test_aac.py): forward X[k] = 2 sum_n z[n] cos(2pi/N (n+n0)(k+1/2)),
+inverse x[n] = (2/N) sum_k X[k] cos(...), n0 = (N/2+1)/2 — the spec's
+4.6.11.1 scaling, which independent decoders assume. Sine and KBD
+windows per 4.6.11.3; with OLA the pair is unity-gain (Princen-Bradley
+TDAC).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+LONG_N = 2048
+SHORT_N = 256
+
+ONLY_LONG_SEQUENCE = 0
+LONG_START_SEQUENCE = 1
+EIGHT_SHORT_SEQUENCE = 2
+LONG_STOP_SEQUENCE = 3
+
+
+@functools.lru_cache(maxsize=8)
+def mdct_matrix(n: int) -> np.ndarray:
+    """(N/2, N) forward cosine basis."""
+    n0 = (n // 2 + 1) / 2.0
+    k = np.arange(n // 2, dtype=np.float64)[:, None]
+    t = np.arange(n, dtype=np.float64)[None, :]
+    return np.cos(2.0 * np.pi / n * (t + n0) * (k + 0.5))
+
+
+@functools.lru_cache(maxsize=8)
+def sine_window(n: int) -> np.ndarray:
+    """sin(pi/N (n + 1/2)), full length N (4.6.11.3.2)."""
+    i = np.arange(n, dtype=np.float64)
+    return np.sin(np.pi / n * (i + 0.5))
+
+
+@functools.lru_cache(maxsize=8)
+def kbd_window(n: int, alpha: float | None = None) -> np.ndarray:
+    """Kaiser-Bessel-derived window (4.6.11.3.3): alpha=4 long, 6 short."""
+    if alpha is None:
+        alpha = 4.0 if n >= LONG_N else 6.0
+    half = n // 2
+    from numpy import i0
+
+    t = np.arange(half + 1, dtype=np.float64)
+    kaiser = i0(np.pi * alpha * np.sqrt(1.0 - (2.0 * t / half - 1.0) ** 2))
+    cum = np.cumsum(kaiser)
+    w_half = np.sqrt(cum[:half] / cum[half])
+    return np.concatenate([w_half, w_half[::-1]])
+
+
+def window_halves(shape: int, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """(rising, falling) halves for window_shape 0=sine, 1=KBD."""
+    w = kbd_window(n) if shape else sine_window(n)
+    return w[: n // 2], w[n // 2:]
+
+
+def forward_mdct(frames: np.ndarray, basis: np.ndarray | None = None,
+                 use_jax: bool = False):
+    """(..., N) windowed time blocks -> (..., N/2) coefficients.
+
+    Caller applies the window first (it varies per frame with
+    transitions); this is the pure basis matmul so it can run inside a
+    jit alongside the quantizer.
+    """
+    n = frames.shape[-1]
+    m = mdct_matrix(n) if basis is None else basis
+    if use_jax:
+        import jax.numpy as jnp
+
+        return 2.0 * jnp.einsum("kn,...n->...k", jnp.asarray(m, jnp.float32),
+                                frames.astype(jnp.float32))
+    return 2.0 * (frames.astype(np.float64) @ m.T)
+
+
+def inverse_mdct(coeffs: np.ndarray) -> np.ndarray:
+    """(..., N/2) coefficients -> (..., N) time aliased blocks (2/N scale)."""
+    half = coeffs.shape[-1]
+    n = half * 2
+    m = mdct_matrix(n)
+    return (2.0 / n) * (coeffs.astype(np.float64) @ m)

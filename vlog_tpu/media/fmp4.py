@@ -78,6 +78,51 @@ def raw_sample_entry(entry: bytes) -> bytes:
     return entry
 
 
+def _descriptor(tag: int, payload: bytes) -> bytes:
+    """MPEG-4 BaseDescriptor with minimal-length size encoding."""
+    size = len(payload)
+    lens = bytearray()
+    while True:
+        lens.insert(0, size & 0x7F)
+        size >>= 7
+        if not size:
+            break
+    for i in range(len(lens) - 1):
+        lens[i] |= 0x80
+    return bytes([tag]) + bytes(lens) + payload
+
+
+def esds_box(asc: bytes, avg_bitrate: int = 128_000) -> bytes:
+    """ES_Descriptor for MPEG-4 AAC (ISO 14496-1 7.2.6.5)."""
+    dec_specific = _descriptor(0x05, asc)
+    dec_config = _descriptor(
+        0x04,
+        u8(0x40)                    # objectTypeIndication: MPEG-4 Audio
+        + u8((0x05 << 2) | 1)       # streamType audio, upStream 0, reserved 1
+        + u24(6144)                 # bufferSizeDB
+        + u32(avg_bitrate * 2)      # maxBitrate
+        + u32(avg_bitrate)
+        + dec_specific,
+    )
+    sl_config = _descriptor(0x06, u8(2))
+    es = _descriptor(0x03, u16(1) + u8(0) + dec_config + sl_config)
+    return full_box("esds", 0, 0, es)
+
+
+def mp4a_sample_entry(channels: int, sample_rate: int, asc: bytes,
+                      avg_bitrate: int = 128_000) -> bytes:
+    """AudioSampleEntry 'mp4a' + esds (ISO 14496-14 5.6)."""
+    return box(
+        "mp4a",
+        b"\x00" * 6 + u16(1),       # reserved + data_reference_index
+        u32(0) * 2,                 # reserved
+        u16(channels) + u16(16),    # channelcount, samplesize
+        u16(0) + u16(0),            # pre_defined, reserved
+        u32(sample_rate << 16),     # 16.16 fixed
+        esds_box(asc, avg_bitrate),
+    )
+
+
 # --------------------------------------------------------------------------
 # Shared moov machinery
 # --------------------------------------------------------------------------
@@ -222,50 +267,68 @@ def media_segment(
 # Progressive MP4 (single-track, faststart layout: moov before mdat)
 # --------------------------------------------------------------------------
 
-def progressive_mp4(track: TrackConfig, samples: list[Sample]) -> bytes:
-    """One-track progressive MP4, moov-first ("faststart")."""
-    n = len(samples)
-    sizes = [len(s.data) for s in samples]
-    total_duration = sum(s.duration for s in samples)
+def progressive_mp4_multi(
+    tracks: list[tuple[TrackConfig, list[Sample]]]) -> bytes:
+    """Multi-track progressive MP4, moov-first; one chunk per track.
 
-    # stts: run-length encode durations
-    stts_entries: list[tuple[int, int]] = []
-    for s in samples:
-        if stts_entries and stts_entries[-1][1] == s.duration:
-            stts_entries[-1] = (stts_entries[-1][0] + 1, s.duration)
-        else:
-            stts_entries.append((1, s.duration))
-    stts = full_box(
-        "stts", 0, 0, u32(len(stts_entries)),
-        b"".join(u32(c) + u32(d) for c, d in stts_entries),
-    )
-    stsc = full_box("stsc", 0, 0, u32(1), u32(1) + u32(n) + u32(1))  # 1 chunk, n samples
-    stsz = full_box("stsz", 0, 0, u32(0), u32(n), b"".join(u32(sz) for sz in sizes))
-    sync_idx = [i for i, s in enumerate(samples) if s.is_sync]
-    stss = (
-        full_box("stss", 0, 0, u32(len(sync_idx)), b"".join(u32(i + 1) for i in sync_idx))
-        if len(sync_idx) != n
-        else b""
-    )
+    A/V uploads are this shape (reference fixtures: sample_videos.py's
+    hand-built atoms); also the 'original' remux container.
+    """
+    ftyp = box("ftyp", b"isom", u32(512), b"isomiso2avc1mp41")
+    movie_ts = max(t.timescale for t, _ in tracks)
+    movie_dur = max(
+        (sum(s.duration for s in ss) * movie_ts) // t.timescale
+        for t, ss in tracks)
 
-    # The single chunk's offset depends on moov size -> compute with placeholder.
-    def build_moov(chunk_offset: int) -> bytes:
+    def build_trak(track: TrackConfig, samples: list[Sample],
+                   chunk_offset: int) -> bytes:
+        n = len(samples)
+        total = sum(s.duration for s in samples)
+        stts_entries: list[tuple[int, int]] = []
+        for s in samples:
+            if stts_entries and stts_entries[-1][1] == s.duration:
+                stts_entries[-1] = (stts_entries[-1][0] + 1, s.duration)
+            else:
+                stts_entries.append((1, s.duration))
+        stts = full_box("stts", 0, 0, u32(len(stts_entries)),
+                        b"".join(u32(c) + u32(d) for c, d in stts_entries))
+        stsc = full_box("stsc", 0, 0, u32(1), u32(1) + u32(n) + u32(1))
+        stsz = full_box("stsz", 0, 0, u32(0), u32(n),
+                        b"".join(u32(len(s.data)) for s in samples))
+        sync_idx = [i for i, s in enumerate(samples) if s.is_sync]
+        stss = (full_box("stss", 0, 0, u32(len(sync_idx)),
+                         b"".join(u32(i + 1) for i in sync_idx))
+                if len(sync_idx) != n else b"")
         stco = full_box("stco", 0, 0, u32(1), u32(chunk_offset))
         stbl = box("stbl", full_box("stsd", 0, 0, u32(1), track.sample_entry),
                    stts, stsc, stsz, *([stss] if stss else []), stco)
         minf = box("minf", _media_header(track.handler), _dinf(), stbl)
-        mdia = box("mdia", _mdhd(track.timescale, total_duration),
+        mdia = box("mdia", _mdhd(track.timescale, total),
                    _hdlr(track.handler, "vlog_tpu"), minf)
-        trak = box("trak", _tkhd(track.track_id, total_duration, track.width, track.height), mdia)
-        return box("moov", _mvhd(track.timescale, total_duration), trak)
+        return box("trak", _tkhd(track.track_id, (total * movie_ts) // track.timescale,
+                                 track.width, track.height), mdia)
 
-    ftyp = box("ftyp", b"isom", u32(512), b"isomiso2avc1mp41")
-    moov_size = len(build_moov(0))
-    payload = b"".join(s.data for s in samples)
-    # box() switches to a 16-byte largesize header past 4 GiB
-    mdat_header = 16 if 8 + len(payload) > 0xFFFFFFFF else 8
-    chunk_offset = len(ftyp) + moov_size + mdat_header
-    moov = build_moov(chunk_offset)
+    def build_moov(offsets: list[int]) -> bytes:
+        traks = [build_trak(t, ss, off)
+                 for (t, ss), off in zip(tracks, offsets)]
+        return box("moov", _mvhd(movie_ts, movie_dur), *traks)
+
+    payloads = [b"".join(s.data for s in ss) for _, ss in tracks]
+    moov_size = len(build_moov([0] * len(tracks)))
+    total_payload = sum(len(p) for p in payloads)
+    mdat_header = 16 if 8 + total_payload > 0xFFFFFFFF else 8
+    base = len(ftyp) + moov_size + mdat_header
+    offsets = []
+    pos = base
+    for p in payloads:
+        offsets.append(pos)
+        pos += len(p)
+    moov = build_moov(offsets)
     assert len(moov) == moov_size
-    mdat = box("mdat", payload)
+    mdat = box("mdat", b"".join(payloads))
     return ftyp + moov + mdat
+
+
+def progressive_mp4(track: TrackConfig, samples: list[Sample]) -> bytes:
+    """One-track progressive MP4, moov-first ("faststart")."""
+    return progressive_mp4_multi([(track, samples)])

@@ -29,6 +29,24 @@ class VariantRef:
     height: int
     codecs: str               # RFC 6381, e.g. "avc1.42C01F"
     frame_rate: float = 0.0
+    audio_group: str = ""     # EXT-X-MEDIA GROUP-ID this rung pairs with
+
+
+@dataclass
+class AudioRendition:
+    """One audio-only rendition (reference ladder pairs audio bitrates
+    with rungs, README.md:201-212; CMAF carries them as a separate
+    track group)."""
+
+    name: str                 # "audio_128k"
+    uri: str                  # "audio_128k/playlist.m3u8"
+    group_id: str             # "aud128"
+    bitrate: int
+    channels: int = 2
+    codecs: str = "mp4a.40.2"
+    language: str = "und"
+    default: bool = True
+    sample_rate: int = 48000
 
 
 # --------------------------------------------------------------------------
@@ -60,16 +78,34 @@ def media_playlist(
     return "\n".join(lines) + "\n"
 
 
-def master_playlist(variants: list[VariantRef]) -> str:
+def master_playlist(variants: list[VariantRef],
+                    audio: list[AudioRendition] | None = None) -> str:
     lines = ["#EXTM3U", "#EXT-X-VERSION:7"]
+    for a in audio or []:
+        lines.append(
+            "#EXT-X-MEDIA:TYPE=AUDIO,"
+            f'GROUP-ID="{a.group_id}",NAME="{a.name}",'
+            f'LANGUAGE="{a.language}",'
+            f"DEFAULT={'YES' if a.default else 'NO'},AUTOSELECT=YES,"
+            f"CHANNELS=\"{a.channels}\",URI=\"{a.uri}\""
+        )
     for v in sorted(variants, key=lambda v: -v.bandwidth):
+        codecs = v.codecs
+        bandwidth = v.bandwidth
+        paired = (next((a for a in audio if a.group_id == v.audio_group), None)
+                  if v.audio_group and audio else None)
+        if paired is not None:
+            codecs = f"{codecs},{paired.codecs}"
+            bandwidth += paired.bitrate
         attrs = [
-            f"BANDWIDTH={v.bandwidth}",
+            f"BANDWIDTH={bandwidth}",
             f"RESOLUTION={v.width}x{v.height}",
-            f'CODECS="{v.codecs}"',
+            f'CODECS="{codecs}"',
         ]
         if v.frame_rate:
             attrs.append(f"FRAME-RATE={v.frame_rate:.3f}")
+        if paired is not None:   # never reference an undefined GROUP-ID
+            attrs.append(f'AUDIO="{v.audio_group}"')
         lines.append("#EXT-X-STREAM-INF:" + ",".join(attrs))
         lines.append(v.uri)
     return "\n".join(lines) + "\n"
@@ -81,6 +117,7 @@ def dash_manifest(
     duration_s: float,
     segment_duration_s: float,
     timescale: int = 90_000,
+    audio: list[AudioRendition] | None = None,
 ) -> str:
     """Static MPD with SegmentTemplate per representation.
 
@@ -103,6 +140,29 @@ def dash_manifest(
             f"      </Representation>"
         )
     reps_xml = "\n".join(reps)
+    audio_xml = ""
+    if audio:
+        areps = []
+        for a in sorted(audio, key=lambda a: -a.bitrate):
+            base = a.uri.rsplit("/", 1)[0]
+            # Audio segments hold a whole number of 1024-sample AAC
+            # frames; declare the EXACT duration in the audio timescale
+            # or number-based addressing drifts over long videos.
+            seg_samples = max(1, round(segment_duration_s * a.sample_rate
+                                       / 1024)) * 1024
+            areps.append(
+                f'      <Representation id="{a.name}" bandwidth="{a.bitrate}" '
+                f'audioSamplingRate="{a.sample_rate}" codecs="{a.codecs}">\n'
+                f'        <SegmentTemplate timescale="{a.sample_rate}" '
+                f'duration="{seg_samples}" '
+                f'initialization="{base}/init.mp4" '
+                f'media="{base}/segment_$Number%05d$.m4s" startNumber="1"/>\n'
+                f"      </Representation>"
+            )
+        audio_xml = (
+            '    <AdaptationSet mimeType="audio/mp4" segmentAlignment="true" '
+            'startWithSAP="1">\n' + "\n".join(areps) + "\n    </AdaptationSet>\n"
+        )
     return (
         '<?xml version="1.0" encoding="UTF-8"?>\n'
         '<MPD xmlns="urn:mpeg:dash:schema:mpd:2011" type="static" '
@@ -114,6 +174,7 @@ def dash_manifest(
         'startWithSAP="1">\n'
         f"{reps_xml}\n"
         "    </AdaptationSet>\n"
+        f"{audio_xml}"
         "  </Period>\n"
         "</MPD>\n"
     )
@@ -221,12 +282,15 @@ def validate_master_playlist(path: str | Path) -> dict:
     if not lines or lines[0] != "#EXTM3U":
         raise PlaylistValidationError(f"{path}: missing #EXTM3U header")
     variants = []
+    media_uris = []
     expect_uri = False
     for ln in lines:
         if ln.startswith("#EXT-X-STREAM-INF:"):
             if expect_uri:
                 raise PlaylistValidationError(f"{path}: STREAM-INF without URI")
             expect_uri = True
+        elif ln.startswith("#EXT-X-MEDIA:") and 'URI="' in ln:
+            media_uris.append(ln.split('URI="', 1)[1].split('"', 1)[0])
         elif not ln.startswith("#") and expect_uri:
             variants.append(ln)
             expect_uri = False
@@ -235,6 +299,6 @@ def validate_master_playlist(path: str | Path) -> dict:
     if not variants:
         raise PlaylistValidationError(f"{path}: no variants")
     results = {}
-    for uri in variants:
+    for uri in variants + media_uris:
         results[uri] = validate_media_playlist(path.parent / uri)
     return results

@@ -10,6 +10,8 @@ Steps (checkpointable by inspecting the output directory):
   1. probe         — media.probe.get_video_info
   2. original      — copy the upload next to the renditions
   3. ladder        — backend.run (thumbnail + segments + playlists)
+  3b. audio        — AAC rendition group at the ladder's audio bitrates
+                     (reference hwaccel.py:700-706 `-c:a aac`)
   4. verify        — validate master/media playlists + segment atoms
   5. finalize      — summary dict for the DB/webhook layer
 """
@@ -40,6 +42,7 @@ class ProcessResult:
     master_playlist: str
     dash_manifest: str
     qualities: list[dict] = field(default_factory=list)
+    audio_renditions: list[dict] = field(default_factory=list)
 
     def to_db_rows(self) -> list[dict]:
         """Rows for the video_qualities table (reference database.py)."""
@@ -68,6 +71,7 @@ def process_video(
     keep_original: bool = True,
     resume: bool = True,
     rungs=None,
+    audio: bool = True,
     **plan_opts,
 ) -> ProcessResult:
     """Run the full pipeline for one video. Blocking & compute-heavy —
@@ -98,6 +102,28 @@ def process_video(
     plan = be.plan(info, rungs, out_dir, **plan_opts)
     run = be.run(plan, progress_cb, resume=resume)
 
+    # Step 3b: audio rendition group (one per distinct ladder audio
+    # bitrate), then re-emit master/DASH including the audio tracks.
+    audio_refs: list[hls.AudioRendition] = []
+    if audio and info.audio_codec:
+        from vlog_tpu.media.audio import extract_audio
+        from vlog_tpu.worker.audio import encode_audio_renditions
+
+        src_audio = extract_audio(source_path)
+        if src_audio is not None and src_audio.pcm.size:
+            bitrates = [r.audio_bitrate for r in plan.rungs
+                        if r.audio_bitrate]
+            audio_refs = encode_audio_renditions(
+                src_audio, out_dir, bitrates,
+                segment_duration_s=plan.segment_duration_s, resume=resume)
+            if audio_refs and run.variants:
+                (out_dir / "master.m3u8").write_text(
+                    hls.master_playlist(run.variants, audio=audio_refs))
+                (out_dir / "manifest.mpd").write_text(hls.dash_manifest(
+                    run.variants, duration_s=run.duration_s,
+                    segment_duration_s=run.segment_duration_s,
+                    audio=audio_refs))
+
     # Step 4: verification (validate_hls_playlist analog)
     master = out_dir / "master.m3u8"
     try:
@@ -115,6 +141,11 @@ def process_video(
         original_path=original,
         master_playlist=str(master),
         dash_manifest=str(out_dir / "manifest.mpd"),
+        audio_renditions=[
+            {"name": a.name, "bitrate": a.bitrate, "channels": a.channels,
+             "codecs": a.codecs, "uri": a.uri}
+            for a in audio_refs
+        ],
     )
     result.qualities = result.to_db_rows()
     return result
