@@ -1,0 +1,242 @@
+"""Worker daemon: the system test — claim → process → ready, unattended.
+
+Reference analog: tests around worker_loop (test_worker_integration.py,
+test_transcoder_integration.py:977-1186): a video row + a started daemon is
+all it takes to reach status=ready; leases extend mid-transcode; shutdown
+hands claims back; startup recovers a crashed incarnation's claims.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from vlog_tpu import config
+from vlog_tpu.enums import AcceleratorKind, JobKind
+from vlog_tpu.jobs import claims, state as js, videos as vids
+from vlog_tpu.worker.daemon import JobCancelled, WorkerDaemon
+from tests.fixtures.media import make_y4m
+
+
+@pytest.fixture
+def video_job(run, db, tmp_path):
+    """A pending video row + enqueued transcode job over a tiny Y4M."""
+    src = make_y4m(tmp_path / "src.y4m", n_frames=10, width=128, height=96,
+                   fps=24)
+    video = run(vids.create_video(db, "Daemon Test", source_path=str(src),
+                                  size_bytes=src.stat().st_size))
+    job_id = run(claims.enqueue_job(db, video["id"]))
+    return video, job_id, src
+
+
+def make_daemon(db, tmp_path, **kw):
+    kw.setdefault("name", "test-worker")
+    kw.setdefault("accelerator", AcceleratorKind.TPU)
+    kw.setdefault("video_dir", tmp_path / "videos")
+    kw.setdefault("progress_min_interval_s", 0.0)
+    return WorkerDaemon(db, **kw)
+
+
+def test_daemon_transcodes_video_to_ready(run, db, tmp_path, video_job):
+    """The headline: insert a video, poll once, video reaches ready with
+    qualities + downstream jobs enqueued (VERDICT round-2 item #1)."""
+    video, job_id, _ = video_job
+    daemon = make_daemon(db, tmp_path)
+
+    async def go():
+        assert await daemon.poll_once() is True
+
+    run(go())
+    row = run(vids.get_video(db, video["id"]))
+    assert row["status"] == "ready"
+    assert row["duration_s"] > 0
+    assert row["thumbnail_path"] and row["width"] == 128
+
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id}))
+    assert job["completed_at"] is not None
+    assert job["progress"] == 100.0
+
+    quals = run(db.fetch_all(
+        "SELECT * FROM video_qualities WHERE video_id=:v", {"v": video["id"]}))
+    assert len(quals) >= 1
+    qp = run(claims.get_quality_progress(db, job_id))
+    assert all(r["status"] == "completed" for r in qp.values())
+
+    # finalize enqueues the sprite job (transcription needs audio; Y4M has none)
+    sprite = run(db.fetch_one(
+        "SELECT * FROM jobs WHERE video_id=:v AND kind='sprite'",
+        {"v": video["id"]}))
+    assert sprite is not None
+
+    # the published tree passes the playlist validators
+    out = tmp_path / "videos" / video["slug"]
+    assert (out / "master.m3u8").exists()
+    assert (out / "manifest.mpd").exists()
+
+
+def test_daemon_processes_sprite_job(run, db, tmp_path, video_job):
+    video, job_id, _ = video_job
+    daemon = make_daemon(db, tmp_path)
+
+    async def go():
+        await daemon.poll_once()          # transcode
+        assert await daemon.poll_once()   # sprite job enqueued by finalize
+
+    run(go())
+    sprite = run(db.fetch_one(
+        "SELECT * FROM jobs WHERE video_id=:v AND kind='sprite'",
+        {"v": video["id"]}))
+    assert sprite["completed_at"] is not None
+    out = tmp_path / "videos" / video["slug"] / "sprites"
+    assert (out / "sprites.vtt").exists()
+    assert (out / "sprite_01.jpg").exists()
+
+
+def test_lease_extends_during_transcode(run, db, tmp_path, video_job,
+                                        monkeypatch):
+    """Progress writes renew the lease (reference worker_api.py:1747-1860)."""
+    video, job_id, _ = video_job
+    observed = []
+    orig = claims.update_progress
+
+    async def spy(db_, jid, worker, **kw):
+        row = await orig(db_, jid, worker, **kw)
+        observed.append(row["claim_expires_at"])
+        return row
+
+    monkeypatch.setattr(claims, "update_progress", spy)
+    daemon = make_daemon(db, tmp_path)
+    initial_expiry = {}
+    orig_claim = claims.claim_job
+
+    async def claim_spy(*a, **kw):
+        row = await orig_claim(*a, **kw)
+        if row is not None:
+            initial_expiry[row["id"]] = row["claim_expires_at"]
+        return row
+
+    monkeypatch.setattr(claims, "claim_job", claim_spy)
+    run(daemon.poll_once())
+    assert observed, "no progress writes happened during the transcode"
+    assert max(observed) > initial_expiry[job_id]
+
+
+def test_shutdown_releases_claim_with_attempt_refund(run, db, tmp_path,
+                                                     video_job):
+    """SIGTERM mid-job hands the claim back without burning an attempt
+    (reference transcoder.py:3227-3276)."""
+    video, job_id, _ = video_job
+    daemon = make_daemon(db, tmp_path)
+
+    async def fake_transcode(job, vid):
+        daemon.request_stop()
+        raise JobCancelled("shutdown")
+
+    daemon._run_transcode = fake_transcode
+    run(daemon.poll_once())
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id}))
+    assert job["claimed_by"] is None
+    assert job["attempt"] == 0          # refunded
+    assert job["failed_at"] is None
+    assert daemon.stats.released == 1
+
+
+def test_cancel_without_shutdown_counts_as_failure(run, db, tmp_path,
+                                                   video_job):
+    video, job_id, _ = video_job
+    daemon = make_daemon(db, tmp_path)
+
+    async def fake_transcode(job, vid):
+        raise JobCancelled("transcode timed out after 1s")
+
+    daemon._run_transcode = fake_transcode
+    run(daemon.poll_once())
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id}))
+    assert job["claimed_by"] is None
+    assert job["attempt"] == 1          # a real failed attempt
+    assert "timed out" in job["error"]
+
+
+def test_timeout_cancels_cooperatively(run, db, tmp_path):
+    """_run_with_timeout sets the cancel flag; the compute thread aborts at
+    its next progress-callback boundary."""
+    daemon = make_daemon(db, tmp_path)
+
+    def stubborn():
+        import time as _t
+        while not daemon._cancel.is_set():
+            _t.sleep(0.01)
+        raise JobCancelled(daemon._cancel_reason)
+
+    async def go():
+        with pytest.raises(JobCancelled, match="timed out"):
+            await daemon._run_with_timeout(stubborn, 0.2, "transcode")
+
+    run(go())
+
+
+def test_startup_recovers_own_stale_claims(run, db, tmp_path, video_job):
+    """A restarted worker releases claims its dead incarnation held
+    (reference transcoder.py:2017-2120)."""
+    video, job_id, _ = video_job
+
+    async def go():
+        row = await claims.claim_job(db, "test-worker")
+        assert row["id"] == job_id
+        daemon = make_daemon(db, tmp_path)
+        await daemon.startup()
+
+    run(go())
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id}))
+    assert job["claimed_by"] is None
+    # NO refund on crash recovery: a poison job that kills its worker must
+    # still exhaust max_attempts eventually.
+    assert job["attempt"] == 1
+
+
+def test_daemon_run_loop_stops_on_request(run, db, tmp_path):
+    daemon = make_daemon(db, tmp_path, poll_interval_s=0.05,
+                         heartbeat_interval_s=0.05)
+
+    async def go():
+        task = asyncio.create_task(daemon.run())
+        await asyncio.sleep(0.2)
+        daemon.request_stop()
+        await asyncio.wait_for(task, 5.0)
+
+    run(go())
+    w = run(db.fetch_one("SELECT * FROM workers WHERE name='test-worker'"))
+    assert w is not None
+    assert w["status"] == "offline"
+    assert w["last_heartbeat_at"] is not None
+
+
+def test_failed_source_marks_video_failed_after_retries(run, db, tmp_path):
+    video = run(vids.create_video(db, "Ghost", source_path=str(
+        tmp_path / "missing.y4m")))
+    run(claims.enqueue_job(db, video["id"], max_attempts=1))
+    daemon = make_daemon(db, tmp_path)
+    run(daemon.poll_once())
+    job = run(db.fetch_one(
+        "SELECT * FROM jobs WHERE video_id=:v", {"v": video["id"]}))
+    assert job["failed_at"] is not None
+    row = run(vids.get_video(db, video["id"]))
+    assert row["status"] == "failed"
+
+
+def test_release_job_refunds_attempt(run, db, tmp_path, video_job):
+    video, job_id, _ = video_job
+
+    async def go():
+        row = await claims.claim_job(db, "w1")
+        assert row["attempt"] == 1
+        released = await claims.release_job(db, job_id, "w1")
+        assert released["attempt"] == 0
+        assert released["claimed_by"] is None
+        # wrong worker cannot release
+        await claims.claim_job(db, "w2")
+        with pytest.raises(js.JobStateError):
+            await claims.release_job(db, job_id, "w1")
+
+    run(go())

@@ -261,6 +261,40 @@ async def fail_job(
         return out
 
 
+async def release_job(
+    db: Database, job_id: int, worker_name: str, *, refund_attempt: bool = True
+) -> Row:
+    """Hand an in-flight claim back to the pool.
+
+    This is the graceful-shutdown path (reference transcoder.py:3227-3276:
+    SIGTERM resets in-flight work to pending so another worker picks it up
+    immediately). With ``refund_attempt`` the attempt counter is rolled back
+    — the work was interrupted, not attempted-and-failed. Crash-recovery
+    callers (a restarted worker releasing its dead incarnation's claims)
+    must pass ``refund_attempt=False``: a job that kills its worker process
+    would otherwise never exhaust ``max_attempts``.
+    """
+    t = db_now()
+    async with db.transaction() as tx:
+        row = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
+        if row is None:
+            raise js.JobStateError(f"job {job_id} does not exist")
+        # Same ownership rule as progress: only the claim holder may release.
+        js.guard_progress(row, worker_name, now=t)
+        attempt_sql = "attempt=MAX(attempt - 1, 0)," if refund_attempt else ""
+        await tx.execute(
+            f"""
+            UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
+                   {attempt_sql} updated_at=:t
+            WHERE id=:id
+            """,
+            {"t": t, "id": job_id},
+        )
+        out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
+        assert out is not None
+        return out
+
+
 async def upsert_quality_progress(
     db: Database,
     job_id: int,

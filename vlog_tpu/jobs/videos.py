@@ -1,0 +1,130 @@
+"""Video-row lifecycle helpers shared by the admin API and workers.
+
+Reference parity: admin.py:1746-1832 (insert + enqueue on upload) and
+transcoder.py:2772-2867 (finalize: video_qualities rows, status=ready,
+downstream job enqueue). These are the only places video.status moves,
+so both the HTTP plane and the in-process worker use one vocabulary.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import unicodedata
+from typing import Any
+
+from vlog_tpu.db.core import Database, Row, now as db_now
+from vlog_tpu.enums import VideoStatus
+
+_SLUG_RE = re.compile(r"[^a-z0-9]+")
+
+
+def slugify(title: str, max_len: int = 80) -> str:
+    """ASCII slug from a title (admin.py slug generation analog)."""
+    norm = unicodedata.normalize("NFKD", title)
+    ascii_str = norm.encode("ascii", "ignore").decode("ascii").lower()
+    slug = _SLUG_RE.sub("-", ascii_str).strip("-")
+    return slug[:max_len] or "video"
+
+
+async def unique_slug(db: Database, title: str) -> str:
+    base = slugify(title)
+    slug = base
+    n = 1
+    while await db.fetch_one("SELECT 1 FROM videos WHERE slug=:s", {"s": slug}):
+        n += 1
+        slug = f"{base}-{n}"
+    return slug
+
+
+async def create_video(
+    db: Database,
+    title: str,
+    *,
+    source_path: str | None = None,
+    original_filename: str | None = None,
+    size_bytes: int | None = None,
+    description: str = "",
+    category: str | None = None,
+    tags: list[str] | None = None,
+) -> Row:
+    slug = await unique_slug(db, title)
+    t = db_now()
+    vid = await db.execute(
+        """
+        INSERT INTO videos (slug, title, description, original_filename,
+                            source_path, size_bytes, category, tags,
+                            created_at, updated_at)
+        VALUES (:slug, :title, :d, :of, :sp, :sz, :cat, :tags, :t, :t)
+        """,
+        {
+            "slug": slug, "title": title, "d": description,
+            "of": original_filename, "sp": source_path, "sz": size_bytes,
+            "cat": category, "tags": json.dumps(tags or []), "t": t,
+        },
+    )
+    row = await db.fetch_one("SELECT * FROM videos WHERE id=:id", {"id": vid})
+    assert row is not None
+    return row
+
+
+async def get_video(db: Database, video_id: int) -> Row | None:
+    return await db.fetch_one("SELECT * FROM videos WHERE id=:id", {"id": video_id})
+
+
+async def get_video_by_slug(db: Database, slug: str) -> Row | None:
+    return await db.fetch_one("SELECT * FROM videos WHERE slug=:s", {"s": slug})
+
+
+async def set_status(
+    db: Database, video_id: int, status: VideoStatus, *, error: str | None = None
+) -> None:
+    await db.execute(
+        "UPDATE videos SET status=:s, error=:e, updated_at=:t WHERE id=:id",
+        {"s": status.value, "e": error, "t": db_now(), "id": video_id},
+    )
+
+
+async def finalize_ready(
+    db: Database,
+    video_id: int,
+    *,
+    probe: Any,                      # media.probe.VideoInfo
+    qualities: list[dict],
+    thumbnail_path: str | None,
+) -> None:
+    """Publish the transcode result (reference transcoder.py:2772-2867)."""
+    t = db_now()
+    async with db.transaction() as tx:
+        await tx.execute(
+            """
+            UPDATE videos SET status='ready', error=NULL, duration_s=:dur,
+                   width=:w, height=:h, fps=:fps, thumbnail_path=:thumb,
+                   updated_at=:t
+            WHERE id=:id
+            """,
+            {
+                "dur": probe.duration_s, "w": probe.width, "h": probe.height,
+                "fps": probe.fps, "thumb": thumbnail_path, "t": t,
+                "id": video_id,
+            },
+        )
+        await tx.execute(
+            "DELETE FROM video_qualities WHERE video_id=:v", {"v": video_id}
+        )
+        for q in qualities:
+            await tx.execute(
+                """
+                INSERT INTO video_qualities (video_id, name, width, height,
+                        video_bitrate, audio_bitrate, codec, playlist_path,
+                        created_at)
+                VALUES (:v, :n, :w, :h, :vb, :ab, :c, :pp, :t)
+                """,
+                {
+                    "v": video_id, "n": q["quality"], "w": q["width"],
+                    "h": q["height"], "vb": q.get("bitrate"),
+                    "ab": q.get("audio_bitrate"),
+                    "c": q.get("codec", "h264"),
+                    "pp": q.get("playlist_path"), "t": t,
+                },
+            )

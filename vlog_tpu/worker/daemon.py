@@ -1,0 +1,479 @@
+"""The worker daemon — turns the job library into a running system.
+
+Reference parity: worker/transcoder.py:3076-3276 (`worker_loop`): startup
+recovery, claim → process → progress (extending the lease) → complete/fail,
+graceful SIGTERM shutdown that hands in-flight work back to the pool, and a
+heartbeat row so the fleet dashboard can see the worker. The compute runs in
+a worker thread; cancellation (timeout / lost claim / shutdown) is
+cooperative at GOP-batch granularity through the progress callback — the
+same chunked-execution contract that makes XLA dispatches checkpointable
+(SURVEY.md §7 hard part 3).
+
+Run it: ``python -m vlog_tpu.worker.daemon --name my-worker``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Awaitable, Callable
+
+from vlog_tpu import config
+from vlog_tpu.db.core import Database, Row, now as db_now
+from vlog_tpu.enums import AcceleratorKind, JobKind, VideoStatus
+from vlog_tpu.jobs import claims, state as js, videos as vids
+
+log = logging.getLogger("vlog_tpu.worker")
+
+
+class JobCancelled(Exception):
+    """Raised inside the compute thread to abort at the next batch boundary."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+@dataclass
+class DaemonStats:
+    claimed: int = 0
+    completed: int = 0
+    failed: int = 0
+    released: int = 0
+    last_error: str | None = None
+
+
+# Async event hook: (event_name, payload) — wired to webhook delivery.
+EventFn = Callable[[str, dict], Awaitable[None]]
+
+
+@dataclass
+class WorkerDaemon:
+    db: Database
+    name: str
+    accelerator: AcceleratorKind = AcceleratorKind.TPU
+    kinds: tuple[JobKind, ...] = (JobKind.TRANSCODE, JobKind.SPRITE,
+                                  JobKind.TRANSCRIPTION)
+    video_dir: Path = field(default_factory=lambda: config.VIDEO_DIR)
+    backend: Any = None                    # backends.Backend; lazy-selected
+    poll_interval_s: float = field(
+        default_factory=lambda: config.WORKER_POLL_INTERVAL_S)
+    heartbeat_interval_s: float = field(
+        default_factory=lambda: float(config.HEARTBEAT_INTERVAL_S))
+    progress_min_interval_s: float = 2.0   # DB-write rate limit (thread side)
+    on_event: EventFn | None = None
+    transcription_model_dir: str | None = None
+
+    def __post_init__(self) -> None:
+        self.stats = DaemonStats()
+        self._stop = asyncio.Event()
+        self._cancel = threading.Event()   # aborts the in-flight compute
+        self._cancel_reason = ""
+        self._current_job_id: int | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def request_stop(self) -> None:
+        """Signal-safe shutdown request: stop polling, abort in-flight work."""
+        self._stop.set()
+        self._cancel_reason = self._cancel_reason or "shutdown"
+        self._cancel.set()
+
+    async def startup(self) -> None:
+        """Recovery sweep + worker registration.
+
+        Reference: transcoder.py:2017-2120 ``recover_interrupted_jobs`` —
+        a restarted worker releases any claims a previous incarnation of
+        itself still holds (the process died mid-job), then sweeps lapsed
+        leases fleet-wide so those jobs are claimable again.
+        """
+        t = db_now()
+        stale = await self.db.fetch_all(
+            f"SELECT * FROM jobs WHERE claimed_by=:w AND {js.SQL_ACTIVELY_CLAIMED}",
+            {"w": self.name, "now": t},
+        )
+        for row in stale:
+            log.warning("recovering interrupted job %s (kind=%s)",
+                        row["id"], row["kind"])
+            # No attempt refund: the previous incarnation CRASHED mid-job.
+            # Refunding would let a poison job that kills its worker retry
+            # past max_attempts forever.
+            await claims.release_job(self.db, row["id"], self.name,
+                                     refund_attempt=False)
+        await claims.sweep_expired_claims(self.db)
+        await self._heartbeat()
+
+    async def _heartbeat(self) -> None:
+        caps = {}
+        if self.backend is not None:
+            try:
+                caps = self.backend.detect().to_dict()
+            except Exception:
+                caps = {}
+        await self.db.execute(
+            """
+            INSERT INTO workers (name, kind, accelerator, capabilities,
+                                 code_version, last_heartbeat_at, created_at)
+            VALUES (:n, 'local', :a, :c, :v, :t, :t)
+            ON CONFLICT (name) DO UPDATE SET accelerator=:a, capabilities=:c,
+                code_version=:v, last_heartbeat_at=:t, status='active'
+            """,
+            {"n": self.name, "a": self.accelerator.value,
+             "c": json.dumps(caps), "v": config.CODE_VERSION, "t": db_now()},
+        )
+
+    async def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await asyncio.wait_for(self._stop.wait(),
+                                       self.heartbeat_interval_s)
+            except asyncio.TimeoutError:
+                pass
+            if not self._stop.is_set():
+                await self._heartbeat()
+
+    async def run(self) -> None:
+        """Main loop: poll → claim → process, until ``request_stop``."""
+        await self.startup()
+        hb = asyncio.create_task(self._heartbeat_loop())
+        try:
+            while not self._stop.is_set():
+                worked = await self.poll_once()
+                if worked or self._stop.is_set():
+                    continue
+                try:
+                    await asyncio.wait_for(self._stop.wait(),
+                                           self.poll_interval_s)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            self._stop.set()
+            hb.cancel()
+            await asyncio.gather(hb, return_exceptions=True)
+            await self.db.execute(
+                "UPDATE workers SET status='offline' WHERE name=:n",
+                {"n": self.name})
+
+    async def poll_once(self) -> bool:
+        """Claim and process at most one job. Returns True if one ran."""
+        job = await claims.claim_job(
+            self.db, self.name, kinds=self.kinds,
+            accelerator=self.accelerator)
+        if job is None:
+            return False
+        self.stats.claimed += 1
+        self._cancel.clear()
+        self._cancel_reason = ""
+        self._current_job_id = job["id"]
+        try:
+            await self._dispatch(job)
+        finally:
+            self._current_job_id = None
+        return True
+
+    # -- job dispatch ------------------------------------------------------
+
+    async def _dispatch(self, job: Row) -> None:
+        kind = JobKind(job["kind"])
+        video = await vids.get_video(self.db, job["video_id"])
+        if video is None:
+            await claims.fail_job(self.db, job["id"], self.name,
+                                  "video row vanished", permanent=True)
+            self.stats.failed += 1
+            return
+        handler = {
+            JobKind.TRANSCODE: self._run_transcode,
+            JobKind.SPRITE: self._run_sprites,
+            JobKind.TRANSCRIPTION: self._run_transcription,
+        }[kind]
+        try:
+            await handler(job, video)
+        except JobCancelled as exc:
+            if self._stop.is_set():
+                # Graceful shutdown: hand the claim back, attempt refunded.
+                # The lease may have lapsed (or been reclaimed) while the
+                # compute thread wound down — then there is nothing to
+                # release and the job is already claimable elsewhere.
+                try:
+                    await claims.release_job(self.db, job["id"], self.name)
+                    self.stats.released += 1
+                    log.info("released job %s on shutdown", job["id"])
+                except js.JobStateError as rel_exc:
+                    log.warning("shutdown release of job %s skipped: %s",
+                                job["id"], rel_exc)
+            else:
+                await self._fail(job, video, f"cancelled: {exc.reason}")
+        except js.JobStateError as exc:
+            # Lost the claim (lease lapsed + reclaimed); nothing to write.
+            log.warning("job %s claim lost: %s", job["id"], exc)
+            self.stats.last_error = str(exc)
+        except Exception as exc:  # noqa: BLE001 — worker must survive any job
+            log.exception("job %s failed", job["id"])
+            await self._fail(job, video, f"{type(exc).__name__}: {exc}")
+
+    async def _fail(self, job: Row, video: Row, error: str) -> None:
+        row = await claims.fail_job(self.db, job["id"], self.name, error)
+        self.stats.failed += 1
+        self.stats.last_error = error
+        terminal = row["failed_at"] is not None
+        if terminal and JobKind(job["kind"]) is JobKind.TRANSCODE:
+            await vids.set_status(self.db, video["id"], VideoStatus.FAILED,
+                                  error=error)
+        await self._emit("job.failed" if not terminal else "job.failed_permanently",
+                         {"job_id": job["id"], "video_id": video["id"],
+                          "kind": job["kind"], "error": error})
+
+    async def _emit(self, event: str, payload: dict) -> None:
+        if self.on_event is not None:
+            try:
+                await self.on_event(event, payload)
+            except Exception:
+                log.exception("event hook failed for %s", event)
+
+    # -- compute-thread plumbing ------------------------------------------
+
+    def _make_progress_cb(self, job_id: int, total_hint: int,
+                          rung_names: list[str]):
+        """Progress callback run on the COMPUTE THREAD.
+
+        Rate-limited DB writes via run_coroutine_threadsafe; every write
+        extends the claim lease (reference worker_api.py:1747-1860). A lost
+        claim or cancellation aborts the thread at the next batch boundary.
+        """
+        loop = asyncio.get_running_loop()
+        last_write = 0.0
+        claim_lost = threading.Event()
+
+        async def write(progress: float, msg: str) -> None:
+            try:
+                await claims.update_progress(
+                    self.db, job_id, self.name,
+                    progress=progress, current_step=msg)
+                for rn in rung_names:
+                    await claims.upsert_quality_progress(
+                        self.db, job_id, rn,
+                        status="in_progress", progress=progress)
+            except js.JobStateError:
+                claim_lost.set()
+
+        def cb(done: int, total: int, msg: str) -> None:
+            nonlocal last_write
+            if self._cancel.is_set():
+                raise JobCancelled(self._cancel_reason or "cancelled")
+            if claim_lost.is_set():
+                raise JobCancelled("claim lost (lease expired and reclaimed)")
+            now = time.monotonic()
+            if now - last_write < self.progress_min_interval_s and done < total:
+                return
+            last_write = now
+            pct = 100.0 * done / max(total or total_hint, 1)
+            asyncio.run_coroutine_threadsafe(write(min(pct, 99.0), msg), loop)
+
+        return cb
+
+    async def _run_with_timeout(self, fn, timeout_s: float, what: str):
+        """Run blocking compute in a thread; cancel cooperatively on timeout."""
+        task = asyncio.create_task(asyncio.to_thread(fn))
+        try:
+            return await asyncio.wait_for(asyncio.shield(task), timeout_s)
+        except asyncio.TimeoutError:
+            self._cancel_reason = f"{what} timed out after {timeout_s:.0f}s"
+            self._cancel.set()
+            # The thread aborts at its next progress callback.
+            return await task
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _run_transcode(self, job: Row, video: Row) -> None:
+        from vlog_tpu.media.probe import get_video_info
+        from vlog_tpu.worker.pipeline import process_video
+
+        source = video["source_path"]
+        if not source or not Path(source).exists():
+            await self._fail(job, video, f"source missing: {source}")
+            return
+        await vids.set_status(self.db, video["id"], VideoStatus.PROCESSING)
+        info = await asyncio.to_thread(get_video_info, source)
+        if info.duration_s > config.MAX_VIDEO_DURATION_S:
+            await claims.fail_job(self.db, job["id"], self.name,
+                                  "video exceeds duration cap", permanent=True)
+            await vids.set_status(self.db, video["id"], VideoStatus.FAILED,
+                                  error="video exceeds duration cap")
+            self.stats.failed += 1
+            return
+
+        rungs = config.ladder_for_source(info.height)
+        # One-pass ladder: the whole job runs under the heaviest rung's
+        # timeout envelope (reference ran one ffmpeg per rung, each with
+        # its own duration×multiplier timeout; config.py:247-260).
+        timeout = config.transcode_timeout_s(info.duration_s, rungs[0].name)
+        out_dir = self.video_dir / video["slug"]
+        cb = self._make_progress_cb(job["id"], info.frame_count,
+                                    [r.name for r in rungs])
+
+        def work():
+            return process_video(source, out_dir, backend=self.backend,
+                                 progress_cb=cb, rungs=rungs)
+
+        result = await self._run_with_timeout(work, timeout, "transcode")
+
+        qualities = [
+            {**q, "playlist_path": str(out_dir / q["quality"] / "playlist.m3u8"),
+             "audio_bitrate": next((r.audio_bitrate for r in rungs
+                                    if r.name == q["quality"]), None)}
+            for q in result.qualities
+        ]
+        await vids.finalize_ready(
+            self.db, video["id"], probe=result.source, qualities=qualities,
+            thumbnail_path=result.run.thumbnail_path)
+        for rn in [r.name for r in rungs]:
+            await claims.upsert_quality_progress(
+                self.db, job["id"], rn, status="completed", progress=100.0)
+        await claims.complete_job(self.db, job["id"], self.name)
+        self.stats.completed += 1
+        # Downstream jobs (reference finalize enqueues sprite_queue,
+        # transcoder.py:2816-2841; transcription polls ready videos).
+        await claims.enqueue_job(self.db, video["id"], JobKind.SPRITE)
+        if config.TRANSCRIPTION_ENABLED and info.audio_codec:
+            await claims.enqueue_job(self.db, video["id"],
+                                     JobKind.TRANSCRIPTION)
+        await self._emit("video.ready", {
+            "video_id": video["id"], "slug": video["slug"],
+            "qualities": [q["quality"] for q in result.qualities]})
+
+    async def _run_sprites(self, job: Row, video: Row) -> None:
+        from vlog_tpu.worker.sprites import generate_sprites
+
+        source = video["source_path"]
+        if not source or not Path(source).exists():
+            await self._fail(job, video, f"source missing: {source}")
+            return
+        out_dir = self.video_dir / video["slug"]
+        cb = self._make_progress_cb(job["id"], 0, [])
+        timeout = config.transcode_timeout_s(
+            float(video["duration_s"] or 0.0), "360p")
+
+        def work():
+            return generate_sprites(source, out_dir, progress_cb=cb)
+
+        result = await self._run_with_timeout(work, timeout, "sprites")
+        await claims.complete_job(self.db, job["id"], self.name)
+        self.stats.completed += 1
+        await self._emit("video.sprites_ready", {
+            "video_id": video["id"], "slug": video["slug"],
+            "sheets": result.sheet_count})
+
+    async def _run_transcription(self, job: Row, video: Row) -> None:
+        from vlog_tpu.worker.transcribe import transcribe_video
+
+        source = video["source_path"]
+        if not source or not Path(source).exists():
+            await self._fail(job, video, f"source missing: {source}")
+            return
+        await self.db.execute(
+            "UPDATE videos SET transcription_status='in_progress', "
+            "updated_at=:t WHERE id=:id",
+            {"t": db_now(), "id": video["id"]})
+        out_dir = self.video_dir / video["slug"]
+        cb = self._make_progress_cb(job["id"], 0, [])
+        timeout = config.transcode_timeout_s(
+            float(video["duration_s"] or 0.0), "720p")
+
+        def work():
+            return transcribe_video(source, out_dir, progress_cb=cb,
+                                    model_dir=self.transcription_model_dir)
+
+        try:
+            result = await self._run_with_timeout(work, timeout, "transcription")
+        except Exception:
+            await self.db.execute(
+                "UPDATE videos SET transcription_status='failed', "
+                "updated_at=:t WHERE id=:id",
+                {"t": db_now(), "id": video["id"]})
+            raise
+        t = db_now()
+        await self.db.execute(
+            """
+            INSERT INTO transcriptions (video_id, language, model, vtt_path,
+                                        full_text, status, created_at,
+                                        completed_at)
+            VALUES (:v, :lang, :m, :p, :txt, 'completed', :t, :t)
+            ON CONFLICT (video_id) DO UPDATE SET language=:lang, model=:m,
+                vtt_path=:p, full_text=:txt, status='completed', error=NULL,
+                completed_at=:t
+            """,
+            {"v": video["id"], "lang": result.language, "m": result.model,
+             "p": result.vtt_path, "txt": result.text, "t": t})
+        await self.db.execute(
+            "UPDATE videos SET transcription_status='completed', "
+            "updated_at=:t WHERE id=:id", {"t": t, "id": video["id"]})
+        await claims.complete_job(self.db, job["id"], self.name)
+        self.stats.completed += 1
+        await self._emit("video.transcribed", {
+            "video_id": video["id"], "slug": video["slug"],
+            "language": result.language})
+
+
+# --------------------------------------------------------------------------
+# Entrypoint
+# --------------------------------------------------------------------------
+
+async def _amain(args: argparse.Namespace) -> None:
+    from vlog_tpu.db.schema import create_all
+
+    config.ensure_dirs()
+    db = Database(args.db)
+    await db.connect()
+    await create_all(db)
+
+    backend = None
+    if not args.no_backend:
+        from vlog_tpu.backends import select_backend
+        backend = select_backend(args.backend or None)
+
+    daemon = WorkerDaemon(
+        db, name=args.name,
+        accelerator=AcceleratorKind(args.accelerator),
+        kinds=tuple(JobKind(k) for k in args.kinds.split(",")),
+        backend=backend,
+        transcription_model_dir=args.whisper_dir,
+    )
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, daemon.request_stop)
+    log.info("worker %s starting (kinds=%s)", args.name, args.kinds)
+    try:
+        await daemon.run()
+    finally:
+        await db.disconnect()
+    log.info("worker %s stopped: %s", args.name, daemon.stats)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="vlog-tpu worker daemon")
+    parser.add_argument("--name", default=f"worker-{int(time.time())}")
+    parser.add_argument("--db", default=config.DATABASE_URL)
+    parser.add_argument("--accelerator", default="tpu",
+                        choices=[a.value for a in AcceleratorKind])
+    parser.add_argument("--kinds", default="transcode,sprite,transcription")
+    parser.add_argument("--backend", default="",
+                        help="force a registered backend by name")
+    parser.add_argument("--no-backend", action="store_true",
+                        help="do not initialize an accelerator backend")
+    parser.add_argument("--whisper-dir", default=None,
+                        help="directory with Whisper weights (HF layout)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()
