@@ -51,6 +51,52 @@ def db_path(tmp_path):
     return str(tmp_path / f"vlog_test_{uuid.uuid4().hex}.db")
 
 
+@pytest.fixture(scope="session")
+def tiny_model_dir(tmp_path_factory):
+    """A random-weight HF Whisper checkpoint + byte-level tokenizer on disk.
+
+    The shared oracle fixture: whisper tests compare JAX vs torch under
+    these weights; transcription/daemon tests run the full pipeline on it.
+    """
+    import json
+
+    import torch
+    import transformers
+    from transformers.models.gpt2.tokenization_gpt2 import bytes_to_unicode
+
+    d = tmp_path_factory.mktemp("whisper-tiny")
+    vocab = {ch: i for i, (_, ch)
+             in enumerate(sorted(bytes_to_unicode().items()))}
+    (d / "vocab.json").write_text(json.dumps(vocab))
+    (d / "merges.txt").write_text("#version: 0.2\n")
+    tok = transformers.WhisperTokenizer(
+        str(d / "vocab.json"), str(d / "merges.txt"),
+        unk_token="<|endoftext|>", bos_token="<|endoftext|>",
+        eos_token="<|endoftext|>")
+    specials = ["<|endoftext|>", "<|startoftranscript|>", "<|en|>", "<|es|>",
+                "<|transcribe|>", "<|translate|>", "<|nospeech|>",
+                "<|notimestamps|>"]
+    tok.add_special_tokens({"additional_special_tokens": specials})
+    tok.save_pretrained(str(d))
+
+    ids = {s: tok.convert_tokens_to_ids(s) for s in specials}
+    vocab_size = max(ids.values()) + 1 + 1501   # + timestamp tokens
+    cfg = transformers.WhisperConfig(
+        vocab_size=vocab_size, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=2, decoder_attention_heads=2,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, num_mel_bins=80,
+        max_source_positions=1500, max_target_positions=64,
+        decoder_start_token_id=ids["<|startoftranscript|>"],
+        eos_token_id=ids["<|endoftext|>"], pad_token_id=ids["<|endoftext|>"],
+        bos_token_id=ids["<|endoftext|>"],
+        suppress_tokens=[], begin_suppress_tokens=[])
+    torch.manual_seed(0)
+    model = transformers.WhisperForConditionalGeneration(cfg)
+    model.eval()
+    model.save_pretrained(str(d))
+    return d
+
+
 @pytest.fixture
 def db(run, db_path):
     """Connected Database with the full schema applied."""

@@ -1,0 +1,10 @@
+"""ASR: Whisper on the TPU mesh.
+
+The transcription compute substrate replacing the reference's
+faster-whisper/CTranslate2 dependency (worker/transcription.py:78-133):
+log-mel frontend, encoder-decoder forward, and batched greedy decoding
+with Whisper's timestamp rules — all JAX, sharded over the device mesh
+for long audio (SURVEY.md §5 long-audio data parallelism).
+"""
+
+from vlog_tpu.asr.mel import log_mel_spectrogram  # noqa: F401

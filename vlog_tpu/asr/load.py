@@ -1,0 +1,141 @@
+"""Load Whisper checkpoints from the HuggingFace on-disk layout.
+
+The reference downloads CTranslate2 conversions of the OpenAI weights at
+worker start (transcription.py:78-90, model cached under ~/.cache). Here
+the operator points ``VLOG_WHISPER_DIR`` (or ``--whisper-dir``) at a local
+HF-format directory: ``config.json`` + ``model.safetensors`` (or
+``pytorch_model.bin``) + tokenizer files. Nothing is fetched — the worker
+fleet has no egress by design.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from vlog_tpu.asr.model import Params, WhisperConfig
+
+
+class ModelLoadError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class SpecialTokens:
+    """Token ids steering generation (HF generation_config semantics)."""
+
+    sot: int                 # <|startoftranscript|>
+    eot: int                 # <|endoftext|>
+    transcribe: int
+    translate: int
+    no_timestamps: int
+    timestamp_begin: int     # first <|0.00|> id; 1500 ids follow (20ms grid)
+    no_speech: int | None
+    language_ids: dict[str, int] = field(default_factory=dict)
+    suppress: tuple[int, ...] = ()
+    begin_suppress: tuple[int, ...] = ()
+
+    def language_token(self, language: str) -> int:
+        try:
+            return self.language_ids[language]
+        except KeyError:
+            raise ModelLoadError(
+                f"language {language!r} not in model vocabulary") from None
+
+
+@dataclass
+class WhisperAssets:
+    cfg: WhisperConfig
+    params: Params
+    tokenizer: Any
+    tokens: SpecialTokens
+    model_name: str
+
+
+def _load_state_dict(model_dir: Path) -> dict[str, np.ndarray]:
+    st = model_dir / "model.safetensors"
+    if st.exists():
+        from safetensors.numpy import load_file
+
+        return load_file(str(st))
+    pt = model_dir / "pytorch_model.bin"
+    if pt.exists():
+        import torch
+
+        sd = torch.load(str(pt), map_location="cpu", weights_only=True)
+        return {k: v.numpy() for k, v in sd.items()}
+    raise ModelLoadError(
+        f"{model_dir}: no model.safetensors or pytorch_model.bin")
+
+
+def convert_state_dict(sd: dict[str, np.ndarray]) -> Params:
+    """HF state dict -> our flat param dict (names preserved, torch layouts
+    kept; forward functions transpose at use site)."""
+    params: Params = {}
+    for k, v in sd.items():
+        if k == "proj_out.weight":          # tied to embed_tokens
+            continue
+        if not k.startswith("model."):
+            k = "model." + k                # WhisperModel vs ForConditionalGen
+        params[k] = jnp.asarray(np.asarray(v, np.float32))
+    return params
+
+
+def derive_special_tokens(tokenizer, hf_cfg: dict,
+                          gen_cfg: dict | None) -> SpecialTokens:
+    gen_cfg = gen_cfg or {}
+
+    def tid(tok: str) -> int | None:
+        i = tokenizer.convert_tokens_to_ids(tok)
+        unk = tokenizer.convert_tokens_to_ids(tokenizer.unk_token) \
+            if tokenizer.unk_token else None
+        return None if i is None or i == unk else i
+
+    no_ts = tid("<|notimestamps|>")
+    if no_ts is None:
+        raise ModelLoadError("tokenizer lacks <|notimestamps|>")
+    lang_ids = {}
+    for tok, i in tokenizer.get_added_vocab().items():
+        if (tok.startswith("<|") and tok.endswith("|>")
+                and 2 < len(tok) <= 7 and tok[2:-2].isalpha()
+                and tok[2:-2].islower()):
+            lang_ids[tok[2:-2]] = i
+    return SpecialTokens(
+        sot=gen_cfg.get("decoder_start_token_id",
+                        hf_cfg.get("decoder_start_token_id")),
+        eot=gen_cfg.get("eos_token_id", hf_cfg.get("eos_token_id")),
+        transcribe=tid("<|transcribe|>") or no_ts,
+        translate=tid("<|translate|>") or no_ts,
+        no_timestamps=no_ts,
+        timestamp_begin=no_ts + 1,
+        no_speech=tid("<|nospeech|>") or tid("<|nocaptions|>"),
+        language_ids=lang_ids,
+        suppress=tuple(gen_cfg.get("suppress_tokens") or []),
+        begin_suppress=tuple(gen_cfg.get("begin_suppress_tokens") or []),
+    )
+
+
+def load_whisper(model_dir: str | Path) -> WhisperAssets:
+    model_dir = Path(model_dir)
+    cfg_path = model_dir / "config.json"
+    if not cfg_path.exists():
+        raise ModelLoadError(f"{model_dir}: missing config.json")
+    hf_cfg = json.loads(cfg_path.read_text())
+    cfg = WhisperConfig.from_hf(hf_cfg)
+
+    from transformers import WhisperTokenizer
+
+    tokenizer = WhisperTokenizer.from_pretrained(str(model_dir))
+    gen_cfg = None
+    gc_path = model_dir / "generation_config.json"
+    if gc_path.exists():
+        gen_cfg = json.loads(gc_path.read_text())
+    tokens = derive_special_tokens(tokenizer, hf_cfg, gen_cfg)
+    params = convert_state_dict(_load_state_dict(model_dir))
+    return WhisperAssets(cfg=cfg, params=params, tokenizer=tokenizer,
+                         tokens=tokens, model_name=model_dir.name)

@@ -178,6 +178,8 @@ WORKER_POLL_INTERVAL_S: float = _env_float("VLOG_WORKER_POLL_INTERVAL", 5.0, lo=
 # --------------------------------------------------------------------------
 
 WHISPER_MODEL: str = _env_str("VLOG_WHISPER_MODEL", "small")
+# Local HF-format weights directory (no egress: the operator provisions it).
+WHISPER_DIR: str = _env_str("VLOG_WHISPER_DIR", "")
 WHISPER_CHUNK_S: float = 30.0       # model window
 WHISPER_OVERLAP_S: float = 5.0      # chunk overlap for stitching
 TRANSCRIPTION_ENABLED: bool = _env_bool("VLOG_TRANSCRIPTION_ENABLED", True)

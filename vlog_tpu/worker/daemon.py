@@ -137,7 +137,11 @@ class WorkerDaemon:
             except asyncio.TimeoutError:
                 pass
             if not self._stop.is_set():
-                await self._heartbeat()
+                try:
+                    await self._heartbeat()
+                except Exception:       # noqa: BLE001 — a transient DB
+                    # error must not permanently kill the heartbeat task
+                    log.exception("heartbeat write failed; will retry")
 
     async def run(self) -> None:
         """Main loop: poll → claim → process, until ``request_stop``."""
@@ -167,6 +171,14 @@ class WorkerDaemon:
             self.db, self.name, kinds=self.kinds,
             accelerator=self.accelerator)
         if job is None:
+            return False
+        if self._stop.is_set():
+            # Shutdown arrived while the claim was in flight: hand it
+            # straight back instead of starting (and then abandoning) work.
+            try:
+                await claims.release_job(self.db, job["id"], self.name)
+            except js.JobStateError:
+                pass
             return False
         self.stats.claimed += 1
         self._cancel.clear()
@@ -278,16 +290,32 @@ class WorkerDaemon:
 
         return cb
 
+    # Grace period for a cancelled compute thread to reach its next
+    # progress-callback boundary before the daemon abandons it.
+    cancel_grace_s: float = 120.0
+
     async def _run_with_timeout(self, fn, timeout_s: float, what: str):
-        """Run blocking compute in a thread; cancel cooperatively on timeout."""
+        """Run blocking compute in a thread; cancel cooperatively on timeout.
+
+        If the thread does not honor the cancel within ``cancel_grace_s``
+        (wedged outside any progress callback — e.g. a pathological parse),
+        it is abandoned: the daemon raises and moves on; the zombie thread
+        can no longer write to the job (its claim is released/failed).
+        """
         task = asyncio.create_task(asyncio.to_thread(fn))
         try:
             return await asyncio.wait_for(asyncio.shield(task), timeout_s)
         except asyncio.TimeoutError:
             self._cancel_reason = f"{what} timed out after {timeout_s:.0f}s"
             self._cancel.set()
-            # The thread aborts at its next progress callback.
-            return await task
+            try:
+                return await asyncio.wait_for(asyncio.shield(task),
+                                              self.cancel_grace_s)
+            except asyncio.TimeoutError:
+                log.error("%s ignored cancellation for %.0fs; abandoning "
+                          "the compute thread", what, self.cancel_grace_s)
+                raise JobCancelled(
+                    f"{self._cancel_reason} (thread unresponsive)") from None
 
     # -- handlers ----------------------------------------------------------
 
@@ -392,6 +420,19 @@ class WorkerDaemon:
 
         try:
             result = await self._run_with_timeout(work, timeout, "transcription")
+        except js.JobStateError:
+            # Claim lost: another worker owns this job now — do not stomp
+            # whatever status it is writing.
+            raise
+        except JobCancelled:
+            # Shutdown release -> job returns to the pool, so the video
+            # goes back to pending; a real cancel (timeout) is a failure.
+            status = "pending" if self._stop.is_set() else "failed"
+            await self.db.execute(
+                "UPDATE videos SET transcription_status=:s, updated_at=:t "
+                "WHERE id=:id",
+                {"s": status, "t": db_now(), "id": video["id"]})
+            raise
         except Exception:
             await self.db.execute(
                 "UPDATE videos SET transcription_status='failed', "

@@ -1,0 +1,187 @@
+"""Transcription job: audio -> batched Whisper-JAX -> WebVTT.
+
+Reference parity: worker/transcription.py:302-450 (process_transcription):
+pick the audio source, extract 16 kHz mono PCM, run ASR, write
+``captions.vtt`` next to the renditions, return language + full text.
+
+TPU-shaped differences (SURVEY §5 long-audio plan): instead of
+faster-whisper's sequential 30 s seek loop, the audio is cut into
+overlapping 30 s windows up front and decoded in data-parallel batches
+sharded over the device mesh — a 30-minute track is ~64 windows, i.e. a
+handful of large dispatches. Digital-silence windows are skipped by an
+energy gate before ever reaching the model (the VAD-filter analog,
+reference transcription.py:105-111), and window outputs are stitched by
+timestamp into one cue stream.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from vlog_tpu import config
+from vlog_tpu.asr import mel as melmod
+from vlog_tpu.asr.vtt import Cue, format_vtt, stitch_windows
+from vlog_tpu.backends.base import ProgressFn
+
+
+class TranscriptionUnavailable(RuntimeError):
+    """No model weights configured (VLOG_WHISPER_DIR) — job should fail
+    with a clear operator-actionable message."""
+
+
+@dataclass
+class TranscribeResult:
+    language: str
+    model: str
+    vtt_path: str
+    text: str
+    cue_count: int
+    windows: int
+
+
+# RMS below this is digital silence — no model call needed.
+SILENCE_RMS = 1e-4
+
+
+def _cut_windows(samples: np.ndarray, *, window_s: float, overlap_s: float
+                 ) -> list[tuple[float, np.ndarray]]:
+    """(start_time, window_samples) list covering the track with overlap."""
+    sr = melmod.SAMPLE_RATE
+    win = int(window_s * sr)
+    stride = int((window_s - overlap_s) * sr)
+    n = samples.shape[-1]
+    out = []
+    t = 0
+    while t == 0 or t < n:
+        out.append((t / sr, samples[t:t + win]))
+        if t + win >= n:
+            break
+        t += stride
+    return out
+
+
+def transcribe_audio(
+    samples: np.ndarray,
+    assets,
+    *,
+    language: str | None = None,
+    window_s: float | None = None,
+    overlap_s: float | None = None,
+    batch_windows: int = 8,
+    max_new: int | None = None,
+    progress_cb: ProgressFn | None = None,
+) -> tuple[list[Cue], str]:
+    """16 kHz mono float PCM -> stitched cues + language code."""
+    from vlog_tpu.asr.decode import (detect_language, generate_batch,
+                                     parse_segments)
+
+    window_s = window_s or config.WHISPER_CHUNK_S
+    overlap_s = overlap_s if overlap_s is not None else config.WHISPER_OVERLAP_S
+    windows = _cut_windows(samples, window_s=window_s, overlap_s=overlap_s)
+    # energy gate: decode only windows with signal
+    live = [i for i, (_, w) in enumerate(windows)
+            if w.size and float(np.sqrt(np.mean(w ** 2))) > SILENCE_RMS]
+    per_window_cues: list[list[Cue]] = [[] for _ in windows]
+    tokenizer = assets.tokenizer
+    st = assets.tokens
+
+    # Multi-chip: shard the window batch over the mesh's data axis —
+    # each device decodes its windows, collective-free (SURVEY §2d.5).
+    import jax
+
+    n_dev = len(jax.devices())
+    mesh = None
+    if n_dev > 1:
+        from vlog_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        batch_windows += (-batch_windows) % n_dev
+
+    done = 0
+    for b0 in range(0, len(live), batch_windows):
+        idxs = live[b0:b0 + batch_windows]
+        n_real = len(idxs)
+        stack = [melmod.pad_or_trim(windows[i][1].astype(np.float32))
+                 for i in idxs]
+        if mesh is not None:     # pad so the batch divides the mesh
+            stack += [np.zeros_like(stack[0])] * ((-n_real) % n_dev)
+        batch = np.stack(stack)
+        feats = melmod.log_mel_spectrogram(batch,
+                                           n_mels=assets.cfg.num_mel_bins)
+        if language is None:
+            # Detect from the first live window only: cheap (one window's
+            # encoder pass) and never polluted by zero-padding rows.
+            language = detect_language(assets, feats[:1])
+        if mesh is not None:
+            from vlog_tpu.parallel.mesh import shard_frames
+
+            (feats,) = shard_frames(mesh, feats)
+        toks, no_speech = generate_batch(assets, feats, language=language,
+                                         max_new=max_new)
+        toks, no_speech = toks[:n_real], no_speech[:n_real]
+        for row, nsp, i in zip(toks, no_speech, idxs):
+            if st.no_speech is not None and nsp > 0.6:
+                continue
+            t0 = windows[i][0]
+            for seg in parse_segments(row, st, window_s=window_s):
+                text = tokenizer.decode([t for t in seg.token_ids
+                                         if t < st.sot])
+                per_window_cues[i].append(
+                    Cue(t0 + seg.start_s, t0 + seg.end_s, text))
+        done += len(idxs)
+        if progress_cb:
+            progress_cb(done, len(live),
+                        f"transcribed {done}/{len(live)} windows")
+    return stitch_windows(per_window_cues), language or "en"
+
+
+def transcribe_video(
+    source_path: str | Path,
+    out_dir: str | Path,
+    *,
+    model_dir: str | None = None,
+    language: str | None = None,
+    progress_cb: ProgressFn | None = None,
+    batch_windows: int = 8,
+    max_new: int | None = None,
+) -> TranscribeResult:
+    """Full transcription job for one video (daemon handler entrypoint)."""
+    from vlog_tpu.media.audio import extract_audio, resample, to_mono
+
+    model_dir = model_dir or config.WHISPER_DIR or os.environ.get(
+        "VLOG_WHISPER_DIR")
+    if not model_dir or not Path(model_dir).exists():
+        raise TranscriptionUnavailable(
+            "no Whisper weights: set VLOG_WHISPER_DIR or pass --whisper-dir "
+            "to a local HF-format model directory")
+    from vlog_tpu.asr.load import load_whisper
+
+    assets = load_whisper(model_dir)
+
+    audio = extract_audio(source_path)
+    if audio is None or not audio.pcm.size:
+        raise ValueError(f"{source_path}: no audio track to transcribe")
+    audio = resample(to_mono(audio), melmod.SAMPLE_RATE)
+    samples = np.ascontiguousarray(audio.pcm[0], np.float32)
+
+    cues, lang = transcribe_audio(
+        samples, assets, language=language, batch_windows=batch_windows,
+        max_new=max_new, progress_cb=progress_cb)
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    vtt_path = out_dir / "captions.vtt"
+    tmp = vtt_path.with_suffix(".vtt.tmp")
+    tmp.write_text(format_vtt(cues))
+    tmp.rename(vtt_path)
+    n_windows = len(_cut_windows(
+        samples, window_s=config.WHISPER_CHUNK_S,
+        overlap_s=config.WHISPER_OVERLAP_S))
+    return TranscribeResult(
+        language=lang, model=assets.model_name, vtt_path=str(vtt_path),
+        text=" ".join(c.text for c in cues), cue_count=len(cues),
+        windows=n_windows)
