@@ -1,0 +1,9 @@
+"""HTTP plane: worker coordination API, admin API, public API.
+
+Reference parity: the three FastAPI services (SURVEY.md §2b — worker_api
+:9002, admin :9001, public :9000). Built on aiohttp here; the DB layer and
+job protocol live in vlog_tpu.jobs / vlog_tpu.db and are shared with
+in-process workers, so the HTTP services are thin authenticated shells —
+the same layering the reference used to keep local workers off the HTTP
+path.
+"""

@@ -1,0 +1,101 @@
+"""Worker API-key auth: 256-bit keys, argon2id at rest, prefix-indexed.
+
+Reference parity: api/worker_auth.py:43-354 — keys are shown once at
+registration, stored as argon2id hashes, looked up by a short indexed
+prefix (so verification is one SELECT + one argon2 verify, not a table
+scan), revocable, with last-used tracking. ``hash_version`` is kept in the
+schema so a future hash migration can auto-rehash on use, as the
+reference's v1(SHA-256)→v2(argon2id) upgrade did.
+"""
+
+from __future__ import annotations
+
+import hmac
+import secrets
+from dataclasses import dataclass
+
+from argon2 import PasswordHasher
+from argon2.exceptions import VerifyMismatchError
+
+from vlog_tpu.db.core import Database, now as db_now
+
+KEY_PREFIX_LEN = 8
+_HASHER = PasswordHasher(time_cost=2, memory_cost=65536, parallelism=1)
+
+
+class AuthError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class WorkerIdentity:
+    worker_name: str
+    key_id: int
+
+
+def generate_key() -> tuple[str, str, str]:
+    """Return (full_key, prefix, secret). Key format: vlwk_<prefix><secret>."""
+    prefix = secrets.token_hex(KEY_PREFIX_LEN // 2)       # 8 hex chars
+    secret = secrets.token_hex(32)                        # 256-bit secret
+    return f"vlwk_{prefix}{secret}", prefix, secret
+
+
+async def create_worker_key(db: Database, worker_name: str) -> str:
+    """Mint a key for a worker; the full key is returned exactly once."""
+    full, prefix, secret = generate_key()
+    await db.execute(
+        """
+        INSERT INTO worker_api_keys (worker_name, key_prefix, key_hash,
+                                     hash_version, created_at)
+        VALUES (:w, :p, :h, 2, :t)
+        """,
+        {"w": worker_name, "p": prefix, "h": _HASHER.hash(secret),
+         "t": db_now()},
+    )
+    return full
+
+
+def _split_key(full_key: str) -> tuple[str, str]:
+    if not full_key.startswith("vlwk_") or len(full_key) < 5 + KEY_PREFIX_LEN + 8:
+        raise AuthError("malformed API key")
+    body = full_key[5:]
+    return body[:KEY_PREFIX_LEN], body[KEY_PREFIX_LEN:]
+
+
+async def verify_key(db: Database, full_key: str) -> WorkerIdentity:
+    """Resolve a presented key to a worker, or raise AuthError."""
+    prefix, secret = _split_key(full_key)
+    rows = await db.fetch_all(
+        "SELECT * FROM worker_api_keys WHERE key_prefix=:p AND revoked_at IS NULL",
+        {"p": prefix},
+    )
+    for row in rows:
+        try:
+            _HASHER.verify(row["key_hash"], secret)
+        except VerifyMismatchError:
+            continue
+        await db.execute(
+            "UPDATE worker_api_keys SET last_used_at=:t WHERE id=:id",
+            {"t": db_now(), "id": row["id"]},
+        )
+        return WorkerIdentity(worker_name=row["worker_name"], key_id=row["id"])
+    raise AuthError("unknown or revoked API key")
+
+
+async def revoke_keys(db: Database, worker_name: str) -> int:
+    """Revoke every active key of a worker (reference: workers revoke
+    endpoint, worker_api.py:3006)."""
+    return await db.execute(
+        """
+        UPDATE worker_api_keys SET revoked_at=:t
+        WHERE worker_name=:w AND revoked_at IS NULL
+        """,
+        {"t": db_now(), "w": worker_name},
+    )
+
+
+def check_admin_secret(presented: str | None, expected: str) -> bool:
+    """Constant-time admin-secret check; empty expected = dev mode (open)."""
+    if not expected:
+        return True
+    return bool(presented) and hmac.compare_digest(presented, expected)

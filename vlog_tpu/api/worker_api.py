@@ -1,0 +1,505 @@
+"""Worker coordination API (:9002) — the distributed control + data plane.
+
+Reference parity: api/worker_api.py:1106-3396. Endpoints map 1:1 onto the
+claim protocol in vlog_tpu.jobs.claims (register/heartbeat/claim/progress/
+complete/fail), plus the bulk data plane remote workers need: source
+download, path-addressed output upload with atomic publish and resume
+status, health, and Prometheus metrics. A progress update extends the
+claim lease; a lost claim surfaces as HTTP 409, which remote workers treat
+as an abort signal (reference remote_transcoder.py:277-300).
+
+Run it: ``python -m vlog_tpu.api.worker_api``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from pathlib import Path
+
+from aiohttp import web
+
+from vlog_tpu import config
+from vlog_tpu.api import auth as authmod
+from vlog_tpu.db.core import Database, Row, now as db_now
+from vlog_tpu.enums import AcceleratorKind, JobKind
+from vlog_tpu.jobs import claims, state as js, videos as vids
+from vlog_tpu.jobs.finalize import finalize_transcode, finalize_transcription
+
+log = logging.getLogger("vlog_tpu.worker_api")
+
+MAX_UPLOAD_PART = 8 * 1024**3         # one rendition file cap
+_COPY_CHUNK = 1 << 20
+
+# request-scoped keys
+IDENTITY = web.AppKey("identity", authmod.WorkerIdentity)
+DB = web.AppKey("db", Database)
+VIDEO_DIR = web.AppKey("video_dir", Path)
+METRICS = web.AppKey("metrics", object)
+# optional async (event_name, payload) hook — wired to webhook delivery
+EVENTS = web.AppKey("events", object)
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+def _job_payload(row: Row) -> dict:
+    out = dict(row)
+    out["payload"] = json.loads(out.get("payload") or "{}")
+    out["last_checkpoint"] = json.loads(out.get("last_checkpoint") or "{}")
+    return out
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    if request.path in ("/healthz", "/metrics", "/api/worker/register"):
+        return await handler(request)
+    hdr = request.headers.get("Authorization", "")
+    if not hdr.startswith("Bearer "):
+        return _json_error(401, "missing bearer API key")
+    try:
+        ident = await authmod.verify_key(request.app[DB], hdr[7:])
+    except authmod.AuthError as exc:
+        return _json_error(401, str(exc))
+    request[IDENTITY] = ident
+    return await handler(request)
+
+
+@web.middleware
+async def metrics_middleware(request: web.Request, handler):
+    m = request.app[METRICS]
+    try:
+        resp = await handler(request)
+        return resp
+    finally:
+        m.http_requests.labels(request.method,
+                               _route_label(request)).inc()
+
+
+def _route_label(request: web.Request) -> str:
+    info = request.match_info.route.resource
+    return info.canonical if info is not None else request.path
+
+
+class Metrics:
+    """Process-local Prometheus registry (one per app, test-safe)."""
+
+    def __init__(self) -> None:
+        from prometheus_client import CollectorRegistry, Counter
+
+        self.registry = CollectorRegistry()
+        self.http_requests = Counter(
+            "vlog_http_requests_total", "HTTP requests",
+            ["method", "route"], registry=self.registry)
+        self.jobs_claimed = Counter(
+            "vlog_jobs_claimed_total", "Jobs claimed over HTTP",
+            ["kind"], registry=self.registry)
+        self.jobs_completed = Counter(
+            "vlog_jobs_completed_total", "Jobs completed over HTTP",
+            ["kind"], registry=self.registry)
+        self.jobs_failed = Counter(
+            "vlog_jobs_failed_total", "Job failures reported over HTTP",
+            ["kind"], registry=self.registry)
+        self.bytes_uploaded = Counter(
+            "vlog_upload_bytes_total", "Output bytes uploaded by workers",
+            registry=self.registry)
+
+    async def render(self, db: Database) -> str:
+        from prometheus_client import generate_latest
+
+        text = generate_latest(self.registry).decode()
+        # live job/worker gauges straight from the DB (scrape-time truth)
+        t = db_now()
+        rows = await db.fetch_all("SELECT * FROM jobs")
+        counts: dict[str, int] = {}
+        for r in rows:
+            st = js.derive_state(r, now=t).value
+            counts[st] = counts.get(st, 0) + 1
+        lines = ["# HELP vlog_jobs Jobs by derived state",
+                 "# TYPE vlog_jobs gauge"]
+        for st, n in sorted(counts.items()):
+            lines.append(f'vlog_jobs{{state="{st}"}} {n}')
+        online = await db.fetch_val(
+            "SELECT COUNT(*) FROM workers WHERE last_heartbeat_at > :cut",
+            {"cut": t - config.WORKER_OFFLINE_THRESHOLD_S})
+        lines.append("# HELP vlog_workers_online Workers with a fresh heartbeat")
+        lines.append("# TYPE vlog_workers_online gauge")
+        lines.append(f"vlog_workers_online {online or 0}")
+        return text + "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------------
+# Handlers
+# --------------------------------------------------------------------------
+
+async def register(request: web.Request) -> web.Response:
+    """Admin-secret-gated worker registration; mints the API key (shown
+    once). Reference: worker_api.py:1106-1218."""
+    if not authmod.check_admin_secret(request.headers.get("X-Admin-Secret"),
+                                      config.ADMIN_SECRET):
+        return _json_error(403, "bad admin secret")
+    body = await request.json()
+    name = (body.get("name") or "").strip()
+    if not name or len(name) > 128:
+        return _json_error(400, "worker name required")
+    db = request.app[DB]
+    t = db_now()
+    await db.execute(
+        """
+        INSERT INTO workers (name, kind, accelerator, capabilities,
+                             code_version, created_at)
+        VALUES (:n, 'remote', :a, :c, :v, :t)
+        ON CONFLICT (name) DO UPDATE SET accelerator=:a, capabilities=:c,
+            code_version=:v, status='active'
+        """,
+        {"n": name, "a": body.get("accelerator", "cpu"),
+         "c": json.dumps(body.get("capabilities") or {}),
+         "v": body.get("code_version", config.CODE_VERSION), "t": t})
+    key = await authmod.create_worker_key(db, name)
+    return web.json_response({"worker": name, "api_key": key}, status=201)
+
+
+async def heartbeat(request: web.Request) -> web.Response:
+    body = await request.json() if request.can_read_body else {}
+    db = request.app[DB]
+    ident = request[IDENTITY]
+    await db.execute(
+        """
+        UPDATE workers SET last_heartbeat_at=:t, status='active',
+               capabilities=COALESCE(:c, capabilities),
+               code_version=COALESCE(:v, code_version)
+        WHERE name=:n
+        """,
+        {"t": db_now(), "n": ident.worker_name,
+         "c": json.dumps(body["capabilities"]) if body.get("capabilities")
+              else None,
+         "v": body.get("code_version")})
+    return web.json_response({"ok": True})
+
+
+async def claim(request: web.Request) -> web.Response:
+    body = await request.json() if request.can_read_body else {}
+    kinds = tuple(JobKind(k) for k in body.get("kinds")
+                  or [k.value for k in JobKind])
+    accel = AcceleratorKind(body.get("accelerator", "cpu"))
+    db = request.app[DB]
+    row = await claims.claim_job(
+        db, request[IDENTITY].worker_name, kinds=kinds, accelerator=accel,
+        code_version=body.get("code_version", config.CODE_VERSION))
+    if row is None:
+        return web.Response(status=204)
+    video = await vids.get_video(db, row["video_id"])
+    request.app[METRICS].jobs_claimed.labels(row["kind"]).inc()
+    return web.json_response({
+        "job": _job_payload(row),
+        "video": {k: video[k] for k in
+                  ("id", "slug", "title", "duration_s", "width", "height")}
+        if video else None,
+    })
+
+
+async def progress(request: web.Request) -> web.Response:
+    body = await request.json()
+    db = request.app[DB]
+    job_id = int(request.match_info["job_id"])
+    try:
+        row = await claims.update_progress(
+            db, job_id, request[IDENTITY].worker_name,
+            progress=body.get("progress"),
+            current_step=body.get("current_step"),
+            checkpoint=body.get("checkpoint"))
+    except js.JobStateError as exc:
+        return _json_error(409, str(exc))
+    for quality, qp in (body.get("qualities") or {}).items():
+        await claims.upsert_quality_progress(
+            db, job_id, quality, status=qp.get("status", "in_progress"),
+            progress=float(qp.get("progress", 0.0)))
+    return web.json_response({
+        "ok": True, "claim_expires_at": row["claim_expires_at"]})
+
+
+async def complete(request: web.Request) -> web.Response:
+    body = await request.json()
+    db = request.app[DB]
+    job_id = int(request.match_info["job_id"])
+    worker = request[IDENTITY].worker_name
+    job = await db.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
+    if job is None:
+        return _json_error(404, "no such job")
+    # Ownership gate BEFORE any finalize side effect: a worker whose lease
+    # lapsed (and whose job was reclaimed) must not overwrite the current
+    # owner's published state — it gets the 409 abort signal up front.
+    try:
+        js.guard_complete(job, worker, now=db_now())
+    except js.JobStateError as exc:
+        return _json_error(409, str(exc))
+    video = await vids.get_video(db, job["video_id"])
+    if video is None:
+        return _json_error(404, "video row vanished")
+    kind = JobKind(job["kind"])
+    result = body.get("result") or {}
+    events: list[tuple[str, dict]] = []
+    try:
+        if kind is JobKind.TRANSCODE:
+            out_dir = request.app[VIDEO_DIR] / video["slug"]
+            # server-side verification pass (reference transcoder.py:2565)
+            from vlog_tpu.media import hls
+
+            try:
+                hls.validate_master_playlist(out_dir / "master.m3u8")
+            except (hls.PlaylistValidationError, OSError) as exc:
+                return _json_error(400, f"uploaded tree failed validation: {exc}")
+            qualities = [
+                {**q, "playlist_path":
+                 str(out_dir / q["quality"] / "playlist.m3u8")}
+                for q in result.get("qualities") or []
+            ]
+            thumb = result.get("thumbnail")
+            await finalize_transcode(
+                db, job, video, probe=result.get("probe") or {},
+                qualities=qualities,
+                thumbnail_path=str(out_dir / thumb) if thumb else None)
+            events.append(("video.ready", {
+                "video_id": video["id"], "slug": video["slug"],
+                "qualities": [q["quality"] for q in qualities]}))
+        elif kind is JobKind.TRANSCRIPTION:
+            vtt = result.get("vtt")
+            await finalize_transcription(
+                db, video["id"], language=result.get("language"),
+                model=result.get("model"),
+                vtt_path=str(request.app[VIDEO_DIR] / video["slug"] / vtt)
+                if vtt else None,
+                text=result.get("text"))
+            events.append(("video.transcribed", {
+                "video_id": video["id"], "slug": video["slug"],
+                "language": result.get("language")}))
+        elif kind is JobKind.SPRITE:
+            events.append(("video.sprites_ready", {
+                "video_id": video["id"], "slug": video["slug"]}))
+        await claims.complete_job(db, job_id, worker)
+    except js.JobStateError as exc:
+        return _json_error(409, str(exc))
+    request.app[METRICS].jobs_completed.labels(job["kind"]).inc()
+    emit = request.app.get(EVENTS)
+    if emit is not None:
+        for name, payload in events:
+            try:
+                await emit(name, payload)
+            except Exception:
+                log.exception("event hook failed for %s", name)
+    return web.json_response({"ok": True})
+
+
+async def fail(request: web.Request) -> web.Response:
+    body = await request.json()
+    db = request.app[DB]
+    job_id = int(request.match_info["job_id"])
+    try:
+        row = await claims.fail_job(
+            db, job_id, request[IDENTITY].worker_name,
+            str(body.get("error") or "unspecified"),
+            permanent=bool(body.get("permanent")))
+    except js.JobStateError as exc:
+        return _json_error(409, str(exc))
+    terminal = row["failed_at"] is not None
+    if terminal and JobKind(row["kind"]) is JobKind.TRANSCODE:
+        from vlog_tpu.enums import VideoStatus
+
+        await vids.set_status(db, row["video_id"], VideoStatus.FAILED,
+                              error=str(body.get("error") or "")[:500])
+    request.app[METRICS].jobs_failed.labels(row["kind"]).inc()
+    return web.json_response({"ok": True, "terminal": terminal})
+
+
+async def release(request: web.Request) -> web.Response:
+    """Graceful worker shutdown hands the claim back (daemon parity)."""
+    db = request.app[DB]
+    job_id = int(request.match_info["job_id"])
+    try:
+        await claims.release_job(db, job_id, request[IDENTITY].worker_name)
+    except js.JobStateError as exc:
+        return _json_error(409, str(exc))
+    return web.json_response({"ok": True})
+
+
+async def download_source(request: web.Request) -> web.StreamResponse:
+    """Bulk source download (reference worker_api.py:2193)."""
+    db = request.app[DB]
+    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    if video is None or not video["source_path"]:
+        return _json_error(404, "no source")
+    path = Path(video["source_path"])
+    if not path.exists():
+        return _json_error(410, "source file gone")
+    return web.FileResponse(path, headers={
+        "X-Source-Name": path.name,
+        "Content-Disposition": f'attachment; filename="{path.name}"'})
+
+
+def _safe_relpath(tail: str) -> Path | None:
+    """Reject traversal/absolute paths in upload targets (tar-bomb parity,
+    reference remote_transcoder.py:149-221)."""
+    p = Path(tail)
+    if p.is_absolute() or not tail or len(tail) > 512:
+        return None
+    parts = p.parts
+    if any(part in ("..", "") or part.startswith("/") for part in parts):
+        return None
+    if len(parts) > 4:
+        return None
+    return p
+
+
+async def _worker_holds_claim(db: Database, worker: str, video_id: int) -> bool:
+    row = await db.fetch_one(
+        f"""
+        SELECT 1 FROM jobs WHERE video_id=:v AND claimed_by=:w
+          AND {js.SQL_ACTIVELY_CLAIMED}
+        """,
+        {"v": video_id, "w": worker, "now": db_now()})
+    return row is not None
+
+
+async def upload(request: web.Request) -> web.Response:
+    """Streaming path-addressed output upload with atomic publish.
+
+    PUT /api/worker/upload/{video_id}/{tail}. The uploader must hold an
+    active claim on the video (reference segment upload,
+    worker_api.py:2492-2933).
+    """
+    db = request.app[DB]
+    video_id = int(request.match_info["video_id"])
+    worker = request[IDENTITY].worker_name
+    video = await vids.get_video(db, video_id)
+    if video is None:
+        return _json_error(404, "no such video")
+    if not await _worker_holds_claim(db, worker, video_id):
+        return _json_error(409, "no active claim on this video")
+    rel = _safe_relpath(request.match_info["tail"])
+    if rel is None:
+        return _json_error(400, "bad upload path")
+    dest = request.app[VIDEO_DIR] / video["slug"] / rel
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    tmp = dest.with_name(dest.name + ".part")
+    size = 0
+    try:
+        with open(tmp, "wb") as fp:
+            async for chunk in request.content.iter_chunked(_COPY_CHUNK):
+                size += len(chunk)
+                if size > MAX_UPLOAD_PART:
+                    raise web.HTTPRequestEntityTooLarge(
+                        max_size=MAX_UPLOAD_PART, actual_size=size)
+                fp.write(chunk)
+        tmp.rename(dest)
+    except BaseException:
+        tmp.unlink(missing_ok=True)
+        raise
+    request.app[METRICS].bytes_uploaded.inc(size)
+    return web.json_response({"ok": True, "path": str(rel), "bytes": size})
+
+
+async def upload_status(request: web.Request) -> web.Response:
+    """Uploaded-file inventory for resume (reference get_segments_status,
+    http_client.py:1065)."""
+    db = request.app[DB]
+    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    if video is None:
+        return _json_error(404, "no such video")
+    root = request.app[VIDEO_DIR] / video["slug"]
+    files = {}
+    if root.exists():
+        for p in root.rglob("*"):
+            if p.is_file() and not p.name.endswith(".part"):
+                files[str(p.relative_to(root))] = p.stat().st_size
+    return web.json_response({"files": files})
+
+
+async def healthz(request: web.Request) -> web.Response:
+    return web.json_response({"ok": True, "db": request.app[DB].connected})
+
+
+async def metrics_endpoint(request: web.Request) -> web.Response:
+    text = await request.app[METRICS].render(request.app[DB])
+    return web.Response(text=text, content_type="text/plain")
+
+
+async def list_workers(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    rows = await db.fetch_all("SELECT * FROM workers ORDER BY name")
+    cut = db_now() - config.WORKER_OFFLINE_THRESHOLD_S
+    for r in rows:
+        r["online"] = bool(r["last_heartbeat_at"]
+                           and r["last_heartbeat_at"] > cut)
+        r["capabilities"] = json.loads(r["capabilities"] or "{}")
+    return web.json_response({"workers": rows})
+
+
+# --------------------------------------------------------------------------
+# App assembly
+# --------------------------------------------------------------------------
+
+def build_worker_app(db: Database, video_dir: Path | None = None) -> web.Application:
+    app = web.Application(middlewares=[metrics_middleware, auth_middleware],
+                          client_max_size=MAX_UPLOAD_PART)
+    app[DB] = db
+    app[VIDEO_DIR] = Path(video_dir or config.VIDEO_DIR)
+    app[METRICS] = Metrics()
+    app.router.add_post("/api/worker/register", register)
+    app.router.add_post("/api/worker/heartbeat", heartbeat)
+    app.router.add_post("/api/worker/claim", claim)
+    app.router.add_post("/api/worker/jobs/{job_id:\\d+}/progress", progress)
+    app.router.add_post("/api/worker/jobs/{job_id:\\d+}/complete", complete)
+    app.router.add_post("/api/worker/jobs/{job_id:\\d+}/fail", fail)
+    app.router.add_post("/api/worker/jobs/{job_id:\\d+}/release", release)
+    app.router.add_get("/api/worker/source/{video_id:\\d+}", download_source)
+    app.router.add_put("/api/worker/upload/{video_id:\\d+}/{tail:.+}", upload)
+    app.router.add_get("/api/worker/upload/{video_id:\\d+}/status",
+                       upload_status)
+    app.router.add_get("/api/worker/workers", list_workers)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics_endpoint)
+    return app
+
+
+async def serve(port: int | None = None, db_url: str | None = None,
+                host: str | None = None) -> None:
+    from vlog_tpu.db.schema import create_all
+
+    config.ensure_dirs()
+    db = Database(db_url or config.DATABASE_URL)
+    await db.connect()
+    await create_all(db)
+    app = build_worker_app(db)
+    if host is None:
+        host = "0.0.0.0" if config.ADMIN_SECRET else "127.0.0.1"
+    if not config.ADMIN_SECRET and host not in ("127.0.0.1", "::1",
+                                                "localhost"):
+        # Open registration mints keys that can read sources and publish
+        # renditions — never expose it beyond loopback without a secret.
+        raise SystemExit(
+            "refusing to bind worker API to a non-loopback address with no "
+            "VLOG_ADMIN_SECRET set (registration would be open)")
+    if not config.ADMIN_SECRET:
+        log.warning("VLOG_ADMIN_SECRET unset: dev mode, loopback only")
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port or config.WORKER_API_PORT)
+    await site.start()
+    log.info("worker API listening on %s:%d", host,
+             port or config.WORKER_API_PORT)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await runner.cleanup()
+        await db.disconnect()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(serve())
+
+
+if __name__ == "__main__":
+    main()

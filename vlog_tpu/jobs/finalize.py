@@ -1,0 +1,74 @@
+"""Per-kind job finalization shared by the in-process daemon and the
+Worker API's complete endpoint.
+
+Reference parity: transcoder.py:2772-2867 (local finalize) and
+worker_api.py:1864-2070 (remote complete) both publish the same state:
+video_qualities rows, status=ready, downstream job enqueue, webhook. One
+module here so the two planes can never drift.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Any
+
+from vlog_tpu import config
+from vlog_tpu.db.core import Database, Row, now as db_now
+from vlog_tpu.enums import JobKind
+from vlog_tpu.jobs import claims, videos as vids
+
+
+async def finalize_transcode(
+    db: Database,
+    job: Row,
+    video: Row,
+    *,
+    probe: Any,
+    qualities: list[dict],
+    thumbnail_path: str | None,
+) -> None:
+    """Publish a completed transcode.
+
+    ``probe`` is either a VideoInfo or a plain dict (the HTTP body from a
+    remote worker).
+    """
+    if isinstance(probe, dict):
+        probe = SimpleNamespace(
+            duration_s=float(probe.get("duration_s") or 0.0),
+            width=int(probe.get("width") or 0),
+            height=int(probe.get("height") or 0),
+            fps=float(probe.get("fps") or 0.0),
+            audio_codec=probe.get("audio_codec"),
+        )
+    await vids.finalize_ready(
+        db, video["id"], probe=probe, qualities=qualities,
+        thumbnail_path=thumbnail_path)
+    rung_names = [q["quality"] for q in qualities]
+    for rn in rung_names:
+        await claims.upsert_quality_progress(
+            db, job["id"], rn, status="completed", progress=100.0)
+    await claims.enqueue_job(db, video["id"], JobKind.SPRITE)
+    if config.TRANSCRIPTION_ENABLED and getattr(probe, "audio_codec", None):
+        await claims.enqueue_job(db, video["id"], JobKind.TRANSCRIPTION)
+
+
+async def finalize_transcription(
+    db: Database, video_id: int, *, language: str | None, model: str | None,
+    vtt_path: str | None, text: str | None,
+) -> None:
+    t = db_now()
+    await db.execute(
+        """
+        INSERT INTO transcriptions (video_id, language, model, vtt_path,
+                                    full_text, status, created_at,
+                                    completed_at)
+        VALUES (:v, :lang, :m, :p, :txt, 'completed', :t, :t)
+        ON CONFLICT (video_id) DO UPDATE SET language=:lang, model=:m,
+            vtt_path=:p, full_text=:txt, status='completed', error=NULL,
+            completed_at=:t
+        """,
+        {"v": video_id, "lang": language, "m": model, "p": vtt_path,
+         "txt": text, "t": t})
+    await db.execute(
+        "UPDATE videos SET transcription_status='completed', updated_at=:t "
+        "WHERE id=:id", {"t": t, "id": video_id})

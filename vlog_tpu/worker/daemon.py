@@ -358,20 +358,13 @@ class WorkerDaemon:
                                     if r.name == q["quality"]), None)}
             for q in result.qualities
         ]
-        await vids.finalize_ready(
-            self.db, video["id"], probe=result.source, qualities=qualities,
+        from vlog_tpu.jobs.finalize import finalize_transcode
+
+        await finalize_transcode(
+            self.db, job, video, probe=result.source, qualities=qualities,
             thumbnail_path=result.run.thumbnail_path)
-        for rn in [r.name for r in rungs]:
-            await claims.upsert_quality_progress(
-                self.db, job["id"], rn, status="completed", progress=100.0)
         await claims.complete_job(self.db, job["id"], self.name)
         self.stats.completed += 1
-        # Downstream jobs (reference finalize enqueues sprite_queue,
-        # transcoder.py:2816-2841; transcription polls ready videos).
-        await claims.enqueue_job(self.db, video["id"], JobKind.SPRITE)
-        if config.TRANSCRIPTION_ENABLED and info.audio_codec:
-            await claims.enqueue_job(self.db, video["id"],
-                                     JobKind.TRANSCRIPTION)
         await self._emit("video.ready", {
             "video_id": video["id"], "slug": video["slug"],
             "qualities": [q["quality"] for q in result.qualities]})
@@ -439,22 +432,11 @@ class WorkerDaemon:
                 "updated_at=:t WHERE id=:id",
                 {"t": db_now(), "id": video["id"]})
             raise
-        t = db_now()
-        await self.db.execute(
-            """
-            INSERT INTO transcriptions (video_id, language, model, vtt_path,
-                                        full_text, status, created_at,
-                                        completed_at)
-            VALUES (:v, :lang, :m, :p, :txt, 'completed', :t, :t)
-            ON CONFLICT (video_id) DO UPDATE SET language=:lang, model=:m,
-                vtt_path=:p, full_text=:txt, status='completed', error=NULL,
-                completed_at=:t
-            """,
-            {"v": video["id"], "lang": result.language, "m": result.model,
-             "p": result.vtt_path, "txt": result.text, "t": t})
-        await self.db.execute(
-            "UPDATE videos SET transcription_status='completed', "
-            "updated_at=:t WHERE id=:id", {"t": t, "id": video["id"]})
+        from vlog_tpu.jobs.finalize import finalize_transcription
+
+        await finalize_transcription(
+            self.db, video["id"], language=result.language,
+            model=result.model, vtt_path=result.vtt_path, text=result.text)
         await claims.complete_job(self.db, job["id"], self.name)
         self.stats.completed += 1
         await self._emit("video.transcribed", {

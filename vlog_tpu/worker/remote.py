@@ -1,0 +1,603 @@
+"""Remote worker: claims jobs over the Worker API, processes locally,
+streams outputs back.
+
+Reference parity: worker/remote_transcoder.py:390-1698 + http_client.py —
+claim over HTTP, download the source, transcode with the local accelerator
+backend, upload outputs as they appear (streaming overlap with device
+compute — the segment-watcher pipeline, reference streaming_upload.py),
+then complete with server-side verification. Every progress post extends
+the lease; an HTTP 409 means the claim was lost and aborts the job at the
+next batch boundary (reference check_claim_expiration:277-300).
+
+Run it: ``python -m vlog_tpu.worker.remote --api http://host:9002 --key ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import shutil
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import httpx
+
+from vlog_tpu import config
+from vlog_tpu.enums import AcceleratorKind, JobKind
+from vlog_tpu.worker.daemon import DaemonStats, JobCancelled
+
+log = logging.getLogger("vlog_tpu.remote")
+
+
+class ClaimLost(Exception):
+    """HTTP 409: the server handed our claim to someone else."""
+
+
+class TransientAPIError(Exception):
+    pass
+
+
+RETRY_STATUS = frozenset({502, 503, 504})
+_UP_CHUNK = 1 << 20
+
+
+class WorkerAPIClient:
+    """Typed async client for the Worker API with bounded retries.
+
+    Reference parity: worker/http_client.py:55-1170 (retry classification;
+    the circuit breaker there protects a much chattier surface — here
+    bounded exponential retry on transport errors/5xx covers the same
+    failure envelope).
+    """
+
+    def __init__(self, base_url: str, api_key: str, *, timeout: float = 120.0,
+                 retries: int = 3):
+        self.base_url = base_url.rstrip("/")
+        self.retries = retries
+        self._client = httpx.AsyncClient(
+            base_url=self.base_url, timeout=timeout,
+            headers={"Authorization": f"Bearer {api_key}"})
+
+    async def aclose(self) -> None:
+        await self._client.aclose()
+
+    @classmethod
+    async def register(cls, base_url: str, name: str, *,
+                       admin_secret: str = "", accelerator: str = "tpu",
+                       capabilities: dict | None = None) -> str:
+        """One-time registration; returns the API key (shown once)."""
+        async with httpx.AsyncClient(base_url=base_url.rstrip("/"),
+                                     timeout=30.0) as c:
+            r = await c.post("/api/worker/register",
+                             json={"name": name, "accelerator": accelerator,
+                                   "capabilities": capabilities or {}},
+                             headers={"X-Admin-Secret": admin_secret})
+            r.raise_for_status()
+            return r.json()["api_key"]
+
+    async def _request(self, method: str, path: str, **kw) -> httpx.Response:
+        delay = 0.5
+        for attempt in range(self.retries + 1):
+            try:
+                resp = await self._client.request(method, path, **kw)
+            except httpx.TransportError as exc:
+                if attempt == self.retries:
+                    raise TransientAPIError(str(exc)) from exc
+            else:
+                if resp.status_code == 409:
+                    raise ClaimLost(resp.text[:300])
+                if resp.status_code in RETRY_STATUS and attempt < self.retries:
+                    pass
+                else:
+                    resp.raise_for_status()
+                    return resp
+            await asyncio.sleep(delay)
+            delay *= 2
+        raise TransientAPIError(f"{method} {path}: retries exhausted")
+
+    async def heartbeat(self, capabilities: dict | None = None) -> None:
+        await self._request("POST", "/api/worker/heartbeat",
+                            json={"capabilities": capabilities or {}})
+
+    async def claim(self, kinds: list[str], accelerator: str) -> dict | None:
+        r = await self._request("POST", "/api/worker/claim",
+                                json={"kinds": kinds,
+                                      "accelerator": accelerator,
+                                      "code_version": config.CODE_VERSION})
+        if r.status_code == 204:
+            return None
+        return r.json()
+
+    async def progress(self, job_id: int, *, progress: float | None = None,
+                       current_step: str | None = None,
+                       qualities: dict | None = None) -> None:
+        await self._request("POST", f"/api/worker/jobs/{job_id}/progress",
+                            json={"progress": progress,
+                                  "current_step": current_step,
+                                  "qualities": qualities})
+
+    async def complete(self, job_id: int, result: dict) -> None:
+        await self._request("POST", f"/api/worker/jobs/{job_id}/complete",
+                            json={"result": result})
+
+    async def fail(self, job_id: int, error: str, *,
+                   permanent: bool = False) -> None:
+        await self._request("POST", f"/api/worker/jobs/{job_id}/fail",
+                            json={"error": error, "permanent": permanent})
+
+    async def release(self, job_id: int) -> None:
+        await self._request("POST", f"/api/worker/jobs/{job_id}/release")
+
+    async def download_source(self, video_id: int, dest: Path) -> Path:
+        """Stream the source into directory ``dest``; returns the file path."""
+        dest.mkdir(parents=True, exist_ok=True)
+        async with self._client.stream(
+                "GET", f"/api/worker/source/{video_id}") as r:
+            r.raise_for_status()
+            name = r.headers.get("X-Source-Name", f"source_{video_id}")
+            out = dest / name
+            tmp = out.with_suffix(out.suffix + ".part")
+            with open(tmp, "wb") as fp:
+                async for chunk in r.aiter_bytes(1 << 20):
+                    fp.write(chunk)
+            tmp.rename(out)
+            return out
+
+    async def upload_file(self, video_id: int, rel: str, path: Path) -> None:
+        """Stream a file up without buffering it in memory; retries reopen
+        the file so each attempt sends a fresh body."""
+
+        async def body():
+            with open(path, "rb") as fp:
+                while True:
+                    chunk = await asyncio.to_thread(fp.read, _UP_CHUNK)
+                    if not chunk:
+                        return
+                    yield chunk
+
+        delay = 0.5
+        url = f"/api/worker/upload/{video_id}/{rel}"
+        for attempt in range(self.retries + 1):
+            try:
+                resp = await self._client.put(url, content=body())
+            except httpx.TransportError as exc:
+                if attempt == self.retries:
+                    raise TransientAPIError(str(exc)) from exc
+            else:
+                if resp.status_code == 409:
+                    raise ClaimLost(resp.text[:300])
+                if not (resp.status_code in RETRY_STATUS
+                        and attempt < self.retries):
+                    resp.raise_for_status()
+                    return
+            await asyncio.sleep(delay)
+            delay *= 2
+        raise TransientAPIError(f"PUT {url}: retries exhausted")
+
+    async def upload_status(self, video_id: int) -> dict[str, int]:
+        r = await self._request("GET",
+                                f"/api/worker/upload/{video_id}/status")
+        return r.json()["files"]
+
+
+# --------------------------------------------------------------------------
+# Streaming uploader: publish outputs while the transcode is still running
+# --------------------------------------------------------------------------
+
+# Manifests/playlists are written last by the backend but must also be
+# uploaded last so the server-side validation pass sees segments first.
+_DEFER = ("master.m3u8", "manifest.mpd")
+
+
+class StreamingUploader:
+    """Polls an output tree and uploads new stable files concurrently with
+    the transcode (reference SegmentWatcher/SegmentUploadWorker,
+    segment_watcher.py:39 + streaming_upload.py:306-607). Files are
+    published atomically by the backend (tmp+rename), so existence is
+    stability."""
+
+    def __init__(self, client: WorkerAPIClient, video_id: int, root: Path,
+                 *, poll_s: float = 1.0, skip_prefixes: tuple[str, ...] = ()):
+        self.client = client
+        self.video_id = video_id
+        self.root = root
+        self.poll_s = poll_s
+        self.skip_prefixes = skip_prefixes
+        self.uploaded: set[str] = set()
+        self.bytes_sent = 0
+        self.errors: list[str] = []
+        self._stop = asyncio.Event()
+
+    async def resume_state(self) -> None:
+        """Skip files the server already has at the same size."""
+        have = await self.client.upload_status(self.video_id)
+        for rel, size in have.items():
+            local = self.root / rel
+            if local.exists() and local.stat().st_size == size:
+                self.uploaded.add(rel)
+
+    def _pending(self, include_deferred: bool) -> list[str]:
+        out = []
+        if not self.root.exists():
+            return out
+        for p in sorted(self.root.rglob("*")):
+            if not p.is_file() or p.suffix in (".part", ".tmp"):
+                continue
+            rel = str(p.relative_to(self.root))
+            if rel in self.uploaded:
+                continue
+            if any(rel.startswith(pre) for pre in self.skip_prefixes):
+                continue
+            if not include_deferred and Path(rel).name in _DEFER:
+                continue
+            out.append(rel)
+        return out
+
+    async def _upload_one(self, rel: str) -> None:
+        await self.client.upload_file(self.video_id, rel, self.root / rel)
+        self.uploaded.add(rel)
+        self.bytes_sent += (self.root / rel).stat().st_size
+
+    async def run(self) -> None:
+        """Poll-and-upload until stopped; manifests deferred to drain()."""
+        while not self._stop.is_set():
+            for rel in self._pending(include_deferred=False):
+                if self._stop.is_set():
+                    return
+                await self._upload_one(rel)
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.poll_s)
+            except asyncio.TimeoutError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    async def drain(self) -> None:
+        """Final sweep including the deferred manifests."""
+        self.stop()
+        for rel in self._pending(include_deferred=False):
+            await self._upload_one(rel)
+        for rel in self._pending(include_deferred=True):
+            await self._upload_one(rel)
+
+
+# --------------------------------------------------------------------------
+# The remote worker loop
+# --------------------------------------------------------------------------
+
+@dataclass
+class RemoteWorker:
+    client: WorkerAPIClient
+    name: str
+    work_dir: Path
+    accelerator: AcceleratorKind = AcceleratorKind.TPU
+    kinds: tuple[JobKind, ...] = (JobKind.TRANSCODE, JobKind.SPRITE,
+                                  JobKind.TRANSCRIPTION)
+    backend: Any = None
+    poll_interval_s: float = field(
+        default_factory=lambda: config.WORKER_POLL_INTERVAL_S)
+    heartbeat_interval_s: float = field(
+        default_factory=lambda: float(config.HEARTBEAT_INTERVAL_S))
+    progress_min_interval_s: float = 2.0
+    cancel_grace_s: float = 120.0
+    keep_work_dirs: bool = False
+    transcription_model_dir: str | None = None
+
+    def __post_init__(self) -> None:
+        self.stats = DaemonStats()
+        self._stop = asyncio.Event()
+        self._cancel = threading.Event()
+        self._cancel_reason = ""
+
+    def request_stop(self) -> None:
+        self._stop.set()
+        self._cancel_reason = self._cancel_reason or "shutdown"
+        self._cancel.set()
+
+    async def run(self) -> None:
+        hb = asyncio.create_task(self._heartbeat_loop())
+        try:
+            while not self._stop.is_set():
+                try:
+                    worked = await self.poll_once()
+                except TransientAPIError as exc:
+                    log.warning("API unreachable: %s", exc)
+                    worked = False
+                if worked or self._stop.is_set():
+                    continue
+                try:
+                    await asyncio.wait_for(self._stop.wait(),
+                                           self.poll_interval_s)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            self._stop.set()
+            hb.cancel()
+            await asyncio.gather(hb, return_exceptions=True)
+
+    async def _heartbeat_loop(self) -> None:
+        caps = {}
+        if self.backend is not None:
+            try:
+                caps = self.backend.detect().to_dict()
+            except Exception:
+                caps = {}
+        while not self._stop.is_set():
+            try:
+                await self.client.heartbeat(caps)
+            except Exception:
+                log.warning("heartbeat failed; will retry", exc_info=True)
+            try:
+                await asyncio.wait_for(self._stop.wait(),
+                                       self.heartbeat_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def poll_once(self) -> bool:
+        claimed = await self.client.claim(
+            [k.value for k in self.kinds], self.accelerator.value)
+        if claimed is None:
+            return False
+        if self._stop.is_set():
+            try:
+                await self.client.release(claimed["job"]["id"])
+            except (ClaimLost, TransientAPIError):
+                pass
+            return False
+        self.stats.claimed += 1
+        self._cancel.clear()
+        self._cancel_reason = ""
+        job, video = claimed["job"], claimed["video"]
+        if video is None:
+            # The video row vanished under a still-queued job.
+            await self._safe_fail(job["id"], "video row vanished",
+                                  permanent=True)
+            return True
+        try:
+            await self._dispatch(job, video)
+        except JobCancelled as exc:
+            if self._stop.is_set():
+                try:
+                    await self.client.release(job["id"])
+                    self.stats.released += 1
+                except (ClaimLost, TransientAPIError):
+                    pass
+            else:
+                await self._safe_fail(job["id"], f"cancelled: {exc.reason}")
+        except ClaimLost as exc:
+            log.warning("job %s claim lost: %s", job["id"], exc)
+            self.stats.last_error = str(exc)
+        except Exception as exc:  # noqa: BLE001
+            log.exception("job %s failed", job["id"])
+            await self._safe_fail(job["id"], f"{type(exc).__name__}: {exc}")
+        finally:
+            if not self.keep_work_dirs:
+                shutil.rmtree(self._job_dir(video), ignore_errors=True)
+        return True
+
+    async def _safe_fail(self, job_id: int, error: str, *,
+                         permanent: bool = False) -> None:
+        self.stats.failed += 1
+        self.stats.last_error = error
+        try:
+            await self.client.fail(job_id, error, permanent=permanent)
+        except (ClaimLost, TransientAPIError) as exc:
+            log.warning("could not report failure for job %s: %s",
+                        job_id, exc)
+
+    def _job_dir(self, video: dict) -> Path:
+        return self.work_dir / video["slug"]
+
+    # -- compute-thread plumbing (HTTP flavor of the daemon's) -------------
+
+    def _make_progress_cb(self, job_id: int, rung_names: list[str]):
+        loop = asyncio.get_running_loop()
+        last = 0.0
+        lost = threading.Event()
+
+        async def post(pct: float, msg: str) -> None:
+            try:
+                await self.client.progress(
+                    job_id, progress=pct, current_step=msg,
+                    qualities={rn: {"status": "in_progress", "progress": pct}
+                               for rn in rung_names})
+            except ClaimLost:
+                lost.set()
+            except TransientAPIError:
+                pass       # missed progress is not fatal; lease has slack
+
+        def cb(done: int, total: int, msg: str) -> None:
+            nonlocal last
+            if self._cancel.is_set():
+                raise JobCancelled(self._cancel_reason or "cancelled")
+            if lost.is_set():
+                raise JobCancelled("claim lost (server returned 409)")
+            now = time.monotonic()
+            if now - last < self.progress_min_interval_s and done < total:
+                return
+            last = now
+            pct = min(100.0 * done / max(total, 1), 99.0)
+            asyncio.run_coroutine_threadsafe(post(pct, msg), loop)
+
+        return cb
+
+    async def _run_with_timeout(self, fn, timeout_s: float, what: str):
+        task = asyncio.create_task(asyncio.to_thread(fn))
+        try:
+            return await asyncio.wait_for(asyncio.shield(task), timeout_s)
+        except asyncio.TimeoutError:
+            self._cancel_reason = f"{what} timed out after {timeout_s:.0f}s"
+            self._cancel.set()
+            try:
+                return await asyncio.wait_for(asyncio.shield(task),
+                                              self.cancel_grace_s)
+            except asyncio.TimeoutError:
+                raise JobCancelled(
+                    f"{self._cancel_reason} (thread unresponsive)") from None
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _dispatch(self, job: dict, video: dict) -> None:
+        handler = {
+            JobKind.TRANSCODE: self._run_transcode,
+            JobKind.SPRITE: self._run_sprites,
+            JobKind.TRANSCRIPTION: self._run_transcription,
+        }[JobKind(job["kind"])]
+        await handler(job, video)
+
+    async def _fetch_source(self, video: dict) -> Path:
+        jdir = self._job_dir(video)
+        src_dir = jdir / "src"
+        existing = [p for p in src_dir.glob("*")
+                    if p.is_file() and not p.name.endswith(".part")] \
+            if src_dir.exists() else []
+        if existing:
+            return existing[0]
+        return await self.client.download_source(video["id"], src_dir)
+
+    async def _run_transcode(self, job: dict, video: dict) -> None:
+        from vlog_tpu.media.probe import get_video_info
+        from vlog_tpu.worker.pipeline import process_video
+
+        src = await self._fetch_source(video)
+        out_dir = self._job_dir(video) / "out"
+        info = await asyncio.to_thread(get_video_info, str(src))
+        rungs = config.ladder_for_source(info.height)
+        timeout = config.transcode_timeout_s(info.duration_s, rungs[0].name)
+        cb = self._make_progress_cb(job["id"], [r.name for r in rungs])
+
+        uploader = StreamingUploader(self.client, video["id"], out_dir,
+                                     skip_prefixes=("original",))
+        await uploader.resume_state()
+        up_task = asyncio.create_task(uploader.run())
+
+        def work():
+            return process_video(src, out_dir, backend=self.backend,
+                                 progress_cb=cb, rungs=rungs,
+                                 keep_original=False)
+
+        try:
+            result = await self._run_with_timeout(work, timeout, "transcode")
+        finally:
+            uploader.stop()
+            await asyncio.gather(up_task, return_exceptions=True)
+        await uploader.drain()
+
+        await self.client.complete(job["id"], {
+            "probe": {
+                "duration_s": result.source.duration_s,
+                "width": result.source.width,
+                "height": result.source.height,
+                "fps": result.source.fps,
+                "audio_codec": result.source.audio_codec,
+            },
+            "qualities": result.qualities,
+            "thumbnail": "thumbnail.jpg" if result.run.thumbnail_path else None,
+        })
+        self.stats.completed += 1
+        log.info("job %s complete: %d files, %d bytes streamed",
+                 job["id"], len(uploader.uploaded), uploader.bytes_sent)
+
+    async def _run_sprites(self, job: dict, video: dict) -> None:
+        from vlog_tpu.worker.sprites import generate_sprites
+
+        src = await self._fetch_source(video)
+        out_dir = self._job_dir(video) / "out"
+        cb = self._make_progress_cb(job["id"], [])
+        timeout = config.transcode_timeout_s(
+            float(video.get("duration_s") or 0.0), "360p")
+
+        def work():
+            return generate_sprites(src, out_dir, progress_cb=cb)
+
+        result = await self._run_with_timeout(work, timeout, "sprites")
+        for p in sorted(Path(result.vtt_path).parent.glob("*")):
+            if p.is_file() and not p.name.endswith(".tmp"):
+                await self.client.upload_file(
+                    video["id"], f"sprites/{p.name}", p)
+        await self.client.complete(job["id"], {
+            "sheets": result.sheet_count, "tiles": result.tile_count})
+        self.stats.completed += 1
+
+    async def _run_transcription(self, job: dict, video: dict) -> None:
+        from vlog_tpu.worker.transcribe import transcribe_video
+
+        src = await self._fetch_source(video)
+        out_dir = self._job_dir(video) / "out"
+        cb = self._make_progress_cb(job["id"], [])
+        timeout = config.transcode_timeout_s(
+            float(video.get("duration_s") or 0.0), "720p")
+
+        def work():
+            return transcribe_video(src, out_dir, progress_cb=cb,
+                                    model_dir=self.transcription_model_dir)
+
+        result = await self._run_with_timeout(work, timeout, "transcription")
+        await self.client.upload_file(video["id"], "captions.vtt",
+                                      Path(result.vtt_path))
+        await self.client.complete(job["id"], {
+            "language": result.language, "model": result.model,
+            "vtt": "captions.vtt", "text": result.text})
+        self.stats.completed += 1
+
+
+# --------------------------------------------------------------------------
+# Entrypoint
+# --------------------------------------------------------------------------
+
+async def _amain(args: argparse.Namespace) -> None:
+    key = args.key
+    if not key:
+        key = await WorkerAPIClient.register(
+            args.api, args.name, admin_secret=args.admin_secret,
+            accelerator=args.accelerator)
+        log.info("registered; api key (save it): %s", key)
+    client = WorkerAPIClient(args.api, key)
+    backend = None
+    if not args.no_backend:
+        from vlog_tpu.backends import select_backend
+
+        backend = select_backend(args.backend or None)
+    worker = RemoteWorker(
+        client, name=args.name, work_dir=Path(args.work_dir),
+        accelerator=AcceleratorKind(args.accelerator),
+        kinds=tuple(JobKind(k) for k in args.kinds.split(",")),
+        backend=backend, transcription_model_dir=args.whisper_dir)
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, worker.request_stop)
+    try:
+        await worker.run()
+    finally:
+        await client.aclose()
+    log.info("remote worker stopped: %s", worker.stats)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="vlog-tpu remote worker")
+    parser.add_argument("--api", default=config.WORKER_API_URL)
+    parser.add_argument("--key", default="",
+                        help="worker API key; omit to register")
+    parser.add_argument("--admin-secret", default=config.ADMIN_SECRET)
+    parser.add_argument("--name", default=f"remote-{int(time.time())}")
+    parser.add_argument("--work-dir", default=str(config.TMP_DIR / "remote"))
+    parser.add_argument("--accelerator", default="tpu",
+                        choices=[a.value for a in AcceleratorKind])
+    parser.add_argument("--kinds", default="transcode,sprite,transcription")
+    parser.add_argument("--backend", default="")
+    parser.add_argument("--no-backend", action="store_true")
+    parser.add_argument("--whisper-dir", default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()
