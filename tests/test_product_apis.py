@@ -1,0 +1,359 @@
+"""Admin + public APIs + CLI: the product plane.
+
+Reference analog: test_admin_api.py / test_public_api.py / test_e2e_upload
+— and the SURVEY §7 minimum end-to-end slice: upload through the admin
+endpoint, a worker takes it to ready, playback serves the CMAF tree with
+correct MIME types.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import httpx
+import pytest
+from aiohttp.test_utils import TestServer
+
+from vlog_tpu import config
+from vlog_tpu.api.admin_api import build_admin_app
+from vlog_tpu.api.public_api import build_public_app
+from vlog_tpu.api.settings import SettingsService, SettingsError
+from vlog_tpu.jobs import claims, videos as vids
+from tests.fixtures.media import make_y4m
+
+
+# --------------------------------------------------------------------------
+# Settings service
+# --------------------------------------------------------------------------
+
+def test_settings_roundtrip_types(run, db):
+    s = SettingsService(db)
+
+    async def go():
+        await s.set("transcoding.segment_duration", 6.5)
+        await s.set("features.downloads", True)
+        await s.set("ui.title", "My VLog")
+        await s.set("ladder.custom", {"rungs": [360, 720]})
+        assert await s.get("transcoding.segment_duration") == 6.5
+        assert await s.get("features.downloads") is True
+        assert await s.get("ui.title") == "My VLog"
+        assert (await s.get("ladder.custom"))["rungs"] == [360, 720]
+        assert await s.get("missing.key", "dflt") == "dflt"
+        assert await s.delete("ui.title") is True
+        s.invalidate()
+        assert await s.get("ui.title") is None
+
+    run(go())
+
+
+def test_settings_ttl_cache(run, db):
+    s = SettingsService(db, ttl_s=60.0)
+
+    async def go():
+        await s.set("k.a", 1)
+        # behind the cache's back
+        await db.execute("UPDATE settings SET value='2' WHERE key='k.a'")
+        assert await s.get("k.a") == 1          # cached
+        s.invalidate("k.a")
+        assert await s.get("k.a") == 2
+
+    run(go())
+
+
+def test_settings_env_fallback(run, db, monkeypatch):
+    monkeypatch.setenv("VLOG_SOME_FLAG", "hello")
+    s = SettingsService(db)
+
+    async def go():
+        assert await s.get("some.flag") == "hello"
+
+    run(go())
+
+
+def test_settings_bad_keys(run, db):
+    s = SettingsService(db)
+
+    async def go():
+        with pytest.raises(SettingsError):
+            await s.set("", 1)
+        with pytest.raises(SettingsError):
+            await s.set("a..b", 1)
+
+    run(go())
+
+
+# --------------------------------------------------------------------------
+# Fixtures: live admin + public apps over one DB
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def stack(db, db_path, tmp_path):
+    """Admin + public servers on a background-thread event loop, so tests
+    (and the CLI) can hit them with plain sync HTTP while using the shared
+    sqlite file from the test's own loop via the ``db`` fixture."""
+    import threading
+
+    from vlog_tpu.db import Database, create_all
+
+    upload_dir = tmp_path / "uploads"
+    video_dir = tmp_path / "videos"
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+
+    def call(coro):
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(30)
+
+    srv_db = Database(f"sqlite:///{db_path}")   # servers' own connection
+    call(srv_db.connect())
+    call(create_all(srv_db))
+    admin_srv = TestServer(build_admin_app(srv_db, upload_dir=upload_dir,
+                                           video_dir=video_dir))
+    public_srv = TestServer(build_public_app(srv_db, video_dir=video_dir))
+    call(admin_srv.start_server())
+    call(public_srv.start_server())
+    yield {
+        "db": db,
+        "admin": str(admin_srv.make_url("")),
+        "public": str(public_srv.make_url("")),
+        "upload_dir": upload_dir,
+        "video_dir": video_dir,
+    }
+    call(admin_srv.close())
+    call(public_srv.close())
+    call(srv_db.disconnect())
+    loop.call_soon_threadsafe(loop.stop)
+    thread.join(timeout=10)
+
+
+def _upload(stack, path, **fields) -> dict:
+    with httpx.Client(base_url=stack["admin"], timeout=60.0) as c, \
+            open(path, "rb") as fp:
+        r = c.post("/api/videos", data=fields,
+                   files={"file": (path.name, fp)})
+        assert r.status_code == 201, r.text
+        return r.json()
+
+
+# --------------------------------------------------------------------------
+# Admin API
+# --------------------------------------------------------------------------
+
+def test_upload_creates_row_and_job(run, tmp_path, stack):
+    src = make_y4m(tmp_path / "clip.y4m", n_frames=8, width=64, height=48)
+    data = _upload(stack, src, title="My Clip", category="demos")
+    v = data["video"]
+    assert v["status"] == "pending"
+    assert v["slug"] == "my-clip"
+    assert v["width"] == 64 and v["duration_s"] > 0
+    job = run(stack["db"].fetch_one(
+        "SELECT * FROM jobs WHERE id=:id", {"id": data["job_id"]}))
+    assert job["kind"] == "transcode"
+    # the upload was moved to its id-keyed resting place
+    assert (stack["upload_dir"] / f"{v['id']}.y4m").exists()
+
+
+def test_upload_rejects_garbage(tmp_path, stack):
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(b"not a video at all")
+    with httpx.Client(base_url=stack["admin"]) as c, open(bad, "rb") as fp:
+        r = c.post("/api/videos", files={"file": ("bad.bin", fp)})
+    assert r.status_code == 400
+    assert "unsupported upload" in r.json()["error"]
+    # nothing left behind
+    assert list(stack["upload_dir"].glob("*")) == []
+
+
+def test_admin_secret_enforced(tmp_path, stack, monkeypatch):
+    monkeypatch.setattr(config, "ADMIN_SECRET", "tops3cret")
+    with httpx.Client(base_url=stack["admin"]) as c:
+        assert c.get("/api/videos").status_code == 403
+        assert c.get("/api/videos",
+                     headers={"X-Admin-Secret": "tops3cret"}).status_code == 200
+        assert c.get("/healthz").status_code == 200   # probe stays open
+
+
+def test_list_detail_delete_restore(run, tmp_path, stack):
+    src = make_y4m(tmp_path / "c.y4m", n_frames=8, width=64, height=48)
+    vid = _upload(stack, src, title="Lifecycle")["video"]
+    with httpx.Client(base_url=stack["admin"]) as c:
+        data = c.get("/api/videos").json()
+        assert data["total"] == 1
+        detail = c.get(f"/api/videos/{vid['id']}").json()
+        assert detail["video"]["slug"] == "lifecycle"
+        assert detail["jobs"][0]["state"] == "unclaimed"
+        assert c.delete(f"/api/videos/{vid['id']}").status_code == 200
+        assert c.get("/api/videos").json()["total"] == 0
+        assert c.post(f"/api/videos/{vid['id']}/restore").status_code == 200
+        assert c.get("/api/videos").json()["total"] == 1
+
+
+def test_retranscode_guards_active_claim(run, tmp_path, stack):
+    src = make_y4m(tmp_path / "c.y4m", n_frames=8, width=64, height=48)
+    vid = _upload(stack, src, title="Busy")["video"]
+    run(claims.claim_job(stack["db"], "w1"))
+    with httpx.Client(base_url=stack["admin"]) as c:
+        r = c.post(f"/api/videos/{vid['id']}/retranscode", json={})
+        assert r.status_code == 409
+        r = c.post(f"/api/videos/{vid['id']}/retranscode",
+                   json={"force": True})
+        assert r.status_code == 200
+
+
+def test_sse_progress_stream(run, tmp_path, stack):
+    src = make_y4m(tmp_path / "c.y4m", n_frames=8, width=64, height=48)
+    vid = _upload(stack, src, title="Live")["video"]
+
+    async def go():
+        job = await claims.claim_job(stack["db"], "w1")
+        await claims.update_progress(stack["db"], job["id"], "w1",
+                                     progress=33.0, current_step="ladder")
+        async with httpx.AsyncClient(base_url=stack["admin"]) as c:
+            async with c.stream("GET", "/api/events/progress",
+                                params={"poll": "0.1"},
+                                timeout=10.0) as r:
+                async for line in r.aiter_lines():
+                    if line.startswith("data: "):
+                        evt = json.loads(line[6:])
+                        assert evt["video_id"] == vid["id"]
+                        assert evt["progress"] == 33.0
+                        assert evt["state"] == "claimed"
+                        return
+
+    run(asyncio.wait_for(go(), 15.0))
+
+
+def test_settings_and_webhooks_endpoints(stack):
+    with httpx.Client(base_url=stack["admin"]) as c:
+        assert c.put("/api/settings/ui.title",
+                     json={"value": "Hi"}).status_code == 200
+        assert c.get("/api/settings").json()["settings"]["ui.title"] == "Hi"
+        assert c.delete("/api/settings/ui.title").status_code == 200
+        wid = c.post("/api/webhooks", json={
+            "url": "https://example.com/hook",
+            "events": ["video.ready"]}).json()["id"]
+        hooks = c.get("/api/webhooks").json()["webhooks"]
+        assert hooks[0]["events"] == ["video.ready"]
+        assert c.post("/api/webhooks",
+                      json={"url": "ftp://bad"}).status_code == 400
+        assert c.delete(f"/api/webhooks/{wid}").status_code == 200
+
+
+# --------------------------------------------------------------------------
+# Public API
+# --------------------------------------------------------------------------
+
+def test_public_hides_non_ready(run, tmp_path, stack):
+    src = make_y4m(tmp_path / "c.y4m", n_frames=8, width=64, height=48)
+    vid = _upload(stack, src, title="Hidden")["video"]
+    with httpx.Client(base_url=stack["public"]) as c:
+        assert c.get("/api/videos").json()["total"] == 0
+        assert c.get(f"/api/videos/{vid['slug']}").status_code == 404
+
+
+def test_e2e_upload_transcode_playback(run, tmp_path, stack):
+    """SURVEY §7 minimum slice: admin upload -> worker -> public playback."""
+    from vlog_tpu.worker.daemon import WorkerDaemon
+
+    src = make_y4m(tmp_path / "movie.y4m", n_frames=10, width=128, height=96,
+                   fps=24)
+    vid = _upload(stack, src, title="Full Slice", category="demos")["video"]
+
+    daemon = WorkerDaemon(stack["db"], name="e2e",
+                          video_dir=stack["video_dir"],
+                          progress_min_interval_s=0.0)
+    run(daemon.poll_once())
+
+    with httpx.Client(base_url=stack["public"]) as c:
+        listing = c.get("/api/videos").json()
+        assert listing["total"] == 1
+        detail = c.get(f"/api/videos/{vid['slug']}").json()["video"]
+        assert detail["stream_url"] == f"/videos/{vid['slug']}/master.m3u8"
+        assert len(detail["qualities"]) >= 1
+
+        master = c.get(detail["stream_url"])
+        assert master.status_code == 200
+        assert master.headers["content-type"].startswith(
+            "application/vnd.apple.mpegurl")
+        assert "#EXTM3U" in master.text
+
+        mpd = c.get(detail["dash_url"])
+        assert mpd.headers["content-type"].startswith("application/dash+xml")
+
+        seg = c.get(f"/videos/{vid['slug']}/360p/segment_00001.m4s")
+        assert seg.status_code == 200
+        assert seg.headers["content-type"] == "video/iso.segment"
+        assert "immutable" in seg.headers["cache-control"]
+
+        thumb = c.get(detail["thumbnail_url"])
+        assert thumb.headers["content-type"] == "image/jpeg"
+
+        # categories reflect the ready video
+        cats = c.get("/api/categories").json()["categories"]
+        assert cats[0]["category"] == "demos"
+
+        # downloads of the original are gated off by default
+        r = c.get(f"/videos/{vid['slug']}/original.y4m")
+        assert r.status_code == 403
+
+        # traversal refused
+        r = c.get(f"/videos/{vid['slug']}/..%2F..%2Fetc%2Fpasswd")
+        assert r.status_code in (400, 404)
+
+
+def test_playback_analytics_session_flow(run, tmp_path, stack):
+    from vlog_tpu.worker.daemon import WorkerDaemon
+
+    src = make_y4m(tmp_path / "c.y4m", n_frames=8, width=64, height=48)
+    vid = _upload(stack, src, title="Watch Me")["video"]
+    daemon = WorkerDaemon(stack["db"], name="e2e",
+                          video_dir=stack["video_dir"],
+                          progress_min_interval_s=0.0)
+    run(daemon.poll_once())
+    with httpx.Client(base_url=stack["public"]) as c:
+        token = c.post(f"/api/videos/{vid['slug']}/session").json()["session"]
+        assert c.post("/api/sessions/heartbeat", json={
+            "session": token, "watch_time_s": 12.5}).status_code == 200
+        assert c.post("/api/sessions/end", json={
+            "session": token, "watch_time_s": 30.0}).json()["ended"] is True
+        # second end is a no-op
+        assert c.post("/api/sessions/end", json={
+            "session": token}).json()["ended"] is False
+    row = run(stack["db"].fetch_one("SELECT * FROM playback_sessions"))
+    assert row["watch_time_s"] == 30.0
+    assert row["ended_at"] is not None
+
+
+# --------------------------------------------------------------------------
+# CLI against the live stack
+# --------------------------------------------------------------------------
+
+def test_cli_upload_list_status(run, tmp_path, stack, monkeypatch, capsys):
+    from vlog_tpu.cli import main as cli
+
+    monkeypatch.setattr(cli, "ADMIN_URL", stack["admin"])
+    monkeypatch.setattr(cli, "PUBLIC_URL", stack["public"])
+    src = make_y4m(tmp_path / "cli.y4m", n_frames=8, width=64, height=48)
+
+    cli.main(["upload", str(src), "--title", "CLI Clip"])
+    out = capsys.readouterr().out
+    assert "uploaded: video" in out and "slug=cli-clip" in out
+
+    cli.main(["list"])
+    out = capsys.readouterr().out
+    assert "cli-clip" in out and "pending" in out
+
+    vid_id = int(out.split("\n")[1].split()[0])
+    cli.main(["status", str(vid_id)])
+    out = capsys.readouterr().out
+    assert "CLI Clip" in out and "unclaimed" in out
+
+    cli.main(["settings", "set", "a.b", "42"])
+    cli.main(["settings", "list"])
+    out = capsys.readouterr().out
+    assert "a.b = 42" in out
+
+    cli.main(["workers"])
+    out = capsys.readouterr().out
+    assert "no workers registered" in out

@@ -1,0 +1,428 @@
+"""Admin API (:9001): upload, video management, live progress, settings,
+webhooks.
+
+Reference parity: api/admin.py (9.7k LoC — the long tail of management
+routes). This service covers the load-bearing surface: size-capped upload
+that probes and enqueues (admin.py:1706-1890 + create_or_reset 719-832),
+video list/detail/retranscode/soft-delete, job + quality progress
+introspection, Server-Sent-Events live progress (admin.py:5291 — DB-poll
+fan-out here instead of Redis pub/sub, since sqlite is the shared truth),
+settings CRUD backed by the SettingsService, webhook CRUD, workers list,
+and Prometheus metrics. Auth: X-Admin-Secret on every mutating route.
+
+Run it: ``python -m vlog_tpu.api.admin_api``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import uuid
+from pathlib import Path
+
+from aiohttp import web
+
+from vlog_tpu import config
+from vlog_tpu.api import auth as authmod
+from vlog_tpu.api.settings import SettingsService, SettingsError
+from vlog_tpu.db.core import Database, now as db_now
+from vlog_tpu.enums import JobKind, VideoStatus
+from vlog_tpu.jobs import claims, state as js, videos as vids
+from vlog_tpu.media.probe import ProbeError, get_video_info
+
+log = logging.getLogger("vlog_tpu.admin_api")
+
+DB = web.AppKey("db", Database)
+UPLOAD_DIR = web.AppKey("upload_dir", Path)
+VIDEO_DIR = web.AppKey("video_dir", Path)
+SETTINGS = web.AppKey("settings", SettingsService)
+
+_COPY_CHUNK = 1 << 20
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+def _qnum(query, name: str, default, *, lo=None, hi=None, cast=int):
+    """Parse a numeric query param; malformed input is a 400, not a 500."""
+    raw = query.get(name)
+    if raw is None:
+        return default
+    try:
+        val = cast(raw)
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(text=f"bad {name!r} parameter") from None
+    if lo is not None:
+        val = max(val, lo)
+    if hi is not None:
+        val = min(val, hi)
+    return val
+
+
+@web.middleware
+async def admin_auth_middleware(request: web.Request, handler):
+    if request.path == "/healthz":
+        return await handler(request)
+    if not authmod.check_admin_secret(request.headers.get("X-Admin-Secret"),
+                                      config.ADMIN_SECRET):
+        return _json_error(403, "bad admin secret")
+    return await handler(request)
+
+
+# --------------------------------------------------------------------------
+# Upload
+# --------------------------------------------------------------------------
+
+async def upload_video(request: web.Request) -> web.Response:
+    """Multipart upload -> size-capped save -> probe -> row + job enqueue.
+
+    Reference: admin.py:1706-1890 (save_upload_with_size_limit at 613).
+    """
+    db = request.app[DB]
+    reader = await request.multipart()
+    title = None
+    description = ""
+    category = None
+    saved: Path | None = None
+    original_name = None
+    size = 0
+    async for part in reader:
+        if part.name == "title":
+            title = (await part.text()).strip()
+        elif part.name == "description":
+            description = await part.text()
+        elif part.name == "category":
+            category = (await part.text()).strip() or None
+        elif part.name == "file":
+            original_name = Path(part.filename or "upload.bin").name
+            suffix = Path(original_name).suffix.lower() or ".bin"
+            tmp = request.app[UPLOAD_DIR] / \
+                f".upload-{uuid.uuid4().hex}{suffix}"
+            tmp.parent.mkdir(parents=True, exist_ok=True)
+            try:
+                with open(tmp, "wb") as fp:
+                    while True:
+                        chunk = await part.read_chunk(_COPY_CHUNK)
+                        if not chunk:
+                            break
+                        size += len(chunk)
+                        if size > config.MAX_UPLOAD_SIZE_BYTES:
+                            raise web.HTTPRequestEntityTooLarge(
+                                max_size=config.MAX_UPLOAD_SIZE_BYTES,
+                                actual_size=size)
+                        fp.write(chunk)
+            except BaseException:
+                tmp.unlink(missing_ok=True)
+                raise
+            saved = tmp
+    if saved is None or size == 0:
+        return _json_error(400, "no file part in upload")
+    if not title:
+        title = Path(original_name or "video").stem.replace("_", " ")
+
+    # probe before accepting (reference rejects unparseable uploads)
+    try:
+        info = await asyncio.to_thread(get_video_info, saved)
+    except (ProbeError, Exception) as exc:  # noqa: BLE001 — any parse error
+        saved.unlink(missing_ok=True)
+        return _json_error(400, f"unsupported upload: {exc}")
+
+    video = await vids.create_video(
+        db, title, source_path=str(saved), original_filename=original_name,
+        size_bytes=size, description=description, category=category)
+    # final resting place keyed by video id (stable across retitle)
+    dest = request.app[UPLOAD_DIR] / f"{video['id']}{saved.suffix}"
+    saved.rename(dest)
+    await db.execute(
+        "UPDATE videos SET source_path=:p, duration_s=:d, width=:w, "
+        "height=:h, fps=:f, updated_at=:t WHERE id=:id",
+        {"p": str(dest), "d": info.duration_s, "w": info.width,
+         "h": info.height, "f": info.fps, "t": db_now(), "id": video["id"]})
+    job_id = await claims.enqueue_job(db, video["id"], JobKind.TRANSCODE)
+    video = await vids.get_video(db, video["id"])
+    return web.json_response(
+        {"video": video, "job_id": job_id}, status=201)
+
+
+# --------------------------------------------------------------------------
+# Video management
+# --------------------------------------------------------------------------
+
+async def list_videos(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    q = request.query
+    limit = _qnum(q, "limit", 50, lo=1, hi=500)
+    offset = _qnum(q, "offset", 0, lo=0)
+    where = ["deleted_at IS NULL"]
+    params: dict = {"limit": limit, "offset": offset}
+    if q.get("status"):
+        where.append("status=:status")
+        params["status"] = q["status"]
+    rows = await db.fetch_all(
+        f"""
+        SELECT * FROM videos WHERE {' AND '.join(where)}
+        ORDER BY created_at DESC LIMIT :limit OFFSET :offset
+        """, params)
+    total = await db.fetch_val(
+        f"SELECT COUNT(*) FROM videos WHERE {' AND '.join(where)}",
+        {k: v for k, v in params.items() if k not in ("limit", "offset")})
+    return web.json_response({"videos": rows, "total": total,
+                              "limit": limit, "offset": offset})
+
+
+async def video_detail(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    if video is None:
+        return _json_error(404, "no such video")
+    quals = await db.fetch_all(
+        "SELECT * FROM video_qualities WHERE video_id=:v ORDER BY height DESC",
+        {"v": video["id"]})
+    jobs = await db.fetch_all(
+        "SELECT * FROM jobs WHERE video_id=:v", {"v": video["id"]})
+    t = db_now()
+    for j in jobs:
+        j["state"] = js.derive_state(j, now=t).value
+        j["quality_progress"] = {
+            k: dict(v) for k, v in
+            (await claims.get_quality_progress(db, j["id"])).items()}
+    tr = await db.fetch_one(
+        "SELECT * FROM transcriptions WHERE video_id=:v", {"v": video["id"]})
+    return web.json_response({"video": video, "qualities": quals,
+                              "jobs": jobs, "transcription": tr})
+
+
+async def retranscode(request: web.Request) -> web.Response:
+    """Force re-enqueue (reference admin.py retranscode, 2883)."""
+    db = request.app[DB]
+    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    if video is None:
+        return _json_error(404, "no such video")
+    force = bool((await request.json() if request.can_read_body else {}
+                  ).get("force"))
+    try:
+        job_id = await claims.enqueue_job(db, video["id"], JobKind.TRANSCODE,
+                                          force=force)
+    except js.JobStateError as exc:
+        return _json_error(409, str(exc))
+    await vids.set_status(db, video["id"], VideoStatus.PENDING)
+    return web.json_response({"job_id": job_id})
+
+
+async def delete_video(request: web.Request) -> web.Response:
+    """Soft delete (reference admin.py:2500: restorable)."""
+    db = request.app[DB]
+    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    if video is None:
+        return _json_error(404, "no such video")
+    await db.execute(
+        "UPDATE videos SET status='deleted', deleted_at=:t, updated_at=:t "
+        "WHERE id=:id", {"t": db_now(), "id": video["id"]})
+    return web.json_response({"ok": True})
+
+
+async def restore_video(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    if video is None or video["deleted_at"] is None:
+        return _json_error(404, "not deleted")
+    has_master = (request.app[VIDEO_DIR] / video["slug"] / "master.m3u8").exists()
+    await db.execute(
+        "UPDATE videos SET status=:s, deleted_at=NULL, updated_at=:t "
+        "WHERE id=:id",
+        {"s": "ready" if has_master else "pending", "t": db_now(),
+         "id": video["id"]})
+    return web.json_response({"ok": True})
+
+
+# --------------------------------------------------------------------------
+# Live progress (SSE)
+# --------------------------------------------------------------------------
+
+async def sse_progress(request: web.Request) -> web.StreamResponse:
+    """Server-Sent-Events stream of job progress (admin.py:5291 analog).
+
+    The DB is the shared truth between API and worker processes, so this
+    polls it and pushes deltas — same contract as the reference's
+    Redis-pub/sub-backed stream, minus the Redis dependency.
+    """
+    db = request.app[DB]
+    resp = web.StreamResponse(headers={
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+        "X-Accel-Buffering": "no"})
+    await resp.prepare(request)
+    last: dict[int, tuple] = {}
+    poll_s = _qnum(request.query, "poll", 1.0, lo=0.1, hi=30.0, cast=float)
+    try:
+        while True:
+            t = db_now()
+            rows = await db.fetch_all(
+                f"SELECT * FROM jobs WHERE {js.SQL_NOT_TERMINAL} "
+                "OR updated_at > :cut", {"cut": t - 10.0})
+            for r in rows:
+                key = (round(r["progress"], 1), r["current_step"],
+                       js.derive_state(r, now=t).value)
+                if last.get(r["id"]) == key:
+                    continue
+                last[r["id"]] = key
+                payload = {"job_id": r["id"], "video_id": r["video_id"],
+                           "kind": r["kind"], "progress": r["progress"],
+                           "current_step": r["current_step"],
+                           "state": key[2]}
+                await resp.write(
+                    f"event: progress\ndata: {json.dumps(payload)}\n\n"
+                    .encode())
+            await asyncio.sleep(poll_s)
+    except (ConnectionResetError, asyncio.CancelledError):
+        pass
+    return resp
+
+
+# --------------------------------------------------------------------------
+# Settings + webhooks + workers
+# --------------------------------------------------------------------------
+
+async def get_settings(request: web.Request) -> web.Response:
+    return web.json_response({"settings": await request.app[SETTINGS].all()})
+
+
+async def put_setting(request: web.Request) -> web.Response:
+    body = await request.json()
+    try:
+        await request.app[SETTINGS].set(
+            request.match_info["key"], body.get("value"),
+            value_type=body.get("type"))
+    except (SettingsError, ValueError, TypeError) as exc:
+        return _json_error(400, str(exc))
+    return web.json_response({"ok": True})
+
+
+async def delete_setting(request: web.Request) -> web.Response:
+    found = await request.app[SETTINGS].delete(request.match_info["key"])
+    return web.json_response({"ok": True, "deleted": found})
+
+
+async def list_webhooks(request: web.Request) -> web.Response:
+    rows = await request.app[DB].fetch_all(
+        "SELECT id, url, events, active, created_at FROM webhooks")
+    for r in rows:
+        r["events"] = json.loads(r["events"] or "[]")
+    return web.json_response({"webhooks": rows})
+
+
+async def create_webhook(request: web.Request) -> web.Response:
+    body = await request.json()
+    url = (body.get("url") or "").strip()
+    if not url.startswith(("http://", "https://")):
+        return _json_error(400, "url must be http(s)")
+    wid = await request.app[DB].execute(
+        """
+        INSERT INTO webhooks (url, secret, events, active, created_at)
+        VALUES (:u, :s, :e, 1, :t)
+        """,
+        {"u": url, "s": body.get("secret"),
+         "e": json.dumps(body.get("events") or []), "t": db_now()})
+    return web.json_response({"id": wid}, status=201)
+
+
+async def delete_webhook(request: web.Request) -> web.Response:
+    n = await request.app[DB].execute(
+        "DELETE FROM webhooks WHERE id=:id",
+        {"id": int(request.match_info["webhook_id"])})
+    return web.json_response({"ok": True, "deleted": bool(n)})
+
+
+async def list_workers(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    rows = await db.fetch_all("SELECT * FROM workers ORDER BY name")
+    cut = db_now() - config.WORKER_OFFLINE_THRESHOLD_S
+    for r in rows:
+        r["online"] = bool(r["last_heartbeat_at"]
+                           and r["last_heartbeat_at"] > cut)
+        r["capabilities"] = json.loads(r["capabilities"] or "{}")
+    return web.json_response({"workers": rows})
+
+
+async def revoke_worker(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    name = request.match_info["name"]
+    n = await authmod.revoke_keys(db, name)
+    await db.execute("UPDATE workers SET status='revoked' WHERE name=:n",
+                     {"n": name})
+    return web.json_response({"ok": True, "keys_revoked": n})
+
+
+async def healthz(request: web.Request) -> web.Response:
+    return web.json_response({"ok": True, "db": request.app[DB].connected})
+
+
+# --------------------------------------------------------------------------
+# App assembly
+# --------------------------------------------------------------------------
+
+def build_admin_app(db: Database, *, upload_dir: Path | None = None,
+                    video_dir: Path | None = None) -> web.Application:
+    app = web.Application(middlewares=[admin_auth_middleware],
+                          client_max_size=config.MAX_UPLOAD_SIZE_BYTES)
+    app[DB] = db
+    app[UPLOAD_DIR] = Path(upload_dir or config.UPLOAD_DIR)
+    app[VIDEO_DIR] = Path(video_dir or config.VIDEO_DIR)
+    app[SETTINGS] = SettingsService(db)
+    r = app.router
+    r.add_post("/api/videos", upload_video)
+    r.add_get("/api/videos", list_videos)
+    r.add_get("/api/videos/{video_id:\\d+}", video_detail)
+    r.add_post("/api/videos/{video_id:\\d+}/retranscode", retranscode)
+    r.add_delete("/api/videos/{video_id:\\d+}", delete_video)
+    r.add_post("/api/videos/{video_id:\\d+}/restore", restore_video)
+    r.add_get("/api/events/progress", sse_progress)
+    r.add_get("/api/settings", get_settings)
+    r.add_put("/api/settings/{key}", put_setting)
+    r.add_delete("/api/settings/{key}", delete_setting)
+    r.add_get("/api/webhooks", list_webhooks)
+    r.add_post("/api/webhooks", create_webhook)
+    r.add_delete("/api/webhooks/{webhook_id:\\d+}", delete_webhook)
+    r.add_get("/api/workers", list_workers)
+    r.add_post("/api/workers/{name}/revoke", revoke_worker)
+    r.add_get("/healthz", healthz)
+    return app
+
+
+async def serve(port: int | None = None, db_url: str | None = None,
+                host: str | None = None) -> None:
+    from vlog_tpu.db.schema import create_all
+
+    config.ensure_dirs()
+    db = Database(db_url or config.DATABASE_URL)
+    await db.connect()
+    await create_all(db)
+    app = build_admin_app(db)
+    if host is None:
+        host = "0.0.0.0" if config.ADMIN_SECRET else "127.0.0.1"
+    if not config.ADMIN_SECRET and host not in ("127.0.0.1", "::1",
+                                                "localhost"):
+        raise SystemExit(
+            "refusing to bind admin API beyond loopback with no "
+            "VLOG_ADMIN_SECRET set")
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port or config.ADMIN_PORT)
+    await site.start()
+    log.info("admin API listening on %s:%d", host, port or config.ADMIN_PORT)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await runner.cleanup()
+        await db.disconnect()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(serve())
+
+
+if __name__ == "__main__":
+    main()

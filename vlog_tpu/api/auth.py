@@ -10,8 +10,10 @@ reference's v1(SHA-256)→v2(argon2id) upgrade did.
 
 from __future__ import annotations
 
+import hashlib
 import hmac
 import secrets
+import time
 from dataclasses import dataclass
 
 from argon2 import PasswordHasher
@@ -21,6 +23,9 @@ from vlog_tpu.db.core import Database, now as db_now
 
 KEY_PREFIX_LEN = 8
 _HASHER = PasswordHasher(time_cost=2, memory_cost=65536, parallelism=1)
+VERIFY_CACHE_TTL_S = 60.0
+# sha256(full_key) -> (expires_monotonic, identity); bounds revocation lag
+_VERIFIED_CACHE: dict[str, tuple[float, "WorkerIdentity"]] = {}
 
 
 class AuthError(Exception):
@@ -63,7 +68,21 @@ def _split_key(full_key: str) -> tuple[str, str]:
 
 
 async def verify_key(db: Database, full_key: str) -> WorkerIdentity:
-    """Resolve a presented key to a worker, or raise AuthError."""
+    """Resolve a presented key to a worker, or raise AuthError.
+
+    The argon2 verify runs off the event loop (it is deliberately ~100 ms
+    of CPU), and successful verifications are cached for a short TTL so a
+    worker streaming hundreds of segment uploads does not serialize the
+    whole API behind repeated hashing. Revocation takes effect within the
+    TTL window.
+    """
+    import asyncio
+
+    digest = hashlib.sha256(full_key.encode()).hexdigest()
+    hit = _VERIFIED_CACHE.get(digest)
+    now = time.monotonic()
+    if hit is not None and now < hit[0]:
+        return hit[1]
     prefix, secret = _split_key(full_key)
     rows = await db.fetch_all(
         "SELECT * FROM worker_api_keys WHERE key_prefix=:p AND revoked_at IS NULL",
@@ -71,20 +90,31 @@ async def verify_key(db: Database, full_key: str) -> WorkerIdentity:
     )
     for row in rows:
         try:
-            _HASHER.verify(row["key_hash"], secret)
+            await asyncio.to_thread(_HASHER.verify, row["key_hash"], secret)
         except VerifyMismatchError:
             continue
         await db.execute(
             "UPDATE worker_api_keys SET last_used_at=:t WHERE id=:id",
             {"t": db_now(), "id": row["id"]},
         )
-        return WorkerIdentity(worker_name=row["worker_name"], key_id=row["id"])
+        ident = WorkerIdentity(worker_name=row["worker_name"],
+                               key_id=row["id"])
+        if len(_VERIFIED_CACHE) > 1024:
+            _VERIFIED_CACHE.clear()
+        _VERIFIED_CACHE[digest] = (now + VERIFY_CACHE_TTL_S, ident)
+        return ident
     raise AuthError("unknown or revoked API key")
+
+
+def invalidate_verify_cache() -> None:
+    _VERIFIED_CACHE.clear()
 
 
 async def revoke_keys(db: Database, worker_name: str) -> int:
     """Revoke every active key of a worker (reference: workers revoke
-    endpoint, worker_api.py:3006)."""
+    endpoint, worker_api.py:3006). In-process verify cache is dropped
+    immediately; other processes converge within VERIFY_CACHE_TTL_S."""
+    _VERIFIED_CACHE.clear()
     return await db.execute(
         """
         UPDATE worker_api_keys SET revoked_at=:t

@@ -1,0 +1,284 @@
+"""Public API (:9000): browse, playback, captions, analytics.
+
+Reference parity: api/public.py — video list/search/detail (916-1331),
+transcript (1399), playback analytics session/heartbeat/end (2521-2660),
+and the custom static file serving with HLS/DASH MIME types
+(docs/ARCHITECTURE.md:59-62 ``HLSStaticFiles``). Read-only over the same
+database the admin/worker planes write; only ready, non-deleted videos
+are visible.
+
+Run it: ``python -m vlog_tpu.api.public_api``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+from pathlib import Path
+
+from aiohttp import web
+
+from vlog_tpu import config
+from vlog_tpu.db.core import Database, now as db_now
+from vlog_tpu.jobs import videos as vids
+
+log = logging.getLogger("vlog_tpu.public_api")
+
+DB = web.AppKey("db", Database)
+VIDEO_DIR = web.AppKey("video_dir", Path)
+
+# The reference subclasses StaticFiles for exactly this table
+# (HLSStaticFiles, docs/ARCHITECTURE.md:59-62).
+MEDIA_MIME = {
+    ".m3u8": "application/vnd.apple.mpegurl",
+    ".mpd": "application/dash+xml",
+    ".m4s": "video/iso.segment",
+    ".mp4": "video/mp4",
+    ".ts": "video/mp2t",
+    ".vtt": "text/vtt",
+    ".jpg": "image/jpeg",
+    ".jpeg": "image/jpeg",
+    ".png": "image/png",
+    ".y4m": "application/octet-stream",
+    ".aac": "audio/aac",
+}
+
+_PUBLIC_VIDEO_FIELDS = ("id", "slug", "title", "description", "duration_s",
+                        "width", "height", "fps", "status", "category",
+                        "tags", "created_at")
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+def _qnum(query, name: str, default, *, lo=None, hi=None, cast=int):
+    """Parse a numeric query param; malformed input is a 400, not a 500."""
+    raw = query.get(name)
+    if raw is None:
+        return default
+    try:
+        val = cast(raw)
+    except (TypeError, ValueError):
+        raise web.HTTPBadRequest(text=f"bad {name!r} parameter") from None
+    if lo is not None:
+        val = max(val, lo)
+    if hi is not None:
+        val = min(val, hi)
+    return val
+
+
+def _public_video(row: dict) -> dict:
+    import json as _json
+
+    out = {k: row[k] for k in _PUBLIC_VIDEO_FIELDS}
+    out["tags"] = _json.loads(row["tags"] or "[]")
+    out["stream_url"] = f"/videos/{row['slug']}/master.m3u8"
+    out["dash_url"] = f"/videos/{row['slug']}/manifest.mpd"
+    out["thumbnail_url"] = (f"/videos/{row['slug']}/thumbnail.jpg"
+                            if row["thumbnail_path"] else None)
+    out["sprites_url"] = f"/videos/{row['slug']}/sprites/sprites.vtt"
+    out["captions_url"] = f"/videos/{row['slug']}/captions.vtt"
+    return out
+
+
+READY = "status='ready' AND deleted_at IS NULL"
+
+
+async def list_videos(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    q = request.query
+    limit = _qnum(q, "limit", 24, lo=1, hi=100)
+    offset = _qnum(q, "offset", 0, lo=0)
+    where = [READY]
+    params: dict = {"limit": limit, "offset": offset}
+    if q.get("q"):
+        where.append("(title LIKE :pat OR description LIKE :pat)")
+        params["pat"] = f"%{q['q']}%"
+    if q.get("category"):
+        where.append("category=:cat")
+        params["cat"] = q["category"]
+    rows = await db.fetch_all(
+        f"""
+        SELECT * FROM videos WHERE {' AND '.join(where)}
+        ORDER BY created_at DESC LIMIT :limit OFFSET :offset
+        """, params)
+    total = await db.fetch_val(
+        f"SELECT COUNT(*) FROM videos WHERE {' AND '.join(where)}",
+        {k: v for k, v in params.items() if k not in ("limit", "offset")})
+    return web.json_response({
+        "videos": [_public_video(r) for r in rows],
+        "total": total, "limit": limit, "offset": offset})
+
+
+async def video_detail(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    row = await vids.get_video_by_slug(db, request.match_info["slug"])
+    if row is None or row["status"] != "ready" or row["deleted_at"]:
+        return _json_error(404, "no such video")
+    quals = await db.fetch_all(
+        "SELECT name, width, height, video_bitrate, audio_bitrate, codec "
+        "FROM video_qualities WHERE video_id=:v ORDER BY height DESC",
+        {"v": row["id"]})
+    out = _public_video(row)
+    out["qualities"] = quals
+    return web.json_response({"video": out})
+
+
+async def transcript(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    row = await vids.get_video_by_slug(db, request.match_info["slug"])
+    if row is None or row["deleted_at"]:
+        return _json_error(404, "no such video")
+    tr = await db.fetch_one(
+        "SELECT language, model, full_text, status, vtt_path "
+        "FROM transcriptions WHERE video_id=:v", {"v": row["id"]})
+    if tr is None or tr["status"] != "completed":
+        return _json_error(404, "no transcript")
+    return web.json_response({
+        "language": tr["language"], "model": tr["model"],
+        "text": tr["full_text"],
+        "vtt_url": f"/videos/{row['slug']}/captions.vtt"})
+
+
+async def categories(request: web.Request) -> web.Response:
+    rows = await request.app[DB].fetch_all(
+        f"""
+        SELECT category, COUNT(*) AS n FROM videos
+        WHERE {READY} AND category IS NOT NULL
+        GROUP BY category ORDER BY n DESC
+        """)
+    return web.json_response({"categories": rows})
+
+
+# --------------------------------------------------------------------------
+# Playback analytics (public.py:2521-2660)
+# --------------------------------------------------------------------------
+
+async def start_session(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    row = await vids.get_video_by_slug(db, request.match_info["slug"])
+    if row is None:
+        return _json_error(404, "no such video")
+    token = secrets.token_urlsafe(24)
+    t = db_now()
+    await db.execute(
+        """
+        INSERT INTO playback_sessions (video_id, session_token, started_at,
+                                       last_heartbeat_at)
+        VALUES (:v, :tok, :t, :t)
+        """, {"v": row["id"], "tok": token, "t": t})
+    return web.json_response({"session": token}, status=201)
+
+
+async def session_heartbeat(request: web.Request) -> web.Response:
+    body = await request.json()
+    n = await request.app[DB].execute(
+        """
+        UPDATE playback_sessions
+        SET last_heartbeat_at=:t, watch_time_s=:w
+        WHERE session_token=:tok AND ended_at IS NULL
+        """,
+        {"t": db_now(), "tok": str(body.get("session") or ""),
+         "w": float(body.get("watch_time_s") or 0.0)})
+    if not n:
+        return _json_error(404, "no live session")
+    return web.json_response({"ok": True})
+
+
+async def end_session(request: web.Request) -> web.Response:
+    body = await request.json()
+    n = await request.app[DB].execute(
+        """
+        UPDATE playback_sessions
+        SET ended_at=:t, watch_time_s=MAX(watch_time_s, :w)
+        WHERE session_token=:tok AND ended_at IS NULL
+        """,
+        {"t": db_now(), "tok": str(body.get("session") or ""),
+         "w": float(body.get("watch_time_s") or 0.0)})
+    return web.json_response({"ok": True, "ended": bool(n)})
+
+
+# --------------------------------------------------------------------------
+# Media static serving with correct MIME (HLSStaticFiles analog)
+# --------------------------------------------------------------------------
+
+async def serve_media(request: web.Request) -> web.StreamResponse:
+    slug = request.match_info["slug"]
+    tail = request.match_info["tail"]
+    db = request.app[DB]
+    row = await vids.get_video_by_slug(db, slug)
+    # Only published videos serve media: a mid-transcode tree (status
+    # pending/processing) must not leak through guessable slugs.
+    if row is None or row["deleted_at"] or row["status"] != "ready":
+        return _json_error(404, "no such video")
+    rel = Path(tail)
+    if rel.is_absolute() or ".." in rel.parts or len(rel.parts) > 4:
+        return _json_error(400, "bad path")
+    if rel.parts and rel.parts[0].startswith("original"):
+        # downloads of the source are gated (reference config.py:602-616)
+        if not config.DOWNLOADS_ENABLED:
+            return _json_error(403, "downloads disabled")
+    path = request.app[VIDEO_DIR] / slug / rel
+    if not path.is_file():
+        return _json_error(404, "not found")
+    mime = MEDIA_MIME.get(path.suffix.lower(), "application/octet-stream")
+    return web.FileResponse(path, headers={
+        "Content-Type": mime,
+        "Cache-Control": ("no-cache" if path.suffix in (".m3u8", ".mpd")
+                          else "public, max-age=31536000, immutable"),
+        "Access-Control-Allow-Origin": "*"})
+
+
+async def healthz(request: web.Request) -> web.Response:
+    return web.json_response({"ok": True, "db": request.app[DB].connected})
+
+
+def build_public_app(db: Database, *, video_dir: Path | None = None
+                     ) -> web.Application:
+    app = web.Application()
+    app[DB] = db
+    app[VIDEO_DIR] = Path(video_dir or config.VIDEO_DIR)
+    r = app.router
+    r.add_get("/api/videos", list_videos)
+    r.add_get("/api/videos/{slug}", video_detail)
+    r.add_get("/api/videos/{slug}/transcript", transcript)
+    r.add_get("/api/categories", categories)
+    r.add_post("/api/videos/{slug}/session", start_session)
+    r.add_post("/api/sessions/heartbeat", session_heartbeat)
+    r.add_post("/api/sessions/end", end_session)
+    r.add_get("/videos/{slug}/{tail:.+}", serve_media)
+    r.add_get("/healthz", healthz)
+    return app
+
+
+async def serve(port: int | None = None, db_url: str | None = None,
+                host: str = "0.0.0.0") -> None:
+    from vlog_tpu.db.schema import create_all
+
+    config.ensure_dirs()
+    db = Database(db_url or config.DATABASE_URL)
+    await db.connect()
+    await create_all(db)
+    app = build_public_app(db)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port or config.PUBLIC_PORT)
+    await site.start()
+    log.info("public API listening on %s:%d", host,
+             port or config.PUBLIC_PORT)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await runner.cleanup()
+        await db.disconnect()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(serve())
+
+
+if __name__ == "__main__":
+    main()

@@ -241,22 +241,32 @@ async def complete(request: web.Request) -> web.Response:
     kind = JobKind(job["kind"])
     result = body.get("result") or {}
     events: list[tuple[str, dict]] = []
-    try:
-        if kind is JobKind.TRANSCODE:
-            out_dir = request.app[VIDEO_DIR] / video["slug"]
-            # server-side verification pass (reference transcoder.py:2565)
-            from vlog_tpu.media import hls
+    # Worker-supplied result paths get the same sanitization as uploads.
+    thumb = result.get("thumbnail")
+    vtt = result.get("vtt")
+    if (thumb and _safe_relpath(thumb) is None) or \
+            (vtt and _safe_relpath(vtt) is None):
+        return _json_error(400, "bad result path")
+    out_dir = request.app[VIDEO_DIR] / video["slug"]
+    if kind is JobKind.TRANSCODE:
+        # server-side verification pass (reference transcoder.py:2565)
+        from vlog_tpu.media import hls
 
-            try:
-                hls.validate_master_playlist(out_dir / "master.m3u8")
-            except (hls.PlaylistValidationError, OSError) as exc:
-                return _json_error(400, f"uploaded tree failed validation: {exc}")
+        try:
+            hls.validate_master_playlist(out_dir / "master.m3u8")
+        except (hls.PlaylistValidationError, OSError) as exc:
+            return _json_error(400, f"uploaded tree failed validation: {exc}")
+    try:
+        # Terminal-state transition FIRST: complete_job atomically re-checks
+        # ownership inside its transaction, so a stale worker that lost the
+        # claim gets its 409 before any published state changes.
+        await claims.complete_job(db, job_id, worker)
+        if kind is JobKind.TRANSCODE:
             qualities = [
                 {**q, "playlist_path":
                  str(out_dir / q["quality"] / "playlist.m3u8")}
                 for q in result.get("qualities") or []
             ]
-            thumb = result.get("thumbnail")
             await finalize_transcode(
                 db, job, video, probe=result.get("probe") or {},
                 qualities=qualities,
@@ -265,12 +275,10 @@ async def complete(request: web.Request) -> web.Response:
                 "video_id": video["id"], "slug": video["slug"],
                 "qualities": [q["quality"] for q in qualities]}))
         elif kind is JobKind.TRANSCRIPTION:
-            vtt = result.get("vtt")
             await finalize_transcription(
                 db, video["id"], language=result.get("language"),
                 model=result.get("model"),
-                vtt_path=str(request.app[VIDEO_DIR] / video["slug"] / vtt)
-                if vtt else None,
+                vtt_path=str(out_dir / vtt) if vtt else None,
                 text=result.get("text"))
             events.append(("video.transcribed", {
                 "video_id": video["id"], "slug": video["slug"],
@@ -278,7 +286,6 @@ async def complete(request: web.Request) -> web.Response:
         elif kind is JobKind.SPRITE:
             events.append(("video.sprites_ready", {
                 "video_id": video["id"], "slug": video["slug"]}))
-        await claims.complete_job(db, job_id, worker)
     except js.JobStateError as exc:
         return _json_error(409, str(exc))
     request.app[METRICS].jobs_completed.labels(job["kind"]).inc()

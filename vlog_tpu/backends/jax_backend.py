@@ -51,6 +51,7 @@ from vlog_tpu.codecs.jpeg import encode_jpeg_yuv420
 from vlog_tpu.media import hls
 from vlog_tpu.media.fmp4 import Sample, TrackConfig, avc1_sample_entry, init_segment, media_segment
 from vlog_tpu.media.probe import VideoInfo
+from vlog_tpu.utils.fsio import atomic_write_bytes, atomic_write_text
 from vlog_tpu.ops.colorspace import yuv420_to_rgb
 from vlog_tpu.ops.resize import resize_yuv420
 
@@ -141,7 +142,8 @@ class JaxBackend:
             )
             rdir = out / rung.name
             rdir.mkdir(parents=True, exist_ok=True)
-            (rdir / "init.mp4").write_bytes(init_segment(tracks[rung.name]))
+            atomic_write_bytes(rdir / "init.mp4",
+                               init_segment(tracks[rung.name]))
             seg_counts[rung.name] = 0
             seg_durs[rung.name] = []
             bytes_written[rung.name] = 0
@@ -290,7 +292,7 @@ class JaxBackend:
                 init_uri="init.mp4",
             )
             ppath = out / name / "playlist.m3u8"
-            ppath.write_text(playlist)
+            atomic_write_text(ppath, playlist)
             total_dur = sum(seg_durs[name])
             achieved = int(bytes_written[name] * 8 / total_dur) if total_dur else 0
             results.append(RungResult(
@@ -311,8 +313,8 @@ class JaxBackend:
                 audio_group=(f"aud{rung.audio_bitrate // 1000}"
                              if rung.audio_bitrate else ""),
             ))
-        (out / "master.m3u8").write_text(hls.master_playlist(variants))
-        (out / "manifest.mpd").write_text(hls.dash_manifest(
+        atomic_write_text(out / "master.m3u8", hls.master_playlist(variants))
+        atomic_write_text(out / "manifest.mpd", hls.dash_manifest(
             variants, duration_s=duration_s,
             segment_duration_s=plan.segment_duration_s))
 
@@ -389,8 +391,8 @@ class JaxBackend:
         rgb = np.asarray(yuv420_to_rgb(y, u, v, standard="bt709"))
         from vlog_tpu.codecs.jpeg import encode_jpeg_rgb
 
-        Path(path).write_bytes(
-            encode_jpeg_rgb((rgb * 255).astype(np.uint8), quality=85))
+        atomic_write_bytes(Path(path), encode_jpeg_rgb(
+            (rgb * 255).astype(np.uint8), quality=85))
 
 
 register_backend("jax", JaxBackend)

@@ -1,0 +1,1 @@
+"""Console client (reference: cli/main.py)."""

@@ -1,0 +1,313 @@
+"""``vlog-tpu`` console client.
+
+Reference parity: cli/main.py:250-1053 — upload, list, status, delete/
+restore/retranscode, worker management, settings, webhooks — speaking to
+the admin (:9001) and public (:9000) APIs over HTTP, plus launcher
+subcommands for the three services and the two worker flavors so one
+entrypoint runs the whole system.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import httpx
+
+ADMIN_URL = os.environ.get("VLOG_ADMIN_URL", "http://127.0.0.1:9001")
+PUBLIC_URL = os.environ.get("VLOG_PUBLIC_URL", "http://127.0.0.1:9000")
+ADMIN_SECRET = os.environ.get("VLOG_ADMIN_SECRET", "")
+
+
+def _client(base: str) -> httpx.Client:
+    headers = {}
+    if ADMIN_SECRET:
+        headers["X-Admin-Secret"] = ADMIN_SECRET
+    return httpx.Client(base_url=base, headers=headers, timeout=600.0)
+
+
+def _die(resp: httpx.Response) -> None:
+    try:
+        msg = resp.json().get("error", resp.text)
+    except Exception:
+        msg = resp.text
+    print(f"error {resp.status_code}: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _ok(resp: httpx.Response) -> dict:
+    if resp.status_code >= 400:
+        _die(resp)
+    return resp.json()
+
+
+def _fmt_duration(s) -> str:
+    s = float(s or 0)
+    return f"{int(s // 60)}:{s % 60:04.1f}"
+
+
+# --------------------------------------------------------------------------
+# Commands
+# --------------------------------------------------------------------------
+
+def cmd_upload(args) -> None:
+    path = Path(args.file)
+    if not path.exists():
+        sys.exit(f"{path}: no such file")
+    with _client(ADMIN_URL) as c, open(path, "rb") as fp:
+        fields = {"title": args.title or path.stem.replace("_", " ")}
+        if args.description:
+            fields["description"] = args.description
+        if args.category:
+            fields["category"] = args.category
+        resp = c.post("/api/videos", data=fields,
+                      files={"file": (path.name, fp)})
+        data = _ok(resp)
+    video = data["video"]
+    print(f"uploaded: video {video['id']} slug={video['slug']} "
+          f"job={data['job_id']}")
+    if args.wait:
+        _wait_ready(video["id"])
+
+
+def _wait_ready(video_id: int, poll_s: float = 3.0) -> None:
+    with _client(ADMIN_URL) as c:
+        last = None
+        while True:
+            data = _ok(c.get(f"/api/videos/{video_id}"))
+            v = data["video"]
+            jobs = {j["kind"]: j for j in data["jobs"]}
+            tj = jobs.get("transcode", {})
+            line = (f"status={v['status']} progress="
+                    f"{tj.get('progress', 0):.1f}% "
+                    f"step={tj.get('current_step') or '-'}")
+            if line != last:
+                print(line)
+                last = line
+            if v["status"] in ("ready", "failed"):
+                if v["status"] == "failed":
+                    sys.exit(f"transcode failed: {v.get('error')}")
+                return
+            time.sleep(poll_s)
+
+
+def cmd_list(args) -> None:
+    with _client(ADMIN_URL) as c:
+        params = {"limit": args.limit}
+        if args.status:
+            params["status"] = args.status
+        data = _ok(c.get("/api/videos", params=params))
+    print(f"{'id':>5} {'status':<10} {'dur':>7} {'res':>10} slug")
+    for v in data["videos"]:
+        res = f"{v['width'] or '?'}x{v['height'] or '?'}"
+        print(f"{v['id']:>5} {v['status']:<10} "
+              f"{_fmt_duration(v['duration_s']):>7} {res:>10} {v['slug']}")
+    print(f"({len(data['videos'])}/{data['total']})")
+
+
+def cmd_status(args) -> None:
+    with _client(ADMIN_URL) as c:
+        data = _ok(c.get(f"/api/videos/{args.video_id}"))
+    v = data["video"]
+    print(f"video {v['id']} [{v['status']}] {v['title']!r} slug={v['slug']}")
+    print(f"  {v['width']}x{v['height']} @{v['fps']}fps "
+          f"{_fmt_duration(v['duration_s'])} err={v.get('error')}")
+    for q in data["qualities"]:
+        print(f"  rung {q['name']:>6}: {q['width']}x{q['height']} "
+              f"{(q['video_bitrate'] or 0) // 1000}kbps")
+    for j in data["jobs"]:
+        print(f"  job {j['kind']:<13} [{j['state']}] "
+              f"{j['progress']:.1f}% attempt={j['attempt']} "
+              f"step={j['current_step'] or '-'}")
+    tr = data.get("transcription")
+    if tr:
+        print(f"  transcript [{tr['status']}] lang={tr['language']}")
+    if args.watch and v["status"] not in ("ready", "failed"):
+        _wait_ready(v["id"])
+
+
+def cmd_delete(args) -> None:
+    with _client(ADMIN_URL) as c:
+        _ok(c.delete(f"/api/videos/{args.video_id}"))
+    print("deleted (soft; restore with `vlog-tpu restore`)")
+
+
+def cmd_restore(args) -> None:
+    with _client(ADMIN_URL) as c:
+        _ok(c.post(f"/api/videos/{args.video_id}/restore"))
+    print("restored")
+
+
+def cmd_retranscode(args) -> None:
+    with _client(ADMIN_URL) as c:
+        data = _ok(c.post(f"/api/videos/{args.video_id}/retranscode",
+                          json={"force": args.force}))
+    print(f"enqueued job {data['job_id']}")
+
+
+def cmd_workers(args) -> None:
+    with _client(ADMIN_URL) as c:
+        data = _ok(c.get("/api/workers"))
+    for w in data["workers"]:
+        mark = "ONLINE " if w["online"] else "offline"
+        print(f"{mark} {w['name']:<24} {w['accelerator']:<6} "
+              f"v{w['code_version'] or '?'} {w['status']}")
+    if not data["workers"]:
+        print("(no workers registered)")
+
+
+def cmd_worker_revoke(args) -> None:
+    with _client(ADMIN_URL) as c:
+        data = _ok(c.post(f"/api/workers/{args.name}/revoke"))
+    print(f"revoked {data['keys_revoked']} key(s)")
+
+
+def cmd_settings(args) -> None:
+    with _client(ADMIN_URL) as c:
+        if args.action == "list":
+            data = _ok(c.get("/api/settings"))
+            for k, v in sorted(data["settings"].items()):
+                print(f"{k} = {v!r}")
+        elif args.action == "set":
+            value: object = args.value
+            try:
+                value = json.loads(args.value)
+            except (json.JSONDecodeError, TypeError):
+                pass       # keep as string
+            _ok(c.put(f"/api/settings/{args.key}", json={"value": value}))
+            print("ok")
+        elif args.action == "unset":
+            _ok(c.delete(f"/api/settings/{args.key}"))
+            print("ok")
+
+
+def cmd_webhooks(args) -> None:
+    with _client(ADMIN_URL) as c:
+        if args.action == "list":
+            data = _ok(c.get("/api/webhooks"))
+            for w in data["webhooks"]:
+                state = "on" if w["active"] else "off"
+                print(f"{w['id']:>4} [{state}] {w['url']} "
+                      f"events={','.join(w['events']) or '*'}")
+        elif args.action == "add":
+            data = _ok(c.post("/api/webhooks", json={
+                "url": args.url, "secret": args.secret,
+                "events": args.events.split(",") if args.events else []}))
+            print(f"webhook {data['id']}")
+        elif args.action == "rm":
+            _ok(c.delete(f"/api/webhooks/{args.webhook_id}"))
+            print("ok")
+
+
+def cmd_serve(args) -> None:
+    if args.service == "worker-api":
+        from vlog_tpu.api.worker_api import main as m
+    elif args.service == "admin":
+        from vlog_tpu.api.admin_api import main as m
+    elif args.service == "public":
+        from vlog_tpu.api.public_api import main as m
+    m()
+
+
+def cmd_worker(args) -> None:
+    if args.flavor == "local":
+        from vlog_tpu.worker.daemon import main as m
+    else:
+        from vlog_tpu.worker.remote import main as m
+    m(args.rest)
+
+
+# --------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="vlog-tpu",
+        description="TPU-native video platform console client")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    u = sub.add_parser("upload", help="upload a video and enqueue transcode")
+    u.add_argument("file")
+    u.add_argument("--title")
+    u.add_argument("--description")
+    u.add_argument("--category")
+    u.add_argument("--wait", action="store_true",
+                   help="poll until ready/failed")
+    u.set_defaults(fn=cmd_upload)
+
+    li = sub.add_parser("list", help="list videos")
+    li.add_argument("--status")
+    li.add_argument("--limit", type=int, default=50)
+    li.set_defaults(fn=cmd_list)
+
+    st = sub.add_parser("status", help="video detail + job progress")
+    st.add_argument("video_id", type=int)
+    st.add_argument("--watch", action="store_true")
+    st.set_defaults(fn=cmd_status)
+
+    d = sub.add_parser("delete", help="soft-delete a video")
+    d.add_argument("video_id", type=int)
+    d.set_defaults(fn=cmd_delete)
+
+    re = sub.add_parser("restore", help="restore a soft-deleted video")
+    re.add_argument("video_id", type=int)
+    re.set_defaults(fn=cmd_restore)
+
+    rt = sub.add_parser("retranscode", help="re-enqueue the transcode job")
+    rt.add_argument("video_id", type=int)
+    rt.add_argument("--force", action="store_true")
+    rt.set_defaults(fn=cmd_retranscode)
+
+    w = sub.add_parser("workers", help="list the worker fleet")
+    w.set_defaults(fn=cmd_workers)
+
+    wr = sub.add_parser("worker-revoke", help="revoke a worker's API keys")
+    wr.add_argument("name")
+    wr.set_defaults(fn=cmd_worker_revoke)
+
+    se = sub.add_parser("settings", help="inspect/update settings")
+    se.add_argument("action", choices=["list", "set", "unset"])
+    se.add_argument("key", nargs="?")
+    se.add_argument("value", nargs="?")
+    se.set_defaults(fn=cmd_settings)
+
+    wh = sub.add_parser("webhooks", help="manage webhooks")
+    wh.add_argument("action", choices=["list", "add", "rm"])
+    wh.add_argument("url", nargs="?")
+    wh.add_argument("--secret")
+    wh.add_argument("--events", help="comma-separated event filter")
+    wh.add_argument("--webhook-id", type=int)
+    wh.set_defaults(fn=cmd_webhooks)
+
+    sv = sub.add_parser("serve", help="run one of the API services")
+    sv.add_argument("service", choices=["worker-api", "admin", "public"])
+    sv.set_defaults(fn=cmd_serve)
+
+    wk = sub.add_parser("worker", help="run a worker daemon")
+    wk.add_argument("flavor", choices=["local", "remote"])
+    wk.add_argument("rest", nargs=argparse.REMAINDER,
+                    help="flags passed through to the worker")
+    wk.set_defaults(fn=cmd_worker)
+    return p
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "settings" and args.action in ("set", "unset") \
+            and not args.key:
+        sys.exit("settings set/unset requires a key")
+    if args.cmd == "settings" and args.action == "set" and args.value is None:
+        sys.exit("settings set requires a value")
+    if args.cmd == "webhooks" and args.action == "add" and not args.url:
+        sys.exit("webhooks add requires a url")
+    if args.cmd == "webhooks" and args.action == "rm" \
+            and args.webhook_id is None:
+        sys.exit("webhooks rm requires --webhook-id")
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

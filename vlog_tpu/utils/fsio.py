@@ -1,0 +1,25 @@
+"""Atomic file publication.
+
+Everything under a video's output tree must appear atomically: the
+streaming uploader (worker/remote.py) and the resume scanner
+(backends/jax_backend.py) both treat *existence* as *stability*, the same
+contract the reference's segment watcher relies on
+(segment_watcher.py:23-26 size-stability polling). tmp+rename within one
+directory is atomic on POSIX.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def atomic_write_bytes(path: str | Path, data: bytes) -> None:
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, path)
+
+
+def atomic_write_text(path: str | Path, text: str) -> None:
+    atomic_write_bytes(path, text.encode())

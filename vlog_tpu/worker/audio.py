@@ -20,6 +20,7 @@ import numpy as np
 from vlog_tpu.codecs.aac import AacEncoder
 from vlog_tpu.media import hls
 from vlog_tpu.media.audio import AudioData, resample, to_stereo
+from vlog_tpu.utils.fsio import atomic_write_bytes, atomic_write_text
 from vlog_tpu.media.fmp4 import (
     Sample,
     TrackConfig,
@@ -81,7 +82,7 @@ def encode_audio_renditions(
             sample_entry=mp4a_sample_entry(
                 2, sr, enc.config.audio_specific_config(), avg_bitrate=bps),
         )
-        (rdir / "init.mp4").write_bytes(init_segment(track))
+        atomic_write_bytes(rdir / "init.mp4", init_segment(track))
         # Drop the priming frame: the timeline then starts at t=0 with a
         # ~21ms windowed fade-in instead of a 1024-sample lead.
         payloads = enc.encode_frames(audio.pcm)[1:]
@@ -102,7 +103,7 @@ def encode_audio_renditions(
                 uri=path.name, duration_s=dur / sr))
             base_time += dur
             idx += 1
-        playlist.write_text(hls.media_playlist(
+        atomic_write_text(playlist, hls.media_playlist(
             seg_refs, target_duration_s=segment_duration_s,
             init_uri="init.mp4"))
         renditions.append(ref)

@@ -353,9 +353,7 @@ class WorkerDaemon:
         result = await self._run_with_timeout(work, timeout, "transcode")
 
         qualities = [
-            {**q, "playlist_path": str(out_dir / q["quality"] / "playlist.m3u8"),
-             "audio_bitrate": next((r.audio_bitrate for r in rungs
-                                    if r.name == q["quality"]), None)}
+            {**q, "playlist_path": str(out_dir / q["quality"] / "playlist.m3u8")}
             for q in result.qualities
         ]
         from vlog_tpu.jobs.finalize import finalize_transcode

@@ -26,6 +26,7 @@ from vlog_tpu.backends import Backend, RunResult, select_backend
 from vlog_tpu.backends.base import ProgressFn
 from vlog_tpu.media import hls
 from vlog_tpu.media.probe import VideoInfo, get_video_info
+from vlog_tpu.utils.fsio import atomic_write_text
 
 
 class VerificationError(RuntimeError):
@@ -44,6 +45,9 @@ class ProcessResult:
     qualities: list[dict] = field(default_factory=list)
     audio_renditions: list[dict] = field(default_factory=list)
 
+    # filled by process_video from the plan: rung name -> paired AAC rate
+    audio_bitrates: dict[str, int] = field(default_factory=dict)
+
     def to_db_rows(self) -> list[dict]:
         """Rows for the video_qualities table (reference database.py)."""
         return [
@@ -53,6 +57,7 @@ class ProcessResult:
                 "height": r.height,
                 "codec_string": r.codec_string,
                 "bitrate": r.achieved_bitrate,
+                "audio_bitrate": self.audio_bitrates.get(r.name),
                 "segment_count": r.segment_count,
                 "bytes": r.bytes_written,
                 "mean_psnr_y": (None if r.mean_psnr_y is None
@@ -117,9 +122,9 @@ def process_video(
                 src_audio, out_dir, bitrates,
                 segment_duration_s=plan.segment_duration_s, resume=resume)
             if audio_refs and run.variants:
-                (out_dir / "master.m3u8").write_text(
+                atomic_write_text(out_dir / "master.m3u8",
                     hls.master_playlist(run.variants, audio=audio_refs))
-                (out_dir / "manifest.mpd").write_text(hls.dash_manifest(
+                atomic_write_text(out_dir / "manifest.mpd", hls.dash_manifest(
                     run.variants, duration_s=run.duration_s,
                     segment_duration_s=run.segment_duration_s,
                     audio=audio_refs))
@@ -146,6 +151,8 @@ def process_video(
              "codecs": a.codecs, "uri": a.uri}
             for a in audio_refs
         ],
+        audio_bitrates={r.name: r.audio_bitrate for r in plan.rungs
+                        if r.audio_bitrate},
     )
     result.qualities = result.to_db_rows()
     return result
