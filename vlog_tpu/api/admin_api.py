@@ -314,10 +314,14 @@ async def list_webhooks(request: web.Request) -> web.Response:
 
 
 async def create_webhook(request: web.Request) -> web.Response:
+    from vlog_tpu.jobs.webhooks import url_allowed
+
     body = await request.json()
     url = (body.get("url") or "").strip()
-    if not url.startswith(("http://", "https://")):
-        return _json_error(400, "url must be http(s)")
+    if not url_allowed(url):
+        return _json_error(
+            400, "url must be http(s) without credentials, and not target "
+                 "a private network (VLOG_WEBHOOK_ALLOW_PRIVATE overrides)")
     wid = await request.app[DB].execute(
         """
         INSERT INTO webhooks (url, secret, events, active, created_at)
@@ -412,9 +416,18 @@ async def serve(port: int | None = None, db_url: str | None = None,
     site = web.TCPSite(runner, host, port or config.ADMIN_PORT)
     await site.start()
     log.info("admin API listening on %s:%d", host, port or config.ADMIN_PORT)
+    # The admin process hosts the webhook delivery worker (reference
+    # webhook_service.py:809: background task in the API process).
+    from vlog_tpu.jobs.webhooks import WebhookDeliverer
+
+    deliverer = WebhookDeliverer(db)
+    delivery_task = asyncio.create_task(deliverer.run())
     try:
         await asyncio.Event().wait()
     finally:
+        deliverer.request_stop()
+        delivery_task.cancel()
+        await asyncio.gather(delivery_task, return_exceptions=True)
         await runner.cleanup()
         await db.disconnect()
 

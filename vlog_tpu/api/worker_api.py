@@ -478,7 +478,10 @@ async def serve(port: int | None = None, db_url: str | None = None,
     db = Database(db_url or config.DATABASE_URL)
     await db.connect()
     await create_all(db)
+    from vlog_tpu.jobs.webhooks import make_event_hook
+
     app = build_worker_app(db)
+    app[EVENTS] = make_event_hook(db)
     if host is None:
         host = "0.0.0.0" if config.ADMIN_SECRET else "127.0.0.1"
     if not config.ADMIN_SECRET and host not in ("127.0.0.1", "::1",

@@ -204,6 +204,9 @@ WORKER_API_PORT: int = _env_int("VLOG_WORKER_API_PORT", 9002, lo=1, hi=65535)
 WORKER_API_URL: str = _env_str("VLOG_WORKER_API_URL", f"http://127.0.0.1:{WORKER_API_PORT}")
 ADMIN_SECRET: str = _env_str("VLOG_ADMIN_SECRET", "")
 DOWNLOADS_ENABLED: bool = _env_bool("VLOG_DOWNLOADS_ENABLED", False)
+# SSRF guard: webhook targets on private/loopback networks are refused
+# unless explicitly allowed (reference webhook_service.py:143).
+WEBHOOK_ALLOW_PRIVATE: bool = _env_bool("VLOG_WEBHOOK_ALLOW_PRIVATE", False)
 
 # --------------------------------------------------------------------------
 # TPU backend

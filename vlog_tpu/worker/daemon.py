@@ -459,12 +459,15 @@ async def _amain(args: argparse.Namespace) -> None:
         from vlog_tpu.backends import select_backend
         backend = select_backend(args.backend or None)
 
+    from vlog_tpu.jobs.webhooks import make_event_hook
+
     daemon = WorkerDaemon(
         db, name=args.name,
         accelerator=AcceleratorKind(args.accelerator),
         kinds=tuple(JobKind(k) for k in args.kinds.split(",")),
         backend=backend,
         transcription_model_dir=args.whisper_dir,
+        on_event=make_event_hook(db),
     )
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
