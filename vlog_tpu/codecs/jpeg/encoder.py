@@ -113,6 +113,71 @@ _AC_LUMA = _build_huffman(AC_LUMA_BITS, AC_LUMA_VALS)
 _AC_CHROMA = _build_huffman(AC_CHROMA_BITS, AC_CHROMA_VALS)
 
 
+def _table_arrays(tbl: dict[int, tuple[int, int]]
+                  ) -> tuple[np.ndarray, np.ndarray]:
+    """Dict table -> (codes uint16[256], lens uint8[256]) for the C packer."""
+    codes = np.zeros(256, np.uint16)
+    lens = np.zeros(256, np.uint8)
+    for sym, (code, length) in tbl.items():
+        codes[sym] = code
+        lens[sym] = length
+    return codes, lens
+
+
+_C_TABLES = None      # lazy: ((dc_l codes, lens), (ac_l ...), (dc_c), (ac_c))
+
+
+def _pack_scan_native(blocks: np.ndarray, comp: np.ndarray) -> bytes | None:
+    """Entropy-code the interleaved scan in C; None -> use the Python path."""
+    from vlog_tpu.native.build import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    global _C_TABLES
+    if _C_TABLES is None:
+        _C_TABLES = tuple(_table_arrays(t) for t in
+                          (_DC_LUMA, _AC_LUMA, _DC_CHROMA, _AC_CHROMA))
+    import ctypes
+
+    blocks = np.ascontiguousarray(blocks, np.int32)
+    comp = np.ascontiguousarray(comp, np.uint8)
+    i8 = ctypes.POINTER(ctypes.c_uint8)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    u16 = ctypes.POINTER(ctypes.c_uint16)
+    cap = blocks.shape[0] * 128 + 64
+    # theoretical worst case is ~2x this (all-escape coefficients + byte
+    # stuffing); retry with a doubled buffer rather than falling back to
+    # the ~1000x-slower Python loop
+    for _ in range(3):
+        out = np.empty(cap, np.uint8)
+        args = [blocks.ctypes.data_as(i32), comp.ctypes.data_as(i8),
+                ctypes.c_int64(blocks.shape[0])]
+        for codes, lens in _C_TABLES:
+            args.append(codes.ctypes.data_as(u16))
+            args.append(lens.ctypes.data_as(i8))
+        args += [out.ctypes.data_as(i8), ctypes.c_int64(cap)]
+        n = lib.vt_jpeg_pack_scan(*args)
+        if n >= 0:
+            return out[:n].tobytes()
+        cap *= 2
+    return None
+
+
+def _pack_scan_python(blocks: np.ndarray, comp: np.ndarray) -> bytes:
+    """Pure-Python scan packer — the C packer's bit-exact oracle/fallback."""
+    pk = _BitPacker()
+    pred = [0, 0, 0]
+    for bi in range(blocks.shape[0]):
+        c = int(comp[bi])
+        pred[c] = _encode_block(
+            pk, blocks[bi], pred[c],
+            _DC_LUMA if c == 0 else _DC_CHROMA,
+            _AC_LUMA if c == 0 else _AC_CHROMA)
+    pk.flush()
+    return bytes(pk.out)
+
+
 def scaled_quant_tables(quality: int) -> tuple[np.ndarray, np.ndarray]:
     """libjpeg-compatible quality (1..100) scaling of the Annex-K tables."""
     quality = min(max(int(quality), 1), 100)
@@ -305,21 +370,28 @@ def encode_jpeg_yuv420(y: np.ndarray, u: np.ndarray, v: np.ndarray,
     ybw = y.shape[1] // 8                      # luma blocks per row
     cbw = u.shape[1] // 8
 
-    pk = _BitPacker()
-    pred = [0, 0, 0]
-    for my in range(mcu_h):
-        for mx in range(mcu_w):
-            for dy in range(2):
-                for dx in range(2):
-                    bi = (my * 2 + dy) * ybw + mx * 2 + dx
-                    pred[0] = _encode_block(pk, yq[bi], pred[0], _DC_LUMA, _AC_LUMA)
-            ci = my * cbw + mx
-            pred[1] = _encode_block(pk, uq[ci], pred[1], _DC_CHROMA, _AC_CHROMA)
-            pred[2] = _encode_block(pk, vq[ci], pred[2], _DC_CHROMA, _AC_CHROMA)
-    pk.flush()
+    # Interleave blocks in MCU scan order (Y00 Y01 Y10 Y11 Cb Cr) with a
+    # component id per block; the hot entropy loop then runs in C
+    # (native/jpeg_pack.c), with the Python packer as bit-exact fallback.
+    n_mcu = mcu_h * mcu_w
+    my, mx = np.mgrid[0:mcu_h, 0:mcu_w]
+    dy, dx = np.mgrid[0:2, 0:2]
+    yidx = ((my[..., None, None] * 2 + dy) * ybw
+            + mx[..., None, None] * 2 + dx).reshape(n_mcu, 4)
+    cidx = (my * cbw + mx).reshape(n_mcu)
+    blocks = np.empty((n_mcu, 6, 64), np.int32)
+    blocks[:, :4] = yq[yidx]
+    blocks[:, 4] = uq[cidx]
+    blocks[:, 5] = vq[cidx]
+    blocks = blocks.reshape(n_mcu * 6, 64)
+    comp = np.tile(np.array([0, 0, 0, 0, 1, 2], np.uint8), n_mcu)
+
+    scan = _pack_scan_native(blocks, comp)
+    if scan is None:
+        scan = _pack_scan_python(blocks, comp)
 
     return (b"\xff\xd8" + _APP0 + _dqt(qy, qc) + _sof0(w, h) + _dht() + _sos()
-            + bytes(pk.out) + b"\xff\xd9")
+            + scan + b"\xff\xd9")
 
 
 def encode_jpeg_rgb(rgb: np.ndarray, *, quality: int = 85) -> bytes:

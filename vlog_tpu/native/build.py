@@ -31,10 +31,11 @@ class NativeBuildError(RuntimeError):
 def _compile() -> Path:
     _BUILD.mkdir(exist_ok=True)
     src = _DIR / "cavlc.c"
+    jpeg_src = _DIR / "jpeg_pack.c"
     so = _BUILD / "libvtnative.so"
     from vlog_tpu.codecs.h264 import cavlc_tables
 
-    stamp_inputs = [src, _DIR / "gen_tables.py",
+    stamp_inputs = [src, jpeg_src, _DIR / "gen_tables.py",
                     Path(cavlc_tables.__file__)]   # real input of gen_tables
     if so.exists() and all(so.stat().st_mtime >= p.stat().st_mtime
                            for p in stamp_inputs):
@@ -50,7 +51,7 @@ def _compile() -> Path:
     tmp_so = _BUILD / f"libvtnative.{pid}.so.tmp"
     cc = os.environ.get("CC", "g++")
     cmd = [cc, "-O3", "-fPIC", "-shared", "-x", "c++",
-           f"-DVT_TABLES_INC=\"{inc.name}\"", str(src),
+           f"-DVT_TABLES_INC=\"{inc.name}\"", str(src), str(jpeg_src),
            "-I", str(_BUILD), "-o", str(tmp_so)]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
@@ -88,5 +89,12 @@ def get_lib() -> ctypes.CDLL | None:
         ]
         lib.vt_escape_emulation.restype = ctypes.c_int64
         lib.vt_escape_emulation.argtypes = [i8, ctypes.c_int64, i8]
+        u16 = ctypes.POINTER(ctypes.c_uint16)
+        lib.vt_jpeg_pack_scan.restype = ctypes.c_int64
+        lib.vt_jpeg_pack_scan.argtypes = [
+            i32, i8, ctypes.c_int64,                 # blocks, comp, n
+            u16, i8, u16, i8, u16, i8, u16, i8,      # 4 Huffman tables
+            i8, ctypes.c_int64,                      # out buffer
+        ]
         _LIB = lib
         return _LIB
