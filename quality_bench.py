@@ -1,0 +1,250 @@
+"""Quality parity bench: PSNR-at-target-bitrate vs the libx264 anchor.
+
+VERDICT round-2 weak #2: "all-intra + VBR hits bitrate targets by
+sacrificing quality, silently … make the all-intra gap a number." This
+harness does exactly that: for each ladder rung it encodes the same
+synthetic-but-temporally-redundant content with (a) the first-party
+encoder through the production backend (closed-loop VBR at the rung's
+ladder bitrate) and (b) libavcodec's libx264 at the same average bitrate
+(the reference's CPU worker path, worker/hwaccel.py `-c:v libx264 -b:v`),
+decodes both with the system libavcodec oracle, and reports PSNR-Y and
+achieved bitrate side by side.
+
+Usage: JAX_PLATFORMS=cpu python quality_bench.py [--frames N] [--rungs 360p,720p]
+Writes QUALITY.md and prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).parent
+FIXTURES = REPO / "tests" / "fixtures"
+
+
+def build_tool(name: str, tmp: Path) -> Path:
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        sys.exit("no C compiler")
+    exe = tmp / name
+    proc = subprocess.run(
+        [cc, "-O2", "-o", str(exe), str(FIXTURES / f"{name}.c"),
+         "-lavcodec", "-lavutil"], capture_output=True, text=True)
+    if proc.returncode != 0:
+        sys.exit(f"{name} build failed: {proc.stderr[:400]}")
+    return exe
+
+
+def moving_scene(n: int, h: int, w: int, *, seed: int = 0) -> np.ndarray:
+    """I420 frames with real temporal structure: a panning textured
+    background + moving objects + light sensor noise. Temporal redundancy
+    is what separates inter from intra coding — pure noise would hide the
+    gap, a static card would exaggerate it."""
+    rng = np.random.default_rng(seed)
+    # big textured world to pan across
+    wh, ww = h + 256, w + 256
+    yy, xx = np.mgrid[0:wh, 0:ww]
+    world = (96 + 60 * np.sin(xx / 17.0) * np.cos(yy / 23.0)
+             + 40 * ((xx // 32 + yy // 32) % 2)
+             + rng.normal(0, 3.0, (wh, ww))).astype(np.float32)
+    frames = np.empty((n, h * w * 3 // 2), np.uint8)
+    for t in range(n):
+        ox = int(2.1 * t) % 256
+        oy = int(1.3 * t) % 256
+        y = world[oy:oy + h, ox:ox + w].copy()
+        # two moving objects
+        bx = int((w - 80) * (0.5 + 0.4 * np.sin(t / 14.0)))
+        by = int((h - 80) * (0.5 + 0.4 * np.cos(t / 19.0)))
+        y[by:by + 64, bx:bx + 64] = 210.0
+        bx2 = int((w - 48) * (0.5 + 0.45 * np.cos(t / 9.0)))
+        y[h // 4:h // 4 + 32, bx2:bx2 + 32] = 40.0
+        y += rng.normal(0, 1.5, y.shape)
+        yq = np.clip(y, 0, 255).astype(np.uint8)
+        u = np.full((h // 2, w // 2), 118, np.uint8)
+        v = np.full((h // 2, w // 2), 138, np.uint8)
+        u[by // 2:(by + 64) // 2, bx // 2:(bx + 64) // 2] = 90
+        v[by // 2:(by + 64) // 2, bx // 2:(bx + 64) // 2] = 160
+        frames[t] = np.concatenate([yq.ravel(), u.ravel(), v.ravel()])
+    return frames
+
+
+def psnr_y(ref: np.ndarray, dec: np.ndarray, h: int, w: int) -> float:
+    n = min(ref.shape[0], dec.shape[0])
+    ys = ref[:n, :h * w].astype(np.float64)
+    yd = dec[:n, :h * w].astype(np.float64)
+    mse = np.mean((ys - yd) ** 2)
+    return 10 * np.log10(255.0 ** 2 / max(mse, 1e-9))
+
+
+def decode_annexb(avdec: Path, annexb: Path, h: int, w: int,
+                  tmp: Path) -> np.ndarray:
+    out = tmp / "dec.yuv"
+    subprocess.run([str(avdec), str(annexb), str(out)], check=True,
+                   capture_output=True)
+    data = np.fromfile(out, np.uint8)
+    fs = h * w * 3 // 2
+    return data[: len(data) // fs * fs].reshape(-1, fs)
+
+
+def run_ours(frames: np.ndarray, h: int, w: int, fps: int, rung,
+             tmp: Path, avdec: Path) -> dict:
+    """Encode through the production backend; decode with the oracle."""
+    from vlog_tpu.media.y4m import write_y4m
+    from vlog_tpu.worker.pipeline import process_video
+
+    fs = h * w
+    y4m = tmp / "src.y4m"
+    write_y4m(y4m, [
+        (f[:fs].reshape(h, w),
+         f[fs:fs + fs // 4].reshape(h // 2, w // 2),
+         f[fs + fs // 4:].reshape(h // 2, w // 2))
+        for f in frames
+    ], fps_num=fps, fps_den=1)
+    out = tmp / "ours"
+    t0 = time.perf_counter()
+    result = process_video(y4m, out, audio=False, thumbnail=False,
+                           rungs=(rung,))
+    wall = time.perf_counter() - t0
+    rr = result.run.rungs[0]
+    # concatenate samples from segments into annex-b for the oracle
+    from vlog_tpu.media.boxes import parse_box_tree
+
+    annexb = bytearray()
+    rdir = out / rung.name
+    from vlog_tpu.codecs.h264.syntax import annexb as to_annexb  # noqa: F401
+
+    # init: SPS/PPS from avcC
+    init = (rdir / "init.mp4").read_bytes()
+    idx = init.find(b"avcC")
+    size = int.from_bytes(init[idx - 4:idx], "big")
+    avcc = init[idx + 4: idx - 4 + size]
+    # parse avcC: sps/pps
+    nsps = avcc[5] & 0x1F
+    off = 6
+    for _ in range(nsps):
+        ln = int.from_bytes(avcc[off:off + 2], "big")
+        annexb += b"\x00\x00\x00\x01" + avcc[off + 2:off + 2 + ln]
+        off += 2 + ln
+    npps = avcc[off]
+    off += 1
+    for _ in range(npps):
+        ln = int.from_bytes(avcc[off:off + 2], "big")
+        annexb += b"\x00\x00\x00\x01" + avcc[off + 2:off + 2 + ln]
+        off += 2 + ln
+    for seg in sorted(rdir.glob("segment_*.m4s")):
+        data = seg.read_bytes()
+        with open(seg, "rb") as fp:
+            tree = parse_box_tree(fp)
+        mdat = next(b for b in tree if b.type == "mdat")
+        payload = data[mdat.offset + 8: mdat.offset + mdat.size]
+        off = 0
+        while off < len(payload):
+            ln = int.from_bytes(payload[off:off + 4], "big")
+            annexb += b"\x00\x00\x00\x01" + payload[off + 4:off + 4 + ln]
+            off += 4 + ln
+    bpath = tmp / "ours.h264"
+    bpath.write_bytes(bytes(annexb))
+    dec = decode_annexb(avdec, bpath, h, w, tmp)
+    return {
+        "encoder": "vlog-tpu (all-intra)" if True else "",
+        "bitrate_kbps": rr.achieved_bitrate // 1000,
+        "psnr_y": round(psnr_y(frames, dec, h, w), 2),
+        "wall_s": round(wall, 1),
+    }
+
+
+def run_x264(frames: np.ndarray, h: int, w: int, fps: int, bps: int,
+             tmp: Path, x264: Path, avdec: Path, preset: str = "medium"
+             ) -> dict:
+    raw = tmp / "src.yuv"
+    frames.tofile(raw)
+    out = tmp / "x264.h264"
+    t0 = time.perf_counter()
+    subprocess.run([str(x264), str(raw), str(w), str(h), str(fps),
+                    str(bps), preset, str(out)], check=True,
+                   capture_output=True)
+    wall = time.perf_counter() - t0
+    dec = decode_annexb(avdec, out, h, w, tmp)
+    dur = frames.shape[0] / fps
+    return {
+        "encoder": f"libx264 -preset {preset}",
+        "bitrate_kbps": int(out.stat().st_size * 8 / dur) // 1000,
+        "psnr_y": round(psnr_y(frames, dec, h, w), 2),
+        "wall_s": round(wall, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frames", type=int, default=96)
+    ap.add_argument("--fps", type=int, default=24)
+    ap.add_argument("--rungs", default="360p,480p,720p")
+    args = ap.parse_args()
+
+    from vlog_tpu import config
+
+    tmp = Path(tempfile.mkdtemp(prefix="vlog-quality-"))
+    avdec = build_tool("avdec", tmp)
+    x264 = build_tool("x264enc", tmp)
+
+    rows = []
+    for name in args.rungs.split(","):
+        rung = config.LADDER_BY_NAME[name.strip()]
+        geom = {"360p": (360, 640), "480p": (480, 854), "720p": (720, 1280),
+                "1080p": (1080, 1920), "1440p": (1440, 2560),
+                "2160p": (2160, 3840)}[rung.name]
+        h, w = geom[0], geom[1] - geom[1] % 16
+        frames = moving_scene(args.frames, h, w)
+        rtmp = tmp / rung.name
+        rtmp.mkdir()
+        ours = run_ours(frames, h, w, args.fps, rung, rtmp, avdec)
+        anchor = run_x264(frames, h, w, args.fps, rung.video_bitrate,
+                          rtmp, x264, avdec)
+        rows.append({"rung": rung.name,
+                     "target_kbps": rung.video_bitrate // 1000,
+                     "ours": ours, "x264": anchor,
+                     "psnr_gap_db": round(anchor["psnr_y"] - ours["psnr_y"],
+                                          2)})
+        print(f"{rung.name}: ours {ours['psnr_y']} dB @ "
+              f"{ours['bitrate_kbps']} kbps | x264 {anchor['psnr_y']} dB @ "
+              f"{anchor['bitrate_kbps']} kbps", file=sys.stderr)
+
+    lines = [
+        "# Quality parity: PSNR at the ladder bitrate vs libx264",
+        "",
+        f"Content: synthetic panning scene with moving objects "
+        f"({args.frames} frames @ {args.fps} fps). Decoded by the system "
+        "libavcodec oracle; PSNR-Y vs the pristine source.",
+        "",
+        "| rung | target | ours kbps | ours PSNR-Y | x264 kbps | "
+        "x264 PSNR-Y | gap (dB) |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['rung']} | {r['target_kbps']}k "
+            f"| {r['ours']['bitrate_kbps']} | {r['ours']['psnr_y']} "
+            f"| {r['x264']['bitrate_kbps']} | {r['x264']['psnr_y']} "
+            f"| {r['psnr_gap_db']} |")
+    lines += ["", f"Generated by quality_bench.py "
+              f"(frames={args.frames}, fps={args.fps})."]
+    (REPO / "QUALITY.md").write_text("\n".join(lines) + "\n")
+    print(json.dumps({"metric": "psnr_gap_vs_x264_db",
+                      "value": max(r["psnr_gap_db"] for r in rows),
+                      "unit": "dB_worst_rung",
+                      "rows": rows}))
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

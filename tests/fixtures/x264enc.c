@@ -1,0 +1,83 @@
+/* Test/bench-only H.264 anchor encoder against system libavcodec+libx264.
+ *
+ * Usage: x264enc <in.yuv (I420)> <w> <h> <fps> <bitrate_bps> <preset> <out.h264>
+ *
+ * Produces the libx264 bitstream the reference's GPU/CPU workers would
+ * emit (worker/hwaccel.py builds `-c:v libx264 -b:v <ladder>` command
+ * lines), so the quality bench can put a number on our encoder's
+ * PSNR-at-bitrate against the industry anchor. NOT part of the product —
+ * the production encoder is first-party (vlog_tpu/codecs/h264).
+ */
+#include <libavcodec/avcodec.h>
+#include <libavutil/opt.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static void die(const char *msg) { fprintf(stderr, "%s\n", msg); exit(1); }
+
+int main(int argc, char **argv) {
+    if (argc != 8)
+        die("usage: x264enc <in.yuv> <w> <h> <fps> <bps> <preset> <out.h264>");
+    int w = atoi(argv[2]), h = atoi(argv[3]), fps = atoi(argv[4]);
+    long bps = atol(argv[5]);
+    FILE *in = fopen(argv[1], "rb");
+    if (!in) die("cannot open input");
+    FILE *out = fopen(argv[7], "wb");
+    if (!out) die("cannot open output");
+
+    const AVCodec *codec = avcodec_find_encoder_by_name("libx264");
+    if (!codec) die("no libx264 encoder");
+    AVCodecContext *ctx = avcodec_alloc_context3(codec);
+    ctx->width = w;
+    ctx->height = h;
+    ctx->time_base = (AVRational){1, fps};
+    ctx->framerate = (AVRational){fps, 1};
+    ctx->pix_fmt = AV_PIX_FMT_YUV420P;
+    ctx->bit_rate = bps;
+    ctx->gop_size = fps * 6;              /* 6 s segments, reference parity */
+    ctx->max_b_frames = 2;
+    av_opt_set(ctx->priv_data, "preset", argv[6], 0);
+    if (avcodec_open2(ctx, codec, NULL) < 0) die("open failed");
+
+    AVFrame *frame = av_frame_alloc();
+    frame->format = ctx->pix_fmt;
+    frame->width = w;
+    frame->height = h;
+    if (av_frame_get_buffer(frame, 0) < 0) die("frame alloc");
+    AVPacket *pkt = av_packet_alloc();
+
+    size_t ysz = (size_t)w * h, csz = ysz / 4;
+    uint8_t *buf = (uint8_t *)malloc(ysz + 2 * csz);
+    int64_t pts = 0;
+    for (;;) {
+        size_t n = fread(buf, 1, ysz + 2 * csz, in);
+        int flushing = (n < ysz + 2 * csz);
+        if (!flushing) {
+            av_frame_make_writable(frame);
+            for (int y = 0; y < h; y++)
+                memcpy(frame->data[0] + (size_t)y * frame->linesize[0],
+                       buf + (size_t)y * w, w);
+            for (int p = 1; p <= 2; p++)
+                for (int y = 0; y < h / 2; y++)
+                    memcpy(frame->data[p] + (size_t)y * frame->linesize[p],
+                           buf + ysz + (p - 1) * csz + (size_t)y * (w / 2),
+                           w / 2);
+            frame->pts = pts++;
+        }
+        if (avcodec_send_frame(ctx, flushing ? NULL : frame) < 0)
+            die("send failed");
+        int ret;
+        while ((ret = avcodec_receive_packet(ctx, pkt)) == 0) {
+            fwrite(pkt->data, 1, pkt->size, out);
+            av_packet_unref(pkt);
+        }
+        if (flushing) {
+            if (ret == AVERROR_EOF) break;
+            if (ret != AVERROR(EAGAIN)) die("flush failed");
+        }
+    }
+    fclose(out);
+    fclose(in);
+    return 0;
+}
