@@ -263,6 +263,189 @@ def encode_slice(
     return syntax.NalUnit(nal_type, 3, w.getvalue())
 
 
+# --------------------------------------------------------------------------
+# P slices (P_L0_16x16 / P_Skip)
+# --------------------------------------------------------------------------
+
+# Table 9-4 column "Inter": codeNum -> coded_block_pattern.
+_CBP_INTER_FROM_CODE = [
+    0, 16, 1, 2, 4, 8, 32, 3, 5, 10, 12, 15, 47, 7, 11, 13,
+    14, 6, 9, 31, 35, 37, 42, 44, 33, 34, 36, 40, 39, 43, 45, 46,
+    17, 18, 20, 24, 19, 21, 26, 28, 23, 27, 29, 30, 22, 25, 38, 41,
+]
+_CBP_INTER_TO_CODE = {cbp: i for i, cbp in enumerate(_CBP_INTER_FROM_CODE)}
+
+# 4x4 luma block coding order as (i8x8, i4x4) -> (by, bx) within the MB.
+_BLK44 = [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+
+def _median3(a: int, b: int, c: int) -> int:
+    return sorted((a, b, c))[1]
+
+
+class PSliceEncoder:
+    """Encodes one P frame's device outputs into slice_data bits.
+
+    MB modes are P_Skip or P_L0_16x16 with one reference; MVs arrive in
+    integer pels from the DSP and are coded as quarter-pel MVDs against
+    the spec median predictor (8.4.1.3), with the P_Skip inferred-MV rule
+    (8.4.1.1) deciding skippability.
+    """
+
+    def __init__(self, mbh: int, mbw: int):
+        self.mbh = mbh
+        self.mbw = mbw
+        self.nz_luma = np.zeros((mbh * 4, mbw * 4), np.int32)
+        self.nz_chroma = np.zeros((2, mbh * 2, mbw * 2), np.int32)
+        # reconstructed MVs in QUARTER pels (what neighbours predict from)
+        self.mvs = np.zeros((mbh, mbw, 2), np.int32)
+
+    # -- MV prediction ----------------------------------------------------
+
+    def _neighbor(self, my: int, mx: int):
+        """(avail, mv) triplets for A (left), B (top), C (top-right with
+        D top-left fallback)."""
+        a_ok = mx > 0
+        b_ok = my > 0
+        c_ok = b_ok and mx < self.mbw - 1
+        d_ok = b_ok and mx > 0
+        a = self.mvs[my, mx - 1] if a_ok else np.zeros(2, np.int32)
+        b = self.mvs[my - 1, mx] if b_ok else np.zeros(2, np.int32)
+        if c_ok:
+            c_av, c = True, self.mvs[my - 1, mx + 1]
+        elif d_ok:
+            c_av, c = True, self.mvs[my - 1, mx - 1]
+        else:
+            c_av, c = False, np.zeros(2, np.int32)
+        return (a_ok, a), (b_ok, b), (c_av, c)
+
+    def mv_pred(self, my: int, mx: int) -> tuple[int, int]:
+        """Median predictor, 8.4.1.3.1 (single ref list, all-inter)."""
+        (a_ok, a), (b_ok, b), (c_ok, c) = self._neighbor(my, mx)
+        avail = [(a_ok, a), (b_ok, b), (c_ok, c)]
+        matches = [mv for ok, mv in avail if ok]
+        if len(matches) == 1:
+            return int(matches[0][0]), int(matches[0][1])
+        return (_median3(int(a[0]), int(b[0]), int(c[0])),
+                _median3(int(a[1]), int(b[1]), int(c[1])))
+
+    def skip_mv(self, my: int, mx: int) -> tuple[int, int]:
+        """P_Skip inferred MV, 8.4.1.1."""
+        (a_ok, a), (b_ok, b), _ = self._neighbor(my, mx)
+        if (not a_ok or not b_ok
+                or (a[0] == 0 and a[1] == 0)
+                or (b[0] == 0 and b[1] == 0)):
+            return 0, 0
+        return self.mv_pred(my, mx)
+
+    # -- MB layer ---------------------------------------------------------
+
+    def _mb_cbp(self, luma, chroma_dc, chroma_ac, my, mx) -> int:
+        bits = 0
+        for i8 in range(4):
+            gy, gx = _BLK44[i8]
+            blk8 = luma[my, mx, 2 * gy:2 * gy + 2, 2 * gx:2 * gx + 2]
+            if np.any(blk8):
+                bits |= 1 << i8
+        if np.any(chroma_ac[:, my, mx]):
+            chroma = 2
+        elif np.any(chroma_dc[:, my, mx]):
+            chroma = 1
+        else:
+            chroma = 0
+        return bits | (chroma << 4)
+
+    def encode_frame(self, w: BitWriter, plevels: dict) -> None:
+        """slice_data for one P frame (single slice)."""
+        luma = plevels["luma"]            # (mbh, mbw, 4, 4, 4, 4)
+        chroma_dc = plevels["chroma_dc"]  # (2, mbh, mbw, 2, 2)
+        chroma_ac = plevels["chroma_ac"]  # (2, mbh, mbw, 2, 2, 4, 4)
+        mv_int = plevels["mv"]            # (mbh, mbw, 2) integer (y, x)
+        skip_run = 0
+        for my in range(self.mbh):
+            for mx in range(self.mbw):
+                # DSP mv is (dy, dx) integer pels; bitstream order is
+                # (x, y) in quarter pels.
+                mvx, mvy = int(mv_int[my, mx, 1]) * 4, int(mv_int[my, mx, 0]) * 4
+                cbp = self._mb_cbp(luma, chroma_dc, chroma_ac, my, mx)
+                smx, smy = self.skip_mv(my, mx)
+                if cbp == 0 and (mvx, mvy) == (smx, smy):
+                    self.mvs[my, mx] = (smx, smy)
+                    skip_run += 1
+                    continue
+                w.write_ue(skip_run)               # mb_skip_run
+                skip_run = 0
+                pmx, pmy = self.mv_pred(my, mx)
+                self.mvs[my, mx] = (mvx, mvy)
+                w.write_ue(0)                      # mb_type: P_L0_16x16
+                w.write_se(mvx - pmx)              # mvd_l0 x
+                w.write_se(mvy - pmy)              # mvd_l0 y
+                w.write_ue(_CBP_INTER_TO_CODE[cbp])
+                if cbp:
+                    w.write_se(0)                  # mb_qp_delta
+                    self._residuals(w, luma, chroma_dc, chroma_ac,
+                                    my, mx, cbp)
+        if skip_run:
+            w.write_ue(skip_run)                   # trailing skips
+
+    def _residuals(self, w: BitWriter, luma, chroma_dc, chroma_ac,
+                   my, mx, cbp) -> None:
+        gy, gx = my * 4, mx * 4
+        for i8 in range(4):
+            oy, ox = _BLK44[i8]
+            for by, bx in ((2 * oy + dy, 2 * ox + dx)
+                           for dy, dx in _BLK44):
+                y, x = gy + by, gx + bx
+                if not (cbp >> i8) & 1:
+                    self.nz_luma[y, x] = 0
+                    continue
+                nc = _nc(x > 0, int(self.nz_luma[y, x - 1]),
+                         y > 0, int(self.nz_luma[y - 1, x]))
+                tc = encode_residual_block(
+                    w, zigzag(luma[my, mx, by, bx]), nc)
+                self.nz_luma[y, x] = tc
+        cbp_chroma = cbp >> 4
+        if cbp_chroma > 0:
+            for comp in range(2):
+                encode_residual_block(
+                    w, chroma_dc[comp, my, mx].reshape(-1), -1)
+        cy, cx = my * 2, mx * 2
+        for comp in range(2):
+            for by in range(2):
+                for bx in range(2):
+                    y, x = cy + by, cx + bx
+                    if cbp_chroma != 2:
+                        self.nz_chroma[comp, y, x] = 0
+                        continue
+                    nc = _nc(x > 0, int(self.nz_chroma[comp, y, x - 1]),
+                             y > 0, int(self.nz_chroma[comp, y - 1, x]))
+                    tc = encode_residual_block(
+                        w, zigzag(chroma_ac[comp, my, mx, by, bx])[1:], nc)
+                    self.nz_chroma[comp, y, x] = tc
+
+
+def encode_p_slice(
+    plevels: dict,
+    *,
+    qp: int,
+    init_qp: int,
+    frame_num: int,
+    log2_max_frame_num: int = 8,
+) -> syntax.NalUnit:
+    """Full P-slice NAL for one frame's inter levels (Python path)."""
+    mbh, mbw = plevels["luma"].shape[:2]
+    w = BitWriter()
+    syntax.write_slice_header(
+        w, first_mb=0, slice_qp=qp, init_qp=init_qp, idr=False,
+        frame_num=frame_num, log2_max_frame_num=log2_max_frame_num,
+        slice_type=syntax.SLICE_P,
+    )
+    enc = PSliceEncoder(mbh, mbw)
+    enc.encode_frame(w, plevels)
+    w.rbsp_trailing_bits()
+    return syntax.NalUnit(syntax.NAL_SLICE, 3, w.getvalue())
+
+
 def _encode_slice_native(levels, header: BitWriter) -> bytes | None:
     """C fast path: returns the complete RBSP, or None to fall back."""
     from vlog_tpu.native import get_lib

@@ -169,6 +169,10 @@ def make_pps(pps_id: int = 0, sps_id: int = 0, init_qp: int = 26) -> NalUnit:
     return NalUnit(NAL_PPS, 3, w.getvalue())
 
 
+SLICE_P = 0
+SLICE_I = 7   # 7 = I (and signals "all slices in picture are I")
+
+
 def write_slice_header(
     w: BitWriter,
     *,
@@ -179,20 +183,25 @@ def write_slice_header(
     frame_num: int,
     idr_pic_id: int = 0,
     log2_max_frame_num: int = 8,
-    slice_type: int = 7,  # 7 = I (all slices in picture are I)
+    slice_type: int = SLICE_I,
 ) -> None:
     """slice_header (spec 7.3.3) for our stream shape.
 
     pic_order_cnt_type=2 and frame_mbs_only keep this short. Deblocking is
     signalled off (idc=1) — the PPS sets
-    deblocking_filter_control_present_flag.
+    deblocking_filter_control_present_flag. P slices use the PPS default
+    single reference (no override, no list modification).
     """
+    is_p = slice_type in (0, 5)
     w.write_ue(first_mb)
     w.write_ue(slice_type)
     w.write_ue(0)                                  # pic_parameter_set_id
     w.write_bits(frame_num % (1 << log2_max_frame_num), log2_max_frame_num)
     if idr:
         w.write_ue(idr_pic_id)
+    if is_p:
+        w.write_bit(0)   # num_ref_idx_active_override_flag (1 ref, PPS)
+        w.write_bit(0)   # ref_pic_list_modification_flag_l0
     # dec_ref_pic_marking (nal_ref_idc != 0)
     if idr:
         w.write_bit(0)   # no_output_of_prior_pics_flag
