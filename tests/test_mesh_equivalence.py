@@ -25,8 +25,13 @@ _SINGLE_DEV_SCRIPT = """
 import sys
 import jax
 assert len(jax.devices()) == 1, jax.devices()
+from vlog_tpu import config
 from vlog_tpu.worker.pipeline import process_video
-process_video(sys.argv[1], sys.argv[2], audio=False, segment_duration_s=1.0)
+kw = {}
+if sys.argv[3] == "p":
+    kw["rungs"] = (config.QualityRung("360p", 360, 0, 0, base_qp=30),)
+process_video(sys.argv[1], sys.argv[2], audio=False, segment_duration_s=1.0,
+              gop_mode=sys.argv[3], **kw)
 """
 
 
@@ -37,19 +42,12 @@ def _tree_files(root: Path) -> dict[str, bytes]:
     }
 
 
-@pytest.mark.slow
-def test_backend_run_on_mesh_matches_single_device(tmp_path):
-    import jax
-
-    assert len(jax.devices()) == 8, "conftest must pin the 8-device mesh"
-    # 20 frames: full batches + a padded tail batch, 2 segments per rung
-    src = make_y4m(tmp_path / "src.y4m", n_frames=20, width=128, height=96,
-                   fps=10)
-
+def _compare_runs(tmp_path, src, gop_mode: str, mesh_kwargs: dict):
     from vlog_tpu.worker.pipeline import process_video
 
     mesh_out = tmp_path / "mesh8"
-    process_video(src, mesh_out, audio=False, segment_duration_s=1.0)
+    process_video(src, mesh_out, audio=False, segment_duration_s=1.0,
+                  gop_mode=gop_mode, **mesh_kwargs)
 
     single_out = tmp_path / "single"
     env = dict(os.environ)
@@ -58,11 +56,10 @@ def test_backend_run_on_mesh_matches_single_device(tmp_path):
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     proc = subprocess.run(
         [sys.executable, "-c", _SINGLE_DEV_SCRIPT, str(src),
-         str(single_out)],
+         str(single_out), gop_mode],
         env=env, cwd="/root/repo", timeout=600,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     assert proc.returncode == 0, proc.stdout[-3000:]
-    # the single-device path must actually have run on one device
     mesh_files = _tree_files(mesh_out)
     single_files = _tree_files(single_out)
     assert set(mesh_files) == set(single_files), (
@@ -72,3 +69,33 @@ def test_backend_run_on_mesh_matches_single_device(tmp_path):
         assert mesh_files[rel] == data, (
             f"{rel}: mesh output differs from single-device "
             f"({len(mesh_files[rel])} vs {len(data)} bytes)")
+
+
+@pytest.mark.slow
+def test_backend_run_on_mesh_matches_single_device_intra(tmp_path):
+    """All-intra: byte identity must hold INCLUDING the closed-loop rate
+    controller (frame-DP batching is device-count-invariant)."""
+    import jax
+
+    assert len(jax.devices()) == 8, "conftest must pin the 8-device mesh"
+    src = make_y4m(tmp_path / "src.y4m", n_frames=20, width=128, height=96,
+                   fps=10)
+    _compare_runs(tmp_path, src, "intra", {})
+
+
+@pytest.mark.slow
+def test_backend_run_on_mesh_matches_single_device_chains(tmp_path):
+    """I+P chains at constant QP: the compute (ME/MC/residual/entropy)
+    must be byte-identical across device counts. Closed-loop rate control
+    is excluded by design here — the mesh dispatches several chains per
+    feedback step, so the QP *schedule* legitimately differs with device
+    count; determinism of the compute is the invariant."""
+    import jax
+
+    from vlog_tpu import config
+
+    assert len(jax.devices()) == 8
+    src = make_y4m(tmp_path / "src.y4m", n_frames=30, width=128, height=96,
+                   fps=10)
+    rung = config.QualityRung("360p", 360, 0, 0, base_qp=30)  # constant QP
+    _compare_runs(tmp_path, src, "p", {"rungs": (rung,)})

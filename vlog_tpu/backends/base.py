@@ -78,6 +78,9 @@ class ExecutionPlan:
     total_frames: int = 0
     streaming_format: str = "cmaf"     # "cmaf" (fMP4) for now
     thumbnail: bool = True
+    # I+P chain length; 1 = all-intra. Always divides frames-per-segment
+    # so every CMAF segment starts on an IDR.
+    gop_len: int = 1
 
 
 @dataclass

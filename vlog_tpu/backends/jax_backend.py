@@ -99,16 +99,41 @@ class JaxBackend:
         from vlog_tpu.media.y4m import fps_to_fraction
 
         fps_num, fps_den = fps_to_fraction(source.fps or 30.0)
+        seg_s = opts.get("segment_duration_s", config.SEGMENT_DURATION_S)
+        fps = fps_num / fps_den
+        frames_per_seg = max(1, round(seg_s * fps))
+        gop_len = 1
+        gop_mode = opts.get("gop_mode", config.GOP_MODE)
+        if gop_mode == "p":
+            # Pick the divisor of frames-per-segment closest to GOP_LEN
+            # (segments must start on chain boundaries = IDRs). Divisors
+            # somewhat above the target are allowed so awkward frame
+            # rates (e.g. 25fps/1s segments) still get long chains.
+            cap = min(frames_per_seg, 2 * config.GOP_LEN)
+            divisors = [d for d in range(1, cap + 1)
+                        if frames_per_seg % d == 0]
+            gop_len = min(divisors,
+                          key=lambda d: (abs(d - config.GOP_LEN), -d))
+            if gop_len <= max(2, config.GOP_LEN // 3):
+                import logging
+
+                logging.getLogger("vlog_tpu.backend").warning(
+                    "gop_mode=p degraded to %d-frame chains "
+                    "(frames/segment=%d has no divisor near GOP_LEN=%d); "
+                    "bitrate efficiency suffers — consider adjusting "
+                    "VLOG_SEGMENT_DURATION", gop_len, frames_per_seg,
+                    config.GOP_LEN)
         return ExecutionPlan(
             source=source,
             rungs=planned,
             out_dir=Path(out_dir),
-            segment_duration_s=opts.get("segment_duration_s", config.SEGMENT_DURATION_S),
+            segment_duration_s=seg_s,
             frame_batch=opts.get("frame_batch", config.TPU_FRAME_BATCH),
             fps_num=fps_num,
             fps_den=fps_den,
             total_frames=source.frame_count,
             thumbnail=opts.get("thumbnail", True),
+            gop_len=gop_len,
         )
 
     # ------------------------------------------------------------------
@@ -183,10 +208,26 @@ class JaxBackend:
                            for r in plan.rungs)
         n_dev = len(jax.devices())
         mesh = make_mesh() if n_dev > 1 else None
-        fn, mats = ladder_encode_program(rungs_spec, src_h, src_w, mesh)
-        # Fixed staged batch size (single compile; mesh-divisible).
-        batch_n = max(plan.frame_batch, n_dev)
-        batch_n += (-batch_n) % max(n_dev, 1)
+        chain_mode = plan.gop_len > 1
+        if chain_mode:
+            from vlog_tpu.parallel.ladder import ladder_chain_program
+
+            # Chains are independent mini-GOPs, so the mesh shards the
+            # chain axis; enough chains per dispatch to honor frame_batch
+            # (amortizing host overhead), rounded to the mesh size.
+            clen = plan.gop_len
+            chains_per = max(1, -(-plan.frame_batch // clen))
+            dev = max(n_dev, 1)
+            chains_per = max(dev, chains_per + (-chains_per) % dev)
+            batch_n = clen * chains_per
+            fn, mats = ladder_chain_program(
+                rungs_spec, src_h, src_w,
+                search=config.MOTION_SEARCH_RADIUS, mesh=mesh)
+        else:
+            fn, mats = ladder_encode_program(rungs_spec, src_h, src_w, mesh)
+            # Fixed staged batch size (single compile; mesh-divisible).
+            batch_n = max(plan.frame_batch, n_dev)
+            batch_n += (-batch_n) % max(n_dev, 1)
 
         # Closed-loop VBR toward each rung's ladder bitrate.
         controllers = {
@@ -203,14 +244,91 @@ class JaxBackend:
                 by = np.concatenate([by, np.repeat(by[-1:], reps, axis=0)])
                 bu = np.concatenate([bu, np.repeat(bu[-1:], reps, axis=0)])
                 bv = np.concatenate([bv, np.repeat(bv[-1:], reps, axis=0)])
-            qps = {r.name: np.full(batch_n, controllers[r.name].qp, np.int32)
-                   for r in plan.rungs}
+            if chain_mode:
+                chain = lambda p: p.reshape((chains_per, clen) + p.shape[1:])
+                by, bu, bv = chain(by), chain(bu), chain(bv)
+                qps = {r.name: np.full((chains_per, clen),
+                                       controllers[r.name].qp, np.int32)
+                       for r in plan.rungs}
+            else:
+                qps = {r.name: np.full(batch_n, controllers[r.name].qp,
+                                       np.int32)
+                       for r in plan.rungs}
             if mesh is not None:
                 by, bu, bv = shard_frames(mesh, by, bu, bv)
                 qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
             return fn(by, bu, bv, mats, qps), n_real, qps
 
-        def consume(outs, n_real, qps):
+        # One long-lived entropy pool for chain mode (frames across a
+        # chain pack in parallel; per-call pools would churn threads).
+        entropy_pool = None
+        if chain_mode:
+            from concurrent.futures import ThreadPoolExecutor
+
+            entropy_pool = ThreadPoolExecutor(max_workers=16)
+
+        def consume_chain(outs, n_real, qps):
+            """Entropy-code one dispatch of I+P chains (display order is
+            chain-major, matching how frames were batched)."""
+            nonlocal frames_done
+            from vlog_tpu.codecs.h264.encoder import FrameLevels
+
+            i32 = lambda a: np.ascontiguousarray(a, np.int32)
+            for rung in plan.rungs:
+                name = rung.name
+                ro = outs[name]
+                sse = np.asarray(ro["sse_y"])             # (nc, clen)
+                host = {k: np.asarray(ro[k]) for k in
+                        ("i_luma_dc", "i_luma_ac", "i_chroma_dc",
+                         "i_chroma_ac", "p_luma", "p_chroma_dc",
+                         "p_chroma_ac", "mv")}
+                qarr = np.asarray(qps[name])              # (nc, clen)
+                batch_bytes = 0
+                n_frames = 0
+                for ci in range(chains_per):
+                    base = ci * clen
+                    if base >= n_real:
+                        break
+                    keep = min(clen, n_real - base)
+                    lv0 = FrameLevels(
+                        luma_dc=i32(host["i_luma_dc"][ci]),
+                        luma_ac=i32(host["i_luma_ac"][ci]),
+                        chroma_dc=i32(host["i_chroma_dc"][ci]),
+                        chroma_ac=i32(host["i_chroma_ac"][ci]),
+                        qp=int(qarr[ci, 0]))
+                    p_list = [
+                        {"luma": i32(host["p_luma"][ci, fi]),
+                         "chroma_dc": i32(host["p_chroma_dc"][ci, fi]),
+                         "chroma_ac": i32(host["p_chroma_ac"][ci, fi]),
+                         "mv": i32(host["mv"][ci, fi])}
+                        for fi in range(keep - 1)
+                    ]
+                    mse = np.maximum(sse[ci, :keep] / npix[name], 1e-12)
+                    psnrs = np.where(mse < 1e-9, 99.0,
+                                     10 * np.log10(255 ** 2 / mse))
+                    efs = encoders[name].encode_chain(
+                        lv0, p_list, qarr[ci, :keep], psnrs,
+                        pool=entropy_pool)
+                    for ef in efs:
+                        pending[name].append(
+                            Sample(data=ef.avcc, duration=frame_dur,
+                                   is_sync=ef.is_idr))
+                        psnr_acc[name].append(ef.psnr_y)
+                        batch_bytes += len(ef.avcc)
+                    n_frames += keep
+                controllers[name].observe(batch_bytes, max(n_frames, 1))
+                while len(pending[name]) >= frames_per_seg:
+                    chunk = pending[name][:frames_per_seg]
+                    pending[name] = pending[name][frames_per_seg:]
+                    self._write_segment(out, rung, tracks[name],
+                                        seg_counts, seg_durs,
+                                        bytes_written, chunk, timescale)
+            frames_done += n_real
+            if progress_cb:
+                progress_cb(frames_done, total,
+                            f"encoded {frames_done}/{total} frames")
+
+        def consume_intra(outs, n_real, qps):
             nonlocal frames_done
             for rung in plan.rungs:
                 name = rung.name
@@ -245,6 +363,8 @@ class JaxBackend:
             if progress_cb:
                 progress_cb(frames_done, total,
                             f"encoded {frames_done}/{total} frames")
+
+        consume = consume_chain if chain_mode else consume_intra
 
         # Decode prefetch: a producer thread reads/decodes the NEXT batches
         # while the device computes and the host entropy-codes — the
@@ -325,6 +445,8 @@ class JaxBackend:
                     break
             decode_thread.join(timeout=10)
             src.close()
+            if entropy_pool is not None:
+                entropy_pool.shutdown(wait=True)
 
         duration_s = total / fps if fps else 0.0
         results = []

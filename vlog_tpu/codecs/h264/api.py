@@ -92,6 +92,49 @@ class H264Encoder:
         annexb = syntax.annexb(prefix + [nal])
         return EncodedFrame(avcc=avcc, annexb=annexb, is_idr=idr, psnr_y=psnr)
 
+    def encode_chain(self, intra: FrameLevels, p_frames: list[dict],
+                     qps: np.ndarray, psnrs: np.ndarray | None = None,
+                     pool: ThreadPoolExecutor | None = None,
+                     ) -> list[EncodedFrame]:
+        """Entropy-code one I+P mini-GOP (GOP_MODE="p" hot path).
+
+        ``intra`` is frame 0's levels; ``p_frames`` holds the inter level
+        dicts (luma/chroma_dc/chroma_ac/mv) for frames 1..clen-1. Frames
+        are slices, so they entropy-code in parallel threads — per-slice
+        CAVLC state never crosses frame boundaries.
+        """
+        from vlog_tpu.codecs.h264.cavlc import encode_p_slice
+
+        idr_pic_id = self._idr_pic_id
+        self._idr_pic_id = (self._idr_pic_id + 1) % 65536
+        n = 1 + len(p_frames)
+        psnr = (lambda i: float(psnrs[i]) if psnrs is not None
+                else float("nan"))
+
+        def pack(i: int) -> EncodedFrame:
+            if i == 0:
+                nal = encode_slice(
+                    intra, qp=int(qps[0]), init_qp=self.qp, frame_num=0,
+                    idr=True, idr_pic_id=idr_pic_id)
+                raw = nal.to_bytes()
+                return EncodedFrame(
+                    avcc=len(raw).to_bytes(4, "big") + raw,
+                    annexb=syntax.annexb([self.sps, self.pps, nal]),
+                    is_idr=True, psnr_y=psnr(0))
+            nal = encode_p_slice(p_frames[i - 1], qp=int(qps[i]),
+                                 init_qp=self.qp, frame_num=i)
+            raw = nal.to_bytes()
+            return EncodedFrame(
+                avcc=len(raw).to_bytes(4, "big") + raw,
+                annexb=syntax.annexb([nal]), is_idr=False, psnr_y=psnr(i))
+
+        if pool is not None:
+            return list(pool.map(pack, range(n)))
+        if n == 1 or self.entropy_threads <= 1:
+            return [pack(i) for i in range(n)]
+        with ThreadPoolExecutor(self.entropy_threads) as own:
+            return list(own.map(pack, range(n)))
+
     def encode_levels(self, levels: dict, qps: np.ndarray,
                       psnrs: np.ndarray | None = None,
                       n: int | None = None) -> list[EncodedFrame]:

@@ -283,24 +283,15 @@ def _median3(a: int, b: int, c: int) -> int:
     return sorted((a, b, c))[1]
 
 
-class PSliceEncoder:
-    """Encodes one P frame's device outputs into slice_data bits.
-
-    MB modes are P_Skip or P_L0_16x16 with one reference; MVs arrive in
-    integer pels from the DSP and are coded as quarter-pel MVDs against
-    the spec median predictor (8.4.1.3), with the P_Skip inferred-MV rule
-    (8.4.1.1) deciding skippability.
-    """
+class MvPredictor:
+    """The spec's MV prediction state machine (8.4.1.3 + 8.4.1.1),
+    shared verbatim between the P-slice encoder and decoder so the two
+    can never drift. Holds reconstructed MVs in QUARTER pels, (x, y)."""
 
     def __init__(self, mbh: int, mbw: int):
         self.mbh = mbh
         self.mbw = mbw
-        self.nz_luma = np.zeros((mbh * 4, mbw * 4), np.int32)
-        self.nz_chroma = np.zeros((2, mbh * 2, mbw * 2), np.int32)
-        # reconstructed MVs in QUARTER pels (what neighbours predict from)
         self.mvs = np.zeros((mbh, mbw, 2), np.int32)
-
-    # -- MV prediction ----------------------------------------------------
 
     def _neighbor(self, my: int, mx: int):
         """(avail, mv) triplets for A (left), B (top), C (top-right with
@@ -337,6 +328,33 @@ class PSliceEncoder:
                 or (b[0] == 0 and b[1] == 0)):
             return 0, 0
         return self.mv_pred(my, mx)
+
+
+class PSliceEncoder:
+    """Encodes one P frame's device outputs into slice_data bits.
+
+    MB modes are P_Skip or P_L0_16x16 with one reference; MVs arrive in
+    integer pels from the DSP and are coded as quarter-pel MVDs against
+    the spec median predictor (8.4.1.3), with the P_Skip inferred-MV rule
+    (8.4.1.1) deciding skippability.
+    """
+
+    def __init__(self, mbh: int, mbw: int):
+        self.mbh = mbh
+        self.mbw = mbw
+        self.nz_luma = np.zeros((mbh * 4, mbw * 4), np.int32)
+        self.nz_chroma = np.zeros((2, mbh * 2, mbw * 2), np.int32)
+        self.mvp = MvPredictor(mbh, mbw)
+
+    @property
+    def mvs(self) -> np.ndarray:
+        return self.mvp.mvs
+
+    def mv_pred(self, my: int, mx: int) -> tuple[int, int]:
+        return self.mvp.mv_pred(my, mx)
+
+    def skip_mv(self, my: int, mx: int) -> tuple[int, int]:
+        return self.mvp.skip_mv(my, mx)
 
     # -- MB layer ---------------------------------------------------------
 

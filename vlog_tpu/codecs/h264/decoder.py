@@ -259,8 +259,10 @@ def parse_slice_header(r: BitReader, sps: Sps, pps: Pps, nal_type: int,
                        nal_ref_idc: int) -> SliceHeader:
     first_mb = r.read_ue()
     slice_type = r.read_ue()
-    if slice_type % 5 != 2:
-        raise UnsupportedStream(f"only I slices supported (slice_type {slice_type})")
+    if slice_type % 5 not in (0, 2):
+        raise UnsupportedStream(
+            f"only I/P slices supported (slice_type {slice_type})")
+    is_p = slice_type % 5 == 0
     pps_id = r.read_ue()
     frame_num = r.read_bits(sps.log2_max_frame_num)
     idr = nal_type == syntax.NAL_IDR
@@ -269,6 +271,12 @@ def parse_slice_header(r: BitReader, sps: Sps, pps: Pps, nal_type: int,
     if sps.pic_order_cnt_type != 2:
         raise UnsupportedStream(
             f"pic_order_cnt_type {sps.pic_order_cnt_type} not supported")
+    if is_p:
+        if r.read_bit():                 # num_ref_idx_active_override_flag
+            if r.read_ue() != 0:         # num_ref_idx_l0_active_minus1
+                raise UnsupportedStream("multiple reference frames")
+        if r.read_bit():                 # ref_pic_list_modification_flag_l0
+            raise UnsupportedStream("ref pic list modification")
     if nal_ref_idc != 0:
         if idr:
             r.read_bit()  # no_output_of_prior_pics
@@ -460,6 +468,92 @@ def decode_slice_data(r: BitReader, sps: Sps, header: SliceHeader) -> dict:
     }
 
 
+def decode_p_slice_data(r: BitReader, sps: Sps, header: SliceHeader) -> dict:
+    """Decode one full-frame P slice (P_Skip / P_L0_16x16 envelope).
+
+    MV prediction state machine is shared with the encoder
+    (cavlc.PSliceEncoder.mv_pred/skip_mv), so the two can never drift.
+    Returns levels + per-MB MVs in quarter pels.
+    """
+    from vlog_tpu.codecs.h264.cavlc import (_BLK44, _CBP_INTER_FROM_CODE,
+                                            MvPredictor)
+
+    mbh, mbw = sps.mb_height, sps.mb_width
+    if header.first_mb != 0:
+        raise UnsupportedStream("multi-slice pictures not supported")
+    luma = np.zeros((mbh, mbw, 4, 4, 4, 4), np.int32)
+    chroma_dc = np.zeros((2, mbh, mbw, 2, 2), np.int32)
+    chroma_ac = np.zeros((2, mbh, mbw, 2, 2, 4, 4), np.int32)
+    nz_luma = np.zeros((mbh * 4, mbw * 4), np.int32)
+    nz_chroma = np.zeros((2, mbh * 2, mbw * 2), np.int32)
+    mvst = MvPredictor(mbh, mbw)          # shared with the encoder
+
+    n_mbs = mbh * mbw
+    mb = 0
+    skip_left = r.read_ue()               # leading mb_skip_run
+    while mb < n_mbs:
+        my, mx = divmod(mb, mbw)
+        if skip_left > 0:
+            mvst.mvs[my, mx] = mvst.skip_mv(my, mx)
+            skip_left -= 1
+            mb += 1
+            continue
+        mb_type = r.read_ue()
+        if mb_type != 0:
+            raise UnsupportedStream(
+                f"P mb_type {mb_type} outside P_L0_16x16 envelope")
+        mvd_x = r.read_se()
+        mvd_y = r.read_se()
+        pmx, pmy = mvst.mv_pred(my, mx)
+        mvx, mvy = pmx + mvd_x, pmy + mvd_y
+        mvst.mvs[my, mx] = (mvx, mvy)
+        cbp = _CBP_INTER_FROM_CODE[r.read_ue()]
+        if cbp:
+            if r.read_se() != 0:
+                raise UnsupportedStream("mb_qp_delta != 0 not supported")
+            gy, gx = my * 4, mx * 4
+            for i8 in range(4):
+                oy, ox = _BLK44[i8]
+                for dy, dx in _BLK44:
+                    by, bx = 2 * oy + dy, 2 * ox + dx
+                    y, x = gy + by, gx + bx
+                    if not (cbp >> i8) & 1:
+                        nz_luma[y, x] = 0
+                        continue
+                    nc = _nc(x > 0, int(nz_luma[y, x - 1]),
+                             y > 0, int(nz_luma[y - 1, x]))
+                    scan = decode_residual_block(r, nc, 16)
+                    luma[my, mx, by, bx] = _unzigzag(scan)
+                    nz_luma[y, x] = int(np.count_nonzero(scan))
+            cbp_chroma = cbp >> 4
+            if cbp_chroma > 0:
+                for comp in range(2):
+                    dc = decode_residual_block(r, -1, 4)
+                    chroma_dc[comp, my, mx] = dc.reshape(2, 2)
+            cy, cx = my * 2, mx * 2
+            for comp in range(2):
+                for by in range(2):
+                    for bx in range(2):
+                        y, x = cy + by, cx + bx
+                        if cbp_chroma != 2:
+                            nz_chroma[comp, y, x] = 0
+                            continue
+                        nc = _nc(x > 0, int(nz_chroma[comp, y, x - 1]),
+                                 y > 0, int(nz_chroma[comp, y - 1, x]))
+                        scan15 = decode_residual_block(r, nc, 15)
+                        full = np.zeros(16, np.int32)
+                        full[1:] = scan15
+                        chroma_ac[comp, my, mx, by, bx] = _unzigzag(full)
+                        nz_chroma[comp, y, x] = int(np.count_nonzero(scan15))
+        mb += 1
+        if mb < n_mbs:
+            skip_left = r.read_ue()
+    return {
+        "luma": luma, "chroma_dc": chroma_dc, "chroma_ac": chroma_ac,
+        "mv_q": np.ascontiguousarray(mvst.mvs),   # quarter pels, (x, y)
+    }
+
+
 # --------------------------------------------------------------------------
 # Reconstruction (JAX) — mirror of encoder.encode_frame's recon path
 # --------------------------------------------------------------------------
@@ -557,6 +651,46 @@ def reconstruct_gop(levels: dict, *, qp: int):
     return jax.vmap(lambda l: reconstruct_frame(l, qp=qp))(levels)
 
 
+@functools.partial(jax.jit, static_argnames=("qp",))
+def reconstruct_p_frame(levels: dict, ref_y, ref_u, ref_v, *, qp: int):
+    """P-frame recon: MC from the previous reconstruction + inter residual
+    (mirror of inter.encode_p_frame's decoder loop)."""
+    from vlog_tpu.codecs.h264.inter import mc_chroma, mc_luma
+
+    qpc = chroma_qp(qp)
+    mv = jnp.asarray(levels["mv_int"], jnp.int32)      # (mbh, mbw, 2) (y, x)
+    luma = jnp.asarray(levels["luma"], jnp.int32)
+    chroma_dc = jnp.asarray(levels["chroma_dc"], jnp.int32)
+    chroma_ac = jnp.asarray(levels["chroma_ac"], jnp.int32)
+    mbh, mbw = luma.shape[0], luma.shape[1]
+    h, w = mbh * 16, mbw * 16
+
+    pred_y = mc_luma(jnp.asarray(ref_y), mv, search=_P_REF_PAD)
+    pred_u = mc_chroma(jnp.asarray(ref_u), mv, search=_P_REF_PAD)
+    pred_v = mc_chroma(jnp.asarray(ref_v), mv, search=_P_REF_PAD)
+
+    rec = inverse_core_transform(dequantize(luma, qp=qp))
+    y_res = jnp.transpose(rec, (0, 2, 4, 1, 3, 5)).reshape(h, w)
+
+    def chroma_res(dc, ac):
+        dc_rec = dequantize_chroma_dc(dc, qp=qpc)
+        full = dequantize(ac, qp=qpc).at[..., 0, 0].set(dc_rec)
+        res = inverse_core_transform(full)
+        return jnp.transpose(res, (0, 2, 4, 1, 3, 5)).reshape(h // 2, w // 2)
+
+    y = jnp.clip(pred_y + y_res, 0, 255).astype(jnp.uint8)
+    u = jnp.clip(pred_u + chroma_res(chroma_dc[0], chroma_ac[0]),
+                 0, 255).astype(jnp.uint8)
+    v = jnp.clip(pred_v + chroma_res(chroma_dc[1], chroma_ac[1]),
+                 0, 255).astype(jnp.uint8)
+    return y, u, v
+
+
+# MC padding for decode: covers |MV| up to this many pels (our encoder's
+# search radius is <= 16; foreign streams beyond it are rejected upstream).
+_P_REF_PAD = 32
+
+
 # --------------------------------------------------------------------------
 # Decoder object
 # --------------------------------------------------------------------------
@@ -578,6 +712,7 @@ class H264Decoder:
         self.sps: Sps | None = None
         self.pps: Pps | None = None
         self._length_size = 4
+        self._ref: tuple | None = None      # previous padded recon (y, u, v)
         if avcc_config:
             self._parse_avcc_config(avcc_config)
 
@@ -624,9 +759,33 @@ class H264Decoder:
             raise DecodeError("slice before SPS/PPS")
         r = BitReader(rbsp)
         header = parse_slice_header(r, self.sps, self.pps, nal_type, ref_idc)
-        levels = decode_slice_data(r, self.sps, header)
+        if header.slice_type % 5 == 0:
+            levels = decode_p_slice_data(r, self.sps, header)
+            levels["is_p"] = True
+        else:
+            levels = decode_slice_data(r, self.sps, header)
+            levels["is_p"] = False
         levels["qp"] = header.qp
         return levels
+
+    def _reconstruct(self, levels: dict) -> tuple:
+        """Levels -> padded planes; updates the reference picture."""
+        qp = levels.pop("qp")
+        if levels.pop("is_p", False):
+            if self._ref is None:
+                raise DecodeError("P slice with no reference picture")
+            mv_q = levels.pop("mv_q")                   # (mbh, mbw, 2) (x, y)
+            if np.any(mv_q % 4):
+                raise UnsupportedStream("sub-pel MVs outside decode envelope")
+            mv_int = np.stack([mv_q[..., 1] // 4, mv_q[..., 0] // 4], axis=-1)
+            if np.any(np.abs(mv_int) > _P_REF_PAD):
+                raise UnsupportedStream("MV beyond reference padding")
+            levels["mv_int"] = mv_int
+            y, u, v = reconstruct_p_frame(levels, *self._ref, qp=qp)
+        else:
+            y, u, v = reconstruct_frame(levels, qp=qp)
+        self._ref = (np.asarray(y), np.asarray(u), np.asarray(v))
+        return y, u, v
 
     def decode_sample_levels(self, sample: bytes) -> dict | None:
         """AVCC sample -> levels dict (host arrays), or None if no slice."""
@@ -649,13 +808,12 @@ class H264Decoder:
         levels = self.decode_sample_levels(sample)
         if levels is None:
             return None
-        qp = levels.pop("qp")
-        y, u, v = reconstruct_frame(levels, qp=qp)
-        return self._crop(y, u, v)
+        return self._crop(*self._reconstruct(levels))
 
     def decode_samples(self, samples: list[bytes]) -> list[DecodedFrame]:
         """Batched decode: CAVLC parse per sample on host, one device
-        dispatch reconstructs the whole batch (frames must share QP)."""
+        dispatch reconstructs the whole batch when the GOP is all-intra
+        with a shared QP; chained (P) GOPs reconstruct sequentially."""
         all_levels = []
         for s in samples:
             lv = self.decode_sample_levels(s)
@@ -664,20 +822,18 @@ class H264Decoder:
         if not all_levels:
             return []
         qps = {lv["qp"] for lv in all_levels}
-        if len(qps) == 1:
+        if len(qps) == 1 and not any(lv.get("is_p") for lv in all_levels):
             qp = qps.pop()
             stacked = {
                 k: np.stack([lv[k] for lv in all_levels])
                 for k in ("luma_dc", "luma_ac", "chroma_dc", "chroma_ac")
             }
             ys, us, vs = reconstruct_gop(stacked, qp=qp)
-            return [self._crop(ys[i], us[i], vs[i]) for i in range(len(all_levels))]
-        return [
-            self._crop(*reconstruct_frame(
-                {k: lv[k] for k in ("luma_dc", "luma_ac", "chroma_dc", "chroma_ac")},
-                qp=lv["qp"]))
-            for lv in all_levels
-        ]
+            self._ref = (np.asarray(ys[-1]), np.asarray(us[-1]),
+                         np.asarray(vs[-1]))
+            return [self._crop(ys[i], us[i], vs[i])
+                    for i in range(len(all_levels))]
+        return [self._crop(*self._reconstruct(lv)) for lv in all_levels]
 
 
 def decode_annexb(data: bytes) -> tuple[list[DecodedFrame], Sps | None]:
@@ -687,9 +843,7 @@ def decode_annexb(data: bytes) -> tuple[list[DecodedFrame], Sps | None]:
     for nal_type, ref_idc, rbsp in split_annexb(data):
         if nal_type in (syntax.NAL_SLICE, syntax.NAL_IDR):
             levels = dec._decode_slice_nal(nal_type, ref_idc, rbsp)
-            qp = levels.pop("qp")
-            y, u, v = reconstruct_frame(levels, qp=qp)
-            frames.append(dec._crop(y, u, v))
+            frames.append(dec._crop(*dec._reconstruct(levels)))
         else:
             dec._handle_nal(nal_type, rbsp)
     return frames, dec.sps

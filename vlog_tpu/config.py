@@ -213,6 +213,15 @@ WEBHOOK_ALLOW_PRIVATE: bool = _env_bool("VLOG_WEBHOOK_ALLOW_PRIVATE", False)
 # --------------------------------------------------------------------------
 
 TPU_ENABLED: bool = _env_bool("VLOG_TPU_ENABLED", True)
+# GOP structure: "p" = I + P chains (inter prediction; the bitrate-
+# efficient default), "intra" = every frame an IDR (the round-1/2 mode).
+GOP_MODE: str = _env_str("VLOG_GOP_MODE", "p")
+# Target chain length (frames per I+P group). The backend picks the
+# largest divisor of frames-per-segment not exceeding this, so every
+# CMAF segment still starts on an IDR.
+GOP_LEN: int = _env_int("VLOG_GOP_LEN", 24, lo=1, hi=256)
+# Integer motion search radius (pels).
+MOTION_SEARCH_RADIUS: int = _env_int("VLOG_MOTION_SEARCH", 8, lo=1, hi=32)
 # Frames per device-batch staged to HBM per encode dispatch. GOP size for the
 # all-intra encoder is a packaging concept (segment boundary), so this is a
 # pure throughput/memory knob.

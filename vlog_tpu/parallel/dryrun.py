@@ -56,8 +56,32 @@ def run(n_devices: int) -> None:
         psnr = float(stats[name])
         assert 10.0 < psnr < 99.0, f"rung {name}: implausible PSNR {psnr}"
         assert out[name]["luma_ac"].shape[0] == n_devices
+
+    # The I+P chain production path (GOP_MODE="p"): one chain per device,
+    # sharded on the chain axis (inter prediction chains WITHIN a device,
+    # never across — the temporal-dependence adaptation of §2d.5).
+    from vlog_tpu.parallel.ladder import ladder_chain_program, ladder_matrices  # noqa: F401
+
+    clen = 3
+    cfn, cmats = ladder_chain_program(rungs, h, w, search=4, mesh=mesh)
+    cy = rng.integers(0, 256, (n_devices, clen, h, w)).astype(np.uint8)
+    cu = rng.integers(0, 256, (n_devices, clen, h // 2, w // 2)).astype(np.uint8)
+    cv = rng.integers(0, 256, (n_devices, clen, h // 2, w // 2)).astype(np.uint8)
+    qps = {name: np.full((n_devices, clen), qp, np.int32)
+           for name, _, _, qp in rungs}
+    cy, cu, cv = shard_frames(mesh, cy, cu, cv)
+    qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
+    couts = cfn(cy, cu, cv, cmats, qps)
+    jax.block_until_ready(couts)
+    for name, _, _, _ in rungs:
+        ro = couts[name]
+        assert ro["p_luma"].shape[:2] == (n_devices, clen - 1)
+        assert ro["mv"].shape[:2] == (n_devices, clen - 1)
+        assert ro["sse_y"].shape == (n_devices, clen)
+
     print(f"dryrun ok: {n_devices} devices, rungs "
-          f"{[(r[0], round(float(stats[r[0]]), 2)) for r in rungs]}")
+          f"{[(r[0], round(float(stats[r[0]]), 2)) for r in rungs]}, "
+          f"chain clen={clen} ok")
 
 
 if __name__ == "__main__":

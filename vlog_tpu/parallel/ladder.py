@@ -129,6 +129,104 @@ def ladder_encode_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
     return jax.jit(fn), mats
 
 
+@functools.lru_cache(maxsize=8)
+def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
+                         search: int = 8, mesh: Mesh | None = None
+                         ) -> tuple[Callable, dict]:
+    """The I+P chain ladder step (GOP_MODE="p" production path).
+
+    ``fn(y, u, v, mats, qps)`` with y/u/v shaped (n_chains, clen, ...) and
+    ``qps`` mapping rung -> (n_chains, clen) int32. Each chain is one
+    mini-GOP: frame 0 intra, frames 1..clen-1 P against the previous
+    frame's reconstruction — a ``lax.scan`` over time whose every step is
+    a full-frame-parallel encode, vmapped over chains. Chains are
+    self-contained (each starts with an IDR), so the mesh path shards the
+    CHAIN axis over "data" with zero steady-state collectives: inter
+    prediction serializes frames within a chain, never across devices
+    (SURVEY §2d.5 adapted for temporal dependence).
+
+    Per rung output (int16 levels, device-only recon):
+      i_luma_dc/(n,4,4) i_luma_ac i_chroma_dc i_chroma_ac   — frame 0
+      p_luma (n, clen-1, mbh, mbw, 4,4,4,4), p_chroma_dc, p_chroma_ac
+      mv (n, clen-1, mbh, mbw, 2) int16, sse_y (n, clen) float32
+    """
+    from vlog_tpu.codecs.h264.encoder import encode_frame
+    from vlog_tpu.codecs.h264.inter import encode_p_frame
+
+    def one_rung(y, u, v, rung_mats, qps, h, w):
+        # y: (n, clen, H, W) local chains; resize whole block at once
+        n, clen = y.shape[0], y.shape[1]
+        flat = lambda p: p.reshape((n * clen,) + p.shape[2:])
+        ry, ru, rv = resize_yuv420_with(flat(y), flat(u), flat(v), rung_mats)
+        py, pu, pv = _pad_mb(ry, ru, rv)
+        unflat = lambda p: p.reshape((n, clen) + p.shape[1:])
+        py, pu, pv = unflat(py), unflat(pu), unflat(pv)
+        ry = unflat(ry)
+
+        i_out = jax.vmap(
+            lambda a, b, c, q: encode_frame(a, b, c, qp=q)
+        )(py[:, 0], pu[:, 0], pv[:, 0], qps[:, 0])
+        sse0 = jnp.sum(
+            (i_out["recon_y"][:, :h, :w].astype(jnp.float32)
+             - ry[:, 0].astype(jnp.float32)) ** 2, axis=(1, 2))
+
+        def step(carry, xs):
+            ref_y, ref_u, ref_v = carry
+            cy, cu, cv, q, src_y = xs
+            pout = jax.vmap(
+                lambda a, b, c, r1, r2, r3, qq: encode_p_frame(
+                    a, b, c, r1, r2, r3, qp=qq, search=search)
+            )(cy, cu, cv, ref_y, ref_u, ref_v, q)
+            sse = jnp.sum(
+                (pout["recon_y"][:, :h, :w].astype(jnp.float32)
+                 - src_y.astype(jnp.float32)) ** 2, axis=(1, 2))
+            out = {
+                "luma": pout["luma"].astype(jnp.int16),
+                "chroma_dc": pout["chroma_dc"].astype(jnp.int16),
+                "chroma_ac": pout["chroma_ac"].astype(jnp.int16),
+                "mv": pout["mv"].astype(jnp.int16),
+                "sse": sse,
+            }
+            return ((pout["recon_y"], pout["recon_u"], pout["recon_v"]),
+                    out)
+
+        t_axis = lambda p: jnp.moveaxis(p[:, 1:], 1, 0)  # (clen-1, n, ...)
+        _, scanned = jax.lax.scan(
+            step,
+            (i_out["recon_y"], i_out["recon_u"], i_out["recon_v"]),
+            (t_axis(py), t_axis(pu), t_axis(pv),
+             jnp.moveaxis(qps[:, 1:], 1, 0), t_axis(ry)),
+        )
+        chain_first = lambda p: jnp.moveaxis(p, 0, 1)    # (n, clen-1, ...)
+        return {
+            "i_luma_dc": i_out["luma_dc"].astype(jnp.int16),
+            "i_luma_ac": i_out["luma_ac"].astype(jnp.int16),
+            "i_chroma_dc": i_out["chroma_dc"].astype(jnp.int16),
+            "i_chroma_ac": i_out["chroma_ac"].astype(jnp.int16),
+            "p_luma": chain_first(scanned["luma"]),
+            "p_chroma_dc": chain_first(scanned["chroma_dc"]),
+            "p_chroma_ac": chain_first(scanned["chroma_ac"]),
+            "mv": chain_first(scanned["mv"]),
+            "sse_y": jnp.concatenate(
+                [sse0[:, None], chain_first(scanned["sse"])], axis=1),
+        }
+
+    def local(y, u, v, mats, qps):
+        return {name: one_rung(y, u, v, mats[name], qps[name], h, w)
+                for name, h, w, qp in rungs}
+
+    mats = ladder_matrices(rungs, src_h, src_w)
+    if mesh is None:
+        return jax.jit(local), jax.device_put(mats)
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("data"), P("data"), P("data"), P(), P("data")),
+        out_specs=P("data"),
+        check_vma=False,
+    )
+    return jax.jit(fn), jax.device_put(mats, NamedSharding(mesh, P()))
+
+
 def single_chip_ladder(rungs: tuple[RungSpec, ...], src_h: int, src_w: int
                        ) -> tuple[Callable, dict]:
     """Jitted one-device ladder step + its matrices pytree.
