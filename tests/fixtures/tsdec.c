@@ -1,0 +1,92 @@
+/* Test-only MPEG-TS oracle: demux+decode via system libavformat/codec.
+ *
+ * Usage: tsdec <in.ts> <out.yuv> [<out.pcm>]
+ * Writes decoded video frames as packed I420 planes; if an audio stream
+ * exists and out.pcm is given, writes mono-summed s16le samples. Prints
+ * "video=<n> audio=<m>" stream counts. Validates that our first-party TS
+ * muxer (vlog_tpu/media/ts.py) produces streams third-party demuxers
+ * accept — the legacy-HLS analog of the fMP4 oracle checks.
+ */
+#include <libavformat/avformat.h>
+#include <libavcodec/avcodec.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+static void die(const char *m) { fprintf(stderr, "%s\n", m); exit(1); }
+
+int main(int argc, char **argv) {
+    if (argc < 3) die("usage: tsdec <in.ts> <out.yuv> [out.pcm]");
+    AVFormatContext *fmt = NULL;
+    if (avformat_open_input(&fmt, argv[1], NULL, NULL) < 0)
+        die("open failed");
+    if (avformat_find_stream_info(fmt, NULL) < 0) die("no stream info");
+
+    int vidx = -1, aidx = -1;
+    AVCodecContext *vctx = NULL, *actx = NULL;
+    for (unsigned i = 0; i < fmt->nb_streams; i++) {
+        enum AVMediaType t = fmt->streams[i]->codecpar->codec_type;
+        if (t == AVMEDIA_TYPE_VIDEO && vidx < 0) vidx = (int)i;
+        if (t == AVMEDIA_TYPE_AUDIO && aidx < 0) aidx = (int)i;
+    }
+    FILE *vout = fopen(argv[2], "wb");
+    FILE *aout = argc > 3 ? fopen(argv[3], "wb") : NULL;
+    int nv = 0, na = 0;
+
+    if (vidx >= 0) {
+        const AVCodec *c = avcodec_find_decoder(
+            fmt->streams[vidx]->codecpar->codec_id);
+        vctx = avcodec_alloc_context3(c);
+        avcodec_parameters_to_context(vctx, fmt->streams[vidx]->codecpar);
+        if (avcodec_open2(vctx, c, NULL) < 0) die("video open failed");
+    }
+    if (aidx >= 0) {
+        const AVCodec *c = avcodec_find_decoder(
+            fmt->streams[aidx]->codecpar->codec_id);
+        actx = avcodec_alloc_context3(c);
+        avcodec_parameters_to_context(actx, fmt->streams[aidx]->codecpar);
+        if (avcodec_open2(actx, c, NULL) < 0) die("audio open failed");
+    }
+
+    AVPacket *pkt = av_packet_alloc();
+    AVFrame *frame = av_frame_alloc();
+    while (av_read_frame(fmt, pkt) >= 0) {
+        if (pkt->stream_index == vidx && vctx) {
+            avcodec_send_packet(vctx, pkt);
+            while (avcodec_receive_frame(vctx, frame) == 0) {
+                for (int p = 0; p < 3; p++) {
+                    int h = p ? (frame->height + 1) / 2 : frame->height;
+                    int w = p ? (frame->width + 1) / 2 : frame->width;
+                    for (int y = 0; y < h; y++)
+                        fwrite(frame->data[p] + (size_t)y * frame->linesize[p],
+                               1, w, vout);
+                }
+                nv++;
+            }
+        } else if (pkt->stream_index == aidx && actx && aout) {
+            avcodec_send_packet(actx, pkt);
+            while (avcodec_receive_frame(actx, frame) == 0) na++;
+        }
+        av_packet_unref(pkt);
+    }
+    if (vctx) {       /* flush */
+        avcodec_send_packet(vctx, NULL);
+        while (avcodec_receive_frame(vctx, frame) == 0) {
+            for (int p = 0; p < 3; p++) {
+                int h = p ? (frame->height + 1) / 2 : frame->height;
+                int w = p ? (frame->width + 1) / 2 : frame->width;
+                for (int y = 0; y < h; y++)
+                    fwrite(frame->data[p] + (size_t)y * frame->linesize[p],
+                           1, w, vout);
+            }
+            nv++;
+        }
+    }
+    if (actx && aout) {
+        avcodec_send_packet(actx, NULL);
+        while (avcodec_receive_frame(actx, frame) == 0) na++;
+    }
+    printf("video=%d audio=%d\n", nv, na);
+    fclose(vout);
+    if (aout) fclose(aout);
+    return 0;
+}

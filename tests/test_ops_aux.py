@@ -1,0 +1,208 @@
+"""Re-encode jobs, dead-letter admin, alerts, worker health probes.
+
+Reference analogs: reencode_worker.py (format conversion), dead-letter
+admin (admin.py:8934), alerts.py (rate-limited operational webhooks),
+health_server.py (k8s probes).
+"""
+
+from __future__ import annotations
+
+import httpx
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from vlog_tpu.enums import JobKind
+from vlog_tpu.jobs import claims, videos as vids
+from vlog_tpu.jobs.alerts import AlertSink
+from vlog_tpu.worker.daemon import WorkerDaemon
+from vlog_tpu.worker.health import WorkerHealthServer
+from tests.fixtures.media import make_y4m
+
+
+# --------------------------------------------------------------------------
+# Re-encode job kind
+# --------------------------------------------------------------------------
+
+def test_daemon_reencode_converts_format(run, db, tmp_path):
+    src = make_y4m(tmp_path / "s.y4m", n_frames=10, width=64, height=48,
+                   fps=10)
+    video = run(vids.create_video(db, "Conv", source_path=str(src)))
+    run(claims.enqueue_job(db, video["id"]))
+    daemon = WorkerDaemon(db, name="re", video_dir=tmp_path / "v",
+                          progress_min_interval_s=0.0)
+    run(daemon.poll_once())        # normal transcode (cmaf)
+    out = tmp_path / "v" / video["slug"]
+    assert (out / "360p" / "init.mp4").exists()
+
+    run(claims.enqueue_job(db, video["id"], JobKind.REENCODE,
+                           payload={"streaming_format": "hls_ts"}))
+    assert run(daemon.poll_once()) is True    # skip the sprite job? order:
+    # sprite was enqueued by finalize and has the lower job id — drain both
+    while run(daemon.poll_once()):
+        pass
+    row = run(vids.get_video(db, video["id"]))
+    assert row["streaming_format"] == "hls_ts"
+    assert row["status"] == "ready"
+    assert list((out / "360p").glob("segment_*.ts"))
+    job = run(db.fetch_one(
+        "SELECT * FROM jobs WHERE video_id=:v AND kind='reencode'",
+        {"v": video["id"]}))
+    assert job["completed_at"] is not None
+
+
+def test_reencode_unknown_codec_fails_permanently(run, db, tmp_path):
+    src = make_y4m(tmp_path / "s.y4m", n_frames=6, width=64, height=48)
+    video = run(vids.create_video(db, "Hevc", source_path=str(src)))
+    run(claims.enqueue_job(db, video["id"], JobKind.REENCODE,
+                           payload={"codec": "hevc"}))
+    daemon = WorkerDaemon(db, name="re", video_dir=tmp_path / "v",
+                          progress_min_interval_s=0.0)
+    run(daemon.poll_once())
+    job = run(db.fetch_one(
+        "SELECT * FROM jobs WHERE video_id=:v", {"v": video["id"]}))
+    assert job["failed_at"] is not None
+    assert "no first-party encoder" in job["error"]
+
+
+# --------------------------------------------------------------------------
+# Dead-letter admin plane
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def admin(run, db, tmp_path):
+    from vlog_tpu.api.admin_api import build_admin_app
+
+    srv = TestServer(build_admin_app(db, upload_dir=tmp_path / "up",
+                                     video_dir=tmp_path / "v"))
+    run(srv.start_server())
+    yield str(srv.make_url(""))
+    run(srv.close())
+
+
+def test_failed_jobs_and_requeue(run, db, tmp_path, admin):
+    video = run(vids.create_video(db, "Dead", source_path="/nope"))
+    run(claims.enqueue_job(db, video["id"], max_attempts=1))
+
+    async def go():
+        row = await claims.claim_job(db, "w")
+        await claims.fail_job(db, row["id"], "w", "boom", permanent=True)
+        async with httpx.AsyncClient(base_url=admin) as c:
+            dead = (await c.get("/api/jobs/failed")).json()["jobs"]
+            assert len(dead) == 1 and dead[0]["error"] == "boom"
+            assert dead[0]["slug"] == "dead"
+            r = await c.post(f"/api/jobs/{row['id']}/requeue")
+            assert r.status_code == 200
+            # requeue of a live job refused
+            assert (await c.post(
+                f"/api/jobs/{row['id']}/requeue")).status_code == 409
+            assert (await c.get("/api/jobs/failed")).json()["jobs"] == []
+        fresh = await db.fetch_one("SELECT * FROM jobs WHERE id=:id",
+                                   {"id": row["id"]})
+        assert fresh["failed_at"] is None and fresh["attempt"] == 0
+
+    run(go())
+
+
+def test_admin_reencode_endpoint(run, db, tmp_path, admin):
+    video = run(vids.create_video(db, "Fmt", source_path="/x"))
+
+    async def go():
+        async with httpx.AsyncClient(base_url=admin) as c:
+            r = await c.post(f"/api/videos/{video['id']}/reencode",
+                             json={"streaming_format": "hls_ts"})
+            assert r.status_code == 200
+            job = await db.fetch_one(
+                "SELECT * FROM jobs WHERE id=:id", {"id": r.json()["job_id"]})
+            assert job["kind"] == "reencode"
+            assert "hls_ts" in job["payload"]
+            r = await c.post(f"/api/videos/{video['id']}/reencode",
+                             json={"streaming_format": "webm"})
+            assert r.status_code == 400
+
+    run(go())
+
+
+# --------------------------------------------------------------------------
+# Alerts
+# --------------------------------------------------------------------------
+
+def test_alert_sink_rate_limits_and_posts(run):
+    received = []
+
+    async def handle(request):
+        received.append(await request.json())
+        return web.Response()
+
+    app = web.Application()
+    app.router.add_post("/alert", handle)
+    srv = TestServer(app)
+
+    async def go():
+        await srv.start_server()
+        sink = AlertSink(url=str(srv.make_url("/alert")),
+                         min_interval_s=60.0, source="test-worker")
+        assert await sink.send("job.failed", "boom", {"job_id": 1})
+        assert not await sink.send("job.failed", "boom again")  # suppressed
+        assert await sink.send("worker.startup", "hi")          # other key
+        assert sink.metrics.sent == 2
+        assert sink.metrics.suppressed == 1
+        await srv.close()
+
+    run(go())
+    assert received[0]["alert"] == "job.failed"
+    assert received[0]["source"] == "test-worker"
+    assert received[1]["alert"] == "worker.startup"
+
+
+def test_alert_sink_disabled_without_url(run):
+    sink = AlertSink(url=None)
+    assert not sink.enabled
+
+    async def go():
+        assert not await sink.send("x", "y")
+
+    run(go())
+    assert sink.metrics.sent == 0
+
+
+# --------------------------------------------------------------------------
+# Worker health probes
+# --------------------------------------------------------------------------
+
+def test_health_server_probes(run):
+    state = {"ready": True}
+
+    async def ready():
+        return state["ready"], "detail-here"
+
+    async def go():
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        hs = WorkerHealthServer(ready, port=port, host="127.0.0.1")
+        assert await hs.start()
+        async with httpx.AsyncClient(
+                base_url=f"http://127.0.0.1:{port}") as c:
+            r = await c.get("/health")
+            assert r.json()["ok"] is True
+            r = await c.get("/ready")
+            assert r.status_code == 200
+            state["ready"] = False
+            r = await c.get("/ready")
+            assert r.status_code == 503
+            assert r.json()["detail"] == "detail-here"
+        await hs.stop()
+
+    run(go())
+
+
+def test_health_server_disabled_by_default(run):
+    async def go():
+        hs = WorkerHealthServer(lambda: None, port=0)
+        assert await hs.start() is False
+        await hs.stop()
+
+    run(go())

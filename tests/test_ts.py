@@ -1,0 +1,201 @@
+"""MPEG-TS muxer: structure + libavformat oracle round trip.
+
+Reference analog: the legacy HLS/TS path (StreamingFormat.HLS_TS). The
+segment must demux in a third-party stack (libavformat) and the decoded
+video must match the encoder's reconstruction bit-exactly.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from vlog_tpu.media.ts import TS_PACKET, TsMuxer, TsSample, _crc32_mpeg
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def test_crc32_mpeg_known_vector():
+    # CRC of empty PAT-style data, spot values from the MPEG CRC spec
+    assert _crc32_mpeg(b"") == 0xFFFFFFFF
+    assert _crc32_mpeg(b"\x00") == 0x4E08BFB4
+
+
+def _structure_checks(data: bytes):
+    assert len(data) % TS_PACKET == 0
+    pids = []
+    for off in range(0, len(data), TS_PACKET):
+        pkt = data[off:off + TS_PACKET]
+        assert pkt[0] == 0x47, f"sync byte lost at {off}"
+        pids.append(((pkt[1] & 0x1F) << 8) | pkt[2])
+    return pids
+
+
+def test_segment_structure_and_continuity():
+    mux = TsMuxer(has_video=True)
+    samples = [TsSample(b"\x00\x00\x00\x01\x65" + bytes(400), pts=0,
+                        is_idr=True),
+               TsSample(b"\x00\x00\x00\x01\x41" + bytes(10), pts=3000,
+                        is_idr=False)]
+    data = mux.mux_segment(video=samples)
+    pids = _structure_checks(data)
+    assert pids[0] == 0x0000 and pids[1] == 0x1000   # PAT then PMT first
+    assert 0x0100 in pids
+    # continuity counters increment mod 16 per PID
+    cc = {}
+    for off in range(0, len(data), TS_PACKET):
+        pkt = data[off:off + TS_PACKET]
+        pid = ((pkt[1] & 0x1F) << 8) | pkt[2]
+        c = pkt[3] & 0xF
+        if pid in cc:
+            assert c == (cc[pid] + 1) & 0xF, f"cc break on pid {pid:#x}"
+        cc[pid] = c
+
+
+@pytest.fixture(scope="session")
+def tsdec(tmp_path_factory):
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    exe = tmp_path_factory.mktemp("tsdec") / "tsdec"
+    proc = subprocess.run(
+        [cc, "-O2", "-o", str(exe), str(FIXTURES / "tsdec.c"),
+         "-lavformat", "-lavcodec", "-lavutil"], capture_output=True)
+    if proc.returncode != 0:
+        pytest.skip(f"tsdec build failed: {proc.stderr.decode()[:200]}")
+    return exe
+
+
+def test_ts_oracle_video_roundtrip(tsdec, tmp_path):
+    """Our encoder's frames muxed to TS decode bit-exactly via
+    libavformat+libavcodec."""
+    from vlog_tpu.codecs.h264.api import H264Encoder
+    from tests.fixtures.media import synthetic_yuv_frames
+
+    h, w, fps = 96, 128, 24
+    frames = synthetic_yuv_frames(6, w, h)
+    enc = H264Encoder(width=w, height=h, qp=28)
+    efs = enc.encode(*[np.stack(p) for p in zip(*frames)])
+
+    mux = TsMuxer(has_video=True)
+    ticks = 90000 // fps
+    samples = [TsSample(ef.annexb, pts=i * ticks, is_idr=ef.is_idr)
+               for i, ef in enumerate(efs)]
+    seg = tmp_path / "seg.ts"
+    seg.write_bytes(mux.mux_segment(video=samples))
+
+    out = tmp_path / "dec.yuv"
+    proc = subprocess.run([str(tsdec), str(seg), str(out)],
+                          capture_output=True, text=True, check=True)
+    assert "video=6" in proc.stdout
+    data = np.fromfile(out, np.uint8)
+    fs = h * w * 3 // 2
+    assert len(data) == 6 * fs
+    # bit-exact against a direct annexb decode of the same frames
+    from tests.test_h264_oracle import oracle_decode  # noqa: F401
+
+    for i in range(6):
+        got_y = data[i * fs:i * fs + h * w].reshape(h, w)
+        # decode the same annexb with our own decoder as reference recon
+        from vlog_tpu.codecs.h264.decoder import decode_annexb
+
+        ref, _ = decode_annexb(efs[i].annexb)
+        np.testing.assert_array_equal(got_y, ref[0].y, err_msg=f"frame {i}")
+
+
+def test_ts_oracle_audio_mux(tsdec, tmp_path):
+    """AAC-ADTS audio muxes into TS and is recognized by libavformat."""
+    from vlog_tpu.codecs.aac import AacEncoder
+
+    sr = 48000
+    t = np.arange(sr) / sr
+    pcm = 0.2 * np.sin(2 * np.pi * 440 * t)
+    enc = AacEncoder(sample_rate=sr, channels=2, bitrate=128_000)
+    adts = enc.encode_adts(np.stack([pcm, pcm]))
+
+    # split ADTS stream into frames by header syncword
+    frames = []
+    pos = 0
+    while pos + 7 <= len(adts):
+        assert adts[pos] == 0xFF and (adts[pos + 1] & 0xF0) == 0xF0
+        ln = ((adts[pos + 3] & 3) << 11) | (adts[pos + 4] << 3) \
+            | (adts[pos + 5] >> 5)
+        frames.append(adts[pos:pos + ln])
+        pos += ln
+    assert len(frames) > 10
+
+    mux = TsMuxer(has_video=False, has_audio=True)
+    ticks = 90000 * 1024 // sr
+    samples = [TsSample(f, pts=i * ticks) for i, f in enumerate(frames)]
+    seg = tmp_path / "aud.ts"
+    seg.write_bytes(mux.mux_segment(audio=samples))
+    proc = subprocess.run(
+        [str(tsdec), str(seg), str(tmp_path / "v.yuv"),
+         str(tmp_path / "a.pcm")],
+        capture_output=True, text=True, check=True)
+    assert "video=0" in proc.stdout
+    n_audio = int(proc.stdout.split("audio=")[1])
+    assert n_audio >= len(frames) - 2          # decoder may trim priming
+
+
+def test_process_video_hls_ts_end_to_end(tsdec, tmp_path):
+    """Full pipeline in legacy mode: TS segments + v3 playlists, no
+    init/DASH, segments demux+decode in libavformat."""
+    from tests.fixtures.media import make_y4m
+    from vlog_tpu.worker.pipeline import process_video
+
+    src = make_y4m(tmp_path / "s.y4m", n_frames=20, width=128, height=96,
+                   fps=10)
+    out = tmp_path / "out"
+    res = process_video(src, out, streaming_format="hls_ts",
+                        segment_duration_s=1.0, thumbnail=False)
+    rdir = out / "360p"
+    assert not (rdir / "init.mp4").exists()
+    assert not (out / "manifest.mpd").exists()
+    segs = sorted(rdir.glob("segment_*.ts"))
+    assert len(segs) == 2
+    pl = (rdir / "playlist.m3u8").read_text()
+    assert "EXT-X-MAP" not in pl and "segment_00001.ts" in pl
+    assert res.run.rungs[0].segment_count == 2
+
+    # oracle: concatenated segments decode to all 20 frames
+    cat = tmp_path / "all.ts"
+    cat.write_bytes(b"".join(s.read_bytes() for s in segs))
+    proc = subprocess.run([str(tsdec), str(cat), str(tmp_path / "d.yuv")],
+                          capture_output=True, text=True, check=True)
+    assert "video=20" in proc.stdout
+
+
+def test_backend_ts_muxes_audio_per_rung(tsdec, tmp_path):
+    """Audio ADTS passed via the plan is interleaved into the variant TS."""
+    from tests.fixtures.media import make_y4m
+    from vlog_tpu.backends import select_backend
+    from vlog_tpu.codecs.aac import AacEncoder
+    from vlog_tpu.codecs.aac.adts import split_adts_frames
+    from vlog_tpu.media.probe import get_video_info
+
+    src = make_y4m(tmp_path / "s.y4m", n_frames=10, width=64, height=48,
+                   fps=10)
+    sr = 48000
+    t = np.arange(sr) / sr
+    pcm = np.stack([0.2 * np.sin(2 * np.pi * 330 * t)] * 2)
+    frames = split_adts_frames(
+        AacEncoder(sample_rate=sr, channels=2,
+                   bitrate=96_000).encode_adts(pcm))
+    be = select_backend()
+    plan = be.plan(get_video_info(src), None, tmp_path / "out",
+                   streaming_format="hls_ts", segment_duration_s=1.0,
+                   thumbnail=False)
+    plan.audio_adts = {plan.rungs[0].audio_bitrate: (frames, sr)}
+    be.run(plan, resume=False)
+    seg = tmp_path / "out" / plan.rungs[0].name / "segment_00001.ts"
+    proc = subprocess.run([str(tsdec), str(seg), str(tmp_path / "d.yuv"),
+                           str(tmp_path / "a.pcm")],
+                          capture_output=True, text=True, check=True)
+    assert "video=10" in proc.stdout
+    n_audio = int(proc.stdout.split("audio=")[1])
+    assert n_audio > 20            # ~47 ADTS frames in the 1s window

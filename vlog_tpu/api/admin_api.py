@@ -211,6 +211,67 @@ async def retranscode(request: web.Request) -> web.Response:
     return web.json_response({"job_id": job_id})
 
 
+async def reencode(request: web.Request) -> web.Response:
+    """Queue a format/codec conversion (reference reencode queue,
+    admin.py:6297-6687)."""
+    db = request.app[DB]
+    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    if video is None:
+        return _json_error(404, "no such video")
+    body = await request.json() if request.can_read_body else {}
+    fmt = body.get("streaming_format", "cmaf")
+    codec = body.get("codec", "h264")
+    if fmt not in ("cmaf", "hls_ts"):
+        return _json_error(400, f"unknown streaming_format {fmt!r}")
+    if codec != "h264":
+        return _json_error(
+            400, f"codec {codec!r} has no first-party encoder yet")
+    try:
+        job_id = await claims.enqueue_job(
+            db, video["id"], JobKind.REENCODE,
+            payload={"streaming_format": fmt, "codec": codec},
+            force=bool(body.get("force")))
+    except js.JobStateError as exc:
+        return _json_error(409, str(exc))
+    return web.json_response({"job_id": job_id})
+
+
+async def failed_jobs(request: web.Request) -> web.Response:
+    """The dead-letter view: terminally failed jobs with their errors
+    (reference dead-letter admin, admin.py:8934-9228)."""
+    db = request.app[DB]
+    rows = await db.fetch_all(
+        """
+        SELECT j.*, v.slug, v.title FROM jobs j
+        JOIN videos v ON v.id = j.video_id
+        WHERE j.failed_at IS NOT NULL
+        ORDER BY j.failed_at DESC LIMIT 200
+        """)
+    return web.json_response({"jobs": rows})
+
+
+async def requeue_job(request: web.Request) -> web.Response:
+    """Return a dead-lettered job to the claimable pool with a fresh
+    retry budget."""
+    db = request.app[DB]
+    job_id = int(request.match_info["job_id"])
+    job = await db.fetch_one("SELECT * FROM jobs WHERE id=:id",
+                             {"id": job_id})
+    if job is None:
+        return _json_error(404, "no such job")
+    if job["failed_at"] is None:
+        return _json_error(409, "job is not dead-lettered")
+    await db.execute(
+        """
+        UPDATE jobs SET failed_at=NULL, error=NULL, attempt=0,
+               progress=0.0, current_step=NULL, updated_at=:t
+        WHERE id=:id
+        """, {"t": db_now(), "id": job_id})
+    if JobKind(job["kind"]) is JobKind.TRANSCODE:
+        await vids.set_status(db, job["video_id"], VideoStatus.PENDING)
+    return web.json_response({"ok": True})
+
+
 async def delete_video(request: web.Request) -> web.Response:
     """Soft delete (reference admin.py:2500: restorable)."""
     db = request.app[DB]
@@ -380,6 +441,9 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_get("/api/videos", list_videos)
     r.add_get("/api/videos/{video_id:\\d+}", video_detail)
     r.add_post("/api/videos/{video_id:\\d+}/retranscode", retranscode)
+    r.add_post("/api/videos/{video_id:\\d+}/reencode", reencode)
+    r.add_get("/api/jobs/failed", failed_jobs)
+    r.add_post("/api/jobs/{job_id:\\d+}/requeue", requeue_job)
     r.add_delete("/api/videos/{video_id:\\d+}", delete_video)
     r.add_post("/api/videos/{video_id:\\d+}/restore", restore_video)
     r.add_get("/api/events/progress", sse_progress)

@@ -261,7 +261,8 @@ async def complete(request: web.Request) -> web.Response:
         # ownership inside its transaction, so a stale worker that lost the
         # claim gets its 409 before any published state changes.
         await claims.complete_job(db, job_id, worker)
-        if kind is JobKind.TRANSCODE:
+        if kind in (JobKind.TRANSCODE, JobKind.REENCODE):
+            reenc = kind is JobKind.REENCODE
             qualities = [
                 {**q, "playlist_path":
                  str(out_dir / q["quality"] / "playlist.m3u8")}
@@ -270,8 +271,12 @@ async def complete(request: web.Request) -> web.Response:
             await finalize_transcode(
                 db, job, video, probe=result.get("probe") or {},
                 qualities=qualities,
-                thumbnail_path=str(out_dir / thumb) if thumb else None)
-            events.append(("video.ready", {
+                thumbnail_path=str(out_dir / thumb) if thumb else None,
+                streaming_format=result.get("streaming_format")
+                if reenc else None,
+                codec=result.get("codec") if reenc else None,
+                enqueue_downstream=not reenc)
+            events.append(("video.reencoded" if reenc else "video.ready", {
                 "video_id": video["id"], "slug": video["slug"],
                 "qualities": [q["quality"] for q in qualities]}))
         elif kind is JobKind.TRANSCRIPTION:

@@ -81,6 +81,10 @@ class ExecutionPlan:
     # I+P chain length; 1 = all-intra. Always divides frames-per-segment
     # so every CMAF segment starts on an IDR.
     gop_len: int = 1
+    # hls_ts mode: {audio_bitrate: (list_of_adts_frames, sample_rate)} —
+    # classic HLS muxes audio INTO each variant's TS segments, so the
+    # pipeline pre-encodes ADTS and the backend interleaves per segment.
+    audio_adts: dict | None = None
 
 
 @dataclass

@@ -134,6 +134,8 @@ class JaxBackend:
             total_frames=source.frame_count,
             thumbnail=opts.get("thumbnail", True),
             gop_len=gop_len,
+            streaming_format=opts.get("streaming_format",
+                                      config.STREAMING_FORMAT),
         )
 
     # ------------------------------------------------------------------
@@ -147,6 +149,9 @@ class JaxBackend:
         frames_per_seg = max(1, round(plan.segment_duration_s * fps))
         timescale = plan.fps_num * 1000
         frame_dur = plan.fps_den * 1000
+        # Legacy HLS: MPEG-TS segments with muxed audio, no init/DASH.
+        ts_mode = plan.streaming_format == "hls_ts"
+        seg_ext = "ts" if ts_mode else "m4s"
 
         encoders: dict[str, H264Encoder] = {}
         tracks: dict[str, TrackConfig] = {}
@@ -167,16 +172,19 @@ class JaxBackend:
             )
             rdir = out / rung.name
             rdir.mkdir(parents=True, exist_ok=True)
-            atomic_write_bytes(rdir / "init.mp4",
-                               init_segment(tracks[rung.name]))
+            if not ts_mode:
+                atomic_write_bytes(rdir / "init.mp4",
+                                   init_segment(tracks[rung.name]))
             seg_counts[rung.name] = 0
             seg_durs[rung.name] = []
             bytes_written[rung.name] = 0
             psnr_acc[rung.name] = []
 
         # --- resume point: first segment index any rung is missing.
+        # (TS mode restarts from 0: continuity counters span the whole
+        # playlist, so a fresh muxer cannot append mid-stream.)
         start_segment = 0
-        if resume:
+        if resume and not ts_mode:
             per_rung = {r.name: self._existing_segments(out / r.name)
                         for r in plan.rungs}
             start_segment = min(len(d) for d in per_rung.values())
@@ -194,6 +202,57 @@ class JaxBackend:
         pending: dict[str, list[Sample]] = {r.name: [] for r in plan.rungs}
         frames_done = start_frame
         thumb_path = None
+
+        # --- TS-mode segment writer state (muxers persist across
+        # segments for playlist-wide continuity counters).
+        from vlog_tpu.media.ts import TsMuxer, TsSample
+
+        audio_by_rate = plan.audio_adts or {}
+        ts_muxers: dict[str, TsMuxer] = {}
+        ts_frame_idx = {r.name: start_frame for r in plan.rungs}
+        ts_audio_idx = {r.name: 0 for r in plan.rungs}
+
+        # Exact 90 kHz timestamps: multiply BEFORE dividing, per index —
+        # a pre-truncated per-frame tick drifts A/V apart on non-integer
+        # rates (23.976 fps / 44.1 kHz) by ~1 s/hour.
+        def vpts(idx: int) -> int:
+            return idx * 90000 * plan.fps_den // plan.fps_num
+
+        def apts(idx: int, sr: int) -> int:
+            return idx * 90000 * 1024 // sr
+
+        def write_segment(rung: PlannedRung, chunk: list[Sample]) -> None:
+            name = rung.name
+            if not ts_mode:
+                self._write_segment(out, rung, tracks[name], seg_counts,
+                                    seg_durs, bytes_written, chunk,
+                                    timescale)
+                return
+            audio = audio_by_rate.get(rung.audio_bitrate)
+            mux = ts_muxers.get(name)
+            if mux is None:
+                mux = ts_muxers[name] = TsMuxer(has_video=True,
+                                                has_audio=audio is not None)
+            i0 = ts_frame_idx[name]
+            vsamples = [TsSample(s.data, pts=vpts(i0 + k), is_idr=s.is_sync)
+                        for k, s in enumerate(chunk)]
+            ts_frame_idx[name] = i0 + len(chunk)
+            asamples = []
+            if audio is not None:
+                frames, sr = audio
+                t_end = vpts(ts_frame_idx[name])
+                j = ts_audio_idx[name]
+                while j < len(frames) and apts(j, sr) < t_end:
+                    asamples.append(TsSample(frames[j], pts=apts(j, sr)))
+                    j += 1
+                ts_audio_idx[name] = j
+            data = mux.mux_segment(video=vsamples, audio=asamples or None)
+            idx = seg_counts[name]
+            path = out / name / f"segment_{idx + 1:05d}.ts"
+            atomic_write_bytes(path, data)
+            seg_counts[name] = idx + 1
+            seg_durs[name].append(sum(s.duration for s in chunk) / timescale)
+            bytes_written[name] += len(data)
 
         # --- the one-pass ladder program: ONE dispatch per GOP batch
         # emits quantized levels for EVERY rung (SURVEY §2d.2); frames
@@ -311,8 +370,8 @@ class JaxBackend:
                         pool=entropy_pool)
                     for ef in efs:
                         pending[name].append(
-                            Sample(data=ef.avcc, duration=frame_dur,
-                                   is_sync=ef.is_idr))
+                            Sample(data=ef.annexb if ts_mode else ef.avcc,
+                                   duration=frame_dur, is_sync=ef.is_idr))
                         psnr_acc[name].append(ef.psnr_y)
                         batch_bytes += len(ef.avcc)
                     n_frames += keep
@@ -320,9 +379,7 @@ class JaxBackend:
                 while len(pending[name]) >= frames_per_seg:
                     chunk = pending[name][:frames_per_seg]
                     pending[name] = pending[name][frames_per_seg:]
-                    self._write_segment(out, rung, tracks[name],
-                                        seg_counts, seg_durs,
-                                        bytes_written, chunk, timescale)
+                    write_segment(rung, chunk)
             frames_done += n_real
             if progress_cb:
                 progress_cb(frames_done, total,
@@ -348,17 +405,15 @@ class JaxBackend:
                 batch_bytes = 0
                 for ef in frames:
                     pending[name].append(
-                        Sample(data=ef.avcc, duration=frame_dur,
-                               is_sync=ef.is_idr))
+                        Sample(data=ef.annexb if ts_mode else ef.avcc,
+                               duration=frame_dur, is_sync=ef.is_idr))
                     psnr_acc[name].append(ef.psnr_y)
                     batch_bytes += len(ef.avcc)
                 controllers[name].observe(batch_bytes, n_real)
                 while len(pending[name]) >= frames_per_seg:
                     chunk = pending[name][:frames_per_seg]
                     pending[name] = pending[name][frames_per_seg:]
-                    self._write_segment(out, rung, tracks[name],
-                                        seg_counts, seg_durs,
-                                        bytes_written, chunk, timescale)
+                    write_segment(rung, chunk)
             frames_done += n_real
             if progress_cb:
                 progress_cb(frames_done, total,
@@ -432,9 +487,7 @@ class JaxBackend:
             # Flush trailing partial segments.
             for rung in plan.rungs:
                 if pending[rung.name]:
-                    self._write_segment(out, rung, tracks[rung.name],
-                                        seg_counts, seg_durs, bytes_written,
-                                        pending[rung.name], timescale)
+                    write_segment(rung, pending[rung.name])
                     pending[rung.name] = []
         finally:
             stop_decode.set()
@@ -455,11 +508,11 @@ class JaxBackend:
             name = rung.name
             enc = encoders[name]
             playlist = hls.media_playlist(
-                [hls.SegmentRef(uri=f"segment_{i + 1:05d}.m4s",
+                [hls.SegmentRef(uri=f"segment_{i + 1:05d}.{seg_ext}",
                                 duration_s=seg_durs[name][i])
                  for i in range(seg_counts[name])],
                 target_duration_s=plan.segment_duration_s,
-                init_uri="init.mp4",
+                init_uri=None if ts_mode else "init.mp4",
             )
             ppath = out / name / "playlist.m3u8"
             atomic_write_text(ppath, playlist)
@@ -475,18 +528,27 @@ class JaxBackend:
                 playlist_path=str(ppath),
                 target_bitrate=rung.video_bitrate,
             ))
+            # TS variants carry muxed AAC: CODECS must list every format
+            # present (RFC 8216) and BANDWIDTH must include the audio.
+            muxed = ts_mode and rung.audio_bitrate in audio_by_rate
             variants.append(hls.VariantRef(
                 name=name, uri=f"{name}/playlist.m3u8",
-                bandwidth=max(achieved, 1), width=rung.width,
-                height=rung.height, codecs=enc.codec_string,
+                bandwidth=max(achieved, 1)
+                + (rung.audio_bitrate if muxed else 0),
+                width=rung.width,
+                height=rung.height,
+                codecs=(enc.codec_string + ",mp4a.40.2" if muxed
+                        else enc.codec_string),
                 frame_rate=fps,
-                audio_group=(f"aud{rung.audio_bitrate // 1000}"
-                             if rung.audio_bitrate else ""),
+                audio_group=("" if ts_mode else
+                             (f"aud{rung.audio_bitrate // 1000}"
+                              if rung.audio_bitrate else "")),
             ))
         atomic_write_text(out / "master.m3u8", hls.master_playlist(variants))
-        atomic_write_text(out / "manifest.mpd", hls.dash_manifest(
-            variants, duration_s=duration_s,
-            segment_duration_s=plan.segment_duration_s))
+        if not ts_mode:      # DASH is CMAF-only; legacy TS serves HLS alone
+            atomic_write_text(out / "manifest.mpd", hls.dash_manifest(
+                variants, duration_s=duration_s,
+                segment_duration_s=plan.segment_duration_s))
 
         return RunResult(
             rungs=results, frames_processed=frames_done,

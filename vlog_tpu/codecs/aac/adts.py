@@ -66,6 +66,24 @@ def adts_header(config: AacConfig, frame_len: int) -> bytes:
     return bytes(h)
 
 
+def split_adts_frames(data: bytes) -> list[bytes]:
+    """ADTS stream -> whole frames WITH headers (what TS carriage needs:
+    stream_type 0x0F is ADTS-framed AAC, ISO 13818-7)."""
+    frames = []
+    i = 0
+    n = len(data)
+    while i + 7 <= n:
+        if data[i] != 0xFF or (data[i + 1] & 0xF0) != 0xF0:
+            raise ValueError(f"bad ADTS syncword at {i}")
+        full = ((data[i + 3] & 0x3) << 11) | (data[i + 4] << 3) \
+            | (data[i + 5] >> 5)
+        if full < 7 or i + full > n:
+            raise ValueError("truncated ADTS frame")
+        frames.append(data[i:i + full])
+        i += full
+    return frames
+
+
 def split_adts(data: bytes) -> tuple[AacConfig, list[bytes]]:
     """ADTS stream -> (config, [raw_data_block payloads])."""
     frames = []

@@ -1,0 +1,101 @@
+"""Operational alert webhooks with per-key rate limiting.
+
+Reference parity: worker/alerts.py:95-427 — fire-and-forget webhook
+notifications for operational events (worker startup/shutdown, permanent
+job failures, stale-job recovery), rate-limited per alert key so a
+crash-looping job cannot flood the channel, with an in-process counter
+for observability. Target URL comes from ``VLOG_ALERT_WEBHOOK_URL``;
+unset = alerts disabled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+
+import aiohttp
+
+log = logging.getLogger("vlog_tpu.alerts")
+
+DEFAULT_MIN_INTERVAL_S = 300.0
+ALERT_TIMEOUT_S = 10.0
+
+
+@dataclass
+class AlertMetrics:
+    sent: int = 0
+    suppressed: int = 0
+    errors: int = 0
+
+
+@dataclass
+class AlertSink:
+    """Rate-limited alert sender; safe to call from any coroutine."""
+
+    url: str | None = field(
+        default_factory=lambda: os.environ.get("VLOG_ALERT_WEBHOOK_URL"))
+    min_interval_s: float = DEFAULT_MIN_INTERVAL_S
+    source: str = "vlog-tpu"
+
+    def __post_init__(self) -> None:
+        self.metrics = AlertMetrics()
+        self._last_sent: dict[str, float] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.url)
+
+    def _allowed(self, key: str) -> bool:
+        now = time.monotonic()
+        last = self._last_sent.get(key)
+        if last is not None and now - last < self.min_interval_s:
+            self.metrics.suppressed += 1
+            return False
+        self._last_sent[key] = now
+        return True
+
+    async def send(self, alert: str, message: str,
+                   details: dict | None = None, *,
+                   key: str | None = None) -> bool:
+        """POST one alert; returns True when actually sent."""
+        if not self.enabled or not self._allowed(key or alert):
+            return False
+        body = json.dumps({
+            "alert": alert,
+            "message": message,
+            "source": self.source,
+            "timestamp": time.time(),
+            "details": details or {},
+        }).encode()
+        try:
+            timeout = aiohttp.ClientTimeout(total=ALERT_TIMEOUT_S)
+            async with aiohttp.ClientSession(timeout=timeout) as s:
+                async with s.post(self.url, data=body, headers={
+                        "Content-Type": "application/json"}) as resp:
+                    ok = 200 <= resp.status < 300
+        except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+            log.debug("alert %s failed: %s", alert, exc)
+            ok = False
+        if ok:
+            self.metrics.sent += 1
+        else:
+            self.metrics.errors += 1
+        return ok
+
+    def send_fire_and_forget(self, alert: str, message: str,
+                             details: dict | None = None, *,
+                             key: str | None = None) -> None:
+        """Schedule without awaiting (reference
+        send_alert_fire_and_forget, alerts.py:193)."""
+        if not self.enabled:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        task = loop.create_task(self.send(alert, message, details, key=key))
+        task.add_done_callback(lambda t: t.exception())

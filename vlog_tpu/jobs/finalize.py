@@ -26,11 +26,16 @@ async def finalize_transcode(
     probe: Any,
     qualities: list[dict],
     thumbnail_path: str | None,
+    streaming_format: str | None = None,
+    codec: str | None = None,
+    enqueue_downstream: bool = True,
 ) -> None:
     """Publish a completed transcode.
 
     ``probe`` is either a VideoInfo or a plain dict (the HTTP body from a
-    remote worker).
+    remote worker). Reencodes pass ``enqueue_downstream=False`` — sprites
+    and transcription derive from the unchanged source, so re-running
+    them would burn accelerator hours for identical output.
     """
     if isinstance(probe, dict):
         probe = SimpleNamespace(
@@ -42,14 +47,17 @@ async def finalize_transcode(
         )
     await vids.finalize_ready(
         db, video["id"], probe=probe, qualities=qualities,
-        thumbnail_path=thumbnail_path)
+        thumbnail_path=thumbnail_path, streaming_format=streaming_format,
+        codec=codec)
     rung_names = [q["quality"] for q in qualities]
     for rn in rung_names:
         await claims.upsert_quality_progress(
             db, job["id"], rn, status="completed", progress=100.0)
-    await claims.enqueue_job(db, video["id"], JobKind.SPRITE)
-    if config.TRANSCRIPTION_ENABLED and getattr(probe, "audio_codec", None):
-        await claims.enqueue_job(db, video["id"], JobKind.TRANSCRIPTION)
+    if enqueue_downstream:
+        await claims.enqueue_job(db, video["id"], JobKind.SPRITE)
+        if config.TRANSCRIPTION_ENABLED and getattr(probe, "audio_codec",
+                                                    None):
+            await claims.enqueue_job(db, video["id"], JobKind.TRANSCRIPTION)
 
 
 async def finalize_transcription(

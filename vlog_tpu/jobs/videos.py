@@ -92,20 +92,29 @@ async def finalize_ready(
     probe: Any,                      # media.probe.VideoInfo
     qualities: list[dict],
     thumbnail_path: str | None,
+    streaming_format: str | None = None,
+    codec: str | None = None,
 ) -> None:
-    """Publish the transcode result (reference transcoder.py:2772-2867)."""
+    """Publish the transcode result (reference transcoder.py:2772-2867).
+
+    ``streaming_format``/``codec`` flip atomically WITH status=ready (the
+    reencode path: the row must never say ready in one format while the
+    tree holds another)."""
     t = db_now()
     async with db.transaction() as tx:
         await tx.execute(
             """
             UPDATE videos SET status='ready', error=NULL, duration_s=:dur,
                    width=:w, height=:h, fps=:fps, thumbnail_path=:thumb,
+                   streaming_format=COALESCE(:fmt, streaming_format),
+                   codec=COALESCE(:codec, codec),
                    updated_at=:t
             WHERE id=:id
             """,
             {
                 "dur": probe.duration_s, "w": probe.width, "h": probe.height,
                 "fps": probe.fps, "thumb": thumbnail_path, "t": t,
+                "fmt": streaming_format, "codec": codec,
                 "id": video_id,
             },
         )

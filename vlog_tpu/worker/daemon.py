@@ -54,13 +54,35 @@ class DaemonStats:
 EventFn = Callable[[str, dict], Awaitable[None]]
 
 
+def _cleanup_other_format(out_dir: Path, new_fmt: str) -> None:
+    """After a format conversion, remove the replaced format's artifacts
+    (stale manifest.mpd / init.mp4 / segments of the other container)."""
+    if new_fmt == "hls_ts":
+        (out_dir / "manifest.mpd").unlink(missing_ok=True)
+        for rung_dir in out_dir.iterdir():
+            if rung_dir.is_dir():
+                (rung_dir / "init.mp4").unlink(missing_ok=True)
+                for seg in rung_dir.glob("segment_*.m4s"):
+                    seg.unlink(missing_ok=True)
+        for adir in out_dir.glob("audio_*"):
+            if adir.is_dir():
+                import shutil as _shutil
+
+                _shutil.rmtree(adir, ignore_errors=True)
+    else:
+        for rung_dir in out_dir.iterdir():
+            if rung_dir.is_dir():
+                for seg in rung_dir.glob("segment_*.ts"):
+                    seg.unlink(missing_ok=True)
+
+
 @dataclass
 class WorkerDaemon:
     db: Database
     name: str
     accelerator: AcceleratorKind = AcceleratorKind.TPU
-    kinds: tuple[JobKind, ...] = (JobKind.TRANSCODE, JobKind.SPRITE,
-                                  JobKind.TRANSCRIPTION)
+    kinds: tuple[JobKind, ...] = (JobKind.TRANSCODE, JobKind.REENCODE,
+                                  JobKind.SPRITE, JobKind.TRANSCRIPTION)
     video_dir: Path = field(default_factory=lambda: config.VIDEO_DIR)
     backend: Any = None                    # backends.Backend; lazy-selected
     poll_interval_s: float = field(
@@ -202,6 +224,7 @@ class WorkerDaemon:
             return
         handler = {
             JobKind.TRANSCODE: self._run_transcode,
+            JobKind.REENCODE: self._run_reencode,
             JobKind.SPRITE: self._run_sprites,
             JobKind.TRANSCRIPTION: self._run_transcription,
         }[kind]
@@ -230,8 +253,10 @@ class WorkerDaemon:
             log.exception("job %s failed", job["id"])
             await self._fail(job, video, f"{type(exc).__name__}: {exc}")
 
-    async def _fail(self, job: Row, video: Row, error: str) -> None:
-        row = await claims.fail_job(self.db, job["id"], self.name, error)
+    async def _fail(self, job: Row, video: Row, error: str, *,
+                    permanent: bool = False) -> None:
+        row = await claims.fail_job(self.db, job["id"], self.name, error,
+                                    permanent=permanent)
         self.stats.failed += 1
         self.stats.last_error = error
         terminal = row["failed_at"] is not None
@@ -367,6 +392,62 @@ class WorkerDaemon:
             "video_id": video["id"], "slug": video["slug"],
             "qualities": [q["quality"] for q in result.qualities]})
 
+    async def _run_reencode(self, job: Row, video: Row) -> None:
+        """Format/codec conversion job (reference reencode_worker.py:49-508:
+        legacy HLS/TS -> CMAF and codec upgrades). The best source is the
+        original upload when kept; the whole ladder re-runs with the
+        requested parameters and the video row flips format atomically at
+        finalize."""
+        import json as _json
+
+        from vlog_tpu.media.probe import get_video_info
+        from vlog_tpu.worker.pipeline import process_video
+
+        payload = _json.loads(job["payload"] or "{}")
+        fmt = payload.get("streaming_format", "cmaf")
+        codec = payload.get("codec", "h264")
+        if codec != "h264":
+            await self._fail(job, video,
+                             f"codec {codec!r} has no first-party encoder yet",
+                             permanent=True)
+            return
+        source = video["source_path"]
+        if not source or not Path(source).exists():
+            await self._fail(job, video, f"source missing: {source}")
+            return
+        info = await asyncio.to_thread(get_video_info, source)
+        rungs = config.ladder_for_source(info.height)
+        timeout = config.transcode_timeout_s(info.duration_s, rungs[0].name)
+        out_dir = self.video_dir / video["slug"]
+        cb = self._make_progress_cb(job["id"], info.frame_count,
+                                    [r.name for r in rungs])
+
+        def work():
+            # resume=False: the output tree changes shape across formats
+            return process_video(source, out_dir, backend=self.backend,
+                                 progress_cb=cb, rungs=rungs, resume=False,
+                                 streaming_format=fmt)
+
+        result = await self._run_with_timeout(work, timeout, "reencode")
+        # Drop the previous format's leftovers so clients can never follow
+        # stale manifests into a mixed tree.
+        _cleanup_other_format(out_dir, fmt)
+        qualities = [
+            {**q, "playlist_path": str(out_dir / q["quality"] / "playlist.m3u8")}
+            for q in result.qualities
+        ]
+        from vlog_tpu.jobs.finalize import finalize_transcode
+
+        await finalize_transcode(
+            self.db, job, video, probe=result.source, qualities=qualities,
+            thumbnail_path=result.run.thumbnail_path,
+            streaming_format=fmt, codec=codec, enqueue_downstream=False)
+        await claims.complete_job(self.db, job["id"], self.name)
+        self.stats.completed += 1
+        await self._emit("video.reencoded", {
+            "video_id": video["id"], "slug": video["slug"],
+            "streaming_format": fmt, "codec": codec})
+
     async def _run_sprites(self, job: Row, video: Row) -> None:
         from vlog_tpu.worker.sprites import generate_sprites
 
@@ -459,7 +540,21 @@ async def _amain(args: argparse.Namespace) -> None:
         from vlog_tpu.backends import select_backend
         backend = select_backend(args.backend or None)
 
+    from vlog_tpu.jobs.alerts import AlertSink
     from vlog_tpu.jobs.webhooks import make_event_hook
+    from vlog_tpu.worker.health import WorkerHealthServer
+
+    alerts = AlertSink(source=args.name)
+    webhook_hook = make_event_hook(db)
+
+    async def on_event(event: str, payload: dict) -> None:
+        await webhook_hook(event, payload)
+        if event == "job.failed_permanently":
+            alerts.send_fire_and_forget(
+                "job.failed_permanently",
+                f"job {payload.get('job_id')} ({payload.get('kind')}) "
+                f"exhausted retries: {payload.get('error')}",
+                payload, key=f"jobfail:{payload.get('kind')}")
 
     daemon = WorkerDaemon(
         db, name=args.name,
@@ -467,15 +562,30 @@ async def _amain(args: argparse.Namespace) -> None:
         kinds=tuple(JobKind(k) for k in args.kinds.split(",")),
         backend=backend,
         transcription_model_dir=args.whisper_dir,
-        on_event=make_event_hook(db),
+        on_event=on_event,
     )
+
+    async def ready() -> tuple[bool, str]:
+        try:
+            await db.fetch_val("SELECT 1")
+        except Exception as exc:  # noqa: BLE001
+            return False, f"db unreachable: {exc}"
+        return True, "ok"
+
+    health = WorkerHealthServer(ready)
+    await health.start()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, daemon.request_stop)
     log.info("worker %s starting (kinds=%s)", args.name, args.kinds)
+    alerts.send_fire_and_forget("worker.startup",
+                                f"worker {args.name} starting")
     try:
         await daemon.run()
     finally:
+        await alerts.send("worker.shutdown",
+                          f"worker {args.name} stopping: {daemon.stats}")
+        await health.stop()
         await db.disconnect()
     log.info("worker %s stopped: %s", args.name, daemon.stats)
 
@@ -486,7 +596,8 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--db", default=config.DATABASE_URL)
     parser.add_argument("--accelerator", default="tpu",
                         choices=[a.value for a in AcceleratorKind])
-    parser.add_argument("--kinds", default="transcode,sprite,transcription")
+    parser.add_argument("--kinds",
+                        default="transcode,reencode,sprite,transcription")
     parser.add_argument("--backend", default="",
                         help="force a registered backend by name")
     parser.add_argument("--no-backend", action="store_true",

@@ -1,0 +1,65 @@
+"""Worker liveness/readiness probes for orchestrators.
+
+Reference parity: worker/health_server.py:22-144 — a tiny HTTP server in
+the worker process: ``/health`` answers while the event loop is alive
+(k8s livenessProbe), ``/ready`` additionally checks the worker's
+dependencies (DB reachable for local daemons, API heartbeat age for
+remote workers — the ffmpeg-present check maps to the accelerator
+backend having initialized). Port via ``VLOG_WORKER_HEALTH_PORT``
+(0 = disabled).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Awaitable, Callable
+
+from aiohttp import web
+
+log = logging.getLogger("vlog_tpu.worker.health")
+
+# async () -> (ready: bool, detail: str)
+ReadyFn = Callable[[], Awaitable[tuple[bool, str]]]
+
+
+class WorkerHealthServer:
+    def __init__(self, ready_fn: ReadyFn, *, port: int | None = None,
+                 host: str = "0.0.0.0"):
+        self.ready_fn = ready_fn
+        self.port = port if port is not None else int(
+            os.environ.get("VLOG_WORKER_HEALTH_PORT", "0"))
+        self.host = host
+        self.started_at = time.time()
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> bool:
+        if not self.port:
+            return False
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/ready", self._ready)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        log.info("worker health server on :%d", self.port)
+        return True
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "ok": True, "uptime_s": round(time.time() - self.started_at, 1)})
+
+    async def _ready(self, request: web.Request) -> web.Response:
+        try:
+            ok, detail = await self.ready_fn()
+        except Exception as exc:  # noqa: BLE001 — readiness must not crash
+            ok, detail = False, f"{type(exc).__name__}: {exc}"
+        return web.json_response({"ready": ok, "detail": detail},
+                                 status=200 if ok else 503)

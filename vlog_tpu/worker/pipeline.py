@@ -105,12 +105,32 @@ def process_video(
     # Step 3: ladder (+ thumbnail + per-rung playlists + master/DASH)
     be = backend or select_backend()
     plan = be.plan(info, rungs, out_dir, **plan_opts)
+    if plan.streaming_format == "hls_ts" and audio and info.audio_codec:
+        # Classic HLS muxes audio INTO each variant's TS; pre-encode one
+        # ADTS stream per distinct ladder audio bitrate for the backend
+        # to interleave (reference hwaccel.py legacy `-c:a aac -b:a`).
+        from vlog_tpu.codecs.aac import AacEncoder
+        from vlog_tpu.codecs.aac.adts import split_adts_frames
+        from vlog_tpu.media.audio import extract_audio
+        from vlog_tpu.worker.audio import normalize_for_encode
+
+        src_audio = extract_audio(source_path)
+        if src_audio is not None and src_audio.pcm.size:
+            norm = normalize_for_encode(src_audio)
+            plan.audio_adts = {}
+            for rate in sorted({r.audio_bitrate for r in plan.rungs
+                                if r.audio_bitrate}):
+                aenc = AacEncoder(sample_rate=norm.sample_rate, channels=2,
+                                  bitrate=rate)
+                frames = split_adts_frames(aenc.encode_adts(norm.pcm))
+                plan.audio_adts[rate] = (frames, norm.sample_rate)
     run = be.run(plan, progress_cb, resume=resume)
 
     # Step 3b: audio rendition group (one per distinct ladder audio
     # bitrate), then re-emit master/DASH including the audio tracks.
+    # (hls_ts mode muxed audio into the variants above instead.)
     audio_refs: list[hls.AudioRendition] = []
-    if audio and info.audio_codec:
+    if audio and info.audio_codec and plan.streaming_format != "hls_ts":
         from vlog_tpu.media.audio import extract_audio
         from vlog_tpu.worker.audio import encode_audio_renditions
 
@@ -131,11 +151,14 @@ def process_video(
 
     # Step 4: verification (validate_hls_playlist analog)
     master = out_dir / "master.m3u8"
+    expect_cmaf = plan.streaming_format == "cmaf"
     try:
         variant_results = hls.validate_master_playlist(master)
         for uri, res in variant_results.items():
-            if not res["cmaf"]:
-                raise VerificationError(f"{uri}: expected CMAF variant")
+            if res["cmaf"] != expect_cmaf:
+                raise VerificationError(
+                    f"{uri}: expected "
+                    f"{'CMAF' if expect_cmaf else 'TS'} variant")
     except (hls.PlaylistValidationError, OSError) as exc:
         raise VerificationError(str(exc)) from exc
 

@@ -184,6 +184,15 @@ class WorkerAPIClient:
                                 f"/api/worker/upload/{video_id}/status")
         return r.json()["files"]
 
+    async def healthz(self) -> bool:
+        """Side-effect-free reachability check (readiness probes must NOT
+        go through /heartbeat, whose write would mask a wedged worker)."""
+        try:
+            r = await self._client.get("/healthz")
+            return r.status_code == 200
+        except httpx.TransportError:
+            return False
+
 
 # --------------------------------------------------------------------------
 # Streaming uploader: publish outputs while the transcode is still running
@@ -279,6 +288,7 @@ class RemoteWorker:
     accelerator: AcceleratorKind = AcceleratorKind.TPU
     kinds: tuple[JobKind, ...] = (JobKind.TRANSCODE, JobKind.SPRITE,
                                   JobKind.TRANSCRIPTION)
+    # REENCODE is opt-in for remote workers (payload-dependent formats)
     backend: Any = None
     poll_interval_s: float = field(
         default_factory=lambda: config.WORKER_POLL_INTERVAL_S)
@@ -446,6 +456,7 @@ class RemoteWorker:
     async def _dispatch(self, job: dict, video: dict) -> None:
         handler = {
             JobKind.TRANSCODE: self._run_transcode,
+            JobKind.REENCODE: self._run_reencode,
             JobKind.SPRITE: self._run_sprites,
             JobKind.TRANSCRIPTION: self._run_transcription,
         }[JobKind(job["kind"])]
@@ -503,6 +514,58 @@ class RemoteWorker:
         self.stats.completed += 1
         log.info("job %s complete: %d files, %d bytes streamed",
                  job["id"], len(uploader.uploaded), uploader.bytes_sent)
+
+    async def _run_reencode(self, job: dict, video: dict) -> None:
+        """Format conversion over HTTP: like transcode, but with the
+        payload's container/codec and no downstream re-derivation."""
+        from vlog_tpu.media.probe import get_video_info
+        from vlog_tpu.worker.pipeline import process_video
+
+        payload = job.get("payload") or {}
+        fmt = payload.get("streaming_format", "cmaf")
+        codec = payload.get("codec", "h264")
+        if codec != "h264":
+            await self._safe_fail(
+                job["id"], f"codec {codec!r} has no first-party encoder yet",
+                permanent=True)
+            return
+        src = await self._fetch_source(video)
+        out_dir = self._job_dir(video) / "out"
+        info = await asyncio.to_thread(get_video_info, str(src))
+        rungs = config.ladder_for_source(info.height)
+        timeout = config.transcode_timeout_s(info.duration_s, rungs[0].name)
+        cb = self._make_progress_cb(job["id"], [r.name for r in rungs])
+
+        uploader = StreamingUploader(self.client, video["id"], out_dir,
+                                     skip_prefixes=("original",))
+        up_task = asyncio.create_task(uploader.run())
+
+        def work():
+            return process_video(src, out_dir, backend=self.backend,
+                                 progress_cb=cb, rungs=rungs,
+                                 keep_original=False, resume=False,
+                                 streaming_format=fmt)
+
+        try:
+            result = await self._run_with_timeout(work, timeout, "reencode")
+        finally:
+            uploader.stop()
+            await asyncio.gather(up_task, return_exceptions=True)
+        await uploader.drain()
+        await self.client.complete(job["id"], {
+            "probe": {
+                "duration_s": result.source.duration_s,
+                "width": result.source.width,
+                "height": result.source.height,
+                "fps": result.source.fps,
+                "audio_codec": result.source.audio_codec,
+            },
+            "qualities": result.qualities,
+            "thumbnail": "thumbnail.jpg" if result.run.thumbnail_path else None,
+            "streaming_format": fmt,
+            "codec": codec,
+        })
+        self.stats.completed += 1
 
     async def _run_sprites(self, job: dict, video: dict) -> None:
         from vlog_tpu.worker.sprites import generate_sprites
@@ -569,12 +632,23 @@ async def _amain(args: argparse.Namespace) -> None:
         accelerator=AcceleratorKind(args.accelerator),
         kinds=tuple(JobKind(k) for k in args.kinds.split(",")),
         backend=backend, transcription_model_dir=args.whisper_dir)
+
+    from vlog_tpu.worker.health import WorkerHealthServer
+
+    async def ready() -> tuple[bool, str]:
+        if not await client.healthz():
+            return False, "worker API unreachable"
+        return True, "ok"
+
+    health = WorkerHealthServer(ready)
+    await health.start()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, worker.request_stop)
     try:
         await worker.run()
     finally:
+        await health.stop()
         await client.aclose()
     log.info("remote worker stopped: %s", worker.stats)
 
