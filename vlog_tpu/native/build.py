@@ -89,6 +89,15 @@ def get_lib() -> ctypes.CDLL | None:
         ]
         lib.vt_escape_emulation.restype = ctypes.c_int64
         lib.vt_escape_emulation.argtypes = [i8, ctypes.c_int64, i8]
+        lib.vt_cavlc_encode_p_slice.restype = ctypes.c_int64
+        lib.vt_cavlc_encode_p_slice.argtypes = [
+            i32, i32, i32, i32,                      # luma, cdc, cac, mv
+            ctypes.c_int, ctypes.c_int,              # mbh, mbw
+            i8, ctypes.c_int64,                      # header bytes
+            ctypes.c_uint32, ctypes.c_int,           # header tail bits
+            i32,                                     # scratch
+            i8, ctypes.c_int64,                      # out buffer
+        ]
         u16 = ctypes.POINTER(ctypes.c_uint16)
         lib.vt_jpeg_pack_scan.restype = ctypes.c_int64
         lib.vt_jpeg_pack_scan.argtypes = [

@@ -286,6 +286,243 @@ int64_t vt_cavlc_encode_slice(
     return w.nbytes;
 }
 
+/* ---------------------------------------------------------------------
+ * P slices (P_L0_16x16 / P_Skip) — mirrors cavlc.PSliceEncoder bit-for-
+ * bit (tests/test_native.py asserts byte equality). P frames are the
+ * bulk of every chain (GOP_LEN-1 of GOP_LEN frames), so this is the
+ * steady-state host entropy path.
+ * ------------------------------------------------------------------- */
+
+/* Table 9-4 "Inter" column: coded_block_pattern -> codeNum. */
+static const uint8_t CBP_INTER_CODE[48] = {
+    0, 2, 3, 7, 4, 8, 17, 13, 5, 18, 9, 14, 10, 15, 16, 11,
+    1, 32, 33, 36, 34, 37, 44, 40, 35, 45, 38, 41, 39, 42, 43, 19,
+    6, 24, 25, 20, 26, 21, 46, 28, 27, 47, 22, 29, 23, 30, 31, 12,
+};
+
+static inline int32_t median3(int32_t a, int32_t b, int32_t c) {
+    if (a > b) { int32_t t = a; a = b; b = t; }
+    if (b > c) { b = c; }
+    return a > b ? a : b;
+}
+
+/* Median MV predictor (8.4.1.3.1) over the quarter-pel mv grid.
+ * mvs: (mbh, mbw, 2) as (x, y). */
+static void mv_pred(const int32_t *mvs, int mbh, int mbw, int my, int mx,
+                    int32_t *px, int32_t *py) {
+    int a_ok = mx > 0;
+    int b_ok = my > 0;
+    int c_ok = b_ok && mx < mbw - 1;
+    int d_ok = b_ok && mx > 0;
+    int32_t ax = 0, ay = 0, bx = 0, by = 0, cx = 0, cy = 0;
+    int c_av = 0;
+    if (a_ok) {
+        ax = mvs[((int64_t)my * mbw + mx - 1) * 2];
+        ay = mvs[((int64_t)my * mbw + mx - 1) * 2 + 1];
+    }
+    if (b_ok) {
+        bx = mvs[(((int64_t)my - 1) * mbw + mx) * 2];
+        by = mvs[(((int64_t)my - 1) * mbw + mx) * 2 + 1];
+    }
+    if (c_ok) {
+        c_av = 1;
+        cx = mvs[(((int64_t)my - 1) * mbw + mx + 1) * 2];
+        cy = mvs[(((int64_t)my - 1) * mbw + mx + 1) * 2 + 1];
+    } else if (d_ok) {
+        c_av = 1;
+        cx = mvs[(((int64_t)my - 1) * mbw + mx - 1) * 2];
+        cy = mvs[(((int64_t)my - 1) * mbw + mx - 1) * 2 + 1];
+    }
+    int n_avail = a_ok + b_ok + c_av;
+    if (n_avail == 1) {
+        if (a_ok) { *px = ax; *py = ay; }
+        else if (b_ok) { *px = bx; *py = by; }
+        else { *px = cx; *py = cy; }
+        return;
+    }
+    *px = median3(ax, bx, cx);
+    *py = median3(ay, by, cy);
+}
+
+/* P_Skip inferred MV (8.4.1.1). */
+static void skip_mv(const int32_t *mvs, int mbh, int mbw, int my, int mx,
+                    int32_t *px, int32_t *py) {
+    int a_ok = mx > 0;
+    int b_ok = my > 0;
+    if (!a_ok || !b_ok) { *px = 0; *py = 0; return; }
+    const int32_t *a = mvs + ((int64_t)my * mbw + mx - 1) * 2;
+    const int32_t *b = mvs + (((int64_t)my - 1) * mbw + mx) * 2;
+    if ((a[0] == 0 && a[1] == 0) || (b[0] == 0 && b[1] == 0)) {
+        *px = 0; *py = 0; return;
+    }
+    mv_pred(mvs, mbh, mbw, my, mx, px, py);
+}
+
+/* i8x8/i4x4 coding-order offsets (quadrant zigzag). */
+static const int BLK2[4][2] = {{0, 0}, {0, 1}, {1, 0}, {1, 1}};
+
+/* Encode one P frame's slice_data.
+ *
+ * Layouts (C-contiguous int32):
+ *   luma      (mbh, mbw, 4, 4, 4, 4)   [block by, bx, then 4x4]
+ *   chroma_dc (2, mbh, mbw, 2, 2)
+ *   chroma_ac (2, mbh, mbw, 2, 2, 4, 4)
+ *   mv        (mbh, mbw, 2)            integer pels, (y, x) — DSP order
+ * scratch: int32 of size mbh*4*mbw*4 + 2*mbh*2*mbw*2 + mbh*mbw*2.
+ * Returns bytes written or -1 on overflow.
+ */
+int64_t vt_cavlc_encode_p_slice(
+    const int32_t *luma, const int32_t *chroma_dc, const int32_t *chroma_ac,
+    const int32_t *mv,
+    int mbh, int mbw,
+    const uint8_t *header_bytes, int64_t n_header_bytes,
+    uint32_t header_tail_bits, int n_header_tail_bits,
+    int32_t *scratch,
+    uint8_t *out, int64_t out_cap)
+{
+    BitWriter w = {out, out_cap, 0, 0, 0, 0};
+    if (n_header_bytes > out_cap) return -1;
+    memcpy(out, header_bytes, (size_t)n_header_bytes);
+    w.nbytes = n_header_bytes;
+    if (n_header_tail_bits > 0)
+        bw_put(&w, header_tail_bits, n_header_tail_bits);
+
+    const int gw = mbw * 4;
+    const int cw = mbw * 2;
+    int32_t *nz_luma = scratch;
+    int32_t *nz_chroma = scratch + (int64_t)mbh * 4 * gw;
+    int32_t *mvs = nz_chroma + 2 * (int64_t)mbh * 2 * cw;  /* quarter, (x,y) */
+    memset(scratch, 0, sizeof(int32_t) *
+           ((int64_t)mbh * 4 * gw + 2 * (int64_t)mbh * 2 * cw
+            + (int64_t)mbh * mbw * 2));
+
+    int32_t scan[16];
+    uint32_t skip_run = 0;
+
+    for (int my = 0; my < mbh; my++) {
+        for (int mx = 0; mx < mbw; mx++) {
+            const int64_t mb = (int64_t)my * mbw + mx;
+            const int32_t *lu = luma + (mb << 8);
+            const int32_t *cdc[2], *cac[2];
+            for (int comp = 0; comp < 2; comp++) {
+                cdc[comp] = chroma_dc + ((((int64_t)comp * mbh + my) * mbw + mx) << 2);
+                cac[comp] = chroma_ac + ((((int64_t)comp * mbh + my) * mbw + mx) << 6);
+            }
+            /* quarter-pel mv, bitstream (x, y) from DSP (y, x) */
+            int32_t mvx = mv[mb * 2 + 1] * 4;
+            int32_t mvy = mv[mb * 2] * 4;
+
+            /* CBP: luma bit per 8x8 quadrant + chroma 0/1/2 */
+            int cbp = 0;
+            for (int i8 = 0; i8 < 4; i8++) {
+                int oy = BLK2[i8][0], ox = BLK2[i8][1];
+                int any = 0;
+                for (int s = 0; s < 4 && !any; s++) {
+                    int by = 2 * oy + BLK2[s][0], bx = 2 * ox + BLK2[s][1];
+                    const int32_t *b = lu + ((by * 4 + bx) << 4);
+                    for (int i = 0; i < 16; i++)
+                        if (b[i]) { any = 1; break; }
+                }
+                if (any) cbp |= 1 << i8;
+            }
+            int any_cac = 0, any_cdc = 0;
+            for (int comp = 0; comp < 2 && !any_cac; comp++)
+                for (int i = 0; i < 64; i++)
+                    if (cac[comp][i]) { any_cac = 1; break; }
+            for (int comp = 0; comp < 2 && !any_cdc; comp++)
+                for (int i = 0; i < 4; i++)
+                    if (cdc[comp][i]) { any_cdc = 1; break; }
+            cbp |= (any_cac ? 2 : (any_cdc ? 1 : 0)) << 4;
+
+            int32_t smx, smy;
+            skip_mv(mvs, mbh, mbw, my, mx, &smx, &smy);
+            if (cbp == 0 && mvx == smx && mvy == smy) {
+                mvs[mb * 2] = smx;
+                mvs[mb * 2 + 1] = smy;
+                skip_run++;
+                continue;
+            }
+            bw_put_ue(&w, skip_run);
+            skip_run = 0;
+            int32_t pmx, pmy;
+            mv_pred(mvs, mbh, mbw, my, mx, &pmx, &pmy);
+            mvs[mb * 2] = mvx;
+            mvs[mb * 2 + 1] = mvy;
+            bw_put_ue(&w, 0);                    /* mb_type P_L0_16x16 */
+            bw_put_se(&w, mvx - pmx);
+            bw_put_se(&w, mvy - pmy);
+            bw_put_ue(&w, CBP_INTER_CODE[cbp]);
+            if (cbp) {
+                bw_put_se(&w, 0);                /* mb_qp_delta */
+                int gy = my * 4, gx = mx * 4;
+                for (int i8 = 0; i8 < 4; i8++) {
+                    int oy = BLK2[i8][0], ox = BLK2[i8][1];
+                    for (int s = 0; s < 4; s++) {
+                        int by = 2 * oy + BLK2[s][0], bx = 2 * ox + BLK2[s][1];
+                        int y = gy + by, x = gx + bx;
+                        if (!((cbp >> i8) & 1)) {
+                            nz_luma[y * gw + x] = 0;
+                            continue;
+                        }
+                        const int32_t *b = lu + ((by * 4 + bx) << 4);
+                        int nc = nc_of(x > 0, x > 0 ? nz_luma[y * gw + x - 1] : 0,
+                                       y > 0, y > 0 ? nz_luma[(y - 1) * gw + x] : 0);
+                        for (int i = 0; i < 16; i++) scan[i] = b[ZIGZAG16[i]];
+                        int tc = encode_residual(&w, scan, 16, nc);
+                        nz_luma[y * gw + x] = tc;
+                    }
+                }
+                int cbp_chroma = cbp >> 4;
+                if (cbp_chroma > 0) {
+                    for (int comp = 0; comp < 2; comp++)
+                        encode_residual(&w, cdc[comp], 4, -1);
+                }
+                int cy = my * 2, cx = mx * 2;
+                for (int comp = 0; comp < 2; comp++) {
+                    int32_t *grid = nz_chroma + (int64_t)comp * mbh * 2 * cw;
+                    for (int by = 0; by < 2; by++) {
+                        for (int bx = 0; bx < 2; bx++) {
+                            int y = cy + by, x = cx + bx;
+                            if (cbp_chroma != 2) {
+                                grid[y * cw + x] = 0;
+                                continue;
+                            }
+                            const int32_t *b = cac[comp] + ((by * 2 + bx) << 4);
+                            int nc = nc_of(x > 0, x > 0 ? grid[y * cw + x - 1] : 0,
+                                           y > 0, y > 0 ? grid[(y - 1) * cw + x] : 0);
+                            for (int i = 1; i < 16; i++)
+                                scan[i - 1] = b[ZIGZAG16[i]];
+                            int tc = encode_residual(&w, scan, 15, nc);
+                            grid[y * cw + x] = tc;
+                        }
+                    }
+                }
+            } else {
+                /* nz grids for an uncoded MB: all zero */
+                int gy = my * 4, gx = mx * 4;
+                for (int by = 0; by < 4; by++)
+                    for (int bx = 0; bx < 4; bx++)
+                        nz_luma[(gy + by) * gw + gx + bx] = 0;
+                int cy = my * 2, cx = mx * 2;
+                for (int comp = 0; comp < 2; comp++) {
+                    int32_t *grid = nz_chroma + (int64_t)comp * mbh * 2 * cw;
+                    for (int by = 0; by < 2; by++)
+                        for (int bx = 0; bx < 2; bx++)
+                            grid[(cy + by) * cw + cx + bx] = 0;
+                }
+            }
+            if (w.overflow) return -1;
+        }
+    }
+    if (skip_run) bw_put_ue(&w, skip_run);      /* trailing skips */
+
+    bw_put(&w, 1, 1);
+    if (w.nbits & 7) bw_put(&w, 0, 8 - (w.nbits & 7));
+    bw_flush_bytes(&w);
+    if (w.overflow || w.nbits != 0) return -1;
+    return w.nbytes;
+}
+
 /* Emulation-prevention escaping (H.264 7.4.1): out must have capacity
  * for worst case 3n/2. Returns escaped length. */
 int64_t vt_escape_emulation(const uint8_t *in, int64_t n, uint8_t *out) {
