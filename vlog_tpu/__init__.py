@@ -11,16 +11,17 @@ Layer map (bottom-up), mirroring the reference layer map (SURVEY.md section 1):
 
 - ``config``        env-driven constants (reference: config.py)
 - ``db``            persistence: async DB facade + schema (reference: api/database.py)
-- ``jobs``          job state machine, claim protocol, queue (reference: api/job_state.py, api/job_queue.py)
-- ``media``         ISO-BMFF demux/mux, HLS/DASH manifests, probing (reference: ffmpeg/ffprobe subprocesses)
-- ``ops``           JAX/Pallas TPU kernels: colorspace, ladder resize, DCT/quant
-- ``codecs``        video codec implementations (H.264 intra encoder: JAX transform + host entropy coding)
-- ``parallel``      device mesh + sharding policies (reference: process/NCCL-free fleet parallelism)
-- ``models``        neural models (Whisper) in Flax
-- ``asr``           audio frontend, chunked transcription pipeline, WebVTT
-- ``worker``        accelerator backend boundary + worker runtimes (reference: worker/hwaccel.py, worker/transcoder.py)
-- ``httpd``         in-house asyncio HTTP framework (reference used FastAPI, unavailable here)
-- ``api``           worker/admin/public HTTP services (reference: api/worker_api.py, api/admin.py, api/public.py)
+- ``jobs``          job plane: state machine, claims, finalize, webhooks, alerts
+- ``media``         ISO-BMFF/TS demux+mux, HLS/DASH manifests, audio, probing
+- ``ops``           JAX TPU kernels: colorspace, ladder resize, DCT/quant
+- ``codecs``        H.264 (I+P encoder/decoder), AAC-LC, JPEG — JAX DSP + C entropy
+- ``native``        on-demand-built C entropy coders (CAVLC I/P, JPEG scans)
+- ``parallel``      device mesh + sharded one-pass ladder / chain programs
+- ``asr``           Whisper in JAX: mel frontend, decode loop, WebVTT
+- ``backends``      accelerator boundary (plan/run) + the JAX ladder backend
+- ``worker``        pipeline, local daemon, remote worker, sprites, transcribe
+- ``api``           worker/admin/public HTTP services (aiohttp)
+- ``cli``           the ``vlog-tpu`` console client
 """
 
 __version__ = "0.1.0"

@@ -183,8 +183,29 @@ class JaxBackend:
         # --- resume point: first segment index any rung is missing.
         # (TS mode restarts from 0: continuity counters span the whole
         # playlist, so a fresh muxer cannot append mid-stream.)
+        src = open_source(plan.source.path)
+        total = src.frame_count
         start_segment = 0
-        if resume and not ts_mode:
+        # (any failure between here and the decode loop must not leak
+        # the source — see the except below)
+        # Foreign (libav) sources have keyframe-coarse seeking only, so
+        # mid-stream segment resume would misalign frames: restart clean.
+        try:
+            return self._run_with_source(
+                plan, progress_cb, resume, t0, src, total, out, fps,
+                frames_per_seg, timescale, frame_dur, ts_mode, seg_ext,
+                encoders, tracks, seg_counts, seg_durs, bytes_written,
+                psnr_acc)
+        except BaseException:
+            src.close()
+            raise
+
+    def _run_with_source(self, plan, progress_cb, resume, t0, src, total,
+                         out, fps, frames_per_seg, timescale, frame_dur,
+                         ts_mode, seg_ext, encoders, tracks, seg_counts,
+                         seg_durs, bytes_written, psnr_acc) -> RunResult:
+        start_segment = 0
+        if resume and not ts_mode and src.exact_seek:
             per_rung = {r.name: self._existing_segments(out / r.name)
                         for r in plan.rungs}
             start_segment = min(len(d) for d in per_rung.values())
@@ -197,8 +218,6 @@ class JaxBackend:
                     bytes_written[rung.name] += seg.stat().st_size
         start_frame = start_segment * frames_per_seg
 
-        src = open_source(plan.source.path)
-        total = src.frame_count
         pending: dict[str, list[Sample]] = {r.name: [] for r in plan.rungs}
         frames_done = start_frame
         thumb_path = None
@@ -501,7 +520,10 @@ class JaxBackend:
             if entropy_pool is not None:
                 entropy_pool.shutdown(wait=True)
 
-        duration_s = total / fps if fps else 0.0
+        # Inexact (libav) sources: the container's frame count is an
+        # estimate — trust the frames actually decoded.
+        true_total = total if src.exact_seek else frames_done
+        duration_s = true_total / fps if fps else 0.0
         results = []
         variants = []
         for rung in plan.rungs:

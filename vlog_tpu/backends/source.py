@@ -35,6 +35,11 @@ class FrameSource:
     frame_count: int
     fps_num: int
     fps_den: int
+    # True: start_frame addressing is frame-exact and frame_count is
+    # authoritative (our containers). False: libav fallback — counts are
+    # container estimates and mid-stream starts are keyframe-coarse, so
+    # the backend disables segment resume.
+    exact_seek: bool = True
 
     def read_batches(self, batch: int, start_frame: int = 0
                      ) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
@@ -117,11 +122,178 @@ class Mp4H264FrameSource(FrameSource):
         self._reader.close()
 
 
+class LibavFrameSource(FrameSource):
+    """Foreign-upload decode through the system libav shim.
+
+    The ingest half of the reference's "anything ffmpeg decodes" contract
+    (transcoder.py:706-758): CABAC/B-frame H.264, HEVC, VP9, MKV/MOV/...
+    decode into the same (y, u, v) batch stream the first-party sources
+    produce. Encode stays first-party; ``exact_seek`` is False (container
+    frame counts are estimates; mid-stream starts are keyframe-coarse).
+    """
+
+    exact_seek = False
+
+    def __init__(self, path: str | Path):
+        import ctypes
+
+        from vlog_tpu.native.avbuild import VtAvInfo, get_av_lib
+
+        lib = get_av_lib()
+        if lib is None:
+            raise UnsupportedSource(
+                f"{path}: outside the first-party decode envelope and the "
+                "libav ingest shim is unavailable")
+        self._lib = lib
+        self.path = Path(path)
+        self._avinfo = VtAvInfo()
+        self._handle = lib.vt_av_open(str(path).encode(),
+                                      ctypes.byref(self._avinfo))
+        if not self._handle:
+            raise UnsupportedSource(f"{path}: libav cannot open this input")
+        ai = self._avinfo
+        if ai.width <= 0 or ai.height <= 0:
+            self.close()
+            raise UnsupportedSource(f"{path}: no decodable video stream")
+        if ai.width % 2 or ai.height % 2:
+            # Reject at PROBE time, not mid-transcode: 4:2:0 needs even
+            # dimensions end to end.
+            self.close()
+            raise UnsupportedSource(
+                f"{path}: odd frame dimensions "
+                f"{ai.width}x{ai.height} unsupported")
+        fps = ai.fps if ai.fps > 0 else 30.0
+        from vlog_tpu.media.y4m import fps_to_fraction
+
+        self.fps_num, self.fps_den = fps_to_fraction(fps)
+        n = int(ai.nb_frames) if ai.nb_frames > 0 else int(
+            round(ai.duration * fps))
+        self.frame_count = max(n, 1)
+        self.info = VideoInfo(
+            container="libav", path=str(path),
+            duration_s=float(ai.duration), width=int(ai.width),
+            height=int(ai.height), fps=round(fps, 3),
+            frame_count=self.frame_count,
+            video_codec=ai.vcodec.decode(errors="replace"),
+            audio_codec=(ai.acodec.decode(errors="replace")
+                         if ai.has_audio else None),
+            size_bytes=self.path.stat().st_size,
+        )
+        self._pos = 0
+
+    def _seek_to(self, start_frame: int) -> None:
+        """Seek to the prior keyframe, then decode-and-discard forward
+        until the stream's PTS reaches the target time (bounded)."""
+        import ctypes
+
+        fps = self.fps_num / self.fps_den
+        target_t = start_frame / fps
+        if self._lib.vt_av_seek(self._handle, target_t) != 0 \
+                and start_frame < self._pos:
+            raise UnsupportedSource(f"{self.path}: seek failed")
+        h, w = self.info.height, self.info.width
+        fsz = w * h * 3 // 2
+        buf = np.empty(fsz, np.uint8)
+        pts = ctypes.c_double(-1.0)
+        # budget bounds pathological streams (e.g. keyframe-free)
+        for _ in range(2000):
+            # Peek one frame; stop once its pts reaches target (within
+            # half a frame). The peeked frame is the NEXT one yielded —
+            # stash it.
+            got = self._lib.vt_av_read_pts(
+                self._handle,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.byref(pts), 1)
+            if got <= 0:
+                self._stash = None
+                break
+            if pts.value < 0 or pts.value >= target_t - 0.5 / fps:
+                self._stash = buf.copy()
+                break
+        else:
+            self._stash = None
+        self._pos = start_frame
+
+    def read_batches(self, batch: int, start_frame: int = 0):
+        import ctypes
+
+        if start_frame != self._pos:
+            self._seek_to(start_frame)
+        h, w = self.info.height, self.info.width
+        fsz = w * h * 3 // 2
+
+        def emit(frames: np.ndarray):
+            n = frames.shape[0]
+            ys = frames[:, : h * w].reshape(n, h, w).copy()
+            us = frames[:, h * w: h * w + (h // 2) * (w // 2)].reshape(
+                n, h // 2, w // 2).copy()
+            vs = frames[:, h * w + (h // 2) * (w // 2):].reshape(
+                n, h // 2, w // 2).copy()
+            return ys, us, vs
+
+        stash = getattr(self, "_stash", None)
+        self._stash = None
+        if stash is not None:
+            self._pos += 1
+            yield emit(stash[None, :])
+        buf = np.empty(batch * fsz, np.uint8)
+        while True:
+            got = self._lib.vt_av_read(
+                self._handle,
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                batch)
+            if got < 0:
+                raise UnsupportedSource(f"{self.path}: libav decode error")
+            if got == 0:
+                return
+            self._pos += int(got)
+            yield emit(buf[: got * fsz].reshape(int(got), fsz))
+            if got < batch:
+                return
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.vt_av_close(self._handle)
+            self._handle = None
+
+
+def _trial_decode(src: Mp4H264FrameSource) -> None:
+    """Decode the first sample so envelope violations (CABAC at the PPS,
+    foreign slice features at the first slice) surface at OPEN time,
+    letting open_source fall back to libav before any work happens."""
+    samples = src._reader.read_range(0, 1)
+    if samples:
+        from vlog_tpu.codecs.h264.decoder import H264Decoder
+
+        probe_dec = H264Decoder(avcc_config=src._track.codec_config)
+        probe_dec.decode_sample_levels(samples[0])
+
+
 def open_source(path: str | Path) -> FrameSource:
-    """Sniff the container and return the right FrameSource."""
-    kind = sniff_container(path)
+    """Sniff the container and return the right FrameSource.
+
+    First-party decoders are preferred (frame-exact, resume-capable);
+    anything outside their envelope falls back to the libav ingest shim
+    when it is available.
+    """
+    try:
+        kind = sniff_container(path)
+    except Exception:
+        kind = "libav"
     if kind == "y4m":
         return Y4mFrameSource(path)
     if kind == "mp4":
-        return Mp4H264FrameSource(path)
-    raise UnsupportedSource(f"{path}: unsupported container {kind!r}")
+        from vlog_tpu.codecs.h264.decoder import DecodeError
+
+        src = None
+        try:
+            src = Mp4H264FrameSource(path)
+            _trial_decode(src)
+            return src
+        except (UnsupportedSource, UnsupportedStream, DecodeError,
+                ValueError):
+            # outside the first-party envelope; try libav — without
+            # leaking the half-open first-party reader
+            if src is not None:
+                src.close()
+    return LibavFrameSource(path)

@@ -170,9 +170,37 @@ def _asc_from_esds(esds_payload: bytes):
         return None
 
 
+def _libav_extract_audio(path: Path) -> AudioData | None:
+    """Foreign-container audio through the libav ingest shim (the
+    reference decoded audio with ffmpeg; transcription.py:259-299)."""
+    import tempfile
+
+    from vlog_tpu.native.avbuild import get_av_lib
+
+    lib = get_av_lib()
+    if lib is None:
+        return None
+    with tempfile.NamedTemporaryFile(suffix=".f32", delete=False) as tmp:
+        out_path = tmp.name
+    try:
+        rc = lib.vt_av_audio_to_f32(str(path).encode(), out_path.encode())
+        if rc < 0:
+            return None
+        rate, channels = int(rc >> 8), int(rc & 0xFF)
+        pcm = np.fromfile(out_path, np.float32)
+        if channels > 1:
+            pcm = pcm.reshape(-1, channels).T
+        else:
+            pcm = pcm[None, :]
+        return AudioData(pcm=pcm.astype(np.float64), sample_rate=rate)
+    finally:
+        Path(out_path).unlink(missing_ok=True)
+
+
 def extract_audio(path: str | Path) -> AudioData | None:
     """Best-effort audio from any supported source; None if the container
-    has no audio (e.g. Y4M)."""
+    has no audio (e.g. Y4M). First-party paths first; the libav shim
+    covers foreign containers and codecs."""
     path = Path(path)
     suffix = path.suffix.lower()
     if suffix == ".wav":
@@ -182,10 +210,31 @@ def extract_audio(path: str | Path) -> AudioData | None:
 
         cfg, pcm = decode_adts(path.read_bytes())
         return AudioData(pcm=pcm[:, 1024:], sample_rate=cfg.sample_rate)
-    from vlog_tpu.media.probe import sniff_container
+    from vlog_tpu.media.probe import ProbeError, sniff_container
 
-    if sniff_container(path) == "mp4":
-        return extract_mp4_audio(path)
+    try:
+        kind = sniff_container(path)
+    except ProbeError:
+        return _libav_extract_audio(path)
+    if kind == "mp4":
+        try:
+            audio = extract_mp4_audio(path)
+        except Exception as exc:  # noqa: BLE001 — exotic MP4 audio -> shim
+            from vlog_tpu.native.avbuild import get_av_lib
+
+            if get_av_lib() is None:
+                raise       # no fallback: surface the real error
+            import logging
+
+            logging.getLogger("vlog_tpu.media").warning(
+                "first-party MP4 audio demux failed (%s); using libav "
+                "fallback", exc)
+            audio = None
+        if audio is not None:
+            return audio
+        return _libav_extract_audio(path)
+    if kind != "y4m":
+        return _libav_extract_audio(path)
     return None
 
 

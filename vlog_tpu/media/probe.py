@@ -45,6 +45,21 @@ def sniff_container(path: str | Path) -> str:
     raise ProbeError(f"{path}: unrecognized container (magic {head[:8]!r})")
 
 
+def _libav_probe(path: Path) -> VideoInfo:
+    """Foreign-container probe through the libav ingest shim (the
+    reference's ffprobe analog for anything outside our demuxers)."""
+    from vlog_tpu.backends.source import LibavFrameSource, UnsupportedSource
+
+    try:
+        src = LibavFrameSource(path)
+    except UnsupportedSource as exc:
+        raise ProbeError(str(exc)) from exc
+    try:
+        return src.info
+    finally:
+        src.close()
+
+
 def get_video_info(path: str | Path) -> VideoInfo:
     path = Path(path)
     if not path.exists():
@@ -52,7 +67,10 @@ def get_video_info(path: str | Path) -> VideoInfo:
     size = path.stat().st_size
     if size == 0:
         raise ProbeError(f"{path}: empty file")
-    container = sniff_container(path)
+    try:
+        container = sniff_container(path)
+    except ProbeError:
+        return _libav_probe(path)
 
     if container == "y4m":
         info = y4mlib.probe_y4m(path)
@@ -69,7 +87,10 @@ def get_video_info(path: str | Path) -> VideoInfo:
             size_bytes=size,
         )
 
-    movie = mp4lib.parse_mp4(path)
+    try:
+        movie = mp4lib.parse_mp4(path)
+    except Exception:  # noqa: BLE001 — exotic MP4s fall to the libav probe
+        return _libav_probe(path)
     video = movie.video
     audio = movie.audio
     if video is None and audio is None:

@@ -118,14 +118,26 @@ def generate_sprites(
 
         # Decode sampled frames in chunks; resize the whole chunk in one
         # batched device call (frames share source geometry).
+        exhausted = False
         for c0 in range(0, n_tiles, decode_chunk):
+            if exhausted:
+                break
             idxs = frame_idx[c0:c0 + decode_chunk]
             ys, us, vs = [], [], []
             for fi in idxs:
-                by, bu, bv = next(src.read_batches(1, fi))
+                # Foreign sources have estimated frame counts: a sampled
+                # index can overshoot the real stream end — stop there.
+                item = next(src.read_batches(1, fi), None)
+                if item is None:
+                    exhausted = True
+                    idxs = idxs[:len(ys)]
+                    break
+                by, bu, bv = item
                 ys.append(by[0])
                 us.append(bu[0])
                 vs.append(bv[0])
+            if not ys:
+                break
             ty, tu, tv = resize_yuv420(
                 np.stack(ys), np.stack(us), np.stack(vs), tile_h, tile_w)
             rgb = np.asarray(yuv420_to_rgb(ty, tu, tv, standard="bt709"))
