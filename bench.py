@@ -155,12 +155,17 @@ def run_body(platform: str) -> None:
                 fp.write(yf.tobytes())
                 fp.write(uv.tobytes())
                 fp.write(uv.tobytes())
-        # warmup run compiles the ladder program for these shapes …
-        process_video(src_path, os.path.join(tmp, "warm"), audio=False)
-        # … so the timed run measures the steady-state pipeline.
+        # E2E runs the ladder in INTRA mode: the 4K I+P chain program
+        # compiles in tens of minutes (amortized in production by the
+        # persistent XLA cache, but not affordable inside the bench
+        # budget) while the intra program compiles in seconds; the key
+        # is labeled below so the number is never mistaken for the
+        # chain-mode default.
+        process_video(src_path, os.path.join(tmp, "warm"), audio=False,
+                      gop_mode="intra")
         t0 = time.perf_counter()
         result = process_video(src_path, os.path.join(tmp, "run"),
-                               audio=False)
+                               audio=False, gop_mode="intra")
         e2e_wall = time.perf_counter() - t0
         e2e_realtime = (e2e_frames / e2e_fps) / e2e_wall
         rung_count = len(result.run.rungs)
@@ -181,6 +186,7 @@ def run_body(platform: str) -> None:
 
     record.update({
         "e2e_realtime_x": round(e2e_realtime, 4),
+        "e2e_gop_mode": "intra",
         "e2e_rungs": rung_count,
         "e2e_wall_s": round(e2e_wall, 2),
         "e2e_video_s": round(e2e_frames / e2e_fps, 2),

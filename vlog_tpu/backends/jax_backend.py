@@ -56,6 +56,28 @@ from vlog_tpu.ops.colorspace import yuv420_to_rgb
 from vlog_tpu.ops.resize import resize_yuv420
 
 
+_COMPILE_CACHE_SET = False
+
+
+def _enable_persistent_compile_cache() -> None:
+    """XLA programs for 4K chain ladders take minutes to compile; the
+    persistent cache amortizes that across worker restarts (first video
+    of a geometry pays once per fleet node, not once per process)."""
+    global _COMPILE_CACHE_SET
+    if _COMPILE_CACHE_SET:
+        return
+    _COMPILE_CACHE_SET = True
+    try:
+        import jax
+
+        cache_dir = Path(config.BASE_DIR) / "xla_cache"
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:   # noqa: BLE001 — cache is an optimization only
+        pass
+
+
 class JaxBackend:
     """Runs the one-pass ladder on whatever devices JAX exposes."""
 
@@ -63,6 +85,8 @@ class JaxBackend:
 
     def detect(self) -> Capabilities:
         import jax
+
+        _enable_persistent_compile_cache()
 
         devices = jax.devices()
         kind = devices[0].platform if devices else "cpu"
@@ -141,6 +165,7 @@ class JaxBackend:
     # ------------------------------------------------------------------
     def run(self, plan: ExecutionPlan, progress_cb: ProgressFn | None = None,
             *, resume: bool = True) -> RunResult:
+        _enable_persistent_compile_cache()
         t0 = time.monotonic()
         out = plan.out_dir
         out.mkdir(parents=True, exist_ok=True)
