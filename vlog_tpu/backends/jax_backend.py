@@ -62,7 +62,11 @@ _COMPILE_CACHE_SET = False
 def _enable_persistent_compile_cache() -> None:
     """XLA programs for 4K chain ladders take minutes to compile; the
     persistent cache amortizes that across worker restarts (first video
-    of a geometry pays once per fleet node, not once per process)."""
+    of a geometry pays once per fleet node, not once per process).
+
+    TPU platforms only: CPU AOT cache entries record exact host ISA
+    features, and reloading them on a different machine warns of
+    possible SIGILL — not worth it for the fast-compiling CPU path."""
     global _COMPILE_CACHE_SET
     if _COMPILE_CACHE_SET:
         return
@@ -70,6 +74,8 @@ def _enable_persistent_compile_cache() -> None:
     try:
         import jax
 
+        if jax.devices()[0].platform == "cpu":
+            return
         cache_dir = Path(config.BASE_DIR) / "xla_cache"
         cache_dir.mkdir(parents=True, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", str(cache_dir))
@@ -350,9 +356,16 @@ class JaxBackend:
             if chain_mode:
                 chain = lambda p: p.reshape((chains_per, clen) + p.shape[1:])
                 by, bu, bv = chain(by), chain(bu), chain(bv)
-                qps = {r.name: np.full((chains_per, clen),
-                                       controllers[r.name].qp, np.int32)
-                       for r in plan.rungs}
+                # I frames carry the whole chain as its reference: spend
+                # ~2 QP more on them than on the P frames they anchor
+                # (standard I/P offset; the rate controller sees the
+                # blended chain bytes either way).
+                qps = {}
+                for r in plan.rungs:
+                    q = np.full((chains_per, clen), controllers[r.name].qp,
+                                np.int32)
+                    q[:, 0] = np.maximum(q[:, 0] - 2, 0)
+                    qps[r.name] = q
             else:
                 qps = {r.name: np.full(batch_n, controllers[r.name].qp,
                                        np.int32)
