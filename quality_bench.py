@@ -26,7 +26,16 @@ import tempfile
 import time
 from pathlib import Path
 
-import numpy as np
+# Hermetic CPU run: the axon sitecustomize overrides the platform CONFIG
+# at interpreter start, so the env var alone does not keep a flaky TPU
+# tunnel out of a quality measurement (conftest.py does the same).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
 
 REPO = Path(__file__).parent
 FIXTURES = REPO / "tests" / "fixtures"
