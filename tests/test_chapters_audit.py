@@ -1,0 +1,156 @@
+"""Chapters (container atoms + transcript heuristics), audit log,
+analytics summary.
+
+Reference analogs: chapter_detection.py + admin chapter routes, audit.py
+rotating security log, admin analytics routes.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import httpx
+import pytest
+from aiohttp.test_utils import TestServer
+
+from vlog_tpu.api.audit import AuditLog
+from vlog_tpu.db.core import now as db_now
+from vlog_tpu.jobs import videos as vids
+from vlog_tpu.media.chapters import (
+    Chapter,
+    parse_mp4_chapters,
+    suggest_from_transcript,
+)
+
+
+def _chpl_mp4(tmp_path, marks):
+    """Minimal MP4 with a moov/udta/chpl chapter box."""
+    body = bytearray(bytes(9))
+    body[8] = len(marks)
+    for start_s, title in marks:
+        t = title.encode()
+        body += struct.pack(">QB", int(start_s * 1e7), len(t)) + t
+    chpl = len(body) + 8
+    chpl_box = chpl.to_bytes(4, "big") + b"chpl" + bytes(body)
+    udta = (len(chpl_box) + 8).to_bytes(4, "big") + b"udta" + chpl_box
+    moov = (len(udta) + 8).to_bytes(4, "big") + b"moov" + udta
+    ftyp = (16).to_bytes(4, "big") + b"ftypisom" + b"\x00\x00\x00\x01"
+    p = tmp_path / "ch.mp4"
+    p.write_bytes(ftyp + moov)
+    return p
+
+
+def test_parse_mp4_chpl_chapters(tmp_path):
+    p = _chpl_mp4(tmp_path, [(0.0, "Intro"), (65.5, "Part Two"),
+                             (120.0, "Outro")])
+    chapters = parse_mp4_chapters(p)
+    assert [(c.start_s, c.title) for c in chapters] == [
+        (0.0, "Intro"), (65.5, "Part Two"), (120.0, "Outro")]
+    assert all(c.source == "container" for c in chapters)
+
+
+def test_transcript_chapter_suggestions():
+    cues = []
+    t = 0.0
+    # three sections separated by >4s silences, each >60s long
+    for section in range(3):
+        for i in range(12):
+            cues.append({"start_s": t, "end_s": t + 4.0,
+                         "text": f"section {section} sentence {i} words"})
+            t += 5.5
+        t += 6.0      # silence boundary
+    chapters = suggest_from_transcript(cues)
+    assert len(chapters) == 3
+    assert chapters[0].start_s == 0.0
+    assert chapters[1].start_s > 60.0
+    assert "section 1" in chapters[1].title
+    assert all(c.source == "transcript" for c in chapters)
+
+
+def test_transcript_suggestions_respect_min_length():
+    # silences every ~10s: only boundaries >=60s apart become chapters
+    cues = [{"start_s": i * 10.0, "end_s": i * 10.0 + 3.0, "text": f"c{i}"}
+            for i in range(30)]
+    chapters = suggest_from_transcript(cues)
+    starts = [c.start_s for c in chapters]
+    assert starts[0] == 0.0
+    assert all(b - a >= 60.0 for a, b in zip(starts, starts[1:]))
+
+
+def test_audit_log_rotation(tmp_path):
+    log = AuditLog(tmp_path / "audit.log")
+    log.record("x", a=1)
+    entry = json.loads((tmp_path / "audit.log").read_text().strip())
+    assert entry["action"] == "x" and entry["a"] == 1
+    # force rotation
+    import vlog_tpu.api.audit as audit_mod
+
+    old = audit_mod.MAX_BYTES
+    audit_mod.MAX_BYTES = 10
+    try:
+        log.record("y")
+        log.record("z")
+    finally:
+        audit_mod.MAX_BYTES = old
+    assert (tmp_path / "audit.1.log").exists()
+
+
+@pytest.fixture
+def admin(run, db, tmp_path):
+    from vlog_tpu.api.admin_api import build_admin_app
+
+    srv = TestServer(build_admin_app(
+        db, upload_dir=tmp_path / "up", video_dir=tmp_path / "v",
+        audit_path=tmp_path / "audit.log"))
+    run(srv.start_server())
+    yield {"base": str(srv.make_url("")), "audit": tmp_path / "audit.log"}
+    run(srv.close())
+
+
+def test_chapter_endpoints_and_audit(run, db, tmp_path, admin):
+    video = run(vids.create_video(db, "Chaptered", source_path=str(
+        _chpl_mp4(tmp_path, [(0.0, "Start"), (90.0, "Middle")]))))
+
+    async def go():
+        async with httpx.AsyncClient(base_url=admin["base"]) as c:
+            det = (await c.post(
+                f"/api/videos/{video['id']}/chapters/detect")).json()
+            assert [ch["title"] for ch in det["chapters"]] == [
+                "Start", "Middle"]
+            r = await c.put(f"/api/videos/{video['id']}/chapters",
+                            json=det)
+            assert r.status_code == 200
+            got = (await c.get(
+                f"/api/videos/{video['id']}/chapters")).json()["chapters"]
+            assert len(got) == 2 and got[1]["start_s"] == 90.0
+            # bad chapter rejected
+            r = await c.put(f"/api/videos/{video['id']}/chapters",
+                            json={"chapters": [{"title": 5, "start_s": 0}]})
+            assert r.status_code == 400
+
+    run(go())
+    audit_lines = admin["audit"].read_text().strip().splitlines()
+    assert any("chapters" in ln and '"PUT"' in ln for ln in audit_lines)
+
+
+def test_analytics_summary(run, db, admin):
+    video = run(vids.create_video(db, "Watched", source_path="/x"))
+
+    async def go():
+        t = db_now()
+        for i, wt in enumerate((30.0, 60.0)):
+            await db.execute(
+                """
+                INSERT INTO playback_sessions (video_id, session_token,
+                        started_at, last_heartbeat_at, ended_at, watch_time_s)
+                VALUES (:v, :tok, :t, :t, :t, :w)
+                """, {"v": video["id"], "tok": f"tok{i}", "t": t, "w": wt})
+        async with httpx.AsyncClient(base_url=admin["base"]) as c:
+            data = (await c.get("/api/analytics/summary")).json()
+        row = data["videos"][0]
+        assert row["slug"] == "watched"
+        assert row["sessions"] == 2
+        assert row["watch_time_s"] == 90.0
+
+    run(go())

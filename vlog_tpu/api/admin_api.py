@@ -61,14 +61,29 @@ def _qnum(query, name: str, default, *, lo=None, hi=None, cast=int):
     return val
 
 
+AUDIT = web.AppKey("audit", object)
+
+
 @web.middleware
 async def admin_auth_middleware(request: web.Request, handler):
     if request.path == "/healthz":
         return await handler(request)
     if not authmod.check_admin_secret(request.headers.get("X-Admin-Secret"),
                                       config.ADMIN_SECRET):
+        audit = request.app.get(AUDIT)
+        if audit is not None:
+            audit.record("auth.denied", method=request.method,
+                         path=request.path, remote=request.remote)
         return _json_error(403, "bad admin secret")
-    return await handler(request)
+    resp = await handler(request)
+    # security log: every MUTATING admin request (reference api/audit.py)
+    if request.method not in ("GET", "HEAD", "OPTIONS"):
+        audit = request.app.get(AUDIT)
+        if audit is not None:
+            audit.record("admin.request", method=request.method,
+                         path=request.path, status=resp.status,
+                         remote=request.remote)
+    return resp
 
 
 # --------------------------------------------------------------------------
@@ -420,6 +435,113 @@ async def revoke_worker(request: web.Request) -> web.Response:
     return web.json_response({"ok": True, "keys_revoked": n})
 
 
+async def get_chapters(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    rows = await db.fetch_all(
+        "SELECT start_s, title, source FROM chapters WHERE video_id=:v "
+        "ORDER BY start_s", {"v": int(request.match_info["video_id"])})
+    return web.json_response({"chapters": rows})
+
+
+async def put_chapters(request: web.Request) -> web.Response:
+    """Replace a video's chapter list (reference admin.py chapters CRUD)."""
+    db = request.app[DB]
+    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    if video is None:
+        return _json_error(404, "no such video")
+    body = await request.json()
+    chapters = body.get("chapters") or []
+    for ch in chapters:
+        if not isinstance(ch.get("title"), str) or \
+                not isinstance(ch.get("start_s"), (int, float)) or \
+                ch["start_s"] < 0:
+            return _json_error(400, "each chapter needs title + start_s>=0")
+    t = db_now()
+    async with db.transaction() as tx:
+        await tx.execute("DELETE FROM chapters WHERE video_id=:v",
+                         {"v": video["id"]})
+        for ch in chapters:
+            await tx.execute(
+                """
+                INSERT INTO chapters (video_id, start_s, title, source,
+                                      created_at)
+                VALUES (:v, :s, :title, :src, :t)
+                """,
+                {"v": video["id"], "s": float(ch["start_s"]),
+                 "title": ch["title"][:200],
+                 "src": ch.get("source", "manual"), "t": t})
+    return web.json_response({"ok": True, "count": len(chapters)})
+
+
+async def detect_chapters(request: web.Request) -> web.Response:
+    """Auto-detect: container chapter atoms first, else transcript
+    silence heuristics (reference admin.py:8391 auto-detect)."""
+    from vlog_tpu.media.chapters import (parse_mp4_chapters,
+                                         suggest_from_transcript)
+
+    db = request.app[DB]
+    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    if video is None:
+        return _json_error(404, "no such video")
+    found = []
+    src = video["source_path"]
+    if src and Path(src).exists() and Path(src).suffix.lower() == ".mp4":
+        try:
+            found = await asyncio.to_thread(parse_mp4_chapters, src)
+        except Exception:  # noqa: BLE001 — malformed atoms just mean none
+            found = []
+    if not found:
+        tr = await db.fetch_one(
+            "SELECT vtt_path FROM transcriptions WHERE video_id=:v "
+            "AND status='completed'", {"v": video["id"]})
+        if tr and tr["vtt_path"] and Path(tr["vtt_path"]).exists():
+            cues = _parse_vtt_cues(Path(tr["vtt_path"]).read_text())
+            found = suggest_from_transcript(cues)
+    return web.json_response({"chapters": [
+        {"start_s": round(c.start_s, 3), "title": c.title,
+         "source": c.source} for c in found]})
+
+
+def _parse_vtt_cues(text: str) -> list[dict]:
+    cues = []
+    for block in text.split("\n\n"):
+        lines = [ln for ln in block.strip().splitlines() if ln]
+        if len(lines) < 2 or "-->" not in lines[0]:
+            continue
+        start, _, end = lines[0].partition("-->")
+
+        def secs(ts: str) -> float:
+            parts = ts.strip().split(":")
+            out = 0.0
+            for p in parts:
+                out = out * 60 + float(p)
+            return out
+
+        cues.append({"start_s": secs(start), "end_s": secs(end),
+                     "text": " ".join(lines[1:])})
+    return cues
+
+
+async def analytics_summary(request: web.Request) -> web.Response:
+    """Per-video playback totals (reference analytics routes,
+    admin.py:3751-4159 condensed to the load-bearing numbers)."""
+    db = request.app[DB]
+    rows = await db.fetch_all(
+        """
+        SELECT v.id, v.slug, v.title,
+               COUNT(s.id) AS sessions,
+               COALESCE(SUM(s.watch_time_s), 0) AS watch_time_s,
+               COUNT(CASE WHEN s.ended_at IS NULL
+                          AND s.last_heartbeat_at > :live_cut
+                     THEN 1 END) AS live_now
+        FROM videos v
+        LEFT JOIN playback_sessions s ON s.video_id = v.id
+        WHERE v.deleted_at IS NULL
+        GROUP BY v.id ORDER BY watch_time_s DESC LIMIT 200
+        """, {"live_cut": db_now() - 120.0})
+    return web.json_response({"videos": rows})
+
+
 async def healthz(request: web.Request) -> web.Response:
     return web.json_response({"ok": True, "db": request.app[DB].connected})
 
@@ -429,7 +551,8 @@ async def healthz(request: web.Request) -> web.Response:
 # --------------------------------------------------------------------------
 
 def build_admin_app(db: Database, *, upload_dir: Path | None = None,
-                    video_dir: Path | None = None) -> web.Application:
+                    video_dir: Path | None = None,
+                    audit_path: Path | str | None = None) -> web.Application:
     app = web.Application(middlewares=[admin_auth_middleware],
                           client_max_size=config.MAX_UPLOAD_SIZE_BYTES)
     app[DB] = db
@@ -455,7 +578,16 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_delete("/api/webhooks/{webhook_id:\\d+}", delete_webhook)
     r.add_get("/api/workers", list_workers)
     r.add_post("/api/workers/{name}/revoke", revoke_worker)
+    r.add_get("/api/videos/{video_id:\\d+}/chapters", get_chapters)
+    r.add_put("/api/videos/{video_id:\\d+}/chapters", put_chapters)
+    r.add_post("/api/videos/{video_id:\\d+}/chapters/detect",
+               detect_chapters)
+    r.add_get("/api/analytics/summary", analytics_summary)
     r.add_get("/healthz", healthz)
+    if audit_path is not None:
+        from vlog_tpu.api.audit import AuditLog
+
+        app[AUDIT] = AuditLog(audit_path)
     return app
 
 
@@ -467,7 +599,8 @@ async def serve(port: int | None = None, db_url: str | None = None,
     db = Database(db_url or config.DATABASE_URL)
     await db.connect()
     await create_all(db)
-    app = build_admin_app(db)
+    app = build_admin_app(
+        db, audit_path=Path(config.BASE_DIR) / "audit" / "admin.log")
     if host is None:
         host = "0.0.0.0" if config.ADMIN_SECRET else "127.0.0.1"
     if not config.ADMIN_SECRET and host not in ("127.0.0.1", "::1",

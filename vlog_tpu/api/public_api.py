@@ -121,8 +121,12 @@ async def video_detail(request: web.Request) -> web.Response:
         "SELECT name, width, height, video_bitrate, audio_bitrate, codec "
         "FROM video_qualities WHERE video_id=:v ORDER BY height DESC",
         {"v": row["id"]})
+    chapters = await db.fetch_all(
+        "SELECT start_s, title FROM chapters WHERE video_id=:v "
+        "ORDER BY start_s", {"v": row["id"]})
     out = _public_video(row)
     out["qualities"] = quals
+    out["chapters"] = chapters
     return web.json_response({"video": out})
 
 

@@ -204,6 +204,26 @@ MIGRATIONS: list[tuple[int, list[str]]] = [
             "CREATE INDEX IF NOT EXISTS idx_sessions_video ON playback_sessions(video_id, started_at)",
         ],
     ),
+    (
+        2,
+        [
+            # -- chapters (reference: chapter_detection.py + admin chapters
+            #    routes, admin.py:8057-8624) --------------------------------
+            """
+            CREATE TABLE IF NOT EXISTS chapters (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                video_id INTEGER NOT NULL REFERENCES videos(id) ON DELETE CASCADE,
+                start_s REAL NOT NULL,
+                title TEXT NOT NULL,
+                source TEXT NOT NULL DEFAULT 'manual',
+                created_at REAL NOT NULL,
+                UNIQUE (video_id, start_s),
+                CHECK (source IN ('manual','container','transcript'))
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS idx_chapters_video ON chapters(video_id, start_s)",
+        ],
+    ),
 ]
 
 
