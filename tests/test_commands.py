@@ -1,0 +1,102 @@
+"""Worker command channel: admin -> DB bus -> worker -> response.
+
+Reference analog: command_listener tests — ping/stats/stop round trips
+for both local daemons (DB-direct) and remote workers (over the worker
+API), with responses visible to the admin.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import httpx
+import pytest
+from aiohttp.test_utils import TestServer
+
+from vlog_tpu.jobs import commands as cmds
+from vlog_tpu.worker.daemon import WorkerDaemon
+
+
+def test_send_claim_respond_roundtrip(run, db):
+    async def go():
+        cid = await cmds.send_command(db, "w1", "ping")
+        with pytest.raises(ValueError):
+            await cmds.send_command(db, "w1", "rm -rf")
+        # other workers see nothing
+        assert await cmds.claim_pending(db, "w2") == []
+        rows = await cmds.claim_pending(db, "w1")
+        assert [r["command"] for r in rows] == ["ping"]
+        # picked up: not claimable twice
+        assert await cmds.claim_pending(db, "w1") == []
+        await cmds.respond(db, cid, {"pong": True})
+        got = await cmds.get_command(db, cid)
+        assert got["response"] == {"pong": True}
+        assert got["completed_at"] is not None
+
+    run(go())
+
+
+def test_daemon_answers_commands_on_heartbeat(run, db, tmp_path):
+    daemon = WorkerDaemon(db, name="cmdw", video_dir=tmp_path,
+                          heartbeat_interval_s=0.05, poll_interval_s=0.05)
+
+    async def go():
+        ping_id = await cmds.send_command(db, "cmdw", "ping")
+        stats_id = await cmds.send_command(db, "cmdw", "stats")
+        stop_id = await cmds.send_command(db, "cmdw", "stop")
+        task = asyncio.create_task(daemon.run())
+        await asyncio.wait_for(task, 10.0)    # the stop command ends run()
+        assert (await cmds.get_command(db, ping_id))["response"]["pong"]
+        stats = (await cmds.get_command(db, stats_id))["response"]
+        assert stats["claimed"] == 0 and "transcode" in stats["kinds"]
+        assert (await cmds.get_command(db, stop_id))["response"]["stopping"]
+
+    run(go())
+
+
+def test_remote_worker_command_over_http(run, db, tmp_path):
+    from vlog_tpu.api.worker_api import build_worker_app
+    from vlog_tpu.worker.remote import RemoteWorker, WorkerAPIClient
+
+    srv = TestServer(build_worker_app(db, video_dir=tmp_path))
+
+    async def go():
+        await srv.start_server()
+        base = str(srv.make_url(""))
+        key = await WorkerAPIClient.register(base, "rcmd")
+        client = WorkerAPIClient(base, key, retries=1)
+        worker = RemoteWorker(client, name="rcmd", work_dir=tmp_path,
+                              heartbeat_interval_s=0.05,
+                              poll_interval_s=0.05)
+        ping_id = await cmds.send_command(db, "rcmd", "ping")
+        stop_id = await cmds.send_command(db, "rcmd", "stop")
+        await asyncio.wait_for(worker.run(), 10.0)
+        assert (await cmds.get_command(db, ping_id))["response"]["pong"]
+        assert (await cmds.get_command(db, stop_id))["response"]["stopping"]
+        await client.aclose()
+        await srv.close()
+
+    run(go())
+
+
+def test_admin_command_endpoints(run, db, tmp_path):
+    from vlog_tpu.api.admin_api import build_admin_app
+
+    srv = TestServer(build_admin_app(db, upload_dir=tmp_path,
+                                     video_dir=tmp_path))
+
+    async def go():
+        await srv.start_server()
+        async with httpx.AsyncClient(base_url=str(srv.make_url(""))) as c:
+            r = await c.post("/api/workers/w9/command",
+                             json={"command": "ping"})
+            assert r.status_code == 201
+            assert (await c.post("/api/workers/w9/command",
+                                 json={"command": "evil"})).status_code == 400
+            listed = (await c.get(
+                "/api/workers/w9/commands")).json()["commands"]
+            assert listed[0]["command"] == "ping"
+            assert listed[0]["response"] is None
+        await srv.close()
+
+    run(go())

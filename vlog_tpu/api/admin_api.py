@@ -426,6 +426,29 @@ async def list_workers(request: web.Request) -> web.Response:
     return web.json_response({"workers": rows})
 
 
+async def send_worker_command(request: web.Request) -> web.Response:
+    """Queue a management command; the worker answers on its next
+    heartbeat tick (reference admin.py:5164-5290 remote worker RPC)."""
+    from vlog_tpu.jobs import commands as cmds
+
+    body = await request.json()
+    try:
+        cmd_id = await cmds.send_command(
+            request.app[DB], request.match_info["name"],
+            str(body.get("command") or ""), body.get("args") or {})
+    except ValueError as exc:
+        return _json_error(400, str(exc))
+    return web.json_response({"command_id": cmd_id}, status=201)
+
+
+async def list_worker_commands(request: web.Request) -> web.Response:
+    from vlog_tpu.jobs import commands as cmds
+
+    rows = await cmds.list_commands(request.app[DB],
+                                    request.match_info["name"])
+    return web.json_response({"commands": rows})
+
+
 async def revoke_worker(request: web.Request) -> web.Response:
     db = request.app[DB]
     name = request.match_info["name"]
@@ -578,6 +601,8 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_delete("/api/webhooks/{webhook_id:\\d+}", delete_webhook)
     r.add_get("/api/workers", list_workers)
     r.add_post("/api/workers/{name}/revoke", revoke_worker)
+    r.add_post("/api/workers/{name}/command", send_worker_command)
+    r.add_get("/api/workers/{name}/commands", list_worker_commands)
     r.add_get("/api/videos/{video_id:\\d+}/chapters", get_chapters)
     r.add_put("/api/videos/{video_id:\\d+}/chapters", put_chapters)
     r.add_post("/api/videos/{video_id:\\d+}/chapters/detect",

@@ -428,6 +428,31 @@ async def upload_status(request: web.Request) -> web.Response:
     return web.json_response({"files": files})
 
 
+async def poll_commands(request: web.Request) -> web.Response:
+    """Remote workers pick up their management commands with the same
+    cadence local daemons do (reference command_listener over pub/sub)."""
+    from vlog_tpu.jobs import commands as cmds
+
+    rows = await cmds.claim_pending(request.app[DB],
+                                    request[IDENTITY].worker_name)
+    return web.json_response({"commands": [
+        {"id": r["id"], "command": r["command"], "args": r["args"]}
+        for r in rows]})
+
+
+async def respond_command(request: web.Request) -> web.Response:
+    from vlog_tpu.jobs import commands as cmds
+
+    db = request.app[DB]
+    cmd_id = int(request.match_info["command_id"])
+    row = await cmds.get_command(db, cmd_id)
+    if row is None or row["worker_name"] != request[IDENTITY].worker_name:
+        return _json_error(404, "no such command")
+    body = await request.json()
+    await cmds.respond(db, cmd_id, body.get("response") or {})
+    return web.json_response({"ok": True})
+
+
 async def healthz(request: web.Request) -> web.Response:
     return web.json_response({"ok": True, "db": request.app[DB].connected})
 
@@ -470,6 +495,9 @@ def build_worker_app(db: Database, video_dir: Path | None = None) -> web.Applica
     app.router.add_get("/api/worker/upload/{video_id:\\d+}/status",
                        upload_status)
     app.router.add_get("/api/worker/workers", list_workers)
+    app.router.add_get("/api/worker/commands", poll_commands)
+    app.router.add_post("/api/worker/commands/{command_id:\\d+}/response",
+                        respond_command)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics_endpoint)
     return app

@@ -224,6 +224,27 @@ MIGRATIONS: list[tuple[int, list[str]]] = [
             "CREATE INDEX IF NOT EXISTS idx_chapters_video ON chapters(video_id, start_s)",
         ],
     ),
+    (
+        3,
+        [
+            # -- worker command channel (reference: command_listener.py over
+            #    Redis pub/sub; here the shared DB is the bus — workers poll
+            #    with their heartbeat) --------------------------------------
+            """
+            CREATE TABLE IF NOT EXISTS worker_commands (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                worker_name TEXT NOT NULL,
+                command TEXT NOT NULL,
+                args TEXT NOT NULL DEFAULT '{}',
+                created_at REAL NOT NULL,
+                picked_up_at REAL,
+                completed_at REAL,
+                response TEXT
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS idx_commands_pending ON worker_commands(worker_name, picked_up_at)",
+        ],
+    ),
 ]
 
 

@@ -161,9 +161,31 @@ class WorkerDaemon:
             if not self._stop.is_set():
                 try:
                     await self._heartbeat()
+                    from vlog_tpu.jobs import commands as cmds
+
+                    await cmds.drain_for_worker(self.db, self.name,
+                                                self.handle_command)
                 except Exception:       # noqa: BLE001 — a transient DB
                     # error must not permanently kill the heartbeat task
                     log.exception("heartbeat write failed; will retry")
+
+    async def handle_command(self, command: str, args: dict) -> dict:
+        """Remote management commands (reference command_listener.py)."""
+        if command == "ping":
+            return {"pong": True, "worker": self.name}
+        if command == "stats":
+            from dataclasses import asdict
+
+            return {**asdict(self.stats),
+                    "current_job_id": self._current_job_id,
+                    "kinds": [k.value for k in self.kinds]}
+        if command == "stop":
+            log.info("remote stop command received")
+            # Defer: the response must be written before shutdown starts
+            # cancelling the heartbeat task that is writing it.
+            asyncio.get_running_loop().call_later(0.5, self.request_stop)
+            return {"stopping": True}
+        return {"error": f"unknown command {command!r}"}
 
     async def run(self) -> None:
         """Main loop: poll → claim → process, until ``request_stop``."""

@@ -184,6 +184,15 @@ class WorkerAPIClient:
                                 f"/api/worker/upload/{video_id}/status")
         return r.json()["files"]
 
+    async def poll_commands(self) -> list[dict]:
+        r = await self._request("GET", "/api/worker/commands")
+        return r.json()["commands"]
+
+    async def respond_command(self, command_id: int, response: dict) -> None:
+        await self._request(
+            "POST", f"/api/worker/commands/{command_id}/response",
+            json={"response": response})
+
     async def healthz(self) -> bool:
         """Side-effect-free reachability check (readiness probes must NOT
         go through /heartbeat, whose write would mask a wedged worker)."""
@@ -341,6 +350,10 @@ class RemoteWorker:
         while not self._stop.is_set():
             try:
                 await self.client.heartbeat(caps)
+                for cmd in await self.client.poll_commands():
+                    resp = await self.handle_command(cmd["command"],
+                                                     cmd.get("args") or {})
+                    await self.client.respond_command(cmd["id"], resp)
             except Exception:
                 log.warning("heartbeat failed; will retry", exc_info=True)
             try:
@@ -348,6 +361,22 @@ class RemoteWorker:
                                        self.heartbeat_interval_s)
             except asyncio.TimeoutError:
                 pass
+
+    async def handle_command(self, command: str, args: dict) -> dict:
+        if command == "ping":
+            return {"pong": True, "worker": self.name}
+        if command == "stats":
+            from dataclasses import asdict
+
+            return {**asdict(self.stats),
+                    "kinds": [k.value for k in self.kinds]}
+        if command == "stop":
+            log.info("remote stop command received")
+            # Defer: the response must be written before shutdown starts
+            # cancelling the heartbeat task that is writing it.
+            asyncio.get_running_loop().call_later(0.5, self.request_stop)
+            return {"stopping": True}
+        return {"error": f"unknown command {command!r}"}
 
     async def poll_once(self) -> bool:
         claimed = await self.client.claim(
