@@ -159,25 +159,38 @@ def get_backend(name: str) -> Backend:
     return factory()
 
 
+_SELECTED: Backend | None = None
+
+
 def select_backend(preference: str | None = None) -> Backend:
     """Pick the best available backend.
 
     Preference order mirrors the reference's GPU-over-CPU encoder
     selection (hwaccel.py:454-481): explicit preference, then whichever
-    registered backend reports TPU devices, then anything.
+    registered backend reports TPU devices, then anything. The choice is
+    cached per process — probing instantiates backends (and may open
+    accelerators), which must happen once, not per job.
     """
+    global _SELECTED
     if preference:
         return get_backend(preference)
+    if _SELECTED is not None:
+        return _SELECTED
     best = None
     for name in _REGISTRY:
         b = get_backend(name)
-        caps = b.detect()
+        try:
+            caps = b.detect()
+        except Exception:       # noqa: BLE001 — a broken backend is
+            continue            # skipped, not fatal to selection
         if caps.device_kind == "tpu":
+            _SELECTED = b
             return b
         if best is None:
             best = b
     if best is None:
-        raise RuntimeError("no backends registered")
+        raise RuntimeError("no backends registered (or none detectable)")
+    _SELECTED = best
     return best
 
 

@@ -60,9 +60,9 @@ _COMPILE_CACHE_SET = False
 
 
 def _enable_persistent_compile_cache() -> None:
-    """XLA programs for 4K chain ladders take minutes to compile; the
-    persistent cache amortizes that across worker restarts (first video
-    of a geometry pays once per fleet node, not once per process).
+    """XLA programs for 4K chain ladders take a minute-plus to compile;
+    the persistent cache amortizes that across worker restarts (first
+    video of a geometry pays once per fleet node, not once per process).
 
     TPU platforms only: CPU AOT cache entries record exact host ISA
     features, and reloading them on a different machine warns of
@@ -439,8 +439,11 @@ class JaxBackend:
                     write_segment(rung, chunk)
             frames_done += n_real
             if progress_cb:
-                progress_cb(frames_done, total,
-                            f"encoded {frames_done}/{total} frames")
+                # total is an estimate for foreign sources; never report
+                # done > total
+                t = max(total, frames_done)
+                progress_cb(frames_done, t,
+                            f"encoded {frames_done}/{t} frames")
 
         def consume_intra(outs, n_real, qps):
             nonlocal frames_done
@@ -473,8 +476,11 @@ class JaxBackend:
                     write_segment(rung, chunk)
             frames_done += n_real
             if progress_cb:
-                progress_cb(frames_done, total,
-                            f"encoded {frames_done}/{total} frames")
+                # total is an estimate for foreign sources; never report
+                # done > total
+                t = max(total, frames_done)
+                progress_cb(frames_done, t,
+                            f"encoded {frames_done}/{t} frames")
 
         consume = consume_chain if chain_mode else consume_intra
 
