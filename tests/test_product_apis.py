@@ -185,6 +185,10 @@ def test_list_detail_delete_restore(run, tmp_path, stack):
         assert detail["jobs"][0]["state"] == "unclaimed"
         assert c.delete(f"/api/videos/{vid['id']}").status_code == 200
         assert c.get("/api/videos").json()["total"] == 0
+        # the admin UI's "show deleted" toggle surfaces the row for restore
+        hidden = c.get("/api/videos?include_deleted=1").json()
+        assert hidden["total"] == 1
+        assert hidden["videos"][0]["deleted_at"] is not None
         assert c.post(f"/api/videos/{vid['id']}/restore").status_code == 200
         assert c.get("/api/videos").json()["total"] == 1
 

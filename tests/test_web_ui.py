@@ -1,0 +1,82 @@
+"""Web UIs: static shell serving, auth exemption, asset resolution.
+
+Reference analog: the web/admin + web/public SPAs (served by the API
+processes). These tests cover the server side of the UI: the shells
+load, assets resolve with correct MIME (including the shared
+stylesheet fallback), traversal is rejected, and the admin auth
+middleware exempts exactly the static shell — never /api.
+
+The in-browser behavior (MSE player, admin SPA flows) is exercised
+manually; the playlist parsers in player.js mirror media/hls.py whose
+writers are oracle-tested in test_media.py.
+"""
+
+from __future__ import annotations
+
+import httpx
+
+from vlog_tpu import config
+from vlog_tpu.web import WEB_ROOT, is_ui_path
+
+from tests.test_product_apis import stack  # noqa: F401  (fixture reuse)
+
+
+def test_public_ui_shell(stack):  # noqa: F811
+    with httpx.Client(base_url=stack["public"]) as c:
+        r = c.get("/")
+        assert r.status_code == 200
+        assert r.headers["content-type"].startswith("text/html")
+        assert "view-browse" in r.text and "view-watch" in r.text
+        for asset, mime, marker in [
+            ("/ui/app.js", "application/javascript", "CmafPlayer"),
+            ("/ui/player.js", "application/javascript", "EXT-X-STREAM-INF"),
+            ("/ui/style.css", "text/css", "--accent"),  # shared/ fallback
+        ]:
+            r = c.get(asset)
+            assert r.status_code == 200, asset
+            assert r.headers["content-type"].startswith(mime), asset
+            assert marker in r.text, asset
+
+
+def test_admin_ui_shell_and_auth_exemption(stack, monkeypatch):  # noqa: F811
+    monkeypatch.setattr(config, "ADMIN_SECRET", "s3cret")
+    with httpx.Client(base_url=stack["admin"]) as c:
+        # static shell loads with no secret...
+        assert c.get("/").status_code == 200
+        assert "login-form" in c.get("/").text
+        assert c.get("/ui/app.js").status_code == 200
+        assert c.get("/ui/style.css").status_code == 200
+        # ...but the API plane still requires it
+        assert c.get("/api/settings").status_code == 403
+        ok = c.get("/api/settings", headers={"X-Admin-Secret": "s3cret"})
+        assert ok.status_code == 200
+
+
+def test_ui_asset_missing_and_traversal(stack):  # noqa: F811
+    with httpx.Client(base_url=stack["public"]) as c:
+        assert c.get("/ui/nope.js").status_code == 404
+        # encoded traversal must not escape the package dir
+        r = c.get("/ui/%2e%2e/%2e%2e/config.py")
+        assert r.status_code in (400, 404)
+        assert "VLOG_" not in r.text
+
+
+def test_is_ui_path_scope():
+    assert is_ui_path("/")
+    assert is_ui_path("/ui/app.js")
+    assert not is_ui_path("/api/settings")
+    assert not is_ui_path("/healthz")
+    assert not is_ui_path("/uiX")
+
+
+def test_ui_files_reference_only_served_assets():
+    """Every /ui/ path mentioned in the shells exists on disk (public
+    assets may also resolve through shared/)."""
+    import re
+
+    for which in ("public", "admin"):
+        html = (WEB_ROOT / which / "index.html").read_text()
+        for ref in re.findall(r'/ui/([\w./-]+)', html):
+            p = WEB_ROOT / which / ref
+            shared = WEB_ROOT / "shared" / ref
+            assert p.is_file() or shared.is_file(), f"{which}: {ref}"

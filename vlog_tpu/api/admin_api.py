@@ -66,7 +66,11 @@ AUDIT = web.AppKey("audit", object)
 
 @web.middleware
 async def admin_auth_middleware(request: web.Request, handler):
-    if request.path == "/healthz":
+    from vlog_tpu.web import is_ui_path
+
+    # The static UI shell (login page + assets) must load without the
+    # secret; every /api route below still requires it.
+    if request.path == "/healthz" or is_ui_path(request.path):
         return await handler(request)
     if not authmod.check_admin_secret(request.headers.get("X-Admin-Secret"),
                                       config.ADMIN_SECRET):
@@ -170,7 +174,9 @@ async def list_videos(request: web.Request) -> web.Response:
     q = request.query
     limit = _qnum(q, "limit", 50, lo=1, hi=500)
     offset = _qnum(q, "offset", 0, lo=0)
-    where = ["deleted_at IS NULL"]
+    # include_deleted=1 surfaces soft-deleted rows so they can be restored
+    where = (["1=1"] if q.get("include_deleted")
+             else ["deleted_at IS NULL"])
     params: dict = {"limit": limit, "offset": offset}
     if q.get("status"):
         where.append("status=:status")
@@ -347,6 +353,7 @@ async def sse_progress(request: web.Request) -> web.StreamResponse:
                 payload = {"job_id": r["id"], "video_id": r["video_id"],
                            "kind": r["kind"], "progress": r["progress"],
                            "current_step": r["current_step"],
+                           "worker": r["claimed_by"],
                            "state": key[2]}
                 await resp.write(
                     f"event: progress\ndata: {json.dumps(payload)}\n\n"
@@ -609,6 +616,9 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
                detect_chapters)
     r.add_get("/api/analytics/summary", analytics_summary)
     r.add_get("/healthz", healthz)
+    from vlog_tpu.web import attach_ui
+
+    attach_ui(app, "admin")
     if audit_path is not None:
         from vlog_tpu.api.audit import AuditLog
 

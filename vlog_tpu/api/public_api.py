@@ -254,6 +254,9 @@ def build_public_app(db: Database, *, video_dir: Path | None = None
     r.add_post("/api/sessions/end", end_session)
     r.add_get("/videos/{slug}/{tail:.+}", serve_media)
     r.add_get("/healthz", healthz)
+    from vlog_tpu.web import attach_ui
+
+    attach_ui(app, "public")
     return app
 
 

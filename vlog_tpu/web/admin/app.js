@@ -1,0 +1,429 @@
+/* Admin SPA over vlog_tpu.api.admin_api.
+ * Auth: X-Admin-Secret header on every /api call (the secret lives in
+ * sessionStorage only). SSE progress arrives via a streamed fetch
+ * because EventSource cannot attach headers.
+ */
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+let secret = sessionStorage.getItem("vlog_admin_secret") || "";
+let sseAbort = null;
+
+function toast(msg, isErr) {
+  const t = document.createElement("div");
+  t.className = "toast" + (isErr ? " error" : "");
+  t.textContent = msg;
+  document.body.appendChild(t);
+  setTimeout(() => t.remove(), 4000);
+}
+
+async function api(path, opts = {}) {
+  opts.headers = Object.assign({ "X-Admin-Secret": secret }, opts.headers);
+  const r = await fetch(path, opts);
+  if (r.status === 403) { showLogin("Bad admin secret."); throw new Error("403"); }
+  if (!r.ok) {
+    let msg = `HTTP ${r.status}`;
+    try { msg = (await r.json()).error || msg; } catch (e) { /* not json */ }
+    throw new Error(msg);
+  }
+  return r.status === 204 ? null : r.json();
+}
+
+function fmtBytes(n) {
+  if (!n) return "—";
+  const u = ["B", "KB", "MB", "GB", "TB"];
+  let i = 0;
+  while (n >= 1024 && i < u.length - 1) { n /= 1024; i++; }
+  return `${n.toFixed(i ? 1 : 0)} ${u[i]}`;
+}
+function fmtDur(s) {
+  if (s == null) return "—";
+  s = Math.round(s);
+  return `${(s / 60) | 0}:${String(s % 60).padStart(2, "0")}`;
+}
+function fmtAgo(t) {
+  if (!t) return "never";
+  const d = Date.now() / 1000 - t;
+  if (d < 90) return `${Math.round(d)}s ago`;
+  if (d < 5400) return `${Math.round(d / 60)}m ago`;
+  return `${Math.round(d / 3600)}h ago`;
+}
+function badge(text) {
+  const b = document.createElement("span");
+  b.className = `badge ${text}`;
+  b.textContent = text;
+  return b;
+}
+function cells(tr, values) {
+  for (const v of values) {
+    const td = document.createElement("td");
+    if (v instanceof Node) td.appendChild(v);
+    else td.textContent = v == null ? "—" : String(v);
+    tr.appendChild(td);
+  }
+}
+function actionBtn(label, fn, cls) {
+  const b = document.createElement("button");
+  b.textContent = label;
+  if (cls) b.className = cls;
+  b.onclick = async () => {
+    b.disabled = true;
+    try { await fn(); } catch (e) { toast(e.message, true); }
+    b.disabled = false;
+  };
+  return b;
+}
+
+/* ------------------------------------------------- login -------------- */
+
+function showLogin(err) {
+  $("login").hidden = false;
+  $("login-err").textContent = err || "";
+  stopSse();
+}
+
+$("login-form").addEventListener("submit", async (ev) => {
+  ev.preventDefault();
+  secret = $("secret").value;
+  try {
+    await api("/api/settings");
+    sessionStorage.setItem("vlog_admin_secret", secret);
+    $("login").hidden = true;
+    boot();
+  } catch (e) { /* showLogin already ran on 403 */ }
+});
+
+$("logout").onclick = () => {
+  sessionStorage.removeItem("vlog_admin_secret");
+  secret = "";
+  showLogin("");
+};
+
+/* ------------------------------------------------- tabs --------------- */
+
+const loaders = {
+  dashboard: loadDashboard, videos: loadVideos, jobs: loadJobs,
+  workers: loadWorkers, settings: loadSettings, webhooks: loadWebhooks,
+};
+
+function switchTab(name) {
+  for (const b of $("tabs").children) b.classList.toggle("active", b.dataset.tab === name);
+  for (const s of document.querySelectorAll(".tab")) s.hidden = s.id !== `tab-${name}`;
+  location.hash = name;
+  loaders[name]();
+}
+$("tabs").addEventListener("click", (ev) => {
+  if (ev.target.dataset.tab) switchTab(ev.target.dataset.tab);
+});
+
+/* ------------------------------------------------- dashboard ---------- */
+
+const progressRows = new Map();   // job_id -> tr
+
+async function loadDashboard() {
+  const d = await api("/api/analytics/summary");
+  const vids = d.videos || [];
+  const totals = vids.reduce((a, v) => {
+    a.sessions += v.sessions; a.watch += v.watch_time_s; a.live += v.live_now;
+    return a;
+  }, { sessions: 0, watch: 0, live: 0 });
+  const w = await api("/api/workers");
+  const online = w.workers.filter((x) => x.online).length;
+  const stats = [
+    [vids.length, "videos with plays"],
+    [totals.sessions, "playback sessions"],
+    [`${(totals.watch / 3600).toFixed(1)}h`, "watch time"],
+    [totals.live, "watching now"],
+    [`${online}/${w.workers.length}`, "workers online"],
+  ];
+  const sg = $("stats");
+  sg.textContent = "";
+  for (const [n, l] of stats) {
+    const div = document.createElement("div");
+    div.className = "stat";
+    div.innerHTML = `<div class="n"></div><div class="l"></div>`;
+    div.firstChild.textContent = n;
+    div.lastChild.textContent = l;
+    sg.appendChild(div);
+  }
+  const tb = $("top-table").tBodies[0];
+  tb.textContent = "";
+  for (const v of vids.slice(0, 10)) {
+    const tr = document.createElement("tr");
+    cells(tr, [v.title, v.sessions, v.live_now, `${(v.watch_time_s / 60).toFixed(1)} min`]);
+    tb.appendChild(tr);
+  }
+  startSse();
+}
+
+function renderProgress(ev) {
+  const tb = $("progress-table").tBodies[0];
+  let tr = progressRows.get(ev.job_id);
+  const terminal = ["completed", "dead", "failed"].includes(ev.state);
+  if (terminal) {
+    if (tr) { tr.remove(); progressRows.delete(ev.job_id); }
+    $("progress-empty").hidden = progressRows.size > 0;
+    return;
+  }
+  if (!tr) {
+    tr = document.createElement("tr");
+    progressRows.set(ev.job_id, tr);
+    tb.appendChild(tr);
+  }
+  tr.textContent = "";
+  const bar = document.createElement("div");
+  bar.className = "progressbar";
+  const fill = document.createElement("div");
+  fill.style.width = `${Math.round((ev.progress || 0) * 100)}%`;
+  bar.appendChild(fill);
+  const pct = document.createElement("span");
+  pct.className = "dim";
+  pct.textContent = ` ${Math.round((ev.progress || 0) * 100)}% ${ev.current_step || ""}`;
+  const cell = document.createElement("div");
+  cell.append(bar, pct);
+  cells(tr, [`#${ev.job_id}`, `video ${ev.video_id}`, ev.kind, badge(ev.state), cell, ev.worker || "—"]);
+  $("progress-empty").hidden = true;
+}
+
+async function startSse() {
+  if (sseAbort) return;
+  sseAbort = new AbortController();
+  $("live").textContent = "● live";
+  try {
+    const r = await fetch("/api/events/progress", {
+      headers: { "X-Admin-Secret": secret },
+      signal: sseAbort.signal,
+    });
+    const reader = r.body.getReader();
+    const dec = new TextDecoder();
+    let buf = "";
+    for (;;) {
+      const { done, value } = await reader.read();
+      if (done) break;
+      buf += dec.decode(value, { stream: true });
+      let idx;
+      while ((idx = buf.indexOf("\n\n")) >= 0) {
+        const block = buf.slice(0, idx);
+        buf = buf.slice(idx + 2);
+        const data = block.split("\n").find((l) => l.startsWith("data: "));
+        if (data) {
+          try { renderProgress(JSON.parse(data.slice(6))); } catch (e) { /* skip */ }
+        }
+      }
+    }
+  } catch (e) { /* aborted or connection lost */ }
+  $("live").textContent = "";
+  sseAbort = null;
+}
+function stopSse() {
+  if (sseAbort) sseAbort.abort();
+}
+
+/* ------------------------------------------------- videos ------------- */
+
+async function loadVideos() {
+  const extra = $("show-deleted").checked ? "&include_deleted=1" : "";
+  const d = await api(`/api/videos?limit=200${extra}`);
+  const tb = $("videos-table").tBodies[0];
+  tb.textContent = "";
+  for (const v of d.videos) {
+    const tr = document.createElement("tr");
+    const acts = document.createElement("div");
+    acts.className = "row-actions";
+    acts.append(
+      actionBtn("retranscode", async () => {
+        await api(`/api/videos/${v.id}/retranscode`, {
+          method: "POST", headers: { "Content-Type": "application/json" },
+          body: JSON.stringify({ force: true }),
+        });
+        toast(`re-transcode queued for #${v.id}`);
+      }),
+      actionBtn("→hls_ts", async () => {
+        await api(`/api/videos/${v.id}/reencode`, {
+          method: "POST", headers: { "Content-Type": "application/json" },
+          body: JSON.stringify({ streaming_format: v.streaming_format === "cmaf" ? "hls_ts" : "cmaf" }),
+        });
+        toast(`re-encode queued for #${v.id}`);
+      }),
+      actionBtn("chapters", async () => {
+        const d2 = await api(`/api/videos/${v.id}/chapters/detect`, { method: "POST" });
+        if (!d2.chapters.length) { toast("no chapters detected"); return; }
+        await api(`/api/videos/${v.id}/chapters`, {
+          method: "PUT", headers: { "Content-Type": "application/json" },
+          body: JSON.stringify({ chapters: d2.chapters }),
+        });
+        toast(`${d2.chapters.length} chapters saved`);
+      }),
+      v.deleted_at
+        ? actionBtn("restore", async () => { await api(`/api/videos/${v.id}/restore`, { method: "POST" }); loadVideos(); })
+        : actionBtn("delete", async () => { await api(`/api/videos/${v.id}`, { method: "DELETE" }); loadVideos(); }),
+    );
+    cells(tr, [v.id, v.title, badge(v.status), fmtBytes(v.size_bytes), fmtDur(v.duration_s), acts]);
+    tb.appendChild(tr);
+  }
+}
+
+$("show-deleted").addEventListener("change", loadVideos);
+
+$("upload-form").addEventListener("submit", (ev) => {
+  ev.preventDefault();
+  const file = $("up-file").files[0];
+  if (!file) return;
+  const fd = new FormData();
+  fd.append("title", $("up-title").value);
+  if ($("up-category").value) fd.append("category", $("up-category").value);
+  fd.append("file", file);
+  const xhr = new XMLHttpRequest();   // fetch has no upload progress
+  xhr.open("POST", "/api/videos");
+  xhr.setRequestHeader("X-Admin-Secret", secret);
+  $("up-bar").hidden = false;
+  xhr.upload.onprogress = (e) => {
+    if (e.lengthComputable) $("up-bar").firstChild.style.width = `${(e.loaded / e.total) * 100}%`;
+  };
+  xhr.onload = () => {
+    $("up-bar").hidden = true;
+    if (xhr.status === 201) {
+      const d = JSON.parse(xhr.responseText);
+      $("up-msg").textContent = `Uploaded: video #${d.video.id}, job #${d.job_id}`;
+      $("upload-form").reset();
+      loadVideos();
+    } else {
+      let msg = `upload failed: HTTP ${xhr.status}`;
+      try { msg = JSON.parse(xhr.responseText).error || msg; } catch (e) { /* */ }
+      toast(msg, true);
+    }
+  };
+  xhr.onerror = () => { $("up-bar").hidden = true; toast("upload failed", true); };
+  xhr.send(fd);
+});
+
+/* ------------------------------------------------- jobs --------------- */
+
+async function loadJobs() {
+  const d = await api("/api/jobs/failed");
+  const tb = $("failed-table").tBodies[0];
+  tb.textContent = "";
+  $("failed-empty").hidden = d.jobs.length > 0;
+  for (const jb of d.jobs) {
+    const tr = document.createElement("tr");
+    const err = document.createElement("span");
+    err.className = "dim";
+    err.textContent = (jb.error || "").slice(0, 120);
+    err.title = jb.error || "";
+    cells(tr, [`#${jb.id}`, jb.title, jb.kind, jb.attempt, err,
+      actionBtn("requeue", async () => { await api(`/api/jobs/${jb.id}/requeue`, { method: "POST" }); loadJobs(); })]);
+    tb.appendChild(tr);
+  }
+}
+
+/* ------------------------------------------------- workers ------------ */
+
+async function loadWorkers() {
+  const d = await api("/api/workers");
+  const tb = $("workers-table").tBodies[0];
+  tb.textContent = "";
+  for (const w of d.workers) {
+    const tr = document.createElement("tr");
+    const acts = document.createElement("div");
+    acts.className = "row-actions";
+    const cmd = (c) => actionBtn(c, async () => {
+      await api(`/api/workers/${encodeURIComponent(w.name)}/command`, {
+        method: "POST", headers: { "Content-Type": "application/json" },
+        body: JSON.stringify({ command: c }),
+      });
+      toast(`${c} queued for ${w.name}; polling result…`);
+      setTimeout(async () => {
+        const r = await api(`/api/workers/${encodeURIComponent(w.name)}/commands`);
+        $("cmd-out").hidden = false;
+        $("cmd-pre").textContent = JSON.stringify(r.commands.slice(0, 3), null, 2);
+      }, 3000);
+    });
+    acts.append(cmd("ping"), cmd("stats"), cmd("stop"),
+      actionBtn("revoke", async () => {
+        await api(`/api/workers/${encodeURIComponent(w.name)}/revoke`, { method: "POST" });
+        toast(`revoked ${w.name}`);
+        loadWorkers();
+      }));
+    cells(tr, [w.name,
+      badge(w.status === "revoked" ? "revoked" : (w.online ? "online" : "offline")),
+      w.accelerator, fmtAgo(w.last_heartbeat_at),
+      w.capabilities.running_jobs != null ? String(w.capabilities.running_jobs) : "—",
+      acts]);
+    tb.appendChild(tr);
+  }
+}
+
+/* ------------------------------------------------- settings ----------- */
+
+async function loadSettings() {
+  const d = await api("/api/settings");   // shape: {settings: {key: value}}
+  const tb = $("settings-table").tBodies[0];
+  tb.textContent = "";
+  for (const [key, value] of Object.entries(d.settings)) {
+    const tr = document.createElement("tr");
+    cells(tr, [key, JSON.stringify(value),
+      actionBtn("delete", async () => { await api(`/api/settings/${encodeURIComponent(key)}`, { method: "DELETE" }); loadSettings(); })]);
+    tb.appendChild(tr);
+  }
+}
+
+$("set-save").onclick = async () => {
+  const key = $("set-key").value.trim();
+  if (!key) return;
+  let value = $("set-val").value;
+  try { value = JSON.parse(value); } catch (e) { /* keep as string */ }
+  try {
+    await api(`/api/settings/${encodeURIComponent(key)}`, {
+      method: "PUT", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ value }),
+    });
+    $("set-key").value = $("set-val").value = "";
+    loadSettings();
+  } catch (e) { toast(e.message, true); }
+};
+
+/* ------------------------------------------------- webhooks ----------- */
+
+async function loadWebhooks() {
+  const d = await api("/api/webhooks");
+  const tb = $("webhooks-table").tBodies[0];
+  tb.textContent = "";
+  for (const w of d.webhooks) {
+    const tr = document.createElement("tr");
+    cells(tr, [w.id, w.url, w.events.join(", ") || "all", w.active ? "yes" : "no",
+      actionBtn("delete", async () => { await api(`/api/webhooks/${w.id}`, { method: "DELETE" }); loadWebhooks(); })]);
+    tb.appendChild(tr);
+  }
+}
+
+$("wh-create").onclick = async () => {
+  const url = $("wh-url").value.trim();
+  if (!url) return;
+  try {
+    await api("/api/webhooks", {
+      method: "POST", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({
+        url,
+        events: $("wh-events").value.split(",").map((s) => s.trim()).filter(Boolean),
+        secret: $("wh-secret").value || null,
+      }),
+    });
+    $("wh-url").value = $("wh-events").value = $("wh-secret").value = "";
+    loadWebhooks();
+  } catch (e) { toast(e.message, true); }
+};
+
+/* ------------------------------------------------- boot --------------- */
+
+async function boot() {
+  const tab = (location.hash || "#dashboard").slice(1);
+  switchTab(loaders[tab] ? tab : "dashboard");
+}
+
+(async () => {
+  if (!secret) { showLogin(""); return; }
+  try {
+    await api("/api/settings");
+    boot();
+  } catch (e) { /* 403 -> login shown */ }
+})();

@@ -1,0 +1,272 @@
+/* Public SPA: #/ browse grid, #/v/{slug} watch page.
+ * Data: vlog_tpu.api.public_api (/api/videos, /api/categories,
+ * /api/videos/{slug}/transcript, playback sessions).
+ */
+"use strict";
+import { CmafPlayer } from "/ui/player.js";
+
+const $ = (id) => document.getElementById(id);
+const PAGE = 24;
+let state = { offset: 0, total: 0, q: "", category: "" };
+let player = null;
+let session = null;        // {token, timer, watched}
+let watchCleanup = [];     // undo-list for listeners/timers of the open video
+let gridSeq = 0;           // drops stale /api/videos responses
+
+function fmtDur(s) {
+  s = Math.round(s || 0);
+  const h = (s / 3600) | 0, m = ((s % 3600) / 60) | 0, sec = s % 60;
+  return (h ? `${h}:${String(m).padStart(2, "0")}` : `${m}`) + ":" + String(sec).padStart(2, "0");
+}
+
+async function j(url, opts) {
+  const r = await fetch(url, opts);
+  if (!r.ok) throw new Error(`${url}: HTTP ${r.status}`);
+  return r.json();
+}
+
+/* ------------------------------------------------- browse ------------ */
+
+async function loadCategories() {
+  try {
+    const d = await j("/api/categories");
+    for (const c of d.categories) {
+      const o = document.createElement("option");
+      o.value = c.category;
+      o.textContent = `${c.category} (${c.n})`;
+      $("category").appendChild(o);
+    }
+  } catch (e) { /* category filter is optional */ }
+}
+
+async function loadGrid() {
+  const p = new URLSearchParams({ limit: PAGE, offset: state.offset });
+  if (state.q) p.set("q", state.q);
+  if (state.category) p.set("category", state.category);
+  const seq = ++gridSeq;
+  const d = await j(`/api/videos?${p}`);
+  if (seq !== gridSeq) return;   // a newer query superseded this response
+  state.total = d.total;
+  const grid = $("grid");
+  grid.textContent = "";
+  $("empty").hidden = d.videos.length > 0;
+  for (const v of d.videos) {
+    const card = document.createElement("div");
+    card.className = "card";
+    card.onclick = () => { location.hash = `#/v/${v.slug}`; };
+    const thumb = document.createElement("div");
+    thumb.className = "thumb";
+    if (v.thumbnail_url) thumb.style.backgroundImage = `url('${v.thumbnail_url}')`;
+    else thumb.textContent = "▶";
+    const dur = document.createElement("span");
+    dur.className = "dur";
+    dur.textContent = fmtDur(v.duration_s);
+    thumb.appendChild(dur);
+    const body = document.createElement("div");
+    body.className = "body";
+    const title = document.createElement("p");
+    title.className = "title";
+    title.textContent = v.title;
+    const meta = document.createElement("span");
+    meta.className = "dim";
+    meta.textContent = `${v.height ? v.height + "p · " : ""}${new Date(v.created_at * 1000).toLocaleDateString()}`;
+    body.append(title, meta);
+    card.append(thumb, body);
+    grid.appendChild(card);
+  }
+  const page = (state.offset / PAGE | 0) + 1;
+  const pages = Math.max(1, Math.ceil(state.total / PAGE));
+  $("page-info").textContent = `${page} / ${pages} · ${state.total} videos`;
+  $("prev").disabled = state.offset === 0;
+  $("next").disabled = state.offset + PAGE >= state.total;
+}
+
+/* ------------------------------------------------- watch ------------- */
+
+async function startAnalytics(slug, video) {
+  try {
+    const d = await j(`/api/videos/${slug}/session`, { method: "POST" });
+    session = { token: d.session, watched: 0, timer: 0 };
+    const mySession = session;
+    let last = 0;
+    const onTime = () => {
+      const t = video.currentTime;
+      if (t > last && t - last < 2) mySession.watched += t - last;
+      last = t;
+    };
+    video.addEventListener("timeupdate", onTime);
+    watchCleanup.push(() => video.removeEventListener("timeupdate", onTime));
+    session.timer = setInterval(() => {
+      if (!session) return;
+      fetch("/api/sessions/heartbeat", {
+        method: "POST", headers: { "Content-Type": "application/json" },
+        body: JSON.stringify({ session: session.token, watch_time_s: session.watched }),
+      }).catch(() => {});
+    }, 15000);
+    window.addEventListener("pagehide", endAnalytics, { once: true });
+  } catch (e) { /* analytics must never break playback */ }
+}
+
+function endAnalytics() {
+  if (!session) return;
+  clearInterval(session.timer);
+  const body = JSON.stringify({ session: session.token, watch_time_s: session.watched });
+  if (navigator.sendBeacon) {
+    navigator.sendBeacon("/api/sessions/end", new Blob([body], { type: "application/json" }));
+  } else {
+    fetch("/api/sessions/end", { method: "POST", headers: { "Content-Type": "application/json" }, body }).catch(() => {});
+  }
+  session = null;
+}
+
+async function loadTranscript(slug, video) {
+  const el = $("transcript");
+  el.textContent = "No transcript.";
+  el.classList.add("dim");
+  try {
+    const d = await j(`/api/videos/${slug}/transcript`);
+    const vtt = await (await fetch(d.vtt_url)).text();
+    const cues = [];
+    // WEBVTT cue blocks: "hh:mm:ss.mmm --> hh:mm:ss.mmm" then text lines
+    const re = /(\d+):(\d\d):(\d\d)\.(\d+)\s+-->\s+(\d+):(\d\d):(\d\d)\.\d+\n((?:[^\n]+\n?)+)/g;
+    let m;
+    while ((m = re.exec(vtt)) !== null) {
+      cues.push({
+        start: (+m[1]) * 3600 + (+m[2]) * 60 + (+m[3]) + (+m[4]) / 1000,
+        text: m[8].trim().replace(/\n/g, " "),
+      });
+    }
+    if (!cues.length) return;
+    el.textContent = "";
+    el.classList.remove("dim");
+    const nodes = cues.map((c) => {
+      const div = document.createElement("div");
+      div.className = "cue";
+      const t = document.createElement("span");
+      t.className = "t";
+      t.textContent = fmtDur(c.start);
+      div.append(t, document.createTextNode(c.text));
+      div.onclick = () => { video.currentTime = c.start; video.play(); };
+      el.appendChild(div);
+      return div;
+    });
+    // native captions overlay
+    const track = document.createElement("track");
+    track.kind = "captions"; track.label = d.language || "captions";
+    track.src = d.vtt_url; track.default = true;
+    video.appendChild(track);
+    const onCueTime = () => {
+      const t = video.currentTime;
+      let live = -1;
+      for (let i = 0; i < cues.length; i++) if (cues[i].start <= t) live = i;
+      nodes.forEach((n, i) => n.classList.toggle("live", i === live));
+    };
+    video.addEventListener("timeupdate", onCueTime);
+    watchCleanup.push(() => video.removeEventListener("timeupdate", onCueTime));
+  } catch (e) { /* 404 = no transcript */ }
+}
+
+async function openWatch(slug) {
+  const d = await j(`/api/videos/${slug}`);
+  const v = d.video;
+  $("v-title").textContent = v.title;
+  $("v-desc").textContent = v.description || "";
+  $("v-meta").textContent =
+    `${v.width}×${v.height} · ${fmtDur(v.duration_s)} · ` +
+    `${v.qualities.map((q) => q.name).join(" ")}`;
+  const chapEl = $("chapters");
+  chapEl.textContent = "";
+  const video = $("player");
+
+  for (const c of v.chapters || []) {
+    const b = document.createElement("button");
+    b.textContent = `${fmtDur(c.start_s)} ${c.title}`;
+    b.onclick = () => { video.currentTime = c.start_s; video.play(); };
+    chapEl.appendChild(b);
+  }
+
+  $("player-fallback").hidden = true;
+  player = new CmafPlayer(video, v.stream_url, {
+    onqualitychange: (i) => {
+      const sel = $("quality");
+      if (sel.dataset.auto === "1") sel.selectedIndex = 0;
+    },
+    onerror: () => {
+      $("player-fallback").hidden = false;
+      $("player-fallback").textContent =
+        "Playback failed in this browser. Direct streams: " ;
+      const a = document.createElement("a");
+      a.href = v.dash_url; a.textContent = "DASH manifest";
+      $("player-fallback").appendChild(a);
+    },
+  });
+  try {
+    await player.load();
+    const sel = $("quality");
+    sel.textContent = "";
+    sel.dataset.auto = "1";
+    const auto = document.createElement("option");
+    auto.value = "-1"; auto.textContent = "Auto";
+    sel.appendChild(auto);
+    player.variants.forEach((va, i) => {
+      const o = document.createElement("option");
+      o.value = String(i);
+      o.textContent = `${va.height}p`;
+      sel.appendChild(o);
+    });
+    sel.onchange = () => {
+      sel.dataset.auto = sel.value === "-1" ? "1" : "0";
+      player.setQuality(parseInt(sel.value, 10));
+    };
+    const bwTimer = setInterval(() => {
+      if (player && player.bwEst) $("bw").textContent = `${(player.bwEst / 1e6).toFixed(1)} Mb/s`;
+    }, 2000);
+    watchCleanup.push(() => clearInterval(bwTimer));
+  } catch (e) {
+    player.onerror(e);
+  }
+  loadTranscript(slug, video);
+  startAnalytics(slug, video);
+}
+
+function closeWatch() {
+  endAnalytics();
+  for (const undo of watchCleanup.splice(0)) undo();
+  if (player) { player.destroy(); player = null; }
+  const video = $("player");
+  video.querySelectorAll("track").forEach((t) => t.remove());
+}
+
+/* ------------------------------------------------- routing ----------- */
+
+function route() {
+  const h = location.hash || "#/";
+  const watch = h.startsWith("#/v/");
+  $("view-browse").hidden = watch;
+  $("view-watch").hidden = !watch;
+  closeWatch();
+  if (watch) openWatch(decodeURIComponent(h.slice(4)));
+  else loadGrid();
+}
+
+let searchTimer = 0;
+$("search").addEventListener("input", () => {
+  clearTimeout(searchTimer);
+  searchTimer = setTimeout(() => {
+    state.q = $("search").value.trim();
+    state.offset = 0;
+    if (!location.hash || location.hash === "#/") loadGrid();
+    else location.hash = "#/";
+  }, 200);
+});
+$("category").addEventListener("change", () => {
+  state.category = $("category").value;
+  state.offset = 0;
+  loadGrid();
+});
+$("prev").onclick = () => { state.offset = Math.max(0, state.offset - PAGE); loadGrid(); };
+$("next").onclick = () => { state.offset += PAGE; loadGrid(); };
+window.addEventListener("hashchange", route);
+
+loadCategories();
+route();
