@@ -1,6 +1,7 @@
-/* Test-only H.264 -> raw I420 oracle decoder against system libavcodec.
+/* Test-only H.264/HEVC -> raw I420 oracle decoder against system
+ * libavcodec.
  *
- * Usage: avdec <in.h264 (annex-b)> <out.yuv>
+ * Usage: avdec <in.bits (annex-b)> <out.yuv> [h264|hevc]
  * Decodes every frame and appends Y, U, V planes (tightly packed) to the
  * output. Used by tests to validate that bitstreams from our TPU encoder
  * reconstruct bit-exactly in a third-party spec decoder (same role ffmpeg
@@ -23,14 +24,17 @@ static void dump(AVFrame *f, FILE *out) {
 }
 
 int main(int argc, char **argv) {
-    if (argc != 3) die("usage: avdec <in.h264> <out.yuv>");
+    if (argc != 3 && argc != 4)
+        die("usage: avdec <in.bits> <out.yuv> [h264|hevc]");
     FILE *in = fopen(argv[1], "rb");
     if (!in) die("cannot open input");
     FILE *out = fopen(argv[2], "wb");
     if (!out) die("cannot open output");
 
-    const AVCodec *codec = avcodec_find_decoder(AV_CODEC_ID_H264);
-    if (!codec) die("no h264 decoder");
+    enum AVCodecID id = AV_CODEC_ID_H264;
+    if (argc == 4 && !strcmp(argv[3], "hevc")) id = AV_CODEC_ID_HEVC;
+    const AVCodec *codec = avcodec_find_decoder(id);
+    if (!codec) die("no decoder");
     AVCodecParserContext *parser = av_parser_init(codec->id);
     AVCodecContext *ctx = avcodec_alloc_context3(codec);
     if (avcodec_open2(ctx, codec, NULL) < 0) die("open failed");

@@ -175,7 +175,7 @@ async def list_videos(request: web.Request) -> web.Response:
     limit = _qnum(q, "limit", 50, lo=1, hi=500)
     offset = _qnum(q, "offset", 0, lo=0)
     # include_deleted=1 surfaces soft-deleted rows so they can be restored
-    where = (["1=1"] if q.get("include_deleted")
+    where = (["1=1"] if q.get("include_deleted") in ("1", "true", "yes")
              else ["deleted_at IS NULL"])
     params: dict = {"limit": limit, "offset": offset}
     if q.get("status"):

@@ -1,0 +1,2 @@
+"""First-party HEVC (H.265) encoder — TPU compute core + CABAC host
+entropy. See syntax.py for the stream shape and encoder.py for the API."""

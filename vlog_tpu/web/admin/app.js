@@ -221,9 +221,17 @@ function stopSse() {
 
 /* ------------------------------------------------- videos ------------- */
 
+const VID_PAGE = 100;
+let vidOffset = 0;
+
 async function loadVideos() {
   const extra = $("show-deleted").checked ? "&include_deleted=1" : "";
-  const d = await api(`/api/videos?limit=200${extra}`);
+  const d = await api(
+    `/api/videos?limit=${VID_PAGE}&offset=${vidOffset}${extra}`);
+  $("vids-page").textContent =
+    `${vidOffset + 1}–${Math.min(vidOffset + VID_PAGE, d.total)} of ${d.total}`;
+  $("vids-prev").disabled = vidOffset === 0;
+  $("vids-next").disabled = vidOffset + VID_PAGE >= d.total;
   const tb = $("videos-table").tBodies[0];
   tb.textContent = "";
   for (const v of d.videos) {
@@ -238,13 +246,16 @@ async function loadVideos() {
         });
         toast(`re-transcode queued for #${v.id}`);
       }),
-      actionBtn("→hls_ts", async () => {
-        await api(`/api/videos/${v.id}/reencode`, {
-          method: "POST", headers: { "Content-Type": "application/json" },
-          body: JSON.stringify({ streaming_format: v.streaming_format === "cmaf" ? "hls_ts" : "cmaf" }),
+      (() => {
+        const target = v.streaming_format === "cmaf" ? "hls_ts" : "cmaf";
+        return actionBtn(`→${target}`, async () => {
+          await api(`/api/videos/${v.id}/reencode`, {
+            method: "POST", headers: { "Content-Type": "application/json" },
+            body: JSON.stringify({ streaming_format: target }),
+          });
+          toast(`re-encode to ${target} queued for #${v.id}`);
         });
-        toast(`re-encode queued for #${v.id}`);
-      }),
+      })(),
       actionBtn("chapters", async () => {
         const d2 = await api(`/api/videos/${v.id}/chapters/detect`, { method: "POST" });
         if (!d2.chapters.length) { toast("no chapters detected"); return; }
@@ -263,7 +274,9 @@ async function loadVideos() {
   }
 }
 
-$("show-deleted").addEventListener("change", loadVideos);
+$("show-deleted").addEventListener("change", () => { vidOffset = 0; loadVideos(); });
+$("vids-prev").onclick = () => { vidOffset = Math.max(0, vidOffset - VID_PAGE); loadVideos(); };
+$("vids-next").onclick = () => { vidOffset += VID_PAGE; loadVideos(); };
 
 $("upload-form").addEventListener("submit", (ev) => {
   ev.preventDefault();

@@ -166,8 +166,12 @@ async function loadTranscript(slug, video) {
   } catch (e) { /* 404 = no transcript */ }
 }
 
+let watchSeq = 0;           // drops stale openWatch responses
+
 async function openWatch(slug) {
+  const seq = ++watchSeq;
   const d = await j(`/api/videos/${slug}`);
+  if (seq !== watchSeq) return;   // user already navigated elsewhere
   const v = d.video;
   $("v-title").textContent = v.title;
   $("v-desc").textContent = v.description || "";
@@ -230,6 +234,7 @@ async function openWatch(slug) {
 }
 
 function closeWatch() {
+  watchSeq++;               // invalidate any in-flight openWatch
   endAnalytics();
   for (const undo of watchCleanup.splice(0)) undo();
   if (player) { player.destroy(); player = null; }
