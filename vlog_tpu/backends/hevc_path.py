@@ -1,0 +1,219 @@
+"""HEVC ladder execution path (codec="h265" re-encodes).
+
+The H.264 path runs a fused all-rungs XLA ladder program
+(parallel/ladder.py); this HEVC v1 path trades that last fusion step
+for simplicity: per batch it resizes on device (matmul lanczos,
+ops/resize.py), runs the batched HEVC DSP (codecs/hevc/jax_core.py —
+one dispatch per rung), and entropy-codes on the host through the C
+CABAC coder, overlapping decode with a one-batch prefetch thread.
+Segments, playlists, and manifests come out identical in shape to the
+H.264 path (hvc1 sample entries, hvc1.* CODECS strings), so the whole
+product plane — players, resume validation, re-encode flips — works
+unchanged.
+
+Reference parity: reencode_worker.py codec upgrades via hevc_nvenc /
+hevc_vaapi (worker/hwaccel.py:509-552).
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from vlog_tpu.backends.base import RungResult, RunResult
+from vlog_tpu.backends.source import open_source
+from vlog_tpu.codecs.hevc.api import HevcEncoder
+from vlog_tpu.media import hls
+from vlog_tpu.media.fmp4 import (
+    Sample,
+    TrackConfig,
+    hvc1_sample_entry,
+    init_segment,
+)
+from vlog_tpu.utils.fsio import atomic_write_bytes, atomic_write_text
+
+
+def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
+             ) -> RunResult:
+    if plan.streaming_format != "cmaf":
+        raise ValueError("h265 output is CMAF-only (hls_ts carries H.264)")
+    out = Path(plan.out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    fps = plan.fps_num / plan.fps_den
+    frames_per_seg = max(1, round(plan.segment_duration_s * fps))
+    timescale = plan.fps_num * 1000
+    frame_dur = plan.fps_den * 1000
+
+    encoders: dict[str, HevcEncoder] = {}
+    tracks: dict[str, TrackConfig] = {}
+    seg_counts: dict[str, int] = {}
+    seg_durs: dict[str, list[float]] = {}
+    bytes_written: dict[str, int] = {}
+    psnr_acc: dict[str, list[float]] = {}
+    for rung in plan.rungs:
+        enc = HevcEncoder(width=rung.width, height=rung.height,
+                          fps_num=plan.fps_num, fps_den=plan.fps_den,
+                          qp=rung.qp)
+        encoders[rung.name] = enc
+        tracks[rung.name] = TrackConfig(
+            track_id=1, handler="vide", timescale=timescale,
+            sample_entry=hvc1_sample_entry(rung.width, rung.height,
+                                           enc.hvcc_config),
+            width=rung.width, height=rung.height)
+        rdir = out / rung.name
+        rdir.mkdir(parents=True, exist_ok=True)
+        atomic_write_bytes(rdir / "init.mp4", init_segment(tracks[rung.name]))
+        seg_counts[rung.name] = 0
+        seg_durs[rung.name] = []
+        bytes_written[rung.name] = 0
+        psnr_acc[rung.name] = []
+
+    src = open_source(plan.source.path)
+    try:
+        total = src.frame_count
+        start_segment = 0
+        if resume and src.exact_seek:
+            start_segment = backend._resume_scan(plan, out, timescale,
+                                                 seg_counts, seg_durs,
+                                                 bytes_written)
+        start_frame = start_segment * frames_per_seg
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        from vlog_tpu.ops.resize import resize_yuv420
+
+        # one long-lived entropy pool shared by every (rung, batch) call
+        # — per-call pools would churn threads (same reason as the H.264
+        # loop's pool)
+        entropy_pool = ThreadPoolExecutor(max_workers=8)
+        pending: dict[str, list[Sample]] = {r.name: [] for r in plan.rungs}
+        frames_done = start_frame
+        thumb_path = None
+
+        # one-batch decode prefetch (same shape as the H.264 loop)
+        fifo: queue_mod.Queue = queue_mod.Queue(maxsize=1)
+        eof = object()
+        stop = threading.Event()
+
+        def producer() -> None:
+            try:
+                for item in src.read_batches(plan.frame_batch, start_frame):
+                    while not stop.is_set():
+                        try:
+                            fifo.put(item, timeout=0.5)
+                            break
+                        except queue_mod.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                fifo.put(eof)
+            except BaseException as exc:  # noqa: BLE001 — relayed below
+                fifo.put(exc)
+
+        threading.Thread(target=producer, daemon=True,
+                         name="vlog-hevc-decode").start()
+
+        try:
+            while True:
+                item = fifo.get()
+                if item is eof:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                by, bu, bv = item
+                if plan.thumbnail and thumb_path is None:
+                    thumb_path = str(out / "thumbnail.jpg")
+                    backend._write_thumbnail(by[0], bu[0], bv[0], thumb_path)
+                for rung in plan.rungs:
+                    if (rung.height, rung.width) == (by.shape[1],
+                                                     by.shape[2]):
+                        ry, ru, rv = by, bu, bv
+                    else:
+                        ry, ru, rv = resize_yuv420(by, bu, bv, rung.height,
+                                                   rung.width)
+                        ry, ru, rv = (np.asarray(ry), np.asarray(ru),
+                                      np.asarray(rv))
+                    frames = encoders[rung.name].encode_batch(
+                        ry, ru, rv, pool=entropy_pool)
+                    for f in frames:
+                        psnr_acc[rung.name].append(f.psnr_y)
+                        pending[rung.name].append(
+                            Sample(data=f.sample, duration=frame_dur,
+                                   is_sync=True))
+                    while len(pending[rung.name]) >= frames_per_seg:
+                        chunk = pending[rung.name][:frames_per_seg]
+                        pending[rung.name] = pending[rung.name][
+                            frames_per_seg:]
+                        backend._write_segment(out, rung, tracks[rung.name],
+                                               seg_counts, seg_durs,
+                                               bytes_written, chunk,
+                                               timescale)
+                frames_done += by.shape[0]
+                if progress_cb is not None:
+                    progress_cb(frames_done, total, "hevc ladder")
+            for rung in plan.rungs:
+                if pending[rung.name]:
+                    backend._write_segment(out, rung, tracks[rung.name],
+                                           seg_counts, seg_durs,
+                                           bytes_written,
+                                           pending[rung.name], timescale)
+                    pending[rung.name] = []
+        finally:
+            stop.set()
+            while True:
+                try:
+                    fifo.get_nowait()
+                except queue_mod.Empty:
+                    break
+            entropy_pool.shutdown(wait=True)
+    finally:
+        src.close()
+
+    true_total = total if src.exact_seek else frames_done
+    duration_s = true_total / fps if fps else 0.0
+    results = []
+    variants = []
+    for rung in plan.rungs:
+        name = rung.name
+        enc = encoders[name]
+        playlist = hls.media_playlist(
+            [hls.SegmentRef(uri=f"segment_{i + 1:05d}.m4s",
+                            duration_s=seg_durs[name][i])
+             for i in range(seg_counts[name])],
+            target_duration_s=plan.segment_duration_s,
+            init_uri="init.mp4")
+        ppath = out / name / "playlist.m3u8"
+        atomic_write_text(ppath, playlist)
+        total_dur = sum(seg_durs[name])
+        achieved = int(bytes_written[name] * 8 / total_dur) if total_dur else 0
+        results.append(RungResult(
+            name=name, width=rung.width, height=rung.height,
+            codec_string=enc.codec_string,
+            segment_count=seg_counts[name],
+            bytes_written=bytes_written[name],
+            mean_psnr_y=(float(np.mean(psnr_acc[name]))
+                         if psnr_acc[name] else None),
+            achieved_bitrate=achieved,
+            playlist_path=str(ppath),
+            target_bitrate=rung.video_bitrate))
+        variants.append(hls.VariantRef(
+            name=name, uri=f"{name}/playlist.m3u8",
+            bandwidth=max(achieved, 1),
+            width=rung.width, height=rung.height,
+            codecs=enc.codec_string, frame_rate=fps,
+            audio_group=(f"aud{rung.audio_bitrate // 1000}"
+                         if rung.audio_bitrate else "")))
+    atomic_write_text(out / "master.m3u8", hls.master_playlist(variants))
+    atomic_write_text(out / "manifest.mpd", hls.dash_manifest(
+        variants, duration_s=duration_s,
+        segment_duration_s=plan.segment_duration_s))
+
+    return RunResult(
+        rungs=results, frames_processed=frames_done, duration_s=duration_s,
+        thumbnail_path=thumb_path, wall_s=time.monotonic() - t0,
+        variants=variants, fps=fps,
+        segment_duration_s=plan.segment_duration_s)

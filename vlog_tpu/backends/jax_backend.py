@@ -126,6 +126,13 @@ class JaxBackend:
         planned = tuple(
             plan_rung_geometry(source.width, source.height, r) for r in rungs
         )
+        codec = opts.get("codec", "h264")
+        if codec in ("h265", "hevc"):
+            from dataclasses import replace
+
+            planned = tuple(replace(r, codec="h265") for r in planned)
+        elif codec != "h264":
+            raise ValueError(f"unknown codec {codec!r}")
         from vlog_tpu.media.y4m import fps_to_fraction
 
         fps_num, fps_den = fps_to_fraction(source.fps or 30.0)
@@ -173,6 +180,10 @@ class JaxBackend:
             *, resume: bool = True) -> RunResult:
         _enable_persistent_compile_cache()
         t0 = time.monotonic()
+        if any(r.codec == "h265" for r in plan.rungs):
+            from vlog_tpu.backends.hevc_path import run_hevc
+
+            return run_hevc(self, plan, progress_cb, resume, t0)
         out = plan.out_dir
         out.mkdir(parents=True, exist_ok=True)
 
@@ -237,16 +248,9 @@ class JaxBackend:
                          seg_durs, bytes_written, psnr_acc) -> RunResult:
         start_segment = 0
         if resume and not ts_mode and src.exact_seek:
-            per_rung = {r.name: self._existing_segments(out / r.name)
-                        for r in plan.rungs}
-            start_segment = min(len(d) for d in per_rung.values())
-            for rung in plan.rungs:
-                durs = per_rung[rung.name][:start_segment]
-                seg_counts[rung.name] = start_segment
-                seg_durs[rung.name] = [d / timescale for d in durs]
-                for i in range(start_segment):
-                    seg = out / rung.name / f"segment_{i + 1:05d}.m4s"
-                    bytes_written[rung.name] += seg.stat().st_size
+            start_segment = self._resume_scan(plan, out, timescale,
+                                              seg_counts, seg_durs,
+                                              bytes_written)
         start_frame = start_segment * frames_per_seg
 
         pending: dict[str, list[Sample]] = {r.name: [] for r in plan.rungs}
@@ -625,6 +629,23 @@ class JaxBackend:
         )
 
     # ------------------------------------------------------------------
+    def _resume_scan(self, plan, out, timescale, seg_counts, seg_durs,
+                     bytes_written) -> int:
+        """Reconstruct per-rung segment state from disk; returns the
+        first segment index every rung still needs (shared by the H.264
+        and HEVC paths — both emit the same CMAF tree)."""
+        per_rung = {r.name: self._existing_segments(out / r.name)
+                    for r in plan.rungs}
+        start_segment = min(len(d) for d in per_rung.values())
+        for rung in plan.rungs:
+            durs = per_rung[rung.name][:start_segment]
+            seg_counts[rung.name] = start_segment
+            seg_durs[rung.name] = [d / timescale for d in durs]
+            for i in range(start_segment):
+                seg = out / rung.name / f"segment_{i + 1:05d}.m4s"
+                bytes_written[rung.name] += seg.stat().st_size
+        return start_segment
+
     @staticmethod
     def _existing_segments(rdir: Path) -> list[int]:
         """Timescale durations of contiguous valid segments (resume state).

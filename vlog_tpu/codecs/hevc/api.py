@@ -1,0 +1,174 @@
+"""High-level HEVC encoding API: frames in, packaged samples out.
+
+Mirror of codecs/h264/api.py for the H.265 path: the backend drives one
+``HevcEncoder`` per quality rung; DSP runs batched on the device
+(jax_core), entropy coding runs on the host — the C coder
+(native/hevc_cabac.c) when buildable, else the Python reference — in
+parallel threads per frame.
+
+Reference parity: hevc_nvenc / hevc_vaapi selection in
+worker/hwaccel.py:509-552; re-encode codec upgrades in
+worker/reencode_worker.py.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+
+from vlog_tpu.codecs.hevc import syntax
+from vlog_tpu.codecs.hevc.slice import SliceWriter
+
+CTB = syntax.CTB
+
+
+@dataclass
+class EncodedFrame:
+    sample: bytes        # 4-byte-length-prefixed NAL (hvc1 sample format)
+    annexb: bytes
+    is_idr: bool
+    psnr_y: float
+
+
+def _u8(v):
+    return bytes([v & 0xFF])
+
+
+def _u16(v):
+    return v.to_bytes(2, "big")
+
+
+def hvcc_config(vps: syntax.NalUnit, sps: syntax.NalUnit,
+                pps: syntax.NalUnit, level_idc: int) -> bytes:
+    """HEVCDecoderConfigurationRecord (ISO 14496-15 8.3.3.1) for the
+    stream shape syntax.py emits (Main profile, tier 0)."""
+    out = bytearray()
+    out += _u8(1)                      # configurationVersion
+    out += _u8(1)                      # profile_space 0, tier 0, idc Main
+    out += (0x60000000).to_bytes(4, "big")   # compat: Main + Main 10
+    # constraints: progressive + non-packed + frame-only (bits 7,5,4)
+    out += bytes([0xB0, 0, 0, 0, 0, 0])
+    out += _u8(level_idc)
+    out += _u16(0xF000)                # reserved + min_spatial_seg 0
+    out += _u8(0xFC)                   # reserved + parallelismType 0
+    out += _u8(0xFC | 1)               # reserved + chroma 4:2:0
+    out += _u8(0xF8)                   # bit_depth_luma_minus8 = 0
+    out += _u8(0xF8)                   # bit_depth_chroma_minus8 = 0
+    out += _u16(0)                     # avgFrameRate unknown
+    out += _u8((1 << 3) | (1 << 2) | 3)  # 1 layer, nested, 4-byte lengths
+    out += _u8(3)                      # numOfArrays
+    for nal in (vps, sps, pps):
+        raw = nal.to_bytes()
+        out += _u8(0x80 | nal.nal_type)   # array_completeness | type
+        out += _u16(1) + _u16(len(raw)) + raw
+    return bytes(out)
+
+
+@dataclass
+class HevcEncoder:
+    """Stateful per-rung encoder; every frame is an IDR (all-intra, the
+    same GOP shape as the H.264 intra path)."""
+
+    width: int
+    height: int
+    fps_num: int = 30
+    fps_den: int = 1
+    qp: int = 30
+    entropy_threads: int = 8
+
+    def __post_init__(self):
+        self.vps = syntax.write_vps(
+            syntax.level_idc_for(self.width, self.height))
+        self.sps = syntax.write_sps(self.width, self.height)
+        self.pps = syntax.write_pps()
+
+    # ---- stream metadata -----------------------------------------------
+    @property
+    def hvcc_config(self) -> bytes:
+        return hvcc_config(self.vps, self.sps, self.pps,
+                           syntax.level_idc_for(self.width, self.height))
+
+    @property
+    def codec_string(self) -> str:
+        """RFC 6381: hvc1.<profile>.<compat-reversed>.L<level>.<constraints>"""
+        return f"hvc1.1.6.L{syntax.level_idc_for(self.width, self.height)}.B0"
+
+    def headers_annexb(self) -> bytes:
+        return syntax.annexb([self.vps, self.sps, self.pps])
+
+    # ---- encoding -------------------------------------------------------
+    def _pad(self, plane: np.ndarray, block: int) -> np.ndarray:
+        b, h, w = plane.shape
+        ph = (h + block - 1) // block * block
+        pw = (w + block - 1) // block * block
+        if (ph, pw) == (h, w):
+            return plane
+        return np.pad(plane, ((0, 0), (0, ph - h), (0, pw - w)),
+                      mode="edge")
+
+    def _entropy(self, ly, lu, lv, rows, cols) -> bytes:
+        from vlog_tpu.native.build import get_lib
+
+        lib = get_lib()
+        if lib is not None:
+            import ctypes
+
+            la = np.ascontiguousarray(ly.reshape(-1), dtype=np.int16)
+            ua = np.ascontiguousarray(lu.reshape(-1), dtype=np.int16)
+            va = np.ascontiguousarray(lv.reshape(-1), dtype=np.int16)
+            cap = max(1 << 16, la.size * 4)
+            out = np.empty(cap, dtype=np.uint8)
+            i16p = ctypes.POINTER(ctypes.c_int16)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            n = lib.vt_hevc_encode_slice(
+                la.ctypes.data_as(i16p), ua.ctypes.data_as(i16p),
+                va.ctypes.data_as(i16p), rows, cols, self.qp,
+                out.ctypes.data_as(u8p), cap)
+            if n >= 0:
+                return out[:n].tobytes()
+        sw = SliceWriter(self.qp)
+        for r in range(rows):
+            for c in range(cols):
+                sw.write_ctu(c, ly[r, c], lu[r, c], lv[r, c],
+                             last_in_slice=(r == rows - 1 and c == cols - 1))
+        return sw.payload()
+
+    def encode_batch(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                     pool: ThreadPoolExecutor | None = None
+                     ) -> list[EncodedFrame]:
+        """Encode a batch of frames: y (B, H, W), u/v (B, H/2, W/2)
+        uint8.  DSP runs as one device dispatch; entropy per frame in
+        threads."""
+        from vlog_tpu.codecs.hevc.jax_core import encode_batch_dsp
+
+        y = self._pad(np.asarray(y, np.uint8), CTB)
+        u = self._pad(np.asarray(u, np.uint8), CTB // 2)
+        v = self._pad(np.asarray(v, np.uint8), CTB // 2)
+        b, h, w = y.shape
+        rows, cols = h // CTB, w // CTB
+        qps = np.full((b,), self.qp, np.int32)
+        (ly, lu, lv), (ry, _, _) = encode_batch_dsp(y, u, v, qps)
+        ly = np.asarray(ly)
+        lu = np.asarray(lu)
+        lv = np.asarray(lv)
+        ry = np.asarray(ry)
+
+        def pack(i: int) -> EncodedFrame:
+            payload = self._entropy(ly[i], lu[i], lv[i], rows, cols)
+            nal = syntax.idr_nal(self.qp, payload)
+            raw = nal.to_bytes()
+            mse = np.mean(
+                (ry[i, :self.height, :self.width].astype(np.float64)
+                 - y[i, :self.height, :self.width].astype(np.float64)) ** 2)
+            psnr = float(10 * np.log10(255.0 ** 2 / max(mse, 1e-12)))
+            return EncodedFrame(
+                sample=len(raw).to_bytes(4, "big") + raw,
+                annexb=syntax.annexb([self.vps, self.sps, self.pps, nal]),
+                is_idr=True, psnr_y=psnr)
+
+        if pool is None:
+            with ThreadPoolExecutor(self.entropy_threads) as p:
+                return list(p.map(pack, range(b)))
+        return list(pool.map(pack, range(b)))

@@ -1,0 +1,155 @@
+"""HEVC intra DSP on the device: batched pred/transform/quant/recon.
+
+The XLA program mirrors the H.264 core's shape (codecs/h264/encoder.py):
+CTB row 0 is a ``lax.scan`` over columns (its prediction chains through
+the left neighbour's top-right reconstructed pixel — a scalar carry),
+and every later CTB row is one batched step of a ``lax.scan`` over rows
+whose carry is the previous row's reconstructed bottom line.  All three
+planes use exact-vertical prediction, so nothing else crosses CTBs.
+
+The transforms are plain (32,32)/(16,16) integer matmuls — exactly what
+the MXU wants — with the spec-exact inverse (stage clipping included) so
+device recon equals transform.py's numpy reference bit-for-bit, which
+test_hevc.py asserts, and equals any conforming decoder's output.
+
+QP is a traced scalar (per-frame rate control can feed it without
+recompiling); frames batch via ``vmap``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vlog_tpu.codecs.hevc.transform import (
+    LEVEL_SCALE,
+    QUANT_SCALE,
+    T16,
+    T32,
+    _QPC,
+)
+
+_QPC_ARR = np.array(_QPC + [0] * 16, dtype=np.int32)  # padded; >=43 computed
+
+
+def chroma_qp_traced(qp):
+    qpi = jnp.clip(qp, 0, 51)
+    return jnp.where(qpi < 43, jnp.asarray(_QPC_ARR)[jnp.minimum(qpi, 42)],
+                     qpi - 6)
+
+
+# All arithmetic below is int32 (JAX's default integer width).  Why that
+# is safe: 8-bit residuals through the 32-point stages peak below 2^27
+# (|m|<=90, 32 taps, stage shifts), quant products peak at ~2^30
+# (|coeff|<=~2^15 x 26214), and the one genuinely 33-bit product — the
+# spec's dequant ``level*16*levelScale << per`` — is decomposed into an
+# int32 product plus a net shift, exactly (proof in _dequant).
+
+def _fwd(res, mat, log2n):
+    s1 = log2n - 1
+    s2 = log2n + 6
+    tmp = (mat @ res + (1 << (s1 - 1))) >> s1
+    return (tmp @ mat.T + (1 << (s2 - 1))) >> s2
+
+
+def _inv(coeff, mat):
+    e = (mat.T @ coeff + 64) >> 7
+    e = jnp.clip(e, -32768, 32767)
+    r = (e @ mat + (1 << 11)) >> 12          # 8-bit: shift 20-8
+    return jnp.clip(r, -32768, 32767)
+
+
+def _quant(coeff, qp, log2n):
+    tr_shift = 15 - 8 - log2n
+    qbits = 14 + qp // 6 + tr_shift
+    f = jnp.asarray(QUANT_SCALE, jnp.int32)[qp % 6]
+    # (1<<qbits)*171 >> 9 == 171 << (qbits-9): qbits is always >= 14, and
+    # the shifted form peaks at 171<<16 ~ 2^23.5 — the direct product
+    # would wrap int32 at qp >= 48 (qbits 24+)
+    offset = jnp.int32(171) << (qbits - 9)
+    level = (jnp.abs(coeff) * f + offset) >> qbits
+    return jnp.sign(coeff) * jnp.clip(level, 0, 32767)
+
+
+def _dequant(level, qp, log2n):
+    """Spec 8.6.3 restated int32-safely.
+
+    d = (level*16*ls << per + 1<<(bd-1)) >> bd  with a = level*16*ls
+    (|a| <= 32767*16*72 < 2^26):
+      per >= bd: low ``per`` bits of a<<per are zero and the offset
+        shifts to < 1, so d = a << (per-bd) exactly;
+      per <  bd: divide numerator and denominator by 2^per, so
+        d = (a + 1<<(bd-per-1)) >> (bd-per) exactly.
+    Arithmetic right-shift floors for negatives in numpy and XLA alike.
+    """
+    bd = 8 + log2n - 5
+    per = qp // 6
+    a = level * (jnp.asarray(LEVEL_SCALE, jnp.int32)[qp % 6] * 16)
+    d = jnp.where(per >= bd,
+                  a << jnp.maximum(per - bd, 0),
+                  (a + (jnp.int32(1) << jnp.maximum(bd - per - 1, 0)))
+                  >> jnp.maximum(bd - per, 0))
+    return jnp.clip(d, -32768, 32767)
+
+
+def _code_blocks(src, pred, qp, mat, log2n):
+    """src/pred: (..., N, N) int32 -> (levels, recon) both int32."""
+    res = src - pred
+    levels = _quant(_fwd(res, mat, log2n), qp, log2n)
+    rec = _inv(_dequant(levels, qp, log2n), mat)
+    return levels, jnp.clip(pred + rec, 0, 255)
+
+
+def _encode_plane(plane, qp, mat, n):
+    """One plane (H, W) uint8 -> levels (R, C, N, N) int32, recon (H, W).
+
+    ``n``/``mat`` static; qp traced scalar (already chroma-mapped).
+    """
+    log2n = n.bit_length() - 1
+    h, w = plane.shape
+    rows, cols = h // n, w // n
+    src = plane.astype(jnp.int32).reshape(rows, n, cols, n).transpose(
+        0, 2, 1, 3)                       # (R, C, N, N)
+
+    # ---- CTB row 0: scan over columns, scalar carry ------------------
+    def col_step(carry, blk):
+        pred = jnp.full((n, n), carry, jnp.int32)
+        levels, recon = _code_blocks(blk, pred, qp, mat, log2n)
+        return recon[0, n - 1], (levels, recon)
+
+    _, (lev0, rec0) = jax.lax.scan(col_step, jnp.int32(128), src[0])
+
+    # ---- rows 1..R-1: scan over rows, bottom-line carry --------------
+    def row_step(bottom, row_blks):          # bottom: (W,), row: (C, N, N)
+        pred = jnp.broadcast_to(
+            bottom.reshape(cols, 1, n), (cols, n, n))
+        levels, recon = _code_blocks(row_blks, pred, qp, mat, log2n)
+        return recon[:, n - 1, :].reshape(w), (levels, recon)
+
+    bottom0 = rec0[:, n - 1, :].reshape(w)
+    if rows > 1:
+        _, (lev_r, rec_r) = jax.lax.scan(row_step, bottom0, src[1:])
+        levels = jnp.concatenate([lev0[None], lev_r], axis=0)
+        recon = jnp.concatenate([rec0[None], rec_r], axis=0)
+    else:
+        levels, recon = lev0[None], rec0[None]
+    recon_plane = recon.transpose(0, 2, 1, 3).reshape(h, w).astype(jnp.uint8)
+    return levels, recon_plane
+
+
+@partial(jax.jit, static_argnums=())
+def encode_frame_dsp(y, u, v, qp):
+    """Device pass for one padded frame: returns per-CTB quantized levels
+    and the bit-exact reconstruction for all three planes."""
+    qp = jnp.asarray(qp, jnp.int32)
+    qpc = chroma_qp_traced(qp)
+    ly, ry = _encode_plane(y, qp, jnp.asarray(T32), 32)
+    lu, ru = _encode_plane(u, qpc, jnp.asarray(T16), 16)
+    lv, rv = _encode_plane(v, qpc, jnp.asarray(T16), 16)
+    return (ly, lu, lv), (ry, ru, rv)
+
+
+encode_batch_dsp = jax.jit(jax.vmap(encode_frame_dsp, in_axes=(0, 0, 0, 0)))

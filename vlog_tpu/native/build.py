@@ -32,11 +32,15 @@ def _compile() -> Path:
     _BUILD.mkdir(exist_ok=True)
     src = _DIR / "cavlc.c"
     jpeg_src = _DIR / "jpeg_pack.c"
+    hevc_src = _DIR / "hevc_cabac.c"
     so = _BUILD / "libvtnative.so"
     from vlog_tpu.codecs.h264 import cavlc_tables
+    from vlog_tpu.codecs.hevc import tables as hevc_tables
 
-    stamp_inputs = [src, jpeg_src, _DIR / "gen_tables.py",
-                    Path(cavlc_tables.__file__)]   # real input of gen_tables
+    stamp_inputs = [src, jpeg_src, hevc_src, _DIR / "gen_tables.py",
+                    _DIR / "gen_hevc_tables.py",
+                    Path(cavlc_tables.__file__),   # real inputs of the
+                    Path(hevc_tables.__file__)]    # two generators
     if so.exists() and all(so.stat().st_mtime >= p.stat().st_mtime
                            for p in stamp_inputs):
         return so
@@ -45,19 +49,26 @@ def _compile() -> Path:
     # Per-process scratch names: multiple worker processes may race the
     # first build; each builds privately and os.replace publishes
     # atomically (last writer wins, all writers produce identical bits).
+    from vlog_tpu.native.gen_hevc_tables import generate_c_header
+
     pid = os.getpid()
     inc = _BUILD / f"cavlc_tables.{pid}.inc"
     inc.write_text(generate())
+    hevc_inc = _BUILD / f"hevc_tables.{pid}.inc"
+    hevc_inc.write_text(generate_c_header())
     tmp_so = _BUILD / f"libvtnative.{pid}.so.tmp"
     cc = os.environ.get("CC", "g++")
     cmd = [cc, "-O3", "-fPIC", "-shared", "-x", "c++",
-           f"-DVT_TABLES_INC=\"{inc.name}\"", str(src), str(jpeg_src),
+           f"-DVT_TABLES_INC=\"{inc.name}\"",
+           f"-DVT_HEVC_TABLES_INC=\"{hevc_inc.name}\"",
+           str(src), str(jpeg_src), str(hevc_src),
            "-I", str(_BUILD), "-o", str(tmp_so)]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeBuildError(f"native build failed: {proc.stderr[:2000]}")
     os.replace(tmp_so, so)
     inc.rename(_BUILD / "cavlc_tables.inc")        # for reference/debugging
+    hevc_inc.rename(_BUILD / "hevc_tables.inc")
     return so
 
 
@@ -96,6 +107,14 @@ def get_lib() -> ctypes.CDLL | None:
             i8, ctypes.c_int64,                      # header bytes
             ctypes.c_uint32, ctypes.c_int,           # header tail bits
             i32,                                     # scratch
+            i8, ctypes.c_int64,                      # out buffer
+        ]
+        i16 = ctypes.POINTER(ctypes.c_int16)
+        lib.vt_hevc_encode_slice.restype = ctypes.c_int64
+        lib.vt_hevc_encode_slice.argtypes = [
+            i16, i16, i16,                           # luma, cb, cr levels
+            ctypes.c_int32, ctypes.c_int32,          # rows, cols
+            ctypes.c_int32,                          # slice qp
             i8, ctypes.c_int64,                      # out buffer
         ]
         u16 = ctypes.POINTER(ctypes.c_uint16)

@@ -198,6 +198,45 @@ def generate() -> str:
     return "".join(lines)
 
 
+def generate_c_header() -> str:
+    """C include for native/hevc_cabac.c, from the generated tables.py
+    (single source of truth, same policy as gen_tables.py)."""
+    from vlog_tpu.codecs.hevc import tables as t
+
+    def arr(name, vals, ctype="uint8_t"):
+        flat = ", ".join(str(int(v)) for v in vals)
+        return f"static const {ctype} {name}[{len(vals)}] = {{{flat}}};\n"
+
+    lps = [v for row in t.RANGE_TAB_LPS for v in row]
+    scan4 = [x * 16 + y for (x, y) in t.DIAG_SCAN_4x4]   # packed x<<4|y
+    scan8 = [x * 16 + y for (x, y) in t.DIAG_SCAN_8x8]
+
+    # whole-TB forward scans precomputed here (constant data, so the C
+    # coder needs no lazy init — and therefore no thread-safety hazard
+    # when the entropy pool fans out)
+    def tb_scan(n):
+        cg = t.DIAG_SCAN_8x8 if n == 32 else t.DIAG_SCAN_4x4
+        out = []
+        for cx, cy in cg[: (n // 4) ** 2]:
+            for ix, iy in t.DIAG_SCAN_4x4:
+                out.append((cy * 4 + iy) * n + (cx * 4 + ix))
+        return out
+
+    parts = [
+        "/* Generated by vlog_tpu/native/gen_hevc_tables.py — do not "
+        "edit. */\n#include <stdint.h>\n",
+        arr("HEVC_LPS", lps), arr("HEVC_MPS_NEXT", t.TRANS_IDX_MPS),
+        arr("HEVC_LPS_NEXT", t.TRANS_IDX_LPS),
+        arr("HEVC_INIT_I", t.INIT_VALUES[0]),
+        arr("HEVC_DIAG4", scan4), arr("HEVC_DIAG8", scan8),
+        arr("HEVC_SCAN32", tb_scan(32), "int16_t"),
+        arr("HEVC_SCAN16", tb_scan(16), "int16_t"),
+    ]
+    for name, (off, n) in _CTX.items():
+        parts.append(f"#define HEVC_CTX_{name} {off}\n")
+    return "".join(parts)
+
+
 if __name__ == "__main__":
     _OUT.write_text(generate())
     print(f"wrote {_OUT}")

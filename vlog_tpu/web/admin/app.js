@@ -256,6 +256,15 @@ async function loadVideos() {
           toast(`re-encode to ${target} queued for #${v.id}`);
         });
       })(),
+      v.codec === "h264" && v.streaming_format === "cmaf"
+        ? actionBtn("→h265", async () => {
+            await api(`/api/videos/${v.id}/reencode`, {
+              method: "POST", headers: { "Content-Type": "application/json" },
+              body: JSON.stringify({ streaming_format: "cmaf", codec: "h265" }),
+            });
+            toast(`h265 upgrade queued for #${v.id}`);
+          })
+        : document.createTextNode(""),
       actionBtn("chapters", async () => {
         const d2 = await api(`/api/videos/${v.id}/chapters/detect`, { method: "POST" });
         if (!d2.chapters.length) { toast("no chapters detected"); return; }

@@ -428,10 +428,14 @@ class WorkerDaemon:
         payload = _json.loads(job["payload"] or "{}")
         fmt = payload.get("streaming_format", "cmaf")
         codec = payload.get("codec", "h264")
-        if codec != "h264":
+        if codec not in ("h264", "h265"):
             await self._fail(job, video,
-                             f"codec {codec!r} has no first-party encoder yet",
+                             f"codec {codec!r} has no first-party encoder",
                              permanent=True)
+            return
+        if codec == "h265" and fmt != "cmaf":
+            await self._fail(job, video,
+                             "h265 output is CMAF-only", permanent=True)
             return
         source = video["source_path"]
         if not source or not Path(source).exists():
@@ -448,7 +452,7 @@ class WorkerDaemon:
             # resume=False: the output tree changes shape across formats
             return process_video(source, out_dir, backend=self.backend,
                                  progress_cb=cb, rungs=rungs, resume=False,
-                                 streaming_format=fmt)
+                                 streaming_format=fmt, codec=codec)
 
         result = await self._run_with_timeout(work, timeout, "reencode")
         # Drop the previous format's leftovers so clients can never follow
