@@ -9,11 +9,17 @@ TPU-first:
   subtract + block-sum — (2s+1)^2 sequential steps of perfectly parallel
   (H, W) work, instead of a per-MB scalar search loop. A small MV-cost
   penalty biases toward short vectors (rate proxy).
-- **Motion compensation as one gather**: per-MB integer MVs expand to
-  per-pixel index maps; luma prediction is a single (H, W) gather from the
-  edge-padded reference. Chroma follows H.264 8.4.2.2.2: integer luma MVs
-  land on half-pel chroma positions, so chroma prediction is the 4-tap
-  bilinear weighting of 4 gathers with weights 0/4/8 per axis.
+- **Half-pel refinement on device**: the three half-sample planes (b, h,
+  j — spec 8.4.2.2.1 six-tap) are whole-plane shifted sums computed once
+  per reference; the nine candidates around each MB's integer winner are
+  then gathers + block-SADs, and motion compensation selects per pixel
+  among the four planes by MV fraction. MVs flow through the pipeline in
+  HALF-PEL units ((y, x), DSP order).
+- **Motion compensation as gathers**: per-MB MVs expand to per-pixel
+  index maps over the edge-padded reference/half planes. Chroma follows
+  H.264 8.4.2.2.2: luma half-pel MVs land on eighth-pel chroma
+  positions, so chroma prediction is the 4-tap bilinear weighting of 4
+  gathers with weights 0/2/4/6/8 per axis.
 - **Residuals**: inter 4x4 luma transform keeps all 16 coefficients per
   block (no Intra16x16 DC split); chroma keeps the 2x2 DC Hadamard.
   Quantizer rounding uses the inter offset (f = 2^qbits/6) — rounding is
@@ -50,17 +56,69 @@ from vlog_tpu.ops.transform import (
 MV_COST_LAMBDA = 4
 
 
+_SIX_TAP = (1, -5, 20, 20, -5, 1)
+
+
+def _six_tap_shift(x, axis):
+    """Un-normalized 6-tap at half positions: out[i] sits between i and
+    i+1 (taps i-2..i+3). jnp.roll wrap contamination reaches 3 (6 after
+    the second pass) samples into the pad ring; callers pad by at least
+    search+8 so gathered positions never touch it."""
+    out = None
+    for k, t in enumerate(_SIX_TAP):
+        term = t * jnp.roll(x, 2 - k, axis=axis)
+        out = term if out is None else out + term
+    return out
+
+
+def half_pel_planes(refp):
+    """Edge-padded (Hp, Wp) int32 reference -> (b, h, j) planes, same
+    shape/alignment (spec 8.4.2.2.1: b right-half, h down-half, j
+    center; j from the un-normalized horizontal intermediates, which is
+    exactly the spec's two-stage filter since no clipping intervenes)."""
+    b1 = _six_tap_shift(refp, axis=1)
+    h1 = _six_tap_shift(refp, axis=0)
+    j1 = _six_tap_shift(b1, axis=0)
+    b = jnp.clip((b1 + 16) >> 5, 0, 255)
+    h = jnp.clip((h1 + 16) >> 5, 0, 255)
+    j = jnp.clip((j1 + 512) >> 10, 0, 255)
+    return b, h, j
+
+
+def _gather_halfpel(refp, planes, mv_hp, *, pad, mb=16):
+    """Luma prediction at half-pel MVs: per-pixel select among the four
+    sample planes by MV fraction, one gather each."""
+    bpl, hpl, jpl = planes
+    hp = refp.shape[0] - 2 * pad
+    wp = refp.shape[1] - 2 * pad
+    dy, dx = _mv_maps(mv_hp, mb)
+    iy, fy = dy >> 1, dy & 1
+    ix, fx = dx >> 1, dx & 1
+    rows = jnp.arange(hp)[:, None] + iy + pad
+    cols = jnp.arange(wp)[None, :] + ix + pad
+    g = refp[rows, cols]
+    return jnp.where(
+        fy == 0,
+        jnp.where(fx == 0, g, bpl[rows, cols]),
+        jnp.where(fx == 0, hpl[rows, cols], jpl[rows, cols]))
+
+
 def motion_search(cur_y, ref_y, *, search: int = 8,
-                  lam: int = MV_COST_LAMBDA):
-    """Full-search integer ME: (H, W) planes -> (mbh, mbw, 2) MVs (y, x).
+                  lam: int = MV_COST_LAMBDA, refp=None, planes=None):
+    """Full-search integer ME + half-pel refinement:
+    (H, W) planes -> (mbh, mbw, 2) MVs in HALF-PEL units (y, x).
 
     Deterministic: ties keep the earlier candidate in raster offset
-    order, with (0,0) evaluated first.
+    order, with (0,0) evaluated first; refinement keeps the integer
+    winner on ties.  ``refp``/``planes`` may be precomputed by the
+    caller (encode_p_frame shares them with motion compensation).
     """
     h, w = cur_y.shape
     mbh, mbw = h // 16, w // 16
     cur = cur_y.astype(jnp.int32)
-    refp = jnp.pad(ref_y.astype(jnp.int32), search, mode="edge")
+    pad = search + 8
+    if refp is None:
+        refp = jnp.pad(ref_y.astype(jnp.int32), pad, mode="edge")
 
     offsets = [(0, 0)] + [
         (dy, dx)
@@ -72,7 +130,7 @@ def motion_search(cur_y, ref_y, *, search: int = 8,
 
     def sad_at(off):
         shifted = jax.lax.dynamic_slice(
-            refp, (search + off[0], search + off[1]), (h, w))
+            refp, (pad + off[0], pad + off[1]), (h, w))
         d = jnp.abs(cur - shifted)
         sad = d.reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
         cost = lam * 4 * (jnp.abs(off[0]) + jnp.abs(off[1]))
@@ -88,8 +146,38 @@ def motion_search(cur_y, ref_y, *, search: int = 8,
 
     init = (jnp.full((mbh, mbw), jnp.iinfo(jnp.int32).max, jnp.int32),
             jnp.zeros((mbh, mbw, 2), jnp.int32))
-    (sad, mv), _ = jax.lax.scan(step, init, offs)
-    return mv
+    (int_sad, mv_int), _ = jax.lax.scan(step, init, offs)
+
+    # --- half-pel refinement: eight candidates around the integer
+    # winner, seeded with its SAD (the cost scales are commensurate:
+    # lam*4*|off_int| == lam*2*|2*off_int|, so no re-evaluation of the
+    # base candidate is needed).
+    if planes is None:
+        planes = half_pel_planes(refp)
+    base_hp = mv_int * 2
+
+    def sad_hp(off):
+        cand = base_hp + off[None, None, :]
+        pred = _gather_halfpel(refp, planes, cand, pad=pad)
+        sad = jnp.abs(cur - pred).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
+        cost = lam * 2 * (jnp.abs(cand[..., 0]) + jnp.abs(cand[..., 1]))
+        return sad + cost
+
+    half_offs = jnp.asarray(
+        [(dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1)
+         if (dy, dx) != (0, 0)], jnp.int32)
+
+    def hstep(carry, off):
+        best_sad, best_mv = carry
+        sad = sad_hp(off)
+        better = sad < best_sad
+        best_sad = jnp.where(better, sad, best_sad)
+        cand = base_hp + off[None, None, :]
+        best_mv = jnp.where(better[..., None], cand, best_mv)
+        return (best_sad, best_mv), None
+
+    (_, mv_hp), _ = jax.lax.scan(hstep, (int_sad, base_hp), half_offs)
+    return mv_hp
 
 
 def _mv_maps(mv, mb: int):
@@ -100,28 +188,32 @@ def _mv_maps(mv, mb: int):
     return dy, dx
 
 
-def mc_luma(ref_y, mv, *, search: int):
-    """Integer-MV luma prediction: one gather from the padded reference."""
-    h, w = ref_y.shape
-    refp = jnp.pad(ref_y.astype(jnp.int32), search, mode="edge")
-    dy, dx = _mv_maps(mv, 16)
-    rows = jnp.arange(h)[:, None] + dy + search
-    cols = jnp.arange(w)[None, :] + dx + search
-    return refp[rows, cols]
+def mc_luma(ref_y, mv_hp, *, search: int, planes=None, refp=None):
+    """Luma prediction at half-pel MVs (spec 8.4.2.2.1 six-tap planes).
+
+    ``planes``/``refp`` may be precomputed (encode path: the search just
+    built them); the decode path passes only the reference."""
+    pad = search + 8
+    if refp is None:
+        refp = jnp.pad(ref_y.astype(jnp.int32), pad, mode="edge")
+    if planes is None:
+        planes = half_pel_planes(refp)
+    return _gather_halfpel(refp, planes, mv_hp, pad=pad)
 
 
-def mc_chroma(ref_c, mv, *, search: int):
-    """Chroma prediction per 8.4.2.2.2 for integer luma MVs.
+def mc_chroma(ref_c, mv_hp, *, search: int):
+    """Chroma prediction per 8.4.2.2.2 for half-pel luma MVs.
 
-    Luma integer MV m -> chroma position m/2: integer part floor(m/2),
-    fraction 0 or 1/2 (weights 8 or 4 in the spec's eighth-pel blend).
-    """
+    The chroma MV equals the luma quarter-pel value interpreted on the
+    eighth-chroma-pel grid: q = 2*mv_hp, integer part q>>3, fraction
+    q&7 in {0, 2, 4, 6} — the spec's bilinear blend."""
     hc, wc = ref_c.shape
     pad = search // 2 + 2
     refp = jnp.pad(ref_c.astype(jnp.int32), pad, mode="edge")
-    dy, dx = _mv_maps(mv, 8)                        # luma-units per pixel
-    iy, fy = (dy >> 1), (dy & 1) * 4                # int + eighth-pel frac
-    ix, fx = (dx >> 1), (dx & 1) * 4
+    dy, dx = _mv_maps(mv_hp, 8)                     # half-luma-pel units
+    q_y, q_x = dy * 2, dx * 2                       # eighth-chroma-pel
+    iy, fy = q_y >> 3, q_y & 7
+    ix, fx = q_x >> 3, q_x & 7
     rows = jnp.arange(hc)[:, None] + iy + pad
     cols = jnp.arange(wc)[None, :] + ix + pad
     a = refp[rows, cols]
@@ -173,13 +265,17 @@ def encode_p_frame(y, u, v, ref_y, ref_u, ref_v, *, qp,
                    search: int = 8):
     """One P frame against one reference (both at the same geometry).
 
-    All MBs are P_L0_16x16 with integer MVs (skip detection happens at
-    entropy time from mv + zero levels). Returns levels, MVs, and the
-    reconstruction that becomes the next frame's reference.
+    All MBs are P_L0_16x16 with half-pel MVs (skip detection happens at
+    entropy time from mv + zero levels). Returns levels, MVs (half-pel),
+    and the reconstruction that becomes the next frame's reference.
     """
     qpc = chroma_qp(qp)
-    mv = motion_search(y, ref_y, search=search)
-    pred_y = mc_luma(ref_y, mv, search=search)
+    pad = search + 8
+    refp = jnp.pad(ref_y.astype(jnp.int32), pad, mode="edge")
+    planes = half_pel_planes(refp)                  # shared search + MC
+    mv = motion_search(y, ref_y, search=search, refp=refp,
+                       planes=planes)               # half-pel units
+    pred_y = mc_luma(ref_y, mv, search=search, refp=refp, planes=planes)
     pred_u = mc_chroma(ref_u, mv, search=search)
     pred_v = mc_chroma(ref_v, mv, search=search)
     luma, recon_y = _inter_luma_residual(y.astype(jnp.int32), pred_y, qp)
@@ -191,7 +287,7 @@ def encode_p_frame(y, u, v, ref_y, ref_u, ref_v, *, qp,
         "luma": luma,                              # (mbh, mbw, 4,4,4,4)
         "chroma_dc": jnp.stack([udc, vdc]),        # (2, mbh, mbw, 2, 2)
         "chroma_ac": jnp.stack([uac, vac]),        # (2, mbh, mbw, 2,2,4,4)
-        "mv": mv,                                  # (mbh, mbw, 2) integer
+        "mv": mv,                                  # (mbh, mbw, 2) half-pel
         "recon_y": recon_y.astype(jnp.uint8),
         "recon_u": recon_u.astype(jnp.uint8),
         "recon_v": recon_v.astype(jnp.uint8),
