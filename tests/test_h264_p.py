@@ -78,42 +78,57 @@ def test_p_chain_oracle_bit_exact(avdec, tmp_path, qp):
         np.testing.assert_array_equal(dv, rv, err_msg=f"frame {i} cr")
 
 
-def test_halfpel_pan_oracle_bit_exact(avdec, tmp_path):
-    """Content panning by exactly half a pixel per frame: the search must
-    pick odd (half-pel) MVs, and the 6-tap MC streams must still decode
-    bit-exactly in libavcodec."""
-    from vlog_tpu.codecs.h264.inter import motion_search
-
-    h, w = 96, 128
-    rng = np.random.default_rng(3)
-    wh, ww = h + 64, (w + 64) * 2
-    yy, xx = np.mgrid[0:wh, 0:ww]
-    # band-limited world at double horizontal resolution; sampling even/
-    # odd phases gives a true half-pel horizontal pan
-    world = np.clip(100 + 60 * np.sin(xx / 23.0) * np.cos(yy / 13.0)
-                    + rng.normal(0, 1.5, (wh, ww)), 0, 255
-                    ).astype(np.uint8)
-    frames = []
-    for t in range(4):
-        ox = 64 + t                      # +0.5 luma px per frame
-        ysamp = world[32:32 + h, ox:ox + 2 * w:2]
-        frames.append((
-            ysamp,
-            np.full((h // 2, w // 2), 120, np.uint8),
-            np.full((h // 2, w // 2), 130, np.uint8)))
-
-    mv = np.asarray(motion_search(frames[1][0], frames[0][0], search=8))
-    assert np.any(mv % 2 != 0), "expected half-pel MVs on half-pel pan"
-
-    enc = H264Encoder(width=w, height=h, qp=28)
-    nals, recons = encode_chain(frames, qp=28)
+def _assert_chain_bit_exact(avdec, tmp_path, frames, *, qp=28):
+    """Encode an I+P chain and assert the libavcodec oracle reproduces
+    every plane of every reconstruction byte-for-byte."""
+    h, w = frames[0][0].shape
+    enc = H264Encoder(width=w, height=h, qp=qp)
+    nals, recons = encode_chain(frames, qp=qp)
     annexb = syntax.annexb([enc.sps, enc.pps] + nals)
     decoded = oracle_decode(avdec, annexb, h, w, tmp_path)
-    assert len(decoded) == 4
+    assert len(decoded) == len(frames)
     for i, ((dy, du, dv), (ry, ru, rv)) in enumerate(zip(decoded, recons)):
         np.testing.assert_array_equal(dy, ry, err_msg=f"frame {i} luma")
         np.testing.assert_array_equal(du, ru, err_msg=f"frame {i} cb")
         np.testing.assert_array_equal(dv, rv, err_msg=f"frame {i} cr")
+
+
+def _subpel_pan_frames(n, h, w, *, oversample, seed, period):
+    """Frames sampled from an ``oversample``x world so each step pans by
+    1/oversample of a luma pixel — true sub-pel motion."""
+    rng = np.random.default_rng(seed)
+    wh, ww = h + 64, (w + 64) * oversample
+    yy, xx = np.mgrid[0:wh, 0:ww]
+    world = np.clip(100 + 60 * np.sin(xx / period) * np.cos(yy / 13.0)
+                    + rng.normal(0, 1.5, (wh, ww)), 0, 255
+                    ).astype(np.uint8)
+    frames = []
+    for t in range(n):
+        ox = 32 * oversample + t
+        ysamp = world[32:32 + h, ox:ox + oversample * w:oversample]
+        frames.append((
+            ysamp,
+            np.full((h // 2, w // 2), 120, np.uint8),
+            np.full((h // 2, w // 2), 130, np.uint8)))
+    return frames
+
+
+@pytest.mark.parametrize("oversample,seed,period,modulus", [
+    (2, 3, 23.0, 4),     # half-pel pan: MVs odd in half-pel units
+    (4, 9, 47.0, 2),     # quarter-pel pan: MVs odd in quarter-pel units
+])
+def test_subpel_pan_oracle_bit_exact(avdec, tmp_path, oversample, seed,
+                                     period, modulus):
+    """Content panning by a fraction of a pixel per frame must produce
+    sub-pel MVs and still decode bit-exactly in libavcodec (the 6-tap /
+    averaging MC on both sides agrees with the spec)."""
+    from vlog_tpu.codecs.h264.inter import motion_search
+
+    frames = _subpel_pan_frames(4, 96, 128, oversample=oversample,
+                                seed=seed, period=period)
+    mv = np.asarray(motion_search(frames[1][0], frames[0][0], search=8))
+    assert np.any(mv % modulus != 0), f"expected 1/{modulus}-pel MVs"
+    _assert_chain_bit_exact(avdec, tmp_path, frames)
 
 
 def test_p_chain_oracle_static_scene_skips(avdec, tmp_path):
@@ -155,7 +170,7 @@ def test_motion_search_finds_pan():
     frames = moving_frames(2, 64, 96, dx=3, dy=1)
     mv = np.asarray(motion_search(frames[1][0], frames[0][0], search=8))
     # panning by (dx, dy) per frame: ideal mv = (+dy, +dx) toward the
-    # matching content in the previous frame — in HALF-PEL units now,
-    # with the refinement allowed a half-pel of wiggle
-    assert np.all(np.abs(mv[..., 0] - 2) <= 3), mv[..., 0]
-    assert np.all(np.abs(mv[..., 1] - 6) <= 3), mv[..., 1]
+    # matching content in the previous frame — in QUARTER-PEL units now,
+    # with the refinement allowed a couple of quarter steps of wiggle
+    assert np.all(np.abs(mv[..., 0] - 4) <= 5), mv[..., 0]
+    assert np.all(np.abs(mv[..., 1] - 12) <= 5), mv[..., 1]

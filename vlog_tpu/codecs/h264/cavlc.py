@@ -334,7 +334,7 @@ class PSliceEncoder:
     """Encodes one P frame's device outputs into slice_data bits.
 
     MB modes are P_Skip or P_L0_16x16 with one reference; MVs arrive in
-    HALF pels from the DSP and are coded as quarter-pel MVDs against
+    QUARTER pels from the DSP and are coded as quarter-pel MVDs against
     the spec median predictor (8.4.1.3), with the P_Skip inferred-MV rule
     (8.4.1.1) deciding skippability.
     """
@@ -378,13 +378,13 @@ class PSliceEncoder:
         luma = plevels["luma"]            # (mbh, mbw, 4, 4, 4, 4)
         chroma_dc = plevels["chroma_dc"]  # (2, mbh, mbw, 2, 2)
         chroma_ac = plevels["chroma_ac"]  # (2, mbh, mbw, 2, 2, 4, 4)
-        mv_hp = plevels["mv"]             # (mbh, mbw, 2) half-pel (y, x)
+        mv_q = plevels["mv"]              # (mbh, mbw, 2) quarter-pel (y, x)
         skip_run = 0
         for my in range(self.mbh):
             for mx in range(self.mbw):
-                # DSP mv is (dy, dx) half pels; bitstream order is
-                # (x, y) in quarter pels.
-                mvx, mvy = int(mv_hp[my, mx, 1]) * 2, int(mv_hp[my, mx, 0]) * 2
+                # DSP mv is (dy, dx); bitstream order is (x, y) — both
+                # already in quarter pels.
+                mvx, mvy = int(mv_q[my, mx, 1]), int(mv_q[my, mx, 0])
                 cbp = self._mb_cbp(luma, chroma_dc, chroma_ac, my, mx)
                 smx, smy = self.skip_mv(my, mx)
                 if cbp == 0 and (mvx, mvy) == (smx, smy):

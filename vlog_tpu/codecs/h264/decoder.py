@@ -658,7 +658,7 @@ def reconstruct_p_frame(levels: dict, ref_y, ref_u, ref_v, *, qp: int):
     from vlog_tpu.codecs.h264.inter import mc_chroma, mc_luma
 
     qpc = chroma_qp(qp)
-    mv = jnp.asarray(levels["mv_hp"], jnp.int32)   # (mbh, mbw, 2) half-pel
+    mv = jnp.asarray(levels["mv_q"], jnp.int32)    # (mbh, mbw, 2) qtr-pel
     luma = jnp.asarray(levels["luma"], jnp.int32)
     chroma_dc = jnp.asarray(levels["chroma_dc"], jnp.int32)
     chroma_ac = jnp.asarray(levels["chroma_ac"], jnp.int32)
@@ -775,13 +775,12 @@ class H264Decoder:
             if self._ref is None:
                 raise DecodeError("P slice with no reference picture")
             mv_q = levels.pop("mv_q")                   # (mbh, mbw, 2) (x, y)
-            if np.any(mv_q % 2):
-                raise UnsupportedStream(
-                    "quarter-pel MVs outside decode envelope")
-            mv_hp = np.stack([mv_q[..., 1] // 2, mv_q[..., 0] // 2], axis=-1)
-            if np.any(np.abs(mv_hp) > 2 * (_P_REF_PAD - 1)):
+            mv = np.stack([mv_q[..., 1], mv_q[..., 0]], axis=-1)
+            # pad = _P_REF_PAD+8 in mc_luma keeps gathers safe through
+            # |mv| = 32 integer pels (the historical envelope)
+            if np.any(np.abs(mv) > 4 * _P_REF_PAD):
                 raise UnsupportedStream("MV beyond reference padding")
-            levels["mv_hp"] = mv_hp
+            levels["mv_q"] = mv                         # DSP (y, x) order
             y, u, v = reconstruct_p_frame(levels, *self._ref, qp=qp)
         else:
             y, u, v = reconstruct_frame(levels, qp=qp)

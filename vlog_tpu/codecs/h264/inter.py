@@ -9,17 +9,19 @@ TPU-first:
   subtract + block-sum — (2s+1)^2 sequential steps of perfectly parallel
   (H, W) work, instead of a per-MB scalar search loop. A small MV-cost
   penalty biases toward short vectors (rate proxy).
-- **Half-pel refinement on device**: the three half-sample planes (b, h,
+- **Sub-pel refinement on device**: the three half-sample planes (b, h,
   j — spec 8.4.2.2.1 six-tap) are whole-plane shifted sums computed once
-  per reference; the nine candidates around each MB's integer winner are
-  then gathers + block-SADs, and motion compensation selects per pixel
-  among the four planes by MV fraction. MVs flow through the pipeline in
-  HALF-PEL units ((y, x), DSP order).
+  per reference; eight half-pel then eight quarter-pel candidates around
+  each MB's winner are gathers + block-SADs. Quarter positions are the
+  spec's upward-rounded averages of two neighbours — expressed as one
+  per-pixel select over eight gathered planes via a 16-entry (fy, fx)
+  case table. MVs flow through the pipeline in QUARTER-PEL units
+  ((y, x), DSP order) — the bitstream's own resolution.
 - **Motion compensation as gathers**: per-MB MVs expand to per-pixel
   index maps over the edge-padded reference/half planes. Chroma follows
-  H.264 8.4.2.2.2: luma half-pel MVs land on eighth-pel chroma
-  positions, so chroma prediction is the 4-tap bilinear weighting of 4
-  gathers with weights 0/2/4/6/8 per axis.
+  H.264 8.4.2.2.2: the luma quarter-pel MV value lands on the
+  eighth-chroma-pel grid directly, so chroma prediction is the 4-tap
+  bilinear blend with weights 0..8 per axis.
 - **Residuals**: inter 4x4 luma transform keeps all 16 coefficients per
   block (no Intra16x16 DC split); chroma keeps the 2x2 DC Hadamard.
   Quantizer rounding uses the inter offset (f = 2^qbits/6) — rounding is
@@ -85,33 +87,57 @@ def half_pel_planes(refp):
     return b, h, j
 
 
-def _gather_halfpel(refp, planes, mv_hp, *, pad, mb=16):
-    """Luma prediction at half-pel MVs: per-pixel select among the four
-    sample planes by MV fraction, one gather each."""
+# Quarter-sample derivation (spec 8.4.2.2.1): every quarter position is
+# the upward-rounded average of two samples drawn from {G (integer), b,
+# h, j} at offsets 0/+1.  Sample ids: 0=G(0,0) 1=G(0,+1) 2=G(+1,0)
+# 3=b(0,0) 4=b(+1,0) 5=h(0,0) 6=h(0,+1) 7=j(0,0).  Indexed [fy][fx].
+_QPEL_A = np.array([[0, 0, 3, 3],      # G a b c
+                    [0, 3, 3, 3],      # d e f g
+                    [5, 5, 7, 7],      # h i j k
+                    [5, 5, 7, 6]],     # n p q r
+                   np.int32)
+_QPEL_B = np.array([[0, 3, 3, 1],
+                    [5, 5, 7, 6],
+                    [5, 7, 7, 6],
+                    [2, 4, 4, 4]], np.int32)
+
+
+def _gather_qpel(refp, planes, mv_q, *, pad, mb=16):
+    """Luma prediction at quarter-pel MVs: eight gathers (the candidate
+    neighbour samples), then one per-pixel pair-select + average."""
     bpl, hpl, jpl = planes
     hp = refp.shape[0] - 2 * pad
     wp = refp.shape[1] - 2 * pad
-    dy, dx = _mv_maps(mv_hp, mb)
-    iy, fy = dy >> 1, dy & 1
-    ix, fx = dx >> 1, dx & 1
+    dy, dx = _mv_maps(mv_q, mb)
+    iy, fy = dy >> 2, dy & 3
+    ix, fx = dx >> 2, dx & 3
     rows = jnp.arange(hp)[:, None] + iy + pad
     cols = jnp.arange(wp)[None, :] + ix + pad
-    g = refp[rows, cols]
-    return jnp.where(
-        fy == 0,
-        jnp.where(fx == 0, g, bpl[rows, cols]),
-        jnp.where(fx == 0, hpl[rows, cols], jpl[rows, cols]))
+    cand = jnp.stack([
+        refp[rows, cols], refp[rows, cols + 1], refp[rows + 1, cols],
+        bpl[rows, cols], bpl[rows + 1, cols],
+        hpl[rows, cols], hpl[rows, cols + 1],
+        jpl[rows, cols],
+    ])                                              # (8, H, W)
+    case = fy * 4 + fx
+    ia = jnp.asarray(_QPEL_A).reshape(-1)[case]     # (H, W) sample ids
+    ib = jnp.asarray(_QPEL_B).reshape(-1)[case]
+    pa = jnp.take_along_axis(cand, ia[None], axis=0)[0]
+    pb = jnp.take_along_axis(cand, ib[None], axis=0)[0]
+    return (pa + pb + 1) >> 1
 
 
 def motion_search(cur_y, ref_y, *, search: int = 8,
                   lam: int = MV_COST_LAMBDA, refp=None, planes=None):
-    """Full-search integer ME + half-pel refinement:
-    (H, W) planes -> (mbh, mbw, 2) MVs in HALF-PEL units (y, x).
+    """Full-search integer ME + half- then quarter-pel refinement:
+    (H, W) planes -> (mbh, mbw, 2) MVs in QUARTER-PEL units (y, x).
 
     Deterministic: ties keep the earlier candidate in raster offset
-    order, with (0,0) evaluated first; refinement keeps the integer
-    winner on ties.  ``refp``/``planes`` may be precomputed by the
-    caller (encode_p_frame shares them with motion compensation).
+    order, with (0,0) evaluated first; each refinement stage keeps the
+    previous winner on ties (its SAD seeds the stage, so the base
+    candidate is never re-evaluated).  ``refp``/``planes`` may be
+    precomputed by the caller (encode_p_frame shares them with motion
+    compensation).
     """
     h, w = cur_y.shape
     mbh, mbw = h // 16, w // 16
@@ -148,36 +174,39 @@ def motion_search(cur_y, ref_y, *, search: int = 8,
             jnp.zeros((mbh, mbw, 2), jnp.int32))
     (int_sad, mv_int), _ = jax.lax.scan(step, init, offs)
 
-    # --- half-pel refinement: eight candidates around the integer
-    # winner, seeded with its SAD (the cost scales are commensurate:
-    # lam*4*|off_int| == lam*2*|2*off_int|, so no re-evaluation of the
-    # base candidate is needed).
+    # --- sub-pel refinement: eight candidates per stage around the
+    # previous winner, seeded with its SAD (cost scales are commensurate
+    # in quarter-pel units: lam*4*|int| == lam*|4*int|).
     if planes is None:
         planes = half_pel_planes(refp)
-    base_hp = mv_int * 2
 
-    def sad_hp(off):
-        cand = base_hp + off[None, None, :]
-        pred = _gather_halfpel(refp, planes, cand, pad=pad)
-        sad = jnp.abs(cur - pred).reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
-        cost = lam * 2 * (jnp.abs(cand[..., 0]) + jnp.abs(cand[..., 1]))
-        return sad + cost
-
-    half_offs = jnp.asarray(
+    neigh = jnp.asarray(
         [(dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1)
          if (dy, dx) != (0, 0)], jnp.int32)
 
-    def hstep(carry, off):
-        best_sad, best_mv = carry
-        sad = sad_hp(off)
-        better = sad < best_sad
-        best_sad = jnp.where(better, sad, best_sad)
-        cand = base_hp + off[None, None, :]
-        best_mv = jnp.where(better[..., None], cand, best_mv)
-        return (best_sad, best_mv), None
+    def refine(base_q, base_sad, step_q):
+        def sad_q(cand):
+            pred = _gather_qpel(refp, planes, cand, pad=pad)
+            sad = jnp.abs(cur - pred).reshape(
+                mbh, 16, mbw, 16).sum(axis=(1, 3))
+            cost = lam * (jnp.abs(cand[..., 0]) + jnp.abs(cand[..., 1]))
+            return sad + cost
 
-    (_, mv_hp), _ = jax.lax.scan(hstep, (int_sad, base_hp), half_offs)
-    return mv_hp
+        def rstep(carry, off):
+            best_sad, best_mv = carry
+            cand = base_q + step_q * off[None, None, :]
+            sad = sad_q(cand)
+            better = sad < best_sad
+            best_sad = jnp.where(better, sad, best_sad)
+            best_mv = jnp.where(better[..., None], cand, best_mv)
+            return (best_sad, best_mv), None
+
+        (sad, mv), _ = jax.lax.scan(rstep, (base_sad, base_q), neigh)
+        return mv, sad
+
+    mv_q, sad_q = refine(mv_int * 4, int_sad, 2)    # half-pel stage
+    mv_q, _ = refine(mv_q, sad_q, 1)                # quarter-pel stage
+    return mv_q
 
 
 def _mv_maps(mv, mb: int):
@@ -188,8 +217,8 @@ def _mv_maps(mv, mb: int):
     return dy, dx
 
 
-def mc_luma(ref_y, mv_hp, *, search: int, planes=None, refp=None):
-    """Luma prediction at half-pel MVs (spec 8.4.2.2.1 six-tap planes).
+def mc_luma(ref_y, mv_q, *, search: int, planes=None, refp=None):
+    """Luma prediction at quarter-pel MVs (spec 8.4.2.2).
 
     ``planes``/``refp`` may be precomputed (encode path: the search just
     built them); the decode path passes only the reference."""
@@ -198,22 +227,19 @@ def mc_luma(ref_y, mv_hp, *, search: int, planes=None, refp=None):
         refp = jnp.pad(ref_y.astype(jnp.int32), pad, mode="edge")
     if planes is None:
         planes = half_pel_planes(refp)
-    return _gather_halfpel(refp, planes, mv_hp, pad=pad)
+    return _gather_qpel(refp, planes, mv_q, pad=pad)
 
 
-def mc_chroma(ref_c, mv_hp, *, search: int):
-    """Chroma prediction per 8.4.2.2.2 for half-pel luma MVs.
-
-    The chroma MV equals the luma quarter-pel value interpreted on the
-    eighth-chroma-pel grid: q = 2*mv_hp, integer part q>>3, fraction
-    q&7 in {0, 2, 4, 6} — the spec's bilinear blend."""
+def mc_chroma(ref_c, mv_q, *, search: int):
+    """Chroma prediction per 8.4.2.2.2: the luma quarter-pel MV value is
+    interpreted directly on the eighth-chroma-pel grid (integer part
+    q>>3, fraction q&7), with the spec's bilinear blend."""
     hc, wc = ref_c.shape
     pad = search // 2 + 2
     refp = jnp.pad(ref_c.astype(jnp.int32), pad, mode="edge")
-    dy, dx = _mv_maps(mv_hp, 8)                     # half-luma-pel units
-    q_y, q_x = dy * 2, dx * 2                       # eighth-chroma-pel
-    iy, fy = q_y >> 3, q_y & 7
-    ix, fx = q_x >> 3, q_x & 7
+    dy, dx = _mv_maps(mv_q, 8)                      # quarter-luma-pel
+    iy, fy = dy >> 3, dy & 7
+    ix, fx = dx >> 3, dx & 7
     rows = jnp.arange(hc)[:, None] + iy + pad
     cols = jnp.arange(wc)[None, :] + ix + pad
     a = refp[rows, cols]
@@ -265,16 +291,17 @@ def encode_p_frame(y, u, v, ref_y, ref_u, ref_v, *, qp,
                    search: int = 8):
     """One P frame against one reference (both at the same geometry).
 
-    All MBs are P_L0_16x16 with half-pel MVs (skip detection happens at
-    entropy time from mv + zero levels). Returns levels, MVs (half-pel),
-    and the reconstruction that becomes the next frame's reference.
+    All MBs are P_L0_16x16 with quarter-pel MVs (skip detection happens
+    at entropy time from mv + zero levels). Returns levels, MVs
+    (quarter-pel), and the reconstruction that becomes the next frame's
+    reference.
     """
     qpc = chroma_qp(qp)
     pad = search + 8
     refp = jnp.pad(ref_y.astype(jnp.int32), pad, mode="edge")
     planes = half_pel_planes(refp)                  # shared search + MC
     mv = motion_search(y, ref_y, search=search, refp=refp,
-                       planes=planes)               # half-pel units
+                       planes=planes)               # quarter-pel units
     pred_y = mc_luma(ref_y, mv, search=search, refp=refp, planes=planes)
     pred_u = mc_chroma(ref_u, mv, search=search)
     pred_v = mc_chroma(ref_v, mv, search=search)
@@ -287,7 +314,7 @@ def encode_p_frame(y, u, v, ref_y, ref_u, ref_v, *, qp,
         "luma": luma,                              # (mbh, mbw, 4,4,4,4)
         "chroma_dc": jnp.stack([udc, vdc]),        # (2, mbh, mbw, 2, 2)
         "chroma_ac": jnp.stack([uac, vac]),        # (2, mbh, mbw, 2,2,4,4)
-        "mv": mv,                                  # (mbh, mbw, 2) half-pel
+        "mv": mv,                                  # (mbh, mbw, 2) qtr-pel
         "recon_y": recon_y.astype(jnp.uint8),
         "recon_u": recon_u.astype(jnp.uint8),
         "recon_v": recon_v.astype(jnp.uint8),

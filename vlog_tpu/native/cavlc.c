@@ -367,7 +367,7 @@ static const int BLK2[4][2] = {{0, 0}, {0, 1}, {1, 0}, {1, 1}};
  *   luma      (mbh, mbw, 4, 4, 4, 4)   [block by, bx, then 4x4]
  *   chroma_dc (2, mbh, mbw, 2, 2)
  *   chroma_ac (2, mbh, mbw, 2, 2, 4, 4)
- *   mv        (mbh, mbw, 2)            HALF pels, (y, x) — DSP order
+ *   mv        (mbh, mbw, 2)            QUARTER pels, (y, x) — DSP order
  * scratch: int32 of size mbh*4*mbw*4 + 2*mbh*2*mbw*2 + mbh*mbw*2.
  * Returns bytes written or -1 on overflow.
  */
@@ -408,9 +408,9 @@ int64_t vt_cavlc_encode_p_slice(
                 cdc[comp] = chroma_dc + ((((int64_t)comp * mbh + my) * mbw + mx) << 2);
                 cac[comp] = chroma_ac + ((((int64_t)comp * mbh + my) * mbw + mx) << 6);
             }
-            /* quarter-pel mv, bitstream (x, y) from DSP half-pel (y, x) */
-            int32_t mvx = mv[mb * 2 + 1] * 2;
-            int32_t mvy = mv[mb * 2] * 2;
+            /* bitstream (x, y) from DSP (y, x), both quarter-pel */
+            int32_t mvx = mv[mb * 2 + 1];
+            int32_t mvy = mv[mb * 2];
 
             /* CBP: luma bit per 8x8 quadrant + chroma 0/1/2 */
             int cbp = 0;
