@@ -35,6 +35,8 @@ class RateController:
     qp: int = field(init=False)
     _ema_bpf: float | None = field(default=None, init=False)
     _calibrating: bool = field(default=True, init=False)
+    _last_sign: int = field(default=0, init=False)
+    _sign_run: int = field(default=0, init=False)
 
     def __post_init__(self) -> None:
         self.qp = self.init_qp
@@ -60,8 +62,17 @@ class RateController:
             self._calibrating = False
             step = round(6.0 * math.log2(ratio))
         else:
-            step = 6.0 * math.log2(ratio) * self.damping
-            step = max(-self.max_step, min(self.max_step, round(step)))
+            full = 6.0 * math.log2(ratio)
+            sign = (full > 0) - (full < 0)
+            # Damping guards against oscillation — but an error that keeps
+            # the same sign across batches is bias, not noise; drop the
+            # damping so short encodes still converge (few observations).
+            self._sign_run = self._sign_run + 1 if sign == self._last_sign \
+                else 1
+            self._last_sign = sign
+            damp = 1.0 if self._sign_run >= 2 else self.damping
+            step = max(-self.max_step,
+                       min(self.max_step, round(full * damp)))
         if step:
             self.qp = max(self.min_qp, min(self.max_qp, self.qp + step))
             # A QP move invalidates the EMA's operating point; restart it
