@@ -1,15 +1,15 @@
 """HEVC ladder execution path (codec="h265" re-encodes).
 
 The H.264 path runs a fused all-rungs XLA ladder program
-(parallel/ladder.py); this HEVC v1 path trades that last fusion step
-for simplicity: per batch it resizes on device (matmul lanczos,
-ops/resize.py), runs the batched HEVC DSP (codecs/hevc/jax_core.py —
-one dispatch per rung), and entropy-codes on the host through the C
-CABAC coder, overlapping decode with a one-batch prefetch thread.
-Segments, playlists, and manifests come out identical in shape to the
-H.264 path (hvc1 sample entries, hvc1.* CODECS strings), so the whole
-product plane — players, resume validation, re-encode flips — works
-unchanged.
+(parallel/ladder.py); this HEVC path trades that last fusion step for
+simplicity: per batch it resizes on device (matmul lanczos,
+ops/resize.py), runs the HEVC DSP (codecs/hevc/jax_core.py — I+P
+chains when the plan's GOP mode asks for them, intra otherwise; one
+dispatch per rung per chain), and entropy-codes on the host,
+overlapping decode with a one-batch prefetch thread. Segments,
+playlists, and manifests come out identical in shape to the H.264 path
+(hvc1 sample entries, hvc1.* CODECS strings), so the whole product
+plane — players, resume validation, re-encode flips — works unchanged.
 
 Reference parity: reencode_worker.py codec upgrades via hevc_nvenc /
 hevc_vaapi (worker/hwaccel.py:509-552).
@@ -24,6 +24,7 @@ from pathlib import Path
 
 import numpy as np
 
+from vlog_tpu import config
 from vlog_tpu.backends.base import RungResult, RunResult
 from vlog_tpu.backends.source import open_source
 from vlog_tpu.codecs.hevc.api import HevcEncoder
@@ -99,9 +100,14 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         eof = object()
         stop = threading.Event()
 
+        # chain-aligned batches: segments are gop_len multiples, so each
+        # batch holds whole chains (the last may be short at EOF)
+        clen = max(1, plan.gop_len)
+        batch_n = clen * max(1, plan.frame_batch // clen)
+
         def producer() -> None:
             try:
-                for item in src.read_batches(plan.frame_batch, start_frame):
+                for item in src.read_batches(batch_n, start_frame):
                     while not stop.is_set():
                         try:
                             fifo.put(item, timeout=0.5)
@@ -137,13 +143,23 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                                                    rung.width)
                         ry, ru, rv = (np.asarray(ry), np.asarray(ru),
                                       np.asarray(rv))
-                    frames = encoders[rung.name].encode_batch(
-                        ry, ru, rv, pool=entropy_pool)
+                    enc = encoders[rung.name]
+                    if clen > 1:
+                        frames = []
+                        for c0 in range(0, ry.shape[0], clen):
+                            frames.extend(enc.encode_chain(
+                                ry[c0:c0 + clen], ru[c0:c0 + clen],
+                                rv[c0:c0 + clen], pool=entropy_pool,
+                                search=config.MOTION_SEARCH_RADIUS,
+                                chain_len=clen))
+                    else:
+                        frames = enc.encode_batch(ry, ru, rv,
+                                                  pool=entropy_pool)
                     for f in frames:
                         psnr_acc[rung.name].append(f.psnr_y)
                         pending[rung.name].append(
                             Sample(data=f.sample, duration=frame_dur,
-                                   is_sync=True))
+                                   is_sync=f.is_idr))
                     while len(pending[rung.name]) >= frames_per_seg:
                         chunk = pending[rung.name][:frames_per_seg]
                         pending[rung.name] = pending[rung.name][

@@ -251,6 +251,30 @@ def mc_chroma(ref_c, mv_q, *, search: int):
     return pred
 
 
+# MB decimation weights (x264's dct_decimate idea): a macroblock whose
+# quantized luma is nothing but scattered +-1s costs far more to CAVLC-
+# code than the energy it restores. Weight each +-1 by how cheap it is
+# to represent (low zigzag index = structurally cheap and perceptually
+# load-bearing, high index = expensive trailing coefficient), and zero
+# the whole MB's luma when the summed score is below threshold. Any
+# |level| >= 2 vetoes. Encoder-side freedom: recon stays closed-loop.
+from vlog_tpu.codecs.h264.cavlc_tables import ZIGZAG_4x4 as _ZZ
+
+_DECIMATE_W = np.zeros((4, 4), np.int32)
+for _zi, (_r, _c) in enumerate(_ZZ):
+    _DECIMATE_W[_r, _c] = 3 if _zi <= 2 else (2 if _zi <= 9 else 1)
+_DECIMATE_THRESHOLD = 6
+
+
+def _decimate_mb_luma(levels):
+    """levels (mbh, mbw, 4, 4, 4, 4) -> same, with low-score MBs zeroed."""
+    absl = jnp.abs(levels)
+    veto = jnp.any(absl >= 2, axis=(2, 3, 4, 5))
+    score = jnp.sum((absl == 1) * jnp.asarray(_DECIMATE_W), axis=(2, 3, 4, 5))
+    keep = veto | (score >= _DECIMATE_THRESHOLD)
+    return levels * keep[:, :, None, None, None, None]
+
+
 def _inter_luma_residual(cur, pred, qp):
     """(H, W) residual -> levels (mbh, mbw, 4, 4, 4, 4) + recon plane."""
     h, w = cur.shape
@@ -260,7 +284,7 @@ def _inter_luma_residual(cur, pred, qp):
     blocks = resid.reshape(mbh, 4, 4, mbw, 4, 4)
     blocks = jnp.transpose(blocks, (0, 3, 1, 4, 2, 5))
     coefs = core_transform(blocks)
-    levels = quantize(coefs, qp=qp, intra=False)
+    levels = _decimate_mb_luma(quantize(coefs, qp=qp, intra=False))
     rec = inverse_core_transform(dequantize(levels, qp=qp))
     rec = jnp.transpose(rec, (0, 2, 4, 1, 3, 5)).reshape(h, w)
     recon = jnp.clip(pred + rec, 0, 255)

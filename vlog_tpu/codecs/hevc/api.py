@@ -108,9 +108,11 @@ class HevcEncoder:
         return np.pad(plane, ((0, 0), (0, ph - h), (0, pw - w)),
                       mode="edge")
 
-    def _entropy(self, ly, lu, lv, rows, cols) -> bytes:
+    def _entropy(self, ly, lu, lv, rows, cols,
+                 qp: int | None = None) -> bytes:
         from vlog_tpu.native.build import get_lib
 
+        qp = self.qp if qp is None else qp
         lib = get_lib()
         if lib is not None:
             import ctypes
@@ -124,16 +126,91 @@ class HevcEncoder:
             u8p = ctypes.POINTER(ctypes.c_uint8)
             n = lib.vt_hevc_encode_slice(
                 la.ctypes.data_as(i16p), ua.ctypes.data_as(i16p),
-                va.ctypes.data_as(i16p), rows, cols, self.qp,
+                va.ctypes.data_as(i16p), rows, cols, qp,
                 out.ctypes.data_as(u8p), cap)
             if n >= 0:
                 return out[:n].tobytes()
-        sw = SliceWriter(self.qp)
+        sw = SliceWriter(qp)
         for r in range(rows):
             for c in range(cols):
                 sw.write_ctu(c, ly[r, c], lu[r, c], lv[r, c],
                              last_in_slice=(r == rows - 1 and c == cols - 1))
         return sw.payload()
+
+    def encode_chain(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                     pool: ThreadPoolExecutor | None = None, *,
+                     search: int = 16,
+                     chain_len: int | None = None) -> list[EncodedFrame]:
+        """Encode one I + P chain: y (T, H, W), u/v (T, H/2, W/2) uint8.
+
+        Frame 0 is an IDR coded at qp-2 (the chain-anchor offset the
+        H.264 path also uses); frames 1..T-1 are P pictures with
+        integer MVs against the running reconstruction
+        (codecs/hevc/pslice.py). One device dispatch per chain; entropy
+        per frame in threads.
+
+        ``chain_len``: pad short tail chains (EOF) up to this length
+        with replicated last frames so every dispatch reuses one
+        compiled program; the padding frames are dropped from the
+        output."""
+        from vlog_tpu.codecs.hevc.jax_core import encode_chain_dsp
+        from vlog_tpu.codecs.hevc.pslice import PSliceWriter, p_nal
+
+        y = self._pad(np.asarray(y, np.uint8), CTB)
+        u = self._pad(np.asarray(u, np.uint8), CTB // 2)
+        v = self._pad(np.asarray(v, np.uint8), CTB // 2)
+        t_real = y.shape[0]
+        if chain_len is not None and t_real < chain_len:
+            reps = chain_len - t_real
+            y = np.concatenate([y, np.repeat(y[-1:], reps, 0)])
+            u = np.concatenate([u, np.repeat(u[-1:], reps, 0)])
+            v = np.concatenate([v, np.repeat(v[-1:], reps, 0)])
+        t, h, w = y.shape
+        rows, cols = h // CTB, w // CTB
+        qp_i = max(10, self.qp - 2)
+        (intra, recon0), (plevels, mvs, precons) = encode_chain_dsp(
+            y, u, v, search, np.int32(qp_i), np.int32(self.qp))
+        recons = [recon0] + ([tuple(np.asarray(p[i]) for p in precons)
+                              for i in range(t - 1)] if t > 1 else [])
+        intra_np = tuple(np.asarray(a) for a in intra)
+        p_np = (tuple(np.asarray(a) for a in plevels)
+                if plevels is not None else None)
+        mv_np = np.asarray(mvs) if mvs is not None else None
+
+        def psnr_of(i):
+            ry = np.asarray(recons[i][0])[:self.height, :self.width]
+            mse = np.mean((ry.astype(np.float64)
+                           - y[i, :self.height, :self.width]
+                           .astype(np.float64)) ** 2)
+            return float(10 * np.log10(255.0 ** 2 / max(mse, 1e-12)))
+
+        def pack(i: int) -> EncodedFrame:
+            if i == 0:
+                payload = self._entropy(*intra_np, rows, cols, qp_i)
+                nal = syntax.idr_nal(qp_i, payload)
+            else:
+                sw = PSliceWriter(self.qp, rows, cols)
+                ly, lu, lvv = (p_np[0][i - 1], p_np[1][i - 1],
+                               p_np[2][i - 1])
+                for r in range(rows):
+                    for c in range(cols):
+                        sw.write_ctu_inter(
+                            r, c, tuple(int(x) for x in mv_np[i - 1, r, c]),
+                            ly[r, c], lu[r, c], lvv[r, c],
+                            last_in_slice=(r == rows - 1 and c == cols - 1))
+                nal = p_nal(self.qp, i, sw.payload())
+            raw = nal.to_bytes()
+            return EncodedFrame(
+                sample=len(raw).to_bytes(4, "big") + raw,
+                annexb=syntax.annexb(
+                    ([self.vps, self.sps, self.pps] if i == 0 else [])
+                    + [nal]),
+                is_idr=(i == 0), psnr_y=psnr_of(i))
+
+        if pool is None:
+            with ThreadPoolExecutor(self.entropy_threads) as p:
+                return list(p.map(pack, range(t_real)))
+        return list(pool.map(pack, range(t_real)))
 
     def encode_batch(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
                      pool: ThreadPoolExecutor | None = None

@@ -140,6 +140,155 @@ def _encode_plane(plane, qp, mat, n):
     return levels, recon_plane
 
 
+# ---------------------------------------------------------------- inter
+# Integer-MV P frames (see pslice.py): luma MC is a shifted gather from
+# the previous reconstruction; chroma lands on {0, 1/2} positions, so
+# the HEVC 4-tap filter at fraction 4 yields three derived planes and MC
+# selects among them per MV parity. Motion search is the same
+# offset-scan SAD pattern as the H.264 core, at 32x32 CTB granularity.
+
+_CTAP = (-4, 36, 36, -4)      # HEVC chroma filter, fraction 4 (table 8-32)
+
+
+def _chroma_frac_planes(refp):
+    """Edge-padded chroma plane -> (copy<<6, H, V, HV) at the uniform
+    'predSample' scale (gain 64); final pred = (sel + 32) >> 6."""
+    def tap(x, axis):
+        out = None
+        for k, t in enumerate(_CTAP):
+            term = t * jnp.roll(x, 1 - k, axis=axis)
+            out = term if out is None else out + term
+        return out
+
+    h1 = tap(refp, 1)
+    v1 = tap(refp, 0)
+    hv = tap(h1, 0) >> 6
+    return refp << 6, h1, v1, hv
+
+
+def _p_ctb_search(cur, refp, *, search, pad, lam=2):
+    """Full-search integer ME per 32x32 CTB: (H, W) -> (R, C, 2) MVs
+    ((y, x), integer luma pels)."""
+    h, w = cur.shape
+    rr, cc = h // 32, w // 32
+    offsets = [(0, 0)] + [
+        (dy, dx) for dy in range(-search, search + 1)
+        for dx in range(-search, search + 1) if (dy, dx) != (0, 0)]
+    offs = jnp.asarray(offsets, jnp.int32)
+
+    def step(carry, off):
+        best_sad, best_mv = carry
+        shifted = jax.lax.dynamic_slice(
+            refp, (pad + off[0], pad + off[1]), (h, w))
+        sad = jnp.abs(cur - shifted).reshape(rr, 32, cc, 32).sum(
+            axis=(1, 3))
+        sad = sad + lam * 4 * (jnp.abs(off[0]) + jnp.abs(off[1]))
+        better = sad < best_sad
+        return (jnp.where(better, sad, best_sad),
+                jnp.where(better[..., None], off[None, None, :],
+                          best_mv)), None
+
+    init = (jnp.full((rr, cc), jnp.iinfo(jnp.int32).max, jnp.int32),
+            jnp.zeros((rr, cc, 2), jnp.int32))
+    (_, mv), _ = jax.lax.scan(step, init, offs)
+    return mv
+
+
+def _mc_luma_int(refp, mv, *, pad, n=32):
+    h = refp.shape[0] - 2 * pad
+    w = refp.shape[1] - 2 * pad
+    dy = jnp.repeat(jnp.repeat(mv[..., 0], n, 0), n, 1)
+    dx = jnp.repeat(jnp.repeat(mv[..., 1], n, 0), n, 1)
+    rows = jnp.arange(h)[:, None] + dy + pad
+    cols = jnp.arange(w)[None, :] + dx + pad
+    return refp[rows, cols]
+
+
+def _mc_chroma_frac4(ref_c, mv, *, pad):
+    """Chroma MC for integer luma MVs: parity picks copy/H/V/HV."""
+    refp = jnp.pad(ref_c.astype(jnp.int32), pad, mode="edge")
+    planes = jnp.stack(_chroma_frac_planes(refp))   # (4, Hp, Wp)
+    hc = ref_c.shape[0]
+    wc = ref_c.shape[1]
+    dy = jnp.repeat(jnp.repeat(mv[..., 0], 16, 0), 16, 1)
+    dx = jnp.repeat(jnp.repeat(mv[..., 1], 16, 0), 16, 1)
+    iy, fy = dy >> 1, dy & 1
+    ix, fx = dx >> 1, dx & 1
+    rows = jnp.arange(hc)[:, None] + iy + pad
+    cols = jnp.arange(wc)[None, :] + ix + pad
+    sel = fy * 2 + fx                               # 0=copy 1=H 2=V 3=HV
+    gathered = planes[:, rows, cols]                # (4, hc, wc)
+    ps = jnp.take_along_axis(gathered, sel[None], axis=0)[0]
+    return jnp.clip((ps + 32) >> 6, 0, 255)
+
+
+def encode_p_frame_dsp(y, u, v, ref_y, ref_u, ref_v, qp, *,
+                       search: int = 16):
+    """One P frame against the previous reconstruction. All CTBs inter
+    with integer MVs (pslice.py codes them); returns levels, MVs, recon.
+    Everything is ref-relative, so the whole frame is one parallel pass
+    — no intra row-scan needed."""
+    qp = jnp.asarray(qp, jnp.int32)
+    qpc = chroma_qp_traced(qp)
+    pad = search + 1
+    h, w = y.shape
+    rr, cc = h // 32, w // 32
+    cur = y.astype(jnp.int32)
+    refp = jnp.pad(ref_y.astype(jnp.int32), pad, mode="edge")
+    mv = _p_ctb_search(cur, refp, search=search, pad=pad)
+
+    pred_y = _mc_luma_int(refp, mv, pad=pad)
+    # chroma pad: mv/2 reach + 2 taps + 4 roll-wrap contamination ring
+    cpad = search // 2 + 6
+    pred_u = _mc_chroma_frac4(ref_u, mv, pad=cpad)
+    pred_v = _mc_chroma_frac4(ref_v, mv, pad=cpad)
+
+    def to_blocks(plane, n):
+        r2, c2 = plane.shape[0] // n, plane.shape[1] // n
+        return plane.reshape(r2, n, c2, n).transpose(0, 2, 1, 3)
+
+    def from_blocks(blk, n):
+        return blk.transpose(0, 2, 1, 3).reshape(blk.shape[0] * n,
+                                                 blk.shape[1] * n)
+
+    ly, ry = _code_blocks(to_blocks(cur, 32), to_blocks(pred_y, 32), qp,
+                          jnp.asarray(T32), 5)
+    lu, ru = _code_blocks(to_blocks(u.astype(jnp.int32), 16),
+                          to_blocks(pred_u, 16), qpc, jnp.asarray(T16), 4)
+    lv, rv = _code_blocks(to_blocks(v.astype(jnp.int32), 16),
+                          to_blocks(pred_v, 16), qpc, jnp.asarray(T16), 4)
+    return ((ly, lu, lv), mv,
+            (from_blocks(ry, 32).astype(jnp.uint8),
+             from_blocks(ru, 16).astype(jnp.uint8),
+             from_blocks(rv, 16).astype(jnp.uint8)))
+
+
+@partial(jax.jit, static_argnums=(3,))
+def encode_chain_dsp(y, u, v, search, qp_i, qp_p):
+    """I + P chain: frame 0 intra (row-scan), frames 1.. inter against
+    the running reconstruction (lax.scan carry). Inputs (T, H, W) padded
+    planes; returns intra levels, per-P levels/MVs, and recons.
+
+    ``qp_i`` is typically qp_p-2: a finer anchor pays off down the whole
+    chain (same offset the H.264 chain path ships, +0.3-0.4 dB)."""
+    qp_i = jnp.asarray(qp_i, jnp.int32)
+    qp_p = jnp.asarray(qp_p, jnp.int32)
+    (li, lui, lvi), (ry, ru, rv) = encode_frame_dsp(y[0], u[0], v[0], qp_i)
+
+    def step(carry, frame):
+        fy, fu, fv = frame
+        levels, mv, recon = encode_p_frame_dsp(
+            fy, fu, fv, *carry, qp_p, search=search)
+        return recon, (levels, mv, recon)
+
+    if y.shape[0] > 1:
+        _, (plevels, mvs, precons) = jax.lax.scan(
+            step, (ry, ru, rv), (y[1:], u[1:], v[1:]))
+    else:
+        plevels, mvs, precons = None, None, None
+    return ((li, lui, lvi), (ry, ru, rv)), (plevels, mvs, precons)
+
+
 @partial(jax.jit, static_argnums=())
 def encode_frame_dsp(y, u, v, qp):
     """Device pass for one padded frame: returns per-CTB quantized levels

@@ -1,0 +1,239 @@
+"""HEVC P-slice syntax: TRAIL pictures with integer-MV inter CTBs.
+
+Extends the all-intra envelope (slice.py) with single-reference P
+slices: every CTB is either an inter 2Nx2N CU with an explicitly coded
+integer MV (AMVP, mvp_l0_flag=0, no merge/skip — avoids the merge
+candidate machinery entirely at a cost of a few bins per CTB) or falls
+back to the intra mode-26 CU when motion fails. Integer luma MVs mean
+luma MC is a shifted copy and chroma lands on {0, 1/2} positions only
+(the 4-tap filter at fraction 4), keeping the device DSP to gathers +
+two small convolutions — the HEVC analog of the H.264 chain design.
+
+The AMVP predictor (8.5.3.2.6) is computed by an entropy-time state
+machine over the CTB grid, mirroring what any decoder derives:
+candidate A = the left CU's MV (below-left is never decoded yet at CTB
+granularity), candidate B = first of above-right/above/above-left,
+pruned and zero-filled. All PUs share one reference picture (the
+previous frame, RPS delta=1), so no MV scaling is ever needed.
+
+Oracle: tests/test_hevc.py decodes I+P chains with libavcodec and
+asserts byte-exact reconstruction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vlog_tpu.codecs.hevc.cabac import CabacEncoder
+from vlog_tpu.codecs.hevc.residual import write_residual
+from vlog_tpu.codecs.hevc.syntax import CTB, NalUnit
+from vlog_tpu.codecs.hevc.tables import CTX_OFF
+from vlog_tpu.media.bitstream import BitWriter
+
+NAL_TRAIL_R = 1
+
+_SKIP = CTX_OFF["SKIP"][0]
+_PRED_MODE = CTX_OFF["PRED_MODE"][0]
+_PART = CTX_OFF["PART_MODE"][0]
+_MERGE = CTX_OFF["MERGE_FLAG"][0]
+_MVP = CTX_OFF["MVP_LX"][0]
+_ROOT_CBF = CTX_OFF["NO_RESIDUAL"][0]
+# mvd_coding contexts: greater0 at the block base, greater1 at +3
+# (both measured from the hls_mvd_coding disassembly)
+_MVD_G0 = CTX_OFF["MVD_GREATER"][0]
+_MVD_G1 = CTX_OFF["MVD_GREATER"][0] + 3
+_PREV = CTX_OFF["PREV_INTRA_LUMA"][0]
+_CHROMA = CTX_OFF["INTRA_CHROMA_PRED"][0]
+_CBF_LUMA = CTX_OFF["CBF_LUMA"][0]
+_CBF_CHROMA = CTX_OFF["CBF_CB_CR"][0]
+
+
+def p_slice_header_bits(slice_qp: int, poc_lsb: int) -> BitWriter:
+    """P slice header for our stream shape (7.3.6.1): one negative ref
+    at delta 1, no SAO/deblock/temporal-MVP, merge depth 1."""
+    w = BitWriter()
+    w.write_bit(1)            # first_slice_segment_in_pic_flag
+    w.write_ue(0)             # slice_pic_parameter_set_id
+    w.write_ue(1)             # slice_type = P
+    w.write_bits(poc_lsb & 0xFF, 8)   # slice_pic_order_cnt_lsb
+    w.write_bit(0)            # short_term_ref_pic_set_sps_flag
+    w.write_ue(1)             # num_negative_pics
+    w.write_ue(0)             # num_positive_pics
+    w.write_ue(0)             # delta_poc_s0_minus1 (prev picture)
+    w.write_bit(1)            # used_by_curr_pic_s0_flag
+    w.write_bit(0)            # num_ref_idx_active_override_flag (PPS: 1)
+    w.write_ue(4)             # five_minus_max_num_merge_cand -> 1
+    w.write_se(slice_qp - 26)  # slice_qp_delta
+    w.write_bit(1)            # alignment_bit_equal_to_one
+    w.byte_align(0)
+    return w
+
+
+class MvpGrid:
+    """AMVP over a grid of CTB-sized PUs (encoder-side mirror of
+    8.5.3.2.6 for our shape). Tracks (is_inter, mv) per coded CTB."""
+
+    def __init__(self, rows: int, cols: int) -> None:
+        self.rows, self.cols = rows, cols
+        self.inter = np.zeros((rows, cols), bool)
+        self._coded = np.zeros((rows, cols), bool)
+        self.mv = np.zeros((rows, cols, 2), np.int32)   # (x, y) qpel
+
+    def _cand(self, r: int, c: int):
+        if 0 <= r < self.rows and 0 <= c < self.cols and self.inter[r, c]:
+            return tuple(int(v) for v in self.mv[r, c])
+        return None
+
+    def predictor(self, r: int, c: int) -> tuple[int, int]:
+        """mvp candidate 0 for the CU at CTB (r, c).
+
+        write_ctu_inter always signals mvp_l0_flag=0, so only the first
+        list entry matters: A if available, else B, else zero (the
+        spec's A==B pruning and zero-fill only reorder entry 1)."""
+        a = self._cand(r, c - 1)                 # A1 (A0 is undecoded)
+        if a is not None:
+            return a
+        for rc in ((r - 1, c + 1), (r - 1, c), (r - 1, c - 1)):  # B0 B1 B2
+            b = self._cand(*rc)
+            if b is not None:
+                return b
+        return (0, 0)
+
+    def record(self, r: int, c: int, *, inter: bool,
+               mv: tuple[int, int] = (0, 0)) -> None:
+        self.inter[r, c] = inter
+        self._coded[r, c] = True
+        self.mv[r, c] = mv
+
+
+def _write_mvd(c: CabacEncoder, dx: int, dy: int) -> None:
+    """mvd_coding (7.3.8.9): greater0/1 context bins, EG1 remainder and
+    sign in bypass. (dx, dy) in quarter-pel, bitstream order x then y."""
+    comps = (dx, dy)
+    g0 = [int(v != 0) for v in comps]
+    g1 = [int(abs(v) > 1) for v in comps]
+    c.encode_bin(_MVD_G0, g0[0])
+    c.encode_bin(_MVD_G0, g0[1])
+    if g0[0]:
+        c.encode_bin(_MVD_G1, g1[0])
+    if g0[1]:
+        c.encode_bin(_MVD_G1, g1[1])
+    for i, v in enumerate(comps):
+        if not g0[i]:
+            continue
+        if g1[i]:
+            rem = abs(v) - 2
+            k = 1                               # EG1 bypass
+            while rem >= (1 << k):
+                c.encode_bypass(1)
+                rem -= 1 << k
+                k += 1
+            c.encode_bypass(0)
+            c.encode_bypass_bits(rem, k)
+        c.encode_bypass(1 if v < 0 else 0)
+
+
+class PSliceWriter:
+    """Accumulates one P-slice's CABAC payload CTU by CTU.
+
+    ``write_ctu_inter``: 2Nx2N inter CU, integer MV (given in luma
+    integer pels, converted to quarter-pel for the bitstream), optional
+    residual levels. ``write_ctu_intra``: the mode-26 intra CU, usable
+    as fallback inside P slices.
+    """
+
+    def __init__(self, slice_qp: int, rows: int, cols: int) -> None:
+        self.c = CabacEncoder(slice_qp, init_type=1)    # P initType
+        self.grid = MvpGrid(rows, cols)
+
+    def _common_p_prefix(self) -> None:
+        # cu_skip_flag: never skipped; both neighbours are non-skip so
+        # ctxInc is always 0
+        self.c.encode_bin(_SKIP, 0)
+
+    def write_ctu_inter(self, r: int, col: int, mv_int: tuple[int, int],
+                        luma, cb, cr, *, last_in_slice: bool) -> None:
+        """mv_int = (y, x) integer luma pels (DSP order)."""
+        c = self.c
+        self._common_p_prefix()
+        c.encode_bin(_PRED_MODE, 0)              # MODE_INTER
+        c.encode_bin(_PART, 1)                   # PART_2Nx2N
+        c.encode_bin(_MERGE, 0)                  # explicit AMVP
+        mvq = (int(mv_int[1]) * 4, int(mv_int[0]) * 4)   # (x, y) qpel
+        pmx, pmy = self.grid.predictor(r, col)
+        _write_mvd(c, mvq[0] - pmx, mvq[1] - pmy)
+        c.encode_bin(_MVP, 0)                    # mvp_l0_flag = cand 0
+        self.grid.record(r, col, inter=True, mv=mvq)
+
+        def has(lv):
+            return lv is not None and np.any(lv)
+
+        cbf_l, cbf_cb, cbf_cr = has(luma), has(cb), has(cr)
+        root = cbf_l or cbf_cb or cbf_cr
+        c.encode_bin(_ROOT_CBF, int(root))       # rqt_root_cbf
+        if not root:
+            c.encode_terminate(1 if last_in_slice else 0)
+            return
+        # transform_tree depth 0 (no split): chroma cbfs, then luma cbf
+        # — which is INFERRED 1 when both chroma are 0 (7.3.8.8)
+        c.encode_bin(_CBF_CHROMA, int(cbf_cb))
+        c.encode_bin(_CBF_CHROMA, int(cbf_cr))
+        if cbf_cb or cbf_cr:
+            c.encode_bin(_CBF_LUMA + 1, int(cbf_l))
+        else:
+            assert cbf_l, "rqt_root_cbf=1 with all-zero TBs"
+        if cbf_l:
+            write_residual(c, luma, log2_size=5, c_idx=0)
+        if cbf_cb:
+            write_residual(c, cb, log2_size=4, c_idx=1)
+        if cbf_cr:
+            write_residual(c, cr, log2_size=4, c_idx=2)
+        c.encode_terminate(1 if last_in_slice else 0)
+
+    def write_ctu_intra(self, r: int, col: int, luma, cb, cr, *,
+                        last_in_slice: bool) -> None:
+        """Intra fallback CU inside the P slice (mode 26, as slice.py)."""
+        c = self.c
+        self._common_p_prefix()
+        c.encode_bin(_PRED_MODE, 1)              # MODE_INTRA
+        c.encode_bin(_PART, 1)                   # 2Nx2N
+        # MPM (8.4.2): candB is always DC (above PU leaves the CTB);
+        # candA is 26 only when the LEFT CU exists and is itself intra
+        # (inter neighbours contribute DC) — in P slices that depends on
+        # per-CTB decisions, unlike the all-intra slice's static pattern:
+        #   A=26, B=DC -> list {26, DC, planar} -> mpm_idx 0
+        #   A=B=DC     -> list {planar, DC, 26} -> mpm_idx 2
+        left_is_intra = (col > 0 and self.grid._coded[r, col - 1]
+                         and not self.grid.inter[r, col - 1])
+        prev_flag, mpm_idx = (1, 0) if left_is_intra else (1, 2)
+        c.encode_bin(_PREV, prev_flag)
+        if mpm_idx == 0:
+            c.encode_bypass(0)
+        else:
+            c.encode_bypass(1)
+            c.encode_bypass(mpm_idx - 1)
+        c.encode_bin(_CHROMA, 0)                 # DM
+
+        def has(lv):
+            return lv is not None and np.any(lv)
+
+        cbf_cb, cbf_cr, cbf_l = has(cb), has(cr), has(luma)
+        c.encode_bin(_CBF_CHROMA, int(cbf_cb))
+        c.encode_bin(_CBF_CHROMA, int(cbf_cr))
+        c.encode_bin(_CBF_LUMA + 1, int(cbf_l))
+        if cbf_l:
+            write_residual(c, luma, log2_size=5, c_idx=0)
+        if cbf_cb:
+            write_residual(c, cb, log2_size=4, c_idx=1)
+        if cbf_cr:
+            write_residual(c, cr, log2_size=4, c_idx=2)
+        self.grid.record(r, col, inter=False)
+        c.encode_terminate(1 if last_in_slice else 0)
+
+    def payload(self) -> bytes:
+        return self.c.getvalue()
+
+
+def p_nal(slice_qp: int, poc_lsb: int, payload: bytes) -> NalUnit:
+    hdr = p_slice_header_bits(slice_qp, poc_lsb)
+    return NalUnit(NAL_TRAIL_R, hdr.getvalue() + payload)
