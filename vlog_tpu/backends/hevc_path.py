@@ -26,6 +26,7 @@ import numpy as np
 
 from vlog_tpu import config
 from vlog_tpu.backends.base import RungResult, RunResult
+from vlog_tpu.backends.rate_control import RateController
 from vlog_tpu.backends.source import open_source
 from vlog_tpu.codecs.hevc.api import HevcEncoder
 from vlog_tpu.media import hls
@@ -91,6 +92,14 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         # — per-call pools would churn threads (same reason as the H.264
         # loop's pool)
         entropy_pool = ThreadPoolExecutor(max_workers=8)
+        # closed-loop VBR toward each rung's ladder bitrate, same
+        # controller the H.264 path uses (per-frame QP is traced, so
+        # stepping never recompiles)
+        controllers = {
+            r.name: RateController(target_bps=r.video_bitrate, fps=fps,
+                                   init_qp=r.qp)
+            for r in plan.rungs
+        }
         pending: dict[str, list[Sample]] = {r.name: [] for r in plan.rungs}
         frames_done = start_frame
         thumb_path = None
@@ -144,6 +153,7 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                         ry, ru, rv = (np.asarray(ry), np.asarray(ru),
                                       np.asarray(rv))
                     enc = encoders[rung.name]
+                    enc.qp = controllers[rung.name].qp
                     if clen > 1:
                         frames = []
                         for c0 in range(0, ry.shape[0], clen):
@@ -155,6 +165,8 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                     else:
                         frames = enc.encode_batch(ry, ru, rv,
                                                   pool=entropy_pool)
+                    controllers[rung.name].observe(
+                        sum(len(f.sample) for f in frames), len(frames))
                     for f in frames:
                         psnr_acc[rung.name].append(f.psnr_y)
                         pending[rung.name].append(
