@@ -212,13 +212,18 @@ def test_api_c_entropy_matches_python(hevcdec, tmp_path, monkeypatch):
 
     if nb.get_lib() is None:
         pytest.skip("native library unavailable")
-    out_c = HevcEncoder(width=96, height=64, qp=27).encode_batch(y, u, v)
+    enc_c = HevcEncoder(width=96, height=64, qp=27)
+    out_c = enc_c.encode_batch(y, u, v)
+    chain_c = enc_c.encode_chain(y, u, v, search=4)
 
     monkeypatch.setenv("VLOG_NATIVE", "0")
     monkeypatch.setattr(nb, "_TRIED", False)
     monkeypatch.setattr(nb, "_LIB", None)
-    out_py = HevcEncoder(width=96, height=64, qp=27).encode_batch(y, u, v)
+    enc_py = HevcEncoder(width=96, height=64, qp=27)
+    out_py = enc_py.encode_batch(y, u, v)
+    chain_py = enc_py.encode_chain(y, u, v, search=4)
     assert [f.sample for f in out_c] == [f.sample for f in out_py]
+    assert [f.sample for f in chain_c] == [f.sample for f in chain_py]
 
     decoded = oracle_decode(hevcdec, b"".join(f.annexb for f in out_c),
                             64, 96, tmp_path)

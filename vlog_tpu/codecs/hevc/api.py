@@ -184,21 +184,49 @@ class HevcEncoder:
                            .astype(np.float64)) ** 2)
             return float(10 * np.log10(255.0 ** 2 / max(mse, 1e-12)))
 
+        def p_entropy(ly, lu, lvv, mvg) -> bytes:
+            """C P-slice coder when available (the DSP emits all-inter
+            slices, which is the C coder's contract); Python fallback."""
+            from vlog_tpu.native.build import get_lib
+
+            lib = get_lib()
+            if lib is not None:
+                import ctypes
+
+                la = np.ascontiguousarray(ly.reshape(-1), np.int16)
+                ua = np.ascontiguousarray(lu.reshape(-1), np.int16)
+                va = np.ascontiguousarray(lvv.reshape(-1), np.int16)
+                mva = np.ascontiguousarray(mvg.reshape(-1), np.int32)
+                scratch = np.empty(rows * cols * 2, np.int32)
+                cap = max(1 << 16, la.size * 4)
+                out = np.empty(cap, np.uint8)
+                i16p = ctypes.POINTER(ctypes.c_int16)
+                i32p = ctypes.POINTER(ctypes.c_int32)
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                n = lib.vt_hevc_encode_p_slice(
+                    la.ctypes.data_as(i16p), ua.ctypes.data_as(i16p),
+                    va.ctypes.data_as(i16p), mva.ctypes.data_as(i32p),
+                    rows, cols, self.qp, scratch.ctypes.data_as(i32p),
+                    out.ctypes.data_as(u8p), cap)
+                if n >= 0:
+                    return out[:n].tobytes()
+            sw = PSliceWriter(self.qp, rows, cols)
+            for r in range(rows):
+                for c in range(cols):
+                    sw.write_ctu_inter(
+                        r, c, tuple(int(x) for x in mvg[r, c]),
+                        ly[r, c], lu[r, c], lvv[r, c],
+                        last_in_slice=(r == rows - 1 and c == cols - 1))
+            return sw.payload()
+
         def pack(i: int) -> EncodedFrame:
             if i == 0:
                 payload = self._entropy(*intra_np, rows, cols, qp_i)
                 nal = syntax.idr_nal(qp_i, payload)
             else:
-                sw = PSliceWriter(self.qp, rows, cols)
-                ly, lu, lvv = (p_np[0][i - 1], p_np[1][i - 1],
-                               p_np[2][i - 1])
-                for r in range(rows):
-                    for c in range(cols):
-                        sw.write_ctu_inter(
-                            r, c, tuple(int(x) for x in mv_np[i - 1, r, c]),
-                            ly[r, c], lu[r, c], lvv[r, c],
-                            last_in_slice=(r == rows - 1 and c == cols - 1))
-                nal = p_nal(self.qp, i, sw.payload())
+                payload = p_entropy(p_np[0][i - 1], p_np[1][i - 1],
+                                    p_np[2][i - 1], mv_np[i - 1])
+                nal = p_nal(self.qp, i, payload)
             raw = nal.to_bytes()
             return EncodedFrame(
                 sample=len(raw).to_bytes(4, "big") + raw,

@@ -117,6 +117,15 @@ def get_lib() -> ctypes.CDLL | None:
             ctypes.c_int32,                          # slice qp
             i8, ctypes.c_int64,                      # out buffer
         ]
+        lib.vt_hevc_encode_p_slice.restype = ctypes.c_int64
+        lib.vt_hevc_encode_p_slice.argtypes = [
+            i16, i16, i16,                           # luma, cb, cr levels
+            i32,                                     # mv (y, x) int pels
+            ctypes.c_int32, ctypes.c_int32,          # rows, cols
+            ctypes.c_int32,                          # slice qp
+            i32,                                     # mv scratch
+            i8, ctypes.c_int64,                      # out buffer
+        ]
         u16 = ctypes.POINTER(ctypes.c_uint16)
         lib.vt_jpeg_pack_scan.restype = ctypes.c_int64
         lib.vt_jpeg_pack_scan.argtypes = [

@@ -228,6 +228,7 @@ def generate_c_header() -> str:
         arr("HEVC_LPS", lps), arr("HEVC_MPS_NEXT", t.TRANS_IDX_MPS),
         arr("HEVC_LPS_NEXT", t.TRANS_IDX_LPS),
         arr("HEVC_INIT_I", t.INIT_VALUES[0]),
+        arr("HEVC_INIT_P", t.INIT_VALUES[1]),
         arr("HEVC_DIAG4", scan4), arr("HEVC_DIAG8", scan8),
         arr("HEVC_SCAN32", tb_scan(32), "int16_t"),
         arr("HEVC_SCAN16", tb_scan(16), "int16_t"),

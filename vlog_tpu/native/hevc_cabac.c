@@ -55,12 +55,13 @@ static void renorm(Cabac *c) {
     }
 }
 
-static void cabac_init(Cabac *c, int qp, uint8_t *out, int64_t cap) {
+static void cabac_init(Cabac *c, int qp, int init_type, uint8_t *out,
+                       int64_t cap) {
     memset(c, 0, sizeof(*c));
     c->range = 510; c->first_bit = 1; c->out = out; c->cap = cap;
     if (qp < 0) qp = 0; if (qp > 51) qp = 51;
     for (int i = 0; i < 199; i++) {
-        int init_value = HEVC_INIT_I[i];
+        int init_value = init_type ? HEVC_INIT_P[i] : HEVC_INIT_I[i];
         int slope = (init_value >> 4) * 5 - 45;
         int offset = ((init_value & 15) << 3) - 16;
         int pre = ((slope * qp) >> 4) + offset;
@@ -318,13 +319,98 @@ extern "C" int64_t vt_hevc_encode_slice(
         int32_t rows, int32_t cols, int32_t slice_qp,
         uint8_t *out, int64_t out_cap) {
     Cabac c;
-    cabac_init(&c, slice_qp, out, out_cap);
+    cabac_init(&c, slice_qp, 0, out, out_cap);
     for (int r = 0; r < rows; r++)
         for (int col = 0; col < cols; col++) {
             int i = r * cols + col;
             write_ctu(&c, col, luma + (int64_t)i * 1024,
                       cb + (int64_t)i * 256, cr + (int64_t)i * 256,
                       r == rows - 1 && col == cols - 1);
+        }
+    return cabac_finish(&c);
+}
+
+/* --------------------------------------------------------- P slices
+ * Mirror of codecs/hevc/pslice.py: every CTB an inter 2Nx2N CU with an
+ * explicitly coded integer MV (AMVP candidate 0, no merge/skip).
+ * mv: (rows*cols, 2) int32 as (y, x) integer luma pels (DSP order).
+ */
+
+static void write_mvd(Cabac *c, int dx, int dy) {
+    int comps[2] = {dx, dy};
+    int g0[2] = {dx != 0, dy != 0};
+    int g1[2] = {dx > 1 || dx < -1, dy > 1 || dy < -1};
+    enc_bin(c, HEVC_CTX_MVD_GREATER, g0[0]);
+    enc_bin(c, HEVC_CTX_MVD_GREATER, g0[1]);
+    if (g0[0]) enc_bin(c, HEVC_CTX_MVD_GREATER + 3, g1[0]);
+    if (g0[1]) enc_bin(c, HEVC_CTX_MVD_GREATER + 3, g1[1]);
+    for (int i = 0; i < 2; i++) {
+        int v = comps[i];
+        if (!g0[i]) continue;
+        if (g1[i]) {
+            int rem = (v < 0 ? -v : v) - 2;
+            int k = 1;                       /* EG1 bypass */
+            while (rem >= (1 << k)) { enc_bypass(c, 1); rem -= 1 << k; k++; }
+            enc_bypass(c, 0);
+            enc_bypass_bits(c, (uint32_t)rem, k);
+        }
+        enc_bypass(c, v < 0);
+    }
+}
+
+extern "C" int64_t vt_hevc_encode_p_slice(
+        const int16_t *luma, const int16_t *cb, const int16_t *cr,
+        const int32_t *mv,
+        int32_t rows, int32_t cols, int32_t slice_qp,
+        int32_t *mv_scratch,      /* rows*cols*2, holds (x, y) qpel */
+        uint8_t *out, int64_t out_cap) {
+    Cabac c;
+    cabac_init(&c, slice_qp, 1, out, out_cap);
+    for (int r = 0; r < rows; r++)
+        for (int col = 0; col < cols; col++) {
+            int i = r * cols + col;
+            enc_bin(&c, HEVC_CTX_SKIP, 0);          /* cu_skip_flag */
+            enc_bin(&c, HEVC_CTX_PRED_MODE, 0);     /* MODE_INTER */
+            enc_bin(&c, HEVC_CTX_PART_MODE, 1);     /* 2Nx2N */
+            enc_bin(&c, HEVC_CTX_MERGE_FLAG, 0);
+            int mvx = mv[i * 2 + 1] * 4, mvy = mv[i * 2] * 4;
+            /* AMVP candidate 0: left CU, else first of B0/B1/B2
+             * (every CTB here is inter, so availability is purely
+             * positional — matches MvpGrid in an all-inter slice) */
+            int px = 0, py = 0;
+            if (col > 0) {
+                px = mv_scratch[(i - 1) * 2];
+                py = mv_scratch[(i - 1) * 2 + 1];
+            } else if (r > 0) {
+                int j = (r - 1) * cols + col + 1;   /* B0 */
+                if (col + 1 >= cols) j = (r - 1) * cols + col;  /* B1 */
+                px = mv_scratch[j * 2];
+                py = mv_scratch[j * 2 + 1];
+            }
+            write_mvd(&c, mvx - px, mvy - py);
+            enc_bin(&c, HEVC_CTX_MVP_LX, 0);        /* mvp_l0_flag */
+            mv_scratch[i * 2] = mvx;
+            mv_scratch[i * 2 + 1] = mvy;
+
+            const int16_t *lu = luma + (int64_t)i * 1024;
+            const int16_t *ub = cb + (int64_t)i * 256;
+            const int16_t *vb = cr + (int64_t)i * 256;
+            int cbf_l = any_nonzero(lu, 1024);
+            int cbf_cb = any_nonzero(ub, 256);
+            int cbf_cr = any_nonzero(vb, 256);
+            int root = cbf_l || cbf_cb || cbf_cr;
+            enc_bin(&c, HEVC_CTX_NO_RESIDUAL, root); /* rqt_root_cbf */
+            if (root) {
+                enc_bin(&c, HEVC_CTX_CBF_CB_CR, cbf_cb);
+                enc_bin(&c, HEVC_CTX_CBF_CB_CR, cbf_cr);
+                if (cbf_cb || cbf_cr)
+                    enc_bin(&c, HEVC_CTX_CBF_LUMA + 1, cbf_l);
+                /* else: cbf_luma inferred 1 */
+                if (cbf_l) write_residual(&c, lu, 5, 0);
+                if (cbf_cb) write_residual(&c, ub, 4, 1);
+                if (cbf_cr) write_residual(&c, vb, 4, 2);
+            }
+            enc_terminate(&c, r == rows - 1 && col == cols - 1);
         }
     return cabac_finish(&c);
 }
