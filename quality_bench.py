@@ -99,9 +99,9 @@ def psnr_y(ref: np.ndarray, dec: np.ndarray, h: int, w: int) -> float:
 
 
 def decode_annexb(avdec: Path, annexb: Path, h: int, w: int,
-                  tmp: Path) -> np.ndarray:
+                  tmp: Path, codec: str = "h264") -> np.ndarray:
     out = tmp / "dec.yuv"
-    subprocess.run([str(avdec), str(annexb), str(out)], check=True,
+    subprocess.run([str(avdec), str(annexb), str(out), codec], check=True,
                    capture_output=True)
     data = np.fromfile(out, np.uint8)
     fs = h * w * 3 // 2
@@ -179,6 +179,58 @@ def run_ours(frames: np.ndarray, h: int, w: int, fps: int, rung,
     }
 
 
+def run_ours_h265(frames: np.ndarray, h: int, w: int, fps: int, rung,
+                  tmp: Path, avdec: Path) -> dict:
+    """codec=h265 through the production backend (I + integer-MV P
+    chains); decode the hvc1 CMAF tree with the oracle."""
+    from vlog_tpu.media.y4m import write_y4m
+    from vlog_tpu.worker.pipeline import process_video
+
+    fs = h * w
+    y4m = tmp / "src265.y4m"
+    write_y4m(y4m, [
+        (f[:fs].reshape(h, w),
+         f[fs:fs + fs // 4].reshape(h // 2, w // 2),
+         f[fs + fs // 4:].reshape(h // 2, w // 2))
+        for f in frames
+    ], fps_num=fps, fps_den=1)
+    out = tmp / "ours265"
+    t0 = time.perf_counter()
+    result = process_video(y4m, out, audio=False, thumbnail=False,
+                           rungs=(rung,), codec="h265")
+    wall = time.perf_counter() - t0
+    rr = result.run.rungs[0]
+    rdir = out / rung.name
+    init = (rdir / "init.mp4").read_bytes()
+    i = init.index(b"hvcC")
+    hvcc = init[i + 4:i - 4 + int.from_bytes(init[i - 4:i], "big")]
+    pos, annexb = 22, bytearray()
+    n_arrays = hvcc[pos]; pos += 1
+    for _ in range(n_arrays):
+        pos += 1
+        cnt = int.from_bytes(hvcc[pos:pos + 2], "big"); pos += 2
+        for _ in range(cnt):
+            ln = int.from_bytes(hvcc[pos:pos + 2], "big"); pos += 2
+            annexb += b"\x00\x00\x00\x01" + hvcc[pos:pos + ln]; pos += ln
+    for seg in sorted(rdir.glob("segment_*.m4s")):
+        data = seg.read_bytes()
+        m = data.index(b"mdat")
+        mdat = data[m + 4:m - 4 + int.from_bytes(data[m - 4:m], "big")]
+        p = 0
+        while p < len(mdat):
+            ln = int.from_bytes(mdat[p:p + 4], "big"); p += 4
+            annexb += b"\x00\x00\x00\x01" + mdat[p:p + ln]; p += ln
+    bpath = tmp / "ours.hevc"
+    bpath.write_bytes(bytes(annexb))
+    dec = decode_annexb(avdec, bpath, h, w, tmp, codec="hevc")
+    return {
+        "encoder": "vlog-tpu h265 (I + integer-MV P chains)",
+        "bitrate_kbps": rr.achieved_bitrate // 1000,
+        "psnr_y": round(psnr_y(frames, dec, h, w), 2),
+        "wall_s": round(wall, 1),
+    }
+
+
 def run_x264(frames: np.ndarray, h: int, w: int, fps: int, bps: int,
              tmp: Path, x264: Path, avdec: Path, preset: str = "medium"
              ) -> dict:
@@ -205,6 +257,8 @@ def main() -> None:
     ap.add_argument("--frames", type=int, default=96)
     ap.add_argument("--fps", type=int, default=24)
     ap.add_argument("--rungs", default="360p,480p,720p")
+    ap.add_argument("--h265", action="store_true",
+                    help="add a codec=h265 row for the first rung")
     args = ap.parse_args()
 
     from vlog_tpu import config
@@ -214,6 +268,7 @@ def main() -> None:
     x264 = build_tool("x264enc", tmp)
 
     rows = []
+    h265_row = None
     for name in args.rungs.split(","):
         rung = config.LADDER_BY_NAME[name.strip()]
         geom = {"360p": (360, 640), "480p": (480, 854), "720p": (720, 1280),
@@ -234,6 +289,13 @@ def main() -> None:
         print(f"{rung.name}: ours {ours['psnr_y']} dB @ "
               f"{ours['bitrate_kbps']} kbps | x264 {anchor['psnr_y']} dB @ "
               f"{anchor['bitrate_kbps']} kbps", file=sys.stderr)
+        if args.h265 and h265_row is None:
+            h265_row = {"rung": rung.name,
+                        "target_kbps": rung.video_bitrate // 1000,
+                        **run_ours_h265(frames, h, w, args.fps, rung,
+                                        rtmp, avdec)}
+            print(f"{rung.name} h265: {h265_row['psnr_y']} dB @ "
+                  f"{h265_row['bitrate_kbps']} kbps", file=sys.stderr)
 
     lines = [
         "# Quality parity: PSNR at the ladder bitrate vs libx264",
@@ -252,13 +314,23 @@ def main() -> None:
             f"| {r['ours']['bitrate_kbps']} | {r['ours']['psnr_y']} "
             f"| {r['x264']['bitrate_kbps']} | {r['x264']['psnr_y']} "
             f"| {r['psnr_gap_db']} |")
+    if h265_row is not None:
+        lines += [
+            "",
+            "## First-party HEVC (codec=h265 re-encode path)",
+            "",
+            f"| {h265_row['rung']} | {h265_row['target_kbps']}k | "
+            f"{h265_row['bitrate_kbps']} kbps | {h265_row['psnr_y']} dB | "
+            f"{h265_row['encoder']} |",
+        ]
     lines += ["", f"Generated by quality_bench.py "
               f"(frames={args.frames}, fps={args.fps})."]
     (REPO / "QUALITY.md").write_text("\n".join(lines) + "\n")
     print(json.dumps({"metric": "psnr_gap_vs_x264_db",
                       "value": max(r["psnr_gap_db"] for r in rows),
                       "unit": "dB_worst_rung",
-                      "rows": rows}))
+                      "rows": rows,
+                      **({"h265": h265_row} if h265_row else {})}))
     shutil.rmtree(tmp, ignore_errors=True)
 
 
