@@ -179,21 +179,14 @@ def run_ours(frames: np.ndarray, h: int, w: int, fps: int, rung,
     }
 
 
-def run_ours_h265(frames: np.ndarray, h: int, w: int, fps: int, rung,
+def run_ours_h265(frames: np.ndarray, h: int, w: int, y4m: Path, rung,
                   tmp: Path, avdec: Path) -> dict:
     """codec=h265 through the production backend (I + integer-MV P
-    chains); decode the hvc1 CMAF tree with the oracle."""
-    from vlog_tpu.media.y4m import write_y4m
+    chains); decode the hvc1 CMAF tree with the oracle. ``y4m`` is the
+    source run_ours already serialized for the same rung."""
+    from vlog_tpu.media.boxes import parse_box_tree
     from vlog_tpu.worker.pipeline import process_video
 
-    fs = h * w
-    y4m = tmp / "src265.y4m"
-    write_y4m(y4m, [
-        (f[:fs].reshape(h, w),
-         f[fs:fs + fs // 4].reshape(h // 2, w // 2),
-         f[fs + fs // 4:].reshape(h // 2, w // 2))
-        for f in frames
-    ], fps_num=fps, fps_den=1)
     out = tmp / "ours265"
     t0 = time.perf_counter()
     result = process_video(y4m, out, audio=False, thumbnail=False,
@@ -214,12 +207,14 @@ def run_ours_h265(frames: np.ndarray, h: int, w: int, fps: int, rung,
             annexb += b"\x00\x00\x00\x01" + hvcc[pos:pos + ln]; pos += ln
     for seg in sorted(rdir.glob("segment_*.m4s")):
         data = seg.read_bytes()
-        m = data.index(b"mdat")
-        mdat = data[m + 4:m - 4 + int.from_bytes(data[m - 4:m], "big")]
+        with open(seg, "rb") as fp:
+            tree = parse_box_tree(fp)
+        mdat = next(b for b in tree if b.type == "mdat")
+        payload = data[mdat.offset + 8: mdat.offset + mdat.size]
         p = 0
-        while p < len(mdat):
-            ln = int.from_bytes(mdat[p:p + 4], "big"); p += 4
-            annexb += b"\x00\x00\x00\x01" + mdat[p:p + ln]; p += ln
+        while p < len(payload):
+            ln = int.from_bytes(payload[p:p + 4], "big"); p += 4
+            annexb += b"\x00\x00\x00\x01" + payload[p:p + ln]; p += ln
     bpath = tmp / "ours.hevc"
     bpath.write_bytes(bytes(annexb))
     dec = decode_annexb(avdec, bpath, h, w, tmp, codec="hevc")
@@ -292,8 +287,8 @@ def main() -> None:
         if args.h265 and h265_row is None:
             h265_row = {"rung": rung.name,
                         "target_kbps": rung.video_bitrate // 1000,
-                        **run_ours_h265(frames, h, w, args.fps, rung,
-                                        rtmp, avdec)}
+                        **run_ours_h265(frames, h, w, rtmp / "src.y4m",
+                                        rung, rtmp, avdec)}
             print(f"{rung.name} h265: {h265_row['psnr_y']} dB @ "
                   f"{h265_row['bitrate_kbps']} kbps", file=sys.stderr)
 
@@ -319,8 +314,10 @@ def main() -> None:
             "",
             "## First-party HEVC (codec=h265 re-encode path)",
             "",
+            "| rung | target | kbps | PSNR-Y | encoder |",
+            "|---|---|---|---|---|",
             f"| {h265_row['rung']} | {h265_row['target_kbps']}k | "
-            f"{h265_row['bitrate_kbps']} kbps | {h265_row['psnr_y']} dB | "
+            f"{h265_row['bitrate_kbps']} | {h265_row['psnr_y']} | "
             f"{h265_row['encoder']} |",
         ]
     lines += ["", f"Generated by quality_bench.py "

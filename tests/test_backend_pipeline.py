@@ -208,3 +208,38 @@ def test_mp4_source_transcode(tmp_path):
     res = hls.validate_media_playlist(out / "360p" / "playlist.m3u8",
                                       expect_cmaf=True)
     assert res["segments"] == 1
+
+
+def test_verify_output_semantic_gates(tmp_path, y4m_source):
+    """verify_output (VERDICT round-2 weak #8): structural playlist
+    checks plus bitrate-band and PSNR-floor gates on the run results."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    from vlog_tpu.backends.base import RunResult
+    from vlog_tpu.worker.pipeline import (VerificationError, process_video,
+                                          verify_output)
+
+    res = process_video(y4m_source, tmp_path / "out", audio=False,
+                        thumbnail=False, resume=False)
+    master = tmp_path / "out" / "master.m3u8"
+    ok_run = res.run
+    verify_output(master, ok_run, expect_cmaf=True)   # passes
+
+    def with_rung(**overrides):
+        rung = dataclasses.replace(ok_run.rungs[0], **overrides)
+        return RunResult(rungs=[rung], frames_processed=1, duration_s=1.0)
+
+    with _pytest.raises(VerificationError, match="target"):
+        verify_output(master, with_rung(
+            achieved_bitrate=10_000_000, target_bitrate=600_000),
+            expect_cmaf=True)
+    with _pytest.raises(VerificationError, match="floor"):
+        verify_output(master, with_rung(mean_psnr_y=5.0), expect_cmaf=True)
+    with _pytest.raises(VerificationError, match="variant"):
+        verify_output(master, ok_run, expect_cmaf=False)
+    # resumed runs (no PSNR measured) and constant-QP runs (no target)
+    # must not trip the gates
+    verify_output(master, with_rung(mean_psnr_y=None, target_bitrate=0),
+                  expect_cmaf=True)

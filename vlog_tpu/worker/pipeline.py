@@ -34,6 +34,38 @@ class VerificationError(RuntimeError):
     verification passes in transcoder.py:2565-2717)."""
 
 
+def verify_output(master_path, run, *, expect_cmaf: bool) -> None:
+    """Post-transcode gates: structural (playlists parse, segments carry
+    the right atom types) plus the semantic checks the reference's
+    decode passes enforce — achieved bitrate within a sane band of the
+    target and a reconstruction-quality floor. Thresholds are
+    deliberately loose: VBR legitimately overshoots on short content;
+    these catch *broken* output (runaway bits, garbage recon), not
+    imperfect convergence."""
+    try:
+        variant_results = hls.validate_master_playlist(master_path)
+        for uri, res in variant_results.items():
+            if res["cmaf"] != expect_cmaf:
+                raise VerificationError(
+                    f"{uri}: expected "
+                    f"{'CMAF' if expect_cmaf else 'TS'} variant")
+    except (hls.PlaylistValidationError, OSError) as exc:
+        raise VerificationError(str(exc)) from exc
+    for r in run.rungs:
+        if r.target_bitrate and r.achieved_bitrate:
+            # undershoot is fine (easy content hits the min-QP quality
+            # cap below target); runaway overshoot means control broke
+            ratio = r.achieved_bitrate / r.target_bitrate
+            if ratio > 4.0:
+                raise VerificationError(
+                    f"{r.name}: achieved {r.achieved_bitrate} bps is "
+                    f"{ratio:.1f}x the {r.target_bitrate} bps target")
+        if r.mean_psnr_y is not None and r.mean_psnr_y < 18.0:
+            raise VerificationError(
+                f"{r.name}: mean PSNR-Y {r.mean_psnr_y:.1f} dB below the "
+                "18 dB floor — reconstruction is broken")
+
+
 @dataclass
 class ProcessResult:
     source: VideoInfo
@@ -151,16 +183,7 @@ def process_video(
 
     # Step 4: verification (validate_hls_playlist analog)
     master = out_dir / "master.m3u8"
-    expect_cmaf = plan.streaming_format == "cmaf"
-    try:
-        variant_results = hls.validate_master_playlist(master)
-        for uri, res in variant_results.items():
-            if res["cmaf"] != expect_cmaf:
-                raise VerificationError(
-                    f"{uri}: expected "
-                    f"{'CMAF' if expect_cmaf else 'TS'} variant")
-    except (hls.PlaylistValidationError, OSError) as exc:
-        raise VerificationError(str(exc)) from exc
+    verify_output(master, run, expect_cmaf=plan.streaming_format == "cmaf")
 
     result = ProcessResult(
         source=info,
