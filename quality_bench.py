@@ -181,7 +181,7 @@ def run_ours(frames: np.ndarray, h: int, w: int, fps: int, rung,
 
 def run_ours_h265(frames: np.ndarray, h: int, w: int, y4m: Path, rung,
                   tmp: Path, avdec: Path) -> dict:
-    """codec=h265 through the production backend (I + integer-MV P
+    """codec=h265 through the production backend (I + quarter-pel P
     chains); decode the hvc1 CMAF tree with the oracle. ``y4m`` is the
     source run_ours already serialized for the same rung."""
     from vlog_tpu.media.boxes import parse_box_tree
@@ -219,7 +219,7 @@ def run_ours_h265(frames: np.ndarray, h: int, w: int, y4m: Path, rung,
     bpath.write_bytes(bytes(annexb))
     dec = decode_annexb(avdec, bpath, h, w, tmp, codec="hevc")
     return {
-        "encoder": "vlog-tpu h265 (I + integer-MV P chains)",
+        "encoder": "vlog-tpu h265 (I + quarter-pel P chains)",
         "bitrate_kbps": rr.achieved_bitrate // 1000,
         "psnr_y": round(psnr_y(frames, dec, h, w), 2),
         "wall_s": round(wall, 1),

@@ -141,34 +141,99 @@ def _encode_plane(plane, qp, mat, n):
 
 
 # ---------------------------------------------------------------- inter
-# Integer-MV P frames (see pslice.py): luma MC is a shifted gather from
-# the previous reconstruction; chroma lands on {0, 1/2} positions, so
-# the HEVC 4-tap filter at fraction 4 yields three derived planes and MC
-# selects among them per MV parity. Motion search is the same
-# offset-scan SAD pattern as the H.264 core, at 32x32 CTB granularity.
+# Quarter-pel P frames (see pslice.py — the slice syntax carries MVs at
+# quarter-pel resolution regardless): luma MC is the HEVC two-stage
+# 8-tap interpolation (table 8-11), chroma the 4-tap eighth-pel filter
+# (table 8-32). Horizontal passes become per-fraction filtered planes
+# (un-normalized, gain 64 — the spec's 8-bit path has no intermediate
+# shift); the vertical pass is an 8-gather weighted sum with per-pixel
+# weight rows, uniformly >>6 then rounded >>6 at the end, which matches
+# the spec case-by-case because the shifts commute exactly with the
+# integer convolutions. Motion search is integer offset-scan SADs plus
+# half- then quarter-pel refinement, at 32x32 CTB granularity.
 
-_CTAP = (-4, 36, 36, -4)      # HEVC chroma filter, fraction 4 (table 8-32)
+# luma 8-tap rows (fraction 0 is the 64-delta so every case unifies)
+_LTAPS = np.array([
+    [0, 0, 0, 64, 0, 0, 0, 0],
+    [-1, 4, -10, 58, 17, -5, 1, 0],
+    [-1, 4, -11, 40, 40, -11, 4, -1],
+    [0, 1, -5, 17, 58, -10, 4, -1],
+], np.int32)
+# chroma 4-tap rows per eighth fraction
+_CTAPS = np.array([
+    [0, 64, 0, 0],
+    [-2, 58, 10, -2],
+    [-4, 54, 16, -2],
+    [-6, 46, 28, -4],
+    [-4, 36, 36, -4],
+    [-4, 28, 46, -6],
+    [-2, 16, 54, -4],
+    [-2, 10, 58, -2],
+], np.int32)
 
 
-def _chroma_frac_planes(refp):
-    """Edge-padded chroma plane -> (copy<<6, H, V, HV) at the uniform
-    'predSample' scale (gain 64); final pred = (sel + 32) >> 6."""
-    def tap(x, axis):
-        out = None
-        for k, t in enumerate(_CTAP):
-            term = t * jnp.roll(x, 1 - k, axis=axis)
-            out = term if out is None else out + term
-        return out
+def _hfiltered_planes(refp, taps):
+    """Horizontal pass: one un-normalized plane per fraction row
+    (fraction 0 = ref<<6, so the stack is at uniform gain 64)."""
+    planes = []
+    center = taps.shape[1] // 2 - 1     # tap k applies at offset k-center
+    for f in range(taps.shape[0]):
+        if f == 0:
+            planes.append(refp << 6)
+            continue
+        acc = None
+        for k in range(taps.shape[1]):
+            t = int(taps[f, k])
+            if t == 0:
+                continue
+            term = t * jnp.roll(refp, center - k, axis=1)
+            acc = term if acc is None else acc + term
+        planes.append(acc)
+    return jnp.stack(planes)            # (F, Hp, Wp)
 
-    h1 = tap(refp, 1)
-    v1 = tap(refp, 0)
-    hv = tap(h1, 0) >> 6
-    return refp << 6, h1, v1, hv
+
+def _mc_luma_qpel(hplanes, mv_q, *, pad, h, w, n=32):
+    """Luma MC at quarter-pel MVs: per-pixel plane select (by fx) then
+    the vertical 8-tap as eight gathers with per-pixel weight rows."""
+    dy = jnp.repeat(jnp.repeat(mv_q[..., 0], n, 0), n, 1)
+    dx = jnp.repeat(jnp.repeat(mv_q[..., 1], n, 0), n, 1)
+    iy, fy = dy >> 2, dy & 3
+    ix, fx = dx >> 2, dx & 3
+    rows = jnp.arange(h)[:, None] + iy + pad
+    cols = jnp.arange(w)[None, :] + ix + pad
+    wtab = jnp.asarray(_LTAPS)                      # (4, 8)
+    acc = jnp.zeros((h, w), jnp.int32)
+    for j in range(8):
+        gj = jnp.take_along_axis(
+            hplanes[:, rows + (j - 3), cols], fx[None], axis=0)[0]
+        acc = acc + wtab[fy, j] * gj
+    pred = acc >> 6
+    return jnp.clip((pred + 32) >> 6, 0, 255)
 
 
-def _p_ctb_search(cur, refp, *, search, pad, lam=2):
-    """Full-search integer ME per 32x32 CTB: (H, W) -> (R, C, 2) MVs
-    ((y, x), integer luma pels)."""
+def _mc_chroma_qpel(cplanes, mv_q, *, pad, hc, wc):
+    """Chroma MC: the luma quarter-pel value lands on the eighth-chroma
+    grid; 4-tap vertical over the fx-selected horizontal plane."""
+    dy = jnp.repeat(jnp.repeat(mv_q[..., 0], 16, 0), 16, 1)
+    dx = jnp.repeat(jnp.repeat(mv_q[..., 1], 16, 0), 16, 1)
+    iy, fy = dy >> 3, dy & 7
+    ix, fx = dx >> 3, dx & 7
+    rows = jnp.arange(hc)[:, None] + iy + pad
+    cols = jnp.arange(wc)[None, :] + ix + pad
+    wtab = jnp.asarray(_CTAPS)                      # (8, 4)
+    acc = jnp.zeros((hc, wc), jnp.int32)
+    for j in range(4):
+        gj = jnp.take_along_axis(
+            cplanes[:, rows + (j - 1), cols], fx[None], axis=0)[0]
+        acc = acc + wtab[fy, j] * gj
+    pred = acc >> 6
+    return jnp.clip((pred + 32) >> 6, 0, 255)
+
+
+def _p_ctb_search(cur, refp, hplanes, *, search, pad, lam=2):
+    """Integer offset-scan ME per 32x32 CTB, then half- and quarter-pel
+    refinement through the real interpolation: (H, W) -> (R, C, 2) MVs
+    ((y, x), QUARTER pels)."""
     h, w = cur.shape
     rr, cc = h // 32, w // 32
     offsets = [(0, 0)] + [
@@ -190,58 +255,62 @@ def _p_ctb_search(cur, refp, *, search, pad, lam=2):
 
     init = (jnp.full((rr, cc), jnp.iinfo(jnp.int32).max, jnp.int32),
             jnp.zeros((rr, cc, 2), jnp.int32))
-    (_, mv), _ = jax.lax.scan(step, init, offs)
-    return mv
+    (int_sad, mv_int), _ = jax.lax.scan(step, init, offs)
 
+    neigh = jnp.asarray(
+        [(dy, dx) for dy in (-1, 0, 1) for dx in (-1, 0, 1)
+         if (dy, dx) != (0, 0)], jnp.int32)
 
-def _mc_luma_int(refp, mv, *, pad, n=32):
-    h = refp.shape[0] - 2 * pad
-    w = refp.shape[1] - 2 * pad
-    dy = jnp.repeat(jnp.repeat(mv[..., 0], n, 0), n, 1)
-    dx = jnp.repeat(jnp.repeat(mv[..., 1], n, 0), n, 1)
-    rows = jnp.arange(h)[:, None] + dy + pad
-    cols = jnp.arange(w)[None, :] + dx + pad
-    return refp[rows, cols]
+    def refine(base_q, base_sad, step_q):
+        def rstep(carry, off):
+            best_sad, best_mv = carry
+            cand = base_q + step_q * off[None, None, :]
+            pred = _mc_luma_qpel(hplanes, cand, pad=pad, h=h, w=w)
+            sad = jnp.abs(cur - pred.astype(jnp.int32)).reshape(
+                rr, 32, cc, 32).sum(axis=(1, 3))
+            sad = sad + lam * (jnp.abs(cand[..., 0])
+                               + jnp.abs(cand[..., 1]))
+            better = sad < best_sad
+            return (jnp.where(better, sad, best_sad),
+                    jnp.where(better[..., None], cand, best_mv)), None
 
+        (sad, mv), _ = jax.lax.scan(rstep, (base_sad, base_q), neigh)
+        return mv, sad
 
-def _mc_chroma_frac4(ref_c, mv, *, pad):
-    """Chroma MC for integer luma MVs: parity picks copy/H/V/HV."""
-    refp = jnp.pad(ref_c.astype(jnp.int32), pad, mode="edge")
-    planes = jnp.stack(_chroma_frac_planes(refp))   # (4, Hp, Wp)
-    hc = ref_c.shape[0]
-    wc = ref_c.shape[1]
-    dy = jnp.repeat(jnp.repeat(mv[..., 0], 16, 0), 16, 1)
-    dx = jnp.repeat(jnp.repeat(mv[..., 1], 16, 0), 16, 1)
-    iy, fy = dy >> 1, dy & 1
-    ix, fx = dx >> 1, dx & 1
-    rows = jnp.arange(hc)[:, None] + iy + pad
-    cols = jnp.arange(wc)[None, :] + ix + pad
-    sel = fy * 2 + fx                               # 0=copy 1=H 2=V 3=HV
-    gathered = planes[:, rows, cols]                # (4, hc, wc)
-    ps = jnp.take_along_axis(gathered, sel[None], axis=0)[0]
-    return jnp.clip((ps + 32) >> 6, 0, 255)
+    mv_q, sad_q = refine(mv_int * 4, int_sad, 2)
+    mv_q, _ = refine(mv_q, sad_q, 1)
+    return mv_q
 
 
 def encode_p_frame_dsp(y, u, v, ref_y, ref_u, ref_v, qp, *,
                        search: int = 16):
     """One P frame against the previous reconstruction. All CTBs inter
-    with integer MVs (pslice.py codes them); returns levels, MVs, recon.
-    Everything is ref-relative, so the whole frame is one parallel pass
-    — no intra row-scan needed."""
+    with quarter-pel MVs (pslice.py codes them); returns levels, MVs,
+    recon. Everything is ref-relative, so the whole frame is one
+    parallel pass — no intra row-scan needed."""
     qp = jnp.asarray(qp, jnp.int32)
     qpc = chroma_qp_traced(qp)
-    pad = search + 1
+    # luma pad: integer reach + 1 refinement pel + 4-tap reach + the
+    # 4-sample roll-wrap contamination ring of the horizontal filters
+    pad = search + 8
     h, w = y.shape
-    rr, cc = h // 32, w // 32
     cur = y.astype(jnp.int32)
     refp = jnp.pad(ref_y.astype(jnp.int32), pad, mode="edge")
-    mv = _p_ctb_search(cur, refp, search=search, pad=pad)
+    hplanes = _hfiltered_planes(refp, _LTAPS)
+    mv = _p_ctb_search(cur, refp, hplanes, search=search, pad=pad)
 
-    pred_y = _mc_luma_int(refp, mv, pad=pad)
-    # chroma pad: mv/2 reach + 2 taps + 4 roll-wrap contamination ring
+    pred_y = _mc_luma_qpel(hplanes, mv, pad=pad, h=h, w=w).astype(
+        jnp.int32)
     cpad = search // 2 + 6
-    pred_u = _mc_chroma_frac4(ref_u, mv, pad=cpad)
-    pred_v = _mc_chroma_frac4(ref_v, mv, pad=cpad)
+    hc, wc = u.shape
+    cplanes_u = _hfiltered_planes(
+        jnp.pad(ref_u.astype(jnp.int32), cpad, mode="edge"), _CTAPS)
+    cplanes_v = _hfiltered_planes(
+        jnp.pad(ref_v.astype(jnp.int32), cpad, mode="edge"), _CTAPS)
+    pred_u = _mc_chroma_qpel(cplanes_u, mv, pad=cpad, hc=hc, wc=wc).astype(
+        jnp.int32)
+    pred_v = _mc_chroma_qpel(cplanes_v, mv, pad=cpad, hc=hc, wc=wc).astype(
+        jnp.int32)
 
     def to_blocks(plane, n):
         r2, c2 = plane.shape[0] // n, plane.shape[1] // n

@@ -2,12 +2,11 @@
 
 Extends the all-intra envelope (slice.py) with single-reference P
 slices: every CTB is either an inter 2Nx2N CU with an explicitly coded
-integer MV (AMVP, mvp_l0_flag=0, no merge/skip — avoids the merge
+quarter-pel MV (AMVP, mvp_l0_flag=0, no merge/skip — avoids the merge
 candidate machinery entirely at a cost of a few bins per CTB) or falls
-back to the intra mode-26 CU when motion fails. Integer luma MVs mean
-luma MC is a shifted copy and chroma lands on {0, 1/2} positions only
-(the 4-tap filter at fraction 4), keeping the device DSP to gathers +
-two small convolutions — the HEVC analog of the H.264 chain design.
+back to the intra mode-26 CU when motion fails. The device DSP
+(jax_core.py) interpolates with the spec 8-tap luma / 4-tap chroma
+filters — the HEVC analog of the H.264 chain design.
 
 The AMVP predictor (8.5.3.2.6) is computed by an entropy-time state
 machine over the CTB grid, mirroring what any decoder derives:
@@ -136,8 +135,8 @@ def _write_mvd(c: CabacEncoder, dx: int, dy: int) -> None:
 class PSliceWriter:
     """Accumulates one P-slice's CABAC payload CTU by CTU.
 
-    ``write_ctu_inter``: 2Nx2N inter CU, integer MV (given in luma
-    integer pels, converted to quarter-pel for the bitstream), optional
+    ``write_ctu_inter``: 2Nx2N inter CU with a quarter-pel MV
+    ((y, x) DSP order — the bitstream's own resolution) and optional
     residual levels. ``write_ctu_intra``: the mode-26 intra CU, usable
     as fallback inside P slices.
     """
@@ -151,15 +150,15 @@ class PSliceWriter:
         # ctxInc is always 0
         self.c.encode_bin(_SKIP, 0)
 
-    def write_ctu_inter(self, r: int, col: int, mv_int: tuple[int, int],
+    def write_ctu_inter(self, r: int, col: int, mv_q: tuple[int, int],
                         luma, cb, cr, *, last_in_slice: bool) -> None:
-        """mv_int = (y, x) integer luma pels (DSP order)."""
+        """mv_q = (y, x) QUARTER luma pels (DSP order)."""
         c = self.c
         self._common_p_prefix()
         c.encode_bin(_PRED_MODE, 0)              # MODE_INTER
         c.encode_bin(_PART, 1)                   # PART_2Nx2N
         c.encode_bin(_MERGE, 0)                  # explicit AMVP
-        mvq = (int(mv_int[1]) * 4, int(mv_int[0]) * 4)   # (x, y) qpel
+        mvq = (int(mv_q[1]), int(mv_q[0]))       # bitstream (x, y)
         pmx, pmy = self.grid.predictor(r, col)
         _write_mvd(c, mvq[0] - pmx, mvq[1] - pmy)
         c.encode_bin(_MVP, 0)                    # mvp_l0_flag = cand 0

@@ -332,8 +332,8 @@ extern "C" int64_t vt_hevc_encode_slice(
 
 /* --------------------------------------------------------- P slices
  * Mirror of codecs/hevc/pslice.py: every CTB an inter 2Nx2N CU with an
- * explicitly coded integer MV (AMVP candidate 0, no merge/skip).
- * mv: (rows*cols, 2) int32 as (y, x) integer luma pels (DSP order).
+ * explicitly coded MV (AMVP candidate 0, no merge/skip).
+ * mv: (rows*cols, 2) int32 as (y, x) QUARTER luma pels (DSP order).
  */
 
 static void write_mvd(Cabac *c, int dx, int dy) {
@@ -373,7 +373,7 @@ extern "C" int64_t vt_hevc_encode_p_slice(
             enc_bin(&c, HEVC_CTX_PRED_MODE, 0);     /* MODE_INTER */
             enc_bin(&c, HEVC_CTX_PART_MODE, 1);     /* 2Nx2N */
             enc_bin(&c, HEVC_CTX_MERGE_FLAG, 0);
-            int mvx = mv[i * 2 + 1] * 4, mvy = mv[i * 2] * 4;
+            int mvx = mv[i * 2 + 1], mvy = mv[i * 2];
             /* AMVP candidate 0: left CU, else first of B0/B1/B2
              * (every CTB here is inter, so availability is purely
              * positional — matches MvpGrid in an all-inter slice) */
