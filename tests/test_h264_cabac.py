@@ -1,0 +1,179 @@
+"""H.264 CABAC entropy vs the libavcodec oracle.
+
+Same drill as the CAVLC tests: every CABAC stream must reconstruct
+byte-exactly in libavcodec, for I slices (the joint I_16x16 mb_type
+code, chroma mode, all residual block categories) and P slices
+(mb_skip_flag, P_L0_16x16, MVD UEG3, inter cbp, cat-2 residuals) —
+plus the headline property: materially smaller output than CAVLC on
+the same levels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from vlog_tpu.codecs.h264 import syntax
+from vlog_tpu.codecs.h264.api import H264Encoder
+from vlog_tpu.codecs.h264.cabac_enc import (
+    encode_p_slice_cabac,
+    encode_slice_cabac,
+)
+from vlog_tpu.codecs.h264.cavlc import encode_p_slice, encode_slice
+from vlog_tpu.codecs.h264.encoder import encode_frame, frame_levels
+from vlog_tpu.codecs.h264.inter import encode_p_frame, p_frame_levels
+
+from tests.fixtures.media import synthetic_yuv_frames
+from tests.test_h264_oracle import avdec, oracle_decode  # noqa: F401
+from tests.test_h264_p import moving_frames
+
+
+@pytest.mark.parametrize("w,h,qp", [(64, 48, 20), (96, 64, 28),
+                                    (128, 96, 40)])
+def test_i_slice_oracle_bit_exact(avdec, tmp_path, w, h, qp):
+    frames = synthetic_yuv_frames(2, w, h)
+    enc = H264Encoder(width=w, height=h, qp=qp, entropy="cabac")
+    nals = [enc.sps, enc.pps]
+    recons = []
+    for (y, u, v) in frames:
+        out = encode_frame(y, u, v, qp=qp)
+        lv = frame_levels(out, qp)
+        nals.append(encode_slice_cabac(lv, qp=qp, init_qp=qp,
+                                       frame_num=0, idr=True))
+        recons.append((np.asarray(out["recon_y"]),
+                       np.asarray(out["recon_u"]),
+                       np.asarray(out["recon_v"])))
+    decoded = oracle_decode(avdec, syntax.annexb(nals), h, w, tmp_path)
+    assert len(decoded) == 2
+    for (dy, du, dv), (ry, ru, rv) in zip(decoded, recons):
+        np.testing.assert_array_equal(dy, ry)
+        np.testing.assert_array_equal(du, ru)
+        np.testing.assert_array_equal(dv, rv)
+
+
+def test_p_chain_oracle_bit_exact_and_smaller(avdec, tmp_path):
+    h, w, qp = 96, 128, 28
+    frames = moving_frames(6, h, w)
+    enc = H264Encoder(width=w, height=h, qp=qp, entropy="cabac")
+    nals = [enc.sps, enc.pps]
+    recons = []
+    cavlc_bytes = cabac_bytes = 0
+    y0, u0, v0 = frames[0]
+    out = encode_frame(y0, u0, v0, qp=qp)
+    lv = frame_levels(out, qp)
+    nal = encode_slice_cabac(lv, qp=qp, init_qp=qp, frame_num=0, idr=True)
+    cabac_bytes += len(nal.to_bytes())
+    cavlc_bytes += len(encode_slice(lv, qp=qp, init_qp=qp, frame_num=0,
+                                    idr=True).to_bytes())
+    nals.append(nal)
+    ref = (np.asarray(out["recon_y"]), np.asarray(out["recon_u"]),
+           np.asarray(out["recon_v"]))
+    recons.append(ref)
+    for i, (y, u, v) in enumerate(frames[1:], start=1):
+        pout = encode_p_frame(y, u, v, *ref, qp=qp, search=8)
+        plv = p_frame_levels(pout)
+        nal = encode_p_slice_cabac(plv, qp=qp, init_qp=qp, frame_num=i)
+        cabac_bytes += len(nal.to_bytes())
+        cavlc_bytes += len(encode_p_slice(plv, qp=qp, init_qp=qp,
+                                          frame_num=i).to_bytes())
+        nals.append(nal)
+        ref = (np.asarray(pout["recon_y"]), np.asarray(pout["recon_u"]),
+               np.asarray(pout["recon_v"]))
+        recons.append(ref)
+
+    decoded = oracle_decode(avdec, syntax.annexb(nals), h, w, tmp_path)
+    assert len(decoded) == len(frames)
+    for i, ((dy, du, dv), (ry, ru, rv)) in enumerate(zip(decoded, recons)):
+        np.testing.assert_array_equal(dy, ry, err_msg=f"frame {i}")
+        np.testing.assert_array_equal(du, ru, err_msg=f"frame {i}")
+        np.testing.assert_array_equal(dv, rv, err_msg=f"frame {i}")
+    # the point of CABAC
+    assert cabac_bytes < 0.95 * cavlc_bytes, (cabac_bytes, cavlc_bytes)
+
+
+def test_first_party_decoder_round_trip():
+    """Our own decoder must decode our CABAC streams (cabac_dec.py) —
+    the self-transcode property the CAVLC envelope always had."""
+    from vlog_tpu.codecs.h264.decoder import H264Decoder, split_annexb
+
+    h, w, qp = 96, 128, 28
+    frames = moving_frames(3, h, w)
+    enc = H264Encoder(width=w, height=h, qp=qp, entropy="cabac")
+    nals = [enc.sps, enc.pps]
+    recons = []
+    out = encode_frame(*frames[0], qp=qp)
+    nals.append(encode_slice_cabac(frame_levels(out, qp), qp=qp,
+                                   init_qp=qp, frame_num=0, idr=True))
+    ref = tuple(np.asarray(out[k])
+                for k in ("recon_y", "recon_u", "recon_v"))
+    recons.append(ref)
+    for i, f in enumerate(frames[1:], 1):
+        pout = encode_p_frame(*f, *ref, qp=qp, search=8)
+        nals.append(encode_p_slice_cabac(p_frame_levels(pout), qp=qp,
+                                         init_qp=qp, frame_num=i))
+        ref = tuple(np.asarray(pout[k])
+                    for k in ("recon_y", "recon_u", "recon_v"))
+        recons.append(ref)
+    dec = H264Decoder()
+    got = []
+    for (t, ri, rbsp) in split_annexb(syntax.annexb(nals)):
+        if t in (7, 8):
+            dec._handle_nal(t, rbsp)
+        elif t in (1, 5):
+            got.append(dec._reconstruct(dec._decode_slice_nal(t, ri, rbsp)))
+    assert len(got) == 3
+    for (dy, du, dv), (ry, ru, rv) in zip(got, recons):
+        np.testing.assert_array_equal(np.asarray(dy), ry)
+        np.testing.assert_array_equal(np.asarray(du), ru)
+        np.testing.assert_array_equal(np.asarray(dv), rv)
+
+
+def test_c_coder_matches_python(monkeypatch):
+    """native/h264_cabac_enc.c must be bit-exact with the Python
+    reference for both slice types."""
+    import vlog_tpu.native.build as nb
+
+    if nb.get_lib() is None:
+        pytest.skip("native library unavailable")
+    h, w, qp = 96, 128, 30
+    frames = moving_frames(2, h, w)
+    out = encode_frame(*frames[0], qp=qp)
+    lv = frame_levels(out, qp)
+    ref = (np.asarray(out["recon_y"]), np.asarray(out["recon_u"]),
+           np.asarray(out["recon_v"]))
+    plv = p_frame_levels(encode_p_frame(*frames[1], *ref, qp=qp, search=8))
+    i_c = encode_slice_cabac(lv, qp=qp, init_qp=qp, frame_num=0,
+                             idr=True).to_bytes()
+    p_c = encode_p_slice_cabac(plv, qp=qp, init_qp=qp,
+                               frame_num=1).to_bytes()
+    monkeypatch.setenv("VLOG_NATIVE", "0")
+    monkeypatch.setattr(nb, "_TRIED", False)
+    monkeypatch.setattr(nb, "_LIB", None)
+    assert encode_slice_cabac(lv, qp=qp, init_qp=qp, frame_num=0,
+                              idr=True).to_bytes() == i_c
+    assert encode_p_slice_cabac(plv, qp=qp, init_qp=qp,
+                                frame_num=1).to_bytes() == p_c
+
+
+def test_static_scene_skips(avdec, tmp_path):
+    """All-skip P frames: mb_skip_flag contexts + terminate only."""
+    h, w, qp = 64, 96, 30
+    f0 = moving_frames(1, h, w)[0]
+    enc = H264Encoder(width=w, height=h, qp=qp, entropy="cabac")
+    out = encode_frame(*f0, qp=qp)
+    ref = (np.asarray(out["recon_y"]), np.asarray(out["recon_u"]),
+           np.asarray(out["recon_v"]))
+    nals = [enc.sps, enc.pps,
+            encode_slice_cabac(frame_levels(out, qp), qp=qp, init_qp=qp,
+                               frame_num=0, idr=True)]
+    for i in range(1, 4):
+        pout = encode_p_frame(*ref, *ref, qp=qp, search=4)
+        nal = encode_p_slice_cabac(p_frame_levels(pout), qp=qp,
+                                   init_qp=qp, frame_num=i)
+        assert len(nal.to_bytes()) < 30     # skip flags compress hard
+        nals.append(nal)
+        ref = (np.asarray(pout["recon_y"]), np.asarray(pout["recon_u"]),
+               np.asarray(pout["recon_v"]))
+    decoded = oracle_decode(avdec, syntax.annexb(nals), h, w, tmp_path)
+    assert len(decoded) == 4
+    np.testing.assert_array_equal(decoded[-1][0], ref[0])

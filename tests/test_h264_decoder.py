@@ -102,18 +102,16 @@ def test_split_annexb_finds_all_nals():
     assert [t for t, _, _ in nals] == [syntax.NAL_SPS, syntax.NAL_PPS, syntax.NAL_IDR]
 
 
-def test_cabac_stream_rejected():
-    from vlog_tpu.media.bitstream import BitWriter
+def test_cabac_pps_accepted():
+    """CABAC is first-party now (codecs/h264/cabac_dec.py): the PPS
+    parses and records the entropy mode."""
+    from vlog_tpu.codecs.h264 import syntax
 
-    w = BitWriter()
-    w.write_ue(0)   # pps_id
-    w.write_ue(0)   # sps_id
-    w.write_bit(1)  # entropy_coding_mode: CABAC
-    w.write_bit(0)
-    w.write_ue(0)
-    w.rbsp_trailing_bits()
-    with pytest.raises(UnsupportedStream):
-        parse_pps(w.getvalue())
+    pps_nal = syntax.make_pps(init_qp=28, cabac=True)
+    pps = parse_pps(pps_nal.rbsp)
+    assert pps.entropy_coding_mode == 1
+    pps = parse_pps(syntax.make_pps(init_qp=28).rbsp)
+    assert pps.entropy_coding_mode == 0
 
 
 def test_flat_frame_roundtrip():

@@ -204,7 +204,7 @@ class JaxBackend:
         for rung in plan.rungs:
             enc = H264Encoder(width=rung.width, height=rung.height,
                               fps_num=plan.fps_num, fps_den=plan.fps_den,
-                              qp=rung.qp)
+                              qp=rung.qp, entropy=config.H264_ENTROPY)
             encoders[rung.name] = enc
             tracks[rung.name] = TrackConfig(
                 track_id=1, handler="vide", timescale=timescale,
@@ -366,13 +366,13 @@ class JaxBackend:
                 # blended chain bytes either way).
                 qps = {}
                 for r in plan.rungs:
-                    q = np.full((chains_per, clen), controllers[r.name].qp,
-                                np.int32)
+                    # fractional working point -> per-frame dither
+                    q = controllers[r.name].frame_qps(
+                        chains_per * clen).reshape(chains_per, clen)
                     q[:, 0] = np.maximum(q[:, 0] - 2, 0)
                     qps[r.name] = q
             else:
-                qps = {r.name: np.full(batch_n, controllers[r.name].qp,
-                                       np.int32)
+                qps = {r.name: controllers[r.name].frame_qps(batch_n)
                        for r in plan.rungs}
             if mesh is not None:
                 by, bu, bv = shard_frames(mesh, by, bu, bv)

@@ -3,13 +3,23 @@
 The reference hits ladder bitrate targets by delegating VBR to
 x264/NVENC (`-b:v`/`-maxrate`, worker/hwaccel.py:660-731). Here the
 control loop is explicit: observe achieved bits after each GOP batch,
-step QP toward the target. The DSP takes QP as a *traced* per-frame
-value (ops/transform.py), so stepping costs no recompile.
+pick the next QP. The DSP takes QP as a *traced* per-frame value
+(ops/transform.py), so stepping costs no recompile.
 
-The plant model is the standard H.264 rule of thumb: bits halve per +6
-QP, i.e. log2(bits) is linear in QP with slope -1/6. A damped
-proportional step on that log scale converges in a few batches and
-cannot oscillate for damping <= 1.
+Two structural choices make this robust where slope controllers fail:
+
+- **Bracketing search** over the observed (QP -> bytes/frame) points.
+  The textbook "bits halve per +6 QP" rule only extrapolates while no
+  bracket exists (including the first calibration jump); once
+  observations straddle the target, the next QP interpolates between
+  the bracketing points in log-bit space, so response cliffs and
+  temporal drift cannot produce limit cycles.
+- **Fractional QP via frame dithering.** The working QP is continuous;
+  ``frame_qps(n)`` assigns each frame floor or ceil in a Bresenham
+  pattern matching the fraction. Rate mixes linearly in the frame
+  count, so targets BETWEEN two integer QPs' rates — exactly the cliff
+  case where no single QP lands near the target — are reachable. This
+  is the frame-level analog of x264's adaptive quantization.
 """
 
 from __future__ import annotations
@@ -17,65 +27,113 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
+import numpy as np
+
 
 @dataclass
 class RateController:
-    """One per rung. ``observe()`` after each batch; read ``qp`` before
-    the next."""
+    """One per rung. ``observe()`` after each batch; read ``qp`` (or
+    ``frame_qps``) before the next."""
 
     target_bps: int            # 0 = constant-QP mode (no adaptation)
     fps: float
     init_qp: int
     min_qp: int = 10
     max_qp: int = 48
-    damping: float = 0.6       # fraction of the full log-domain correction
-    max_step: int = 4          # per-batch QP step clamp
-    ema_alpha: float = 0.6     # weight of the newest batch in the bpf EMA
+    damping: float = 0.6       # kept for API compat (unused by search)
+    max_step: int = 4          # extrapolation step clamp (x2 applied)
+    ema_alpha: float = 0.5     # per-QP estimate update weight
+    band: float = 0.15         # +-15% of target counts as converged
 
-    qp: int = field(init=False)
-    _ema_bpf: float | None = field(default=None, init=False)
+    _q: float = field(init=False)
+    _obs: dict = field(default_factory=dict, init=False)  # q -> bpf EMA
+    _order: list = field(default_factory=list, init=False)
     _calibrating: bool = field(default=True, init=False)
-    _last_sign: int = field(default=0, init=False)
-    _sign_run: int = field(default=0, init=False)
 
     def __post_init__(self) -> None:
-        self.qp = self.init_qp
+        self._q = float(self.init_qp)
+
+    @property
+    def qp(self) -> int:
+        return int(round(self._q))
+
+    @qp.setter
+    def qp(self, value: int) -> None:
+        self._q = float(value)
 
     @property
     def target_bytes_per_frame(self) -> float:
         return self.target_bps / 8.0 / self.fps if self.fps else 0.0
+
+    def frame_qps(self, n: int) -> np.ndarray:
+        """Per-frame integer QPs whose mix realizes the fractional
+        working point (evenly interleaved)."""
+        lo = math.floor(self._q)
+        frac = self._q - lo
+        i = np.arange(n)
+        bump = ((i + 1) * frac).astype(np.int64) - (i * frac).astype(
+            np.int64)
+        return np.clip(lo + bump, self.min_qp, self.max_qp).astype(
+            np.int32)
+
+    # ------------------------------------------------------------------
+    def _record(self, q: float, bpf: float) -> None:
+        key = round(q, 2)
+        if key in self._obs:
+            self._obs[key] += self.ema_alpha * (bpf - self._obs[key])
+            self._order.remove(key)
+        else:
+            self._obs[key] = bpf
+        self._order.append(key)
+        while len(self._order) > 8:            # bounded, recency-kept
+            self._obs.pop(self._order.pop(0))
 
     def observe(self, bytes_out: int, n_frames: int) -> int:
         """Feed achieved bytes for ``n_frames`` frames; returns next QP."""
         if self.target_bps <= 0 or n_frames <= 0 or self.fps <= 0:
             return self.qp
         bpf = bytes_out / n_frames
-        if self._ema_bpf is None:
-            self._ema_bpf = bpf
-        else:
-            self._ema_bpf += self.ema_alpha * (bpf - self._ema_bpf)
-        ratio = max(self._ema_bpf, 1e-9) / max(self.target_bytes_per_frame, 1e-9)
-        # +6 QP ~ half the bits -> full correction is 6*log2(ratio).
-        if self._calibrating:
-            # First real observation: jump the whole way (the init QP is a
-            # ladder-wide default, often far off for this content).
-            self._calibrating = False
-            step = round(6.0 * math.log2(ratio))
-        else:
-            full = 6.0 * math.log2(ratio)
-            sign = (full > 0) - (full < 0)
-            # Damping guards against oscillation — but an error that keeps
-            # the same sign across batches is bias, not noise; drop the
-            # damping so short encodes still converge (few observations).
-            self._sign_run = self._sign_run + 1 if sign == self._last_sign \
-                else 1
-            self._last_sign = sign
-            damp = 1.0 if self._sign_run >= 2 else self.damping
-            step = max(-self.max_step,
-                       min(self.max_step, round(full * damp)))
-        if step:
-            self.qp = max(self.min_qp, min(self.max_qp, self.qp + step))
-            # A QP move invalidates the EMA's operating point; restart it
-            # so stale samples don't fight the next correction.
-            self._ema_bpf = None
+        self._record(self._q, bpf)
+        target = max(self.target_bytes_per_frame, 1e-9)
+
+        est = self._obs[round(self._q, 2)]
+        ratio = max(est, 1e-9) / target
+        calibrating, self._calibrating = self._calibrating, False
+        if abs(math.log2(ratio)) <= math.log2(1 + self.band):
+            return self.qp                      # converged: hold
+
+        over = {q: b for q, b in self._obs.items() if b > target}
+        under = {q: b for q, b in self._obs.items() if b <= target}
+        nxt = None
+        if over and under:
+            q_lo = max(over)                    # highest QP still over
+            q_hi = min(under)                   # lowest QP at/under
+            if q_lo >= q_hi:
+                # contradicts bits-decrease-with-QP: the content moved;
+                # trust only what we just measured
+                self._obs = {round(self._q, 2): est}
+                self._order = [round(self._q, 2)]
+            else:
+                # interpolate in log-bit space inside the bracket; the
+                # fractional result is realized by frame dithering
+                l_lo = math.log2(over[q_lo])
+                l_hi = math.log2(under[q_hi])
+                t = (math.log2(target) - l_lo) / (l_hi - l_lo)
+                nxt = q_lo + t * (q_hi - q_lo)
+                span = q_hi - q_lo
+                nxt = min(max(nxt, q_lo + 0.05 * span),
+                          q_hi - 0.05 * span)
+        if nxt is None:
+            # no (usable) bracket: extrapolate on the textbook slope;
+            # the calibration jump goes the whole way (the init QP is a
+            # ladder-wide default, often far off), later ones clamp. If
+            # the jump lands past a response cliff, that one batch is
+            # the unavoidable probe cost — the bracket formed from it
+            # pulls the very next batch onto the interpolated point.
+            step = 6.0 * math.log2(ratio)
+            if not calibrating:
+                cap = 2.0 * self.max_step
+                step = max(-cap, min(cap, step))
+            nxt = self._q + step
+        self._q = min(max(nxt, float(self.min_qp)), float(self.max_qp))
         return self.qp

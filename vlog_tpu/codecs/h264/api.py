@@ -50,17 +50,31 @@ class H264Encoder:
     qp: int = 26
     idr_period: int = 1          # every frame IDR by default
     entropy_threads: int = 8
+    entropy: str = "cavlc"       # "cavlc" (C fast path) | "cabac"
     _frame_index: int = field(default=0, init=False)
     _idr_pic_id: int = field(default=0, init=False)
 
     def __post_init__(self):
+        if self.entropy not in ("cavlc", "cabac"):
+            raise ValueError(f"unknown entropy coder {self.entropy!r}")
         self.sps = syntax.make_sps(
             syntax.SpsConfig(
                 width=self.width, height=self.height,
                 fps_num=self.fps_num, fps_den=self.fps_den,
             )
         )
-        self.pps = syntax.make_pps(init_qp=self.qp)
+        self.pps = syntax.make_pps(init_qp=self.qp,
+                                   cabac=self.entropy == "cabac")
+
+    def _slice_fns(self):
+        if self.entropy == "cabac":
+            from vlog_tpu.codecs.h264.cabac_enc import (
+                encode_p_slice_cabac, encode_slice_cabac)
+
+            return encode_slice_cabac, encode_p_slice_cabac
+        from vlog_tpu.codecs.h264.cavlc import encode_p_slice
+
+        return encode_slice, encode_p_slice
 
     # ---- stream metadata -------------------------------------------------
     @property
@@ -78,7 +92,8 @@ class H264Encoder:
     def _pack_one(self, frame_id: int, lv: FrameLevels, frame_qp: int,
                   psnr: float) -> EncodedFrame:
         idr = (frame_id % self.idr_period) == 0
-        nal = encode_slice(
+        slice_fn, _ = self._slice_fns()
+        nal = slice_fn(
             lv, qp=frame_qp, init_qp=self.qp,
             # frame_num counts reference frames since the last IDR.
             frame_num=(frame_id % self.idr_period) % 256,
@@ -103,8 +118,7 @@ class H264Encoder:
         are slices, so they entropy-code in parallel threads — per-slice
         CAVLC state never crosses frame boundaries.
         """
-        from vlog_tpu.codecs.h264.cavlc import encode_p_slice
-
+        slice_fn, p_slice_fn = self._slice_fns()
         idr_pic_id = self._idr_pic_id
         self._idr_pic_id = (self._idr_pic_id + 1) % 65536
         n = 1 + len(p_frames)
@@ -113,7 +127,7 @@ class H264Encoder:
 
         def pack(i: int) -> EncodedFrame:
             if i == 0:
-                nal = encode_slice(
+                nal = slice_fn(
                     intra, qp=int(qps[0]), init_qp=self.qp, frame_num=0,
                     idr=True, idr_pic_id=idr_pic_id)
                 raw = nal.to_bytes()
@@ -121,8 +135,8 @@ class H264Encoder:
                     avcc=len(raw).to_bytes(4, "big") + raw,
                     annexb=syntax.annexb([self.sps, self.pps, nal]),
                     is_idr=True, psnr_y=psnr(0))
-            nal = encode_p_slice(p_frames[i - 1], qp=int(qps[i]),
-                                 init_qp=self.qp, frame_num=i)
+            nal = p_slice_fn(p_frames[i - 1], qp=int(qps[i]),
+                             init_qp=self.qp, frame_num=i)
             raw = nal.to_bytes()
             return EncodedFrame(
                 avcc=len(raw).to_bytes(4, "big") + raw,

@@ -1,0 +1,518 @@
+"""H.264 CABAC entropy coding for the I16x16 / P_L0_16x16 envelope.
+
+CAVLC (cavlc.py) was the launch entropy coder; CABAC buys the standard
+~10-15% bitrate at equal PSNR — the same step x264's default profile
+takes. The arithmetic engine is byte-identical to HEVC's
+(codecs/hevc/cabac.ArithEncoder — H.264 9.3.4 and H.265 9.3.4 share the
+range/transition tables), so this module only adds the H.264 context
+layer: the 1024 (m, n) init pairs (cabac_ctx_tables.py, extracted from
+libavcodec), the per-element ctxIdx derivations with their neighbor
+state grids (9.3.3.1), binarizations (9.3.2: TU, UEG0/UEG3, the joint
+I_16x16 mb_type code), and the block-categorized residual coding
+(coded_block_flag, significance maps, level magnitudes).
+
+Oracle: tests/test_h264_cabac.py decodes these streams with libavcodec
+and asserts byte-exact reconstruction, exactly like the CAVLC tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from vlog_tpu.codecs.h264 import syntax
+from vlog_tpu.codecs.h264.cabac_ctx_tables import INIT_I, INIT_PB
+from vlog_tpu.codecs.h264.cavlc import MvPredictor, _BLK44
+from vlog_tpu.codecs.h264.cavlc_tables import LUMA_BLOCK_ORDER, ZIGZAG_4x4
+from vlog_tpu.codecs.hevc.cabac import ArithEncoder
+from vlog_tpu.media.bitstream import BitWriter
+
+_ZZ16 = [r * 4 + c for r, c in ZIGZAG_4x4]
+
+
+def zigzag(block: np.ndarray) -> np.ndarray:
+    return np.asarray(block).reshape(-1)[_ZZ16]
+
+
+def init_states_264(slice_qp: int, *, i_slice: bool,
+                    cabac_init_idc: int = 0) -> tuple[list, list]:
+    """H.264 context init (9.3.1.1) — shared by encoder and decoder so
+    the two can never drift."""
+    table = INIT_I if i_slice else INIT_PB[cabac_init_idc]
+    qp = min(max(slice_qp, 0), 51)
+    pstate = [0] * 1024
+    mps = [0] * 1024
+    for i in range(1024):
+        m, n = table[2 * i], table[2 * i + 1]
+        pre = min(max(((m * qp) >> 4) + n, 1), 126)
+        if pre <= 63:
+            pstate[i], mps[i] = 63 - pre, 0
+        else:
+            pstate[i], mps[i] = pre - 64, 1
+    return pstate, mps
+
+
+class H264Cabac(ArithEncoder):
+    """The engine with H.264 context initialization (9.3.1.1)."""
+
+    def __init__(self, slice_qp: int, *, i_slice: bool,
+                 cabac_init_idc: int = 0) -> None:
+        super().__init__(*init_states_264(
+            slice_qp, i_slice=i_slice, cabac_init_idc=cabac_init_idc))
+
+    def tu(self, value: int, cmax: int, ctxs: list[int]) -> None:
+        """Truncated unary with a per-bin ctx list (last entry reused)."""
+        for k in range(value):
+            self.encode_bin(ctxs[min(k, len(ctxs) - 1)], 1)
+        if value < cmax:
+            self.encode_bin(ctxs[min(value, len(ctxs) - 1)], 0)
+
+    def eg_bypass(self, value: int, k: int) -> None:
+        """k-th order Exp-Golomb in bypass (9.3.2.3 suffix)."""
+        while value >= (1 << k):
+            self.encode_bypass(1)
+            value -= 1 << k
+            k += 1
+        self.encode_bypass(0)
+        for i in range(k - 1, -1, -1):
+            self.encode_bypass((value >> i) & 1)
+
+
+# block categories: (ctx offsets into cbf/sig/last/level bases, #coeffs)
+#   0 Intra16 luma DC, 1 Intra16 luma AC, 2 luma 4x4, 3 chroma DC,
+#   4 chroma AC
+_CBF_BASE = 85
+_CBF_CAT = (0, 4, 8, 12, 16)
+_SIG_BASE = 105
+_LAST_BASE = 166
+_SIGLAST_CAT = (0, 15, 29, 44, 47)
+_LVL_BASE = 227
+_LVL_CAT = (0, 10, 20, 30, 39)
+
+
+class _SliceState:
+    """Neighbor grids shared by the ctxIdxInc derivations (9.3.3.1)."""
+
+    def __init__(self, mbh: int, mbw: int):
+        self.mbh, self.mbw = mbh, mbw
+        self.skip = np.zeros((mbh, mbw), bool)
+        self.intra = np.zeros((mbh, mbw), bool)
+        self.i16 = np.zeros((mbh, mbw), bool)
+        self.cbp_luma = np.zeros((mbh, mbw), np.int32)
+        self.cbp_chroma = np.zeros((mbh, mbw), np.int32)
+        self.chroma_mode = np.zeros((mbh, mbw), np.int32)
+        self.cbf_lumadc = np.zeros((mbh, mbw), np.int32)
+        self.cbf_luma44 = np.zeros((mbh * 4, mbw * 4), np.int32)
+        self.cbf_chdc = np.zeros((2, mbh, mbw), np.int32)
+        self.cbf_ch44 = np.zeros((2, mbh * 2, mbw * 2), np.int32)
+        self.mvd = np.zeros((mbh, mbw, 2), np.int32)   # |mvd| (x, y)
+        self.prev_qp_delta_nz = False
+
+
+
+
+def cbf_ctx_inc(st: _SliceState, cat: int, my: int, mx: int, comp: int,
+                by: int, bx: int, cur_intra: bool) -> int:
+    """ctxIdxInc for coded_block_flag: condA + 2*condB from the
+    same-category neighbor blocks (9.3.3.1.1.9). Shared by the encoder
+    and the decoder (cabac_dec.py) over the same _SliceState grids."""
+
+    def cond(n_my, n_mx, grid_val):
+        if not (0 <= n_my < st.mbh and 0 <= n_mx < st.mbw):
+            # neighbor MB outside the picture
+            return 1 if cur_intra else 0
+        return grid_val
+
+    if cat == 0:                        # luma DC: neighbor MB's DC cbf
+        a = cond(my, mx - 1,
+                 int(st.cbf_lumadc[my, mx - 1]) if mx > 0 else 0)
+        b = cond(my - 1, mx,
+                 int(st.cbf_lumadc[my - 1, mx]) if my > 0 else 0)
+        # available neighbor that is not I16x16: transBlock absent -> 0
+        if mx > 0 and not st.i16[my, mx - 1]:
+            a = 0
+        if my > 0 and not st.i16[my - 1, mx]:
+            b = 0
+        return a + 2 * b
+    if cat in (1, 2):                   # luma 4x4 grid neighbors
+        y, x = my * 4 + by, mx * 4 + bx
+        a = cond(my, mx - 1 if x % 4 == 0 else mx,
+                 int(st.cbf_luma44[y, x - 1]) if x > 0 else 0)
+        b = cond(my - 1 if y % 4 == 0 else my, mx,
+                 int(st.cbf_luma44[y - 1, x]) if y > 0 else 0)
+        return a + 2 * b
+    if cat == 3:                        # chroma DC per component
+        a = cond(my, mx - 1,
+                 int(st.cbf_chdc[comp, my, mx - 1]) if mx > 0 else 0)
+        b = cond(my - 1, mx,
+                 int(st.cbf_chdc[comp, my - 1, mx]) if my > 0 else 0)
+        return a + 2 * b
+    y, x = my * 2 + by, mx * 2 + bx     # chroma AC 2x2 grid
+    a = cond(my, mx - 1 if x % 2 == 0 else mx,
+             int(st.cbf_ch44[comp, y, x - 1]) if x > 0 else 0)
+    b = cond(my - 1 if y % 2 == 0 else my, mx,
+             int(st.cbf_ch44[comp, y - 1, x]) if y > 0 else 0)
+    return a + 2 * b
+
+class CabacSliceCoder:
+    """Shared element writers for I and P slices."""
+
+    def __init__(self, c: H264Cabac, mbh: int, mbw: int):
+        self.c = c
+        self.st = _SliceState(mbh, mbw)
+
+    # ---------------------------------------------------------- residual
+    def _cbf_inc(self, cat, my, mx, comp, by, bx, cur_intra):
+        return cbf_ctx_inc(self.st, cat, my, mx, comp, by, bx, cur_intra)
+
+    def residual_block(self, cat: int, coeffs: np.ndarray, my: int,
+                       mx: int, *, comp: int = 0, by: int = 0, bx: int = 0,
+                       cur_intra: bool = True) -> int:
+        """coded_block_flag + significance map + levels (7.3.5.3.3).
+        ``coeffs`` already in scan order. Returns the cbf bit."""
+        c = self.c
+        cbf = int(np.any(coeffs))
+        ctx = _CBF_BASE + _CBF_CAT[cat] + self._cbf_inc(
+            cat, my, mx, comp, by, bx, cur_intra)
+        c.encode_bin(ctx, cbf)
+        if not cbf:
+            return 0
+        n = len(coeffs)
+        nz = [i for i in range(n) if coeffs[i]]
+        last = nz[-1]
+        for i in range(n - 1):
+            inc = min(i, 2) if cat == 3 else i
+            sig = int(coeffs[i] != 0)
+            c.encode_bin(_SIG_BASE + _SIGLAST_CAT[cat] + inc, sig)
+            if sig:
+                c.encode_bin(_LAST_BASE + _SIGLAST_CAT[cat] + inc,
+                             int(i == last))
+                if i == last:
+                    break
+        num_eq1 = 0
+        num_gt1 = 0
+        for i in reversed(nz):
+            val = abs(int(coeffs[i])) - 1
+            inc0 = 0 if num_gt1 > 0 else min(4, 1 + num_eq1)
+            base = _LVL_BASE + _LVL_CAT[cat]
+            c.encode_bin(base + inc0, 1 if val > 0 else 0)
+            if val > 0:
+                inc_gt = 5 + min(4, num_gt1)
+                prefix = min(val, 14)
+                for k in range(1, prefix):
+                    c.encode_bin(base + inc_gt, 1)
+                if val < 14:
+                    c.encode_bin(base + inc_gt, 0)
+                else:
+                    c.eg_bypass(val - 14, 0)
+                num_gt1 += 1
+            else:
+                num_eq1 += 1
+            c.encode_bypass(1 if coeffs[i] < 0 else 0)
+        return 1
+
+    # ---------------------------------------------------------- MB layer
+    def _mb_type_i16(self, my: int, mx: int, cbp_luma: int,
+                     cbp_chroma: int, luma_mode: int,
+                     ctx0: int, ctx_rest: int, with_inc: bool) -> None:
+        """The joint I_16x16 mb_type code (9.3.2.5): '1', terminate(0),
+        then cbp/pred-mode bins with positional ctx."""
+        c = self.c
+        st = self.st
+        if with_inc:
+            ca = 1 if mx > 0 and not st.skip[my, mx - 1] and \
+                st.intra[my, mx - 1] and st.i16[my, mx - 1] else 0
+            cb = 1 if my > 0 and not st.skip[my - 1, mx] and \
+                st.intra[my - 1, mx] and st.i16[my - 1, mx] else 0
+            c.encode_bin(ctx0 + ca + cb, 1)
+        else:
+            c.encode_bin(ctx0, 1)
+        c.encode_terminate(0)                    # not I_PCM
+        # fixed ctx per field (not per bin position — the chroma second
+        # bin is conditionally present but later ctxs do not shift)
+        c.encode_bin(ctx_rest, 1 if cbp_luma else 0)
+        c.encode_bin(ctx_rest + 1, 1 if cbp_chroma else 0)
+        if cbp_chroma:
+            c.encode_bin(ctx_rest + 2, 1 if cbp_chroma == 2 else 0)
+        c.encode_bin(ctx_rest + 3, (luma_mode >> 1) & 1)
+        c.encode_bin(ctx_rest + 4, luma_mode & 1)
+
+    def chroma_pred_mode(self, my: int, mx: int, mode: int) -> None:
+        st = self.st
+        ca = 1 if mx > 0 and st.intra[my, mx - 1] and \
+            st.chroma_mode[my, mx - 1] != 0 else 0
+        cb = 1 if my > 0 and st.intra[my - 1, mx] and \
+            st.chroma_mode[my - 1, mx] != 0 else 0
+        self.c.encode_bin(64 + ca + cb, 1 if mode > 0 else 0)
+        if mode > 0:
+            self.c.encode_bin(67, 1 if mode > 1 else 0)
+            if mode > 1:
+                self.c.encode_bin(67, 1 if mode > 2 else 0)
+
+    def qp_delta(self, value: int) -> None:
+        c = self.c
+        inc = 1 if self.st.prev_qp_delta_nz else 0
+        mapped = 2 * abs(value) - (1 if value > 0 else 0)
+        c.encode_bin(60 + inc, 1 if mapped > 0 else 0)
+        if mapped > 0:
+            c.tu(mapped - 1, 10 ** 9, [62, 63])
+        self.st.prev_qp_delta_nz = value != 0
+
+    def i16_residual(self, levels_like: dict, my: int, mx: int,
+                     cbp_luma: int, cbp_chroma: int,
+                     cur_intra: bool = True) -> None:
+        """The Intra16x16 residual block sequence (same order as
+        CAVLC's SliceEncoder.encode_macroblock)."""
+        st = self.st
+        luma_dc = levels_like["luma_dc"]
+        luma_ac = levels_like["luma_ac"]
+        chroma_dc = levels_like["chroma_dc"]
+        chroma_ac = levels_like["chroma_ac"]
+        st.cbf_lumadc[my, mx] = self.residual_block(
+            0, zigzag(luma_dc), my, mx, cur_intra=cur_intra)
+        if cbp_luma:
+            for by, bx in LUMA_BLOCK_ORDER:
+                cbf = self.residual_block(
+                    1, zigzag(luma_ac[by, bx])[1:], my, mx,
+                    by=by, bx=bx, cur_intra=cur_intra)
+                st.cbf_luma44[my * 4 + by, mx * 4 + bx] = cbf
+        if cbp_chroma > 0:
+            for comp in range(2):
+                st.cbf_chdc[comp, my, mx] = self.residual_block(
+                    3, chroma_dc[comp].reshape(-1), my, mx, comp=comp,
+                    cur_intra=cur_intra)
+        if cbp_chroma == 2:
+            for comp in range(2):
+                for by in range(2):
+                    for bx in range(2):
+                        cbf = self.residual_block(
+                            4, zigzag(chroma_ac[comp, by, bx])[1:], my, mx,
+                            comp=comp, by=by, bx=bx, cur_intra=cur_intra)
+                        st.cbf_ch44[comp, my * 2 + by, mx * 2 + bx] = cbf
+
+
+def _native_cabac(kind: str, arrays: list, mbh: int, mbw: int, qp: int,
+                  header: bytes) -> bytes | None:
+    """C fast path (native/h264_cabac_enc.c); None falls back to Python.
+    Both are bit-identical (tests/test_h264_cabac.py)."""
+    from vlog_tpu.native import get_lib
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    import ctypes
+
+    arrs = [np.ascontiguousarray(a, np.int32) for a in arrays]
+    scratch = np.zeros((mbh * mbw * 37,), np.int32)
+    cap = 64 + len(header) + mbh * mbw * (384 * 4)
+    out = np.empty(cap, np.uint8)
+    hdr = (np.frombuffer(header, np.uint8) if header
+           else np.empty(0, np.uint8))
+
+    def ptr(a, t=ctypes.c_int32):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    fn = (lib.vt_h264_cabac_i_slice if kind == "i"
+          else lib.vt_h264_cabac_p_slice)
+    n = fn(*(ptr(a) for a in arrs), mbh, mbw, qp,
+           ptr(hdr, ctypes.c_uint8), len(header), ptr(scratch),
+           ptr(out, ctypes.c_uint8), cap)
+    if n < 0:
+        return None
+    return out[:n].tobytes()
+
+
+def encode_p_slice_cabac(plevels: dict, *, qp: int, init_qp: int,
+                         frame_num: int,
+                         log2_max_frame_num: int = 8) -> syntax.NalUnit:
+    """Full P-slice NAL with CABAC (counterpart of cavlc.encode_p_slice:
+    P_Skip / P_L0_16x16, quarter-pel MVDs against the median predictor).
+
+    CABAC has no skip runs — every MB codes mb_skip_flag with a
+    neighbor-conditioned context."""
+    luma = plevels["luma"]
+    chroma_dc = plevels["chroma_dc"]
+    chroma_ac = plevels["chroma_ac"]
+    mv_q = plevels["mv"]
+    mbh, mbw = luma.shape[:2]
+
+    w = BitWriter()
+    syntax.write_slice_header(
+        w, first_mb=0, slice_qp=qp, init_qp=init_qp, idr=False,
+        frame_num=frame_num, log2_max_frame_num=log2_max_frame_num,
+        slice_type=syntax.SLICE_P, cabac=True)
+    w.byte_align(1)
+    header = w.getvalue()
+
+    rbsp = _native_cabac("p", [luma, chroma_dc, chroma_ac, mv_q],
+                         mbh, mbw, qp, header)
+    if rbsp is not None:
+        return syntax.NalUnit(syntax.NAL_SLICE, 3, rbsp)
+
+    c = H264Cabac(qp, i_slice=False)
+    coder = CabacSliceCoder(c, mbh, mbw)
+    st = coder.st
+    mvp = MvPredictor(mbh, mbw)
+    cbp8 = np.zeros((mbh * 2, mbw * 2), np.int32)   # luma bit per 8x8
+
+    def mb_cbp(my, mx):
+        bits = 0
+        for i8 in range(4):
+            gy, gx = _BLK44[i8]
+            if np.any(luma[my, mx, 2 * gy:2 * gy + 2, 2 * gx:2 * gx + 2]):
+                bits |= 1 << i8
+        if np.any(chroma_ac[:, my, mx]):
+            return bits | (2 << 4)
+        if np.any(chroma_dc[:, my, mx]):
+            return bits | (1 << 4)
+        return bits
+
+    for my in range(mbh):
+        for mx in range(mbw):
+            mvx, mvy = int(mv_q[my, mx, 1]), int(mv_q[my, mx, 0])
+            cbp = mb_cbp(my, mx)
+            smx, smy = mvp.skip_mv(my, mx)
+            skip = cbp == 0 and (mvx, mvy) == (smx, smy)
+            ca = 1 if mx > 0 and not st.skip[my, mx - 1] else 0
+            cb = 1 if my > 0 and not st.skip[my - 1, mx] else 0
+            c.encode_bin(11 + ca + cb, 1 if skip else 0)
+            if skip:
+                mvp.mvs[my, mx] = (smx, smy)
+                st.skip[my, mx] = True
+                c.encode_terminate(
+                    1 if my == mbh - 1 and mx == mbw - 1 else 0)
+                continue
+
+            c.encode_bin(14, 0)                 # P type
+            c.encode_bin(15, 0)                 # {16x16, 8x8}
+            c.encode_bin(16, 0)                 # P_L0_16x16
+
+            pmx, pmy = mvp.mv_pred(my, mx)
+            mvp.mvs[my, mx] = (mvx, mvy)
+            for comp, (mvd, base) in enumerate(
+                    (((mvx - pmx), 40), ((mvy - pmy), 47))):
+                amvd = 0
+                if mx > 0:
+                    amvd += int(st.mvd[my, mx - 1, comp])
+                if my > 0:
+                    amvd += int(st.mvd[my - 1, mx, comp])
+                inc = 0 if amvd < 3 else (1 if amvd <= 32 else 2)
+                val = abs(mvd)
+                c.encode_bin(base + inc, 1 if val > 0 else 0)
+                if val > 0:
+                    prefix = min(val, 9)
+                    for k in range(1, prefix):
+                        c.encode_bin(base + 2 + min(k, 4), 1)
+                    if val < 9:
+                        c.encode_bin(base + 2 + min(prefix, 4), 0)
+                    else:
+                        c.eg_bypass(val - 9, 3)
+                    c.encode_bypass(1 if mvd < 0 else 0)
+                st.mvd[my, mx, comp] = val
+
+            # coded_block_pattern: 4 luma bins + up to 2 chroma bins
+            for i8 in range(4):
+                gy, gx = _BLK44[i8]
+                y8, x8 = my * 2 + gy, mx * 2 + gx
+                a = 1 if x8 > 0 and cbp8[y8, x8 - 1] == 0 else 0
+                b = 1 if y8 > 0 and cbp8[y8 - 1, x8] == 0 else 0
+                bit = (cbp >> i8) & 1
+                c.encode_bin(73 + a + 2 * b, bit)
+                cbp8[y8, x8] = bit
+            cbp_chroma = cbp >> 4
+            ca = 1 if mx > 0 and st.cbp_chroma[my, mx - 1] != 0 else 0
+            cb = 1 if my > 0 and st.cbp_chroma[my - 1, mx] != 0 else 0
+            c.encode_bin(77 + ca + 2 * cb, 1 if cbp_chroma else 0)
+            if cbp_chroma:
+                ca = 1 if mx > 0 and st.cbp_chroma[my, mx - 1] == 2 else 0
+                cb = 1 if my > 0 and st.cbp_chroma[my - 1, mx] == 2 else 0
+                c.encode_bin(81 + ca + 2 * cb,
+                             1 if cbp_chroma == 2 else 0)
+            st.cbp_chroma[my, mx] = cbp_chroma
+
+            if cbp:
+                coder.qp_delta(0)
+                # luma 4x4 blocks in quadrant order for set cbp bits
+                for i8 in range(4):
+                    oy, ox = _BLK44[i8]
+                    for dy, dx in _BLK44:
+                        by, bx = 2 * oy + dy, 2 * ox + dx
+                        if not (cbp >> i8) & 1:
+                            st.cbf_luma44[my * 4 + by, mx * 4 + bx] = 0
+                            continue
+                        cbf = coder.residual_block(
+                            2, zigzag(luma[my, mx, by, bx]), my, mx,
+                            by=by, bx=bx, cur_intra=False)
+                        st.cbf_luma44[my * 4 + by, mx * 4 + bx] = cbf
+                if cbp_chroma > 0:
+                    for comp in range(2):
+                        st.cbf_chdc[comp, my, mx] = coder.residual_block(
+                            3, chroma_dc[comp, my, mx].reshape(-1),
+                            my, mx, comp=comp, cur_intra=False)
+                if cbp_chroma == 2:
+                    for comp in range(2):
+                        for by in range(2):
+                            for bx in range(2):
+                                cbf = coder.residual_block(
+                                    4, zigzag(
+                                        chroma_ac[comp, my, mx, by, bx]
+                                    )[1:], my, mx, comp=comp, by=by,
+                                    bx=bx, cur_intra=False)
+                                st.cbf_ch44[
+                                    comp, my * 2 + by, mx * 2 + bx] = cbf
+            c.encode_terminate(
+                1 if my == mbh - 1 and mx == mbw - 1 else 0)
+
+    return syntax.NalUnit(syntax.NAL_SLICE, 3, header + c.getvalue())
+
+
+def encode_slice_cabac(levels, *, qp: int, init_qp: int,
+                       frame_num: int = 0, idr: bool = True,
+                       idr_pic_id: int = 0,
+                       log2_max_frame_num: int = 8) -> syntax.NalUnit:
+    """Full I-slice NAL with CABAC entropy (counterpart of
+    cavlc.encode_slice)."""
+    mbh, mbw = levels.mb_height, levels.mb_width
+    w = BitWriter()
+    syntax.write_slice_header(
+        w, first_mb=0, slice_qp=qp, init_qp=init_qp, idr=idr,
+        frame_num=frame_num, idr_pic_id=idr_pic_id,
+        log2_max_frame_num=log2_max_frame_num, cabac=True)
+    w.byte_align(1)                     # cabac_alignment_one_bit(s)
+    header = w.getvalue()
+    nal_type = syntax.NAL_IDR if idr else syntax.NAL_SLICE
+
+    rbsp = _native_cabac(
+        "i", [levels.luma_dc, levels.luma_ac, levels.chroma_dc,
+              levels.chroma_ac], mbh, mbw, qp, header)
+    if rbsp is not None:
+        return syntax.NalUnit(nal_type, 3, rbsp)
+
+    c = H264Cabac(qp, i_slice=True)
+    coder = CabacSliceCoder(c, mbh, mbw)
+    st = coder.st
+    for my in range(mbh):
+        for mx in range(mbw):
+            luma_ac = levels.luma_ac[my, mx]
+            chroma_dc = levels.chroma_dc[:, my, mx]
+            chroma_ac = levels.chroma_ac[:, my, mx]
+            cbp_luma = 15 if np.any(luma_ac) else 0
+            cbp_chroma = (2 if np.any(chroma_ac)
+                          else (1 if np.any(chroma_dc) else 0))
+            luma_mode = 2 if my == 0 else 0
+            chroma_mode = 0 if my == 0 else 2
+            coder._mb_type_i16(my, mx, cbp_luma, cbp_chroma, luma_mode,
+                               3, 6, with_inc=True)
+            coder.chroma_pred_mode(my, mx, chroma_mode)
+            coder.qp_delta(0)
+            coder.i16_residual(
+                {"luma_dc": levels.luma_dc[my, mx], "luma_ac": luma_ac,
+                 "chroma_dc": chroma_dc, "chroma_ac": chroma_ac},
+                my, mx, cbp_luma, cbp_chroma)
+            st.intra[my, mx] = True
+            st.i16[my, mx] = True
+            st.cbp_luma[my, mx] = cbp_luma
+            st.cbp_chroma[my, mx] = cbp_chroma
+            st.chroma_mode[my, mx] = chroma_mode
+            c.encode_terminate(
+                1 if my == mbh - 1 and mx == mbw - 1 else 0)
+    return syntax.NalUnit(nal_type, 3, header + c.getvalue())

@@ -223,9 +223,7 @@ def parse_pps(rbsp: bytes) -> Pps:
     r = BitReader(rbsp)
     pps_id = r.read_ue()
     sps_id = r.read_ue()
-    entropy = r.read_bit()
-    if entropy:
-        raise UnsupportedStream("CABAC not supported (CAVLC only)")
+    entropy = r.read_bit()      # 1 = CABAC (codecs/h264/cabac_dec.py)
     r.read_bit()  # bottom_field_pic_order_in_frame_present
     if r.read_ue() != 0:
         raise UnsupportedStream("slice groups not supported")
@@ -284,6 +282,9 @@ def parse_slice_header(r: BitReader, sps: Sps, pps: Pps, nal_type: int,
         else:
             if r.read_bit():
                 raise UnsupportedStream("adaptive ref pic marking not supported")
+    if pps.entropy_coding_mode and is_p:
+        if r.read_ue() != 0:             # cabac_init_idc
+            raise UnsupportedStream("cabac_init_idc != 0 not supported")
     qp = pps.init_qp + r.read_se()
     if pps.deblocking_filter_control_present:
         idc = r.read_ue()
@@ -759,12 +760,22 @@ class H264Decoder:
             raise DecodeError("slice before SPS/PPS")
         r = BitReader(rbsp)
         header = parse_slice_header(r, self.sps, self.pps, nal_type, ref_idc)
-        if header.slice_type % 5 == 0:
+        is_p = header.slice_type % 5 == 0
+        if self.pps.entropy_coding_mode:
+            from vlog_tpu.codecs.h264.cabac_dec import (
+                decode_p_slice_data_cabac, decode_slice_data_cabac)
+
+            r.byte_align()               # cabac_alignment_one_bit(s)
+            start = (len(rbsp) * 8 - r.bits_remaining) // 8
+            data = rbsp[start:]
+            levels = (decode_p_slice_data_cabac(data, self.sps, header)
+                      if is_p else
+                      decode_slice_data_cabac(data, self.sps, header))
+        elif is_p:
             levels = decode_p_slice_data(r, self.sps, header)
-            levels["is_p"] = True
         else:
             levels = decode_slice_data(r, self.sps, header)
-            levels["is_p"] = False
+        levels["is_p"] = is_p
         levels["qp"] = header.qp
         return levels
 

@@ -147,12 +147,13 @@ def make_sps(cfg: SpsConfig, sps_id: int = 0) -> NalUnit:
     return NalUnit(NAL_SPS, 3, w.getvalue())
 
 
-def make_pps(pps_id: int = 0, sps_id: int = 0, init_qp: int = 26) -> NalUnit:
-    """pic_parameter_set_rbsp (spec 7.3.2.2), CAVLC, deblock-controllable."""
+def make_pps(pps_id: int = 0, sps_id: int = 0, init_qp: int = 26,
+             cabac: bool = False) -> NalUnit:
+    """pic_parameter_set_rbsp (spec 7.3.2.2), deblock-controllable."""
     w = BitWriter()
     w.write_ue(pps_id)
     w.write_ue(sps_id)
-    w.write_bit(0)            # entropy_coding_mode_flag: CAVLC
+    w.write_bit(1 if cabac else 0)   # entropy_coding_mode_flag
     w.write_bit(0)            # bottom_field_pic_order_in_frame_present
     w.write_ue(0)             # num_slice_groups_minus1
     w.write_ue(0)             # num_ref_idx_l0_default_active_minus1
@@ -184,6 +185,7 @@ def write_slice_header(
     idr_pic_id: int = 0,
     log2_max_frame_num: int = 8,
     slice_type: int = SLICE_I,
+    cabac: bool = False,
 ) -> None:
     """slice_header (spec 7.3.3) for our stream shape.
 
@@ -208,6 +210,8 @@ def write_slice_header(
         w.write_bit(0)   # long_term_reference_flag
     else:
         w.write_bit(0)   # adaptive_ref_pic_marking_mode_flag
+    if cabac and is_p:
+        w.write_ue(0)    # cabac_init_idc
     w.write_se(slice_qp - init_qp)                 # slice_qp_delta
     w.write_ue(1)                                  # disable_deblocking_filter_idc
     # idc==1 -> no alpha/beta offsets

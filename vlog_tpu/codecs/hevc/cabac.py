@@ -49,12 +49,14 @@ def init_states(slice_qp: int, init_type: int = 0) -> tuple[list, list]:
     return pstate, mps
 
 
-class CabacEncoder:
-    """H.265 9.3.4 arithmetic encoding engine (encoder-side mirror of
-    the decoding process; identical renormalization flow)."""
+class ArithEncoder:
+    """The shared binary arithmetic engine (identical in H.264 9.3.4 and
+    H.265 9.3.4 — same range/transition tables, renorm, bypass, and
+    terminate/flush). Subclasses provide the context initialization."""
 
-    def __init__(self, slice_qp: int, init_type: int = 0) -> None:
-        self.pstate, self.mps = init_states(slice_qp, init_type)
+    def __init__(self, pstate: list, mps: list) -> None:
+        self.pstate = pstate
+        self.mps = mps
         self.low = 0
         self.range = 510
         self.outstanding = 0
@@ -153,3 +155,10 @@ class CabacEncoder:
         if self._nbits:
             out.append(self._cur << (8 - self._nbits))
         return bytes(out)
+
+
+class CabacEncoder(ArithEncoder):
+    """H.265 contexts over the shared engine (I/P initTypes)."""
+
+    def __init__(self, slice_qp: int, init_type: int = 0) -> None:
+        super().__init__(*init_states(slice_qp, init_type))

@@ -222,6 +222,12 @@ GOP_MODE: str = _env_str("VLOG_GOP_MODE", "p")
 GOP_LEN: int = _env_int("VLOG_GOP_LEN", 24, lo=1, hi=256)
 # Integer motion search radius (pels).
 MOTION_SEARCH_RADIUS: int = _env_int("VLOG_MOTION_SEARCH", 8, lo=1, hi=32)
+# H.264 entropy coder: "cabac" (default — 10-45% smaller streams, the
+# profile x264 ships by default) or "cavlc" (~2.5x faster host entropy
+# when the host stage, not the device, is the bottleneck). Both have
+# native C coders. Changing this mid-tree invalidates partial resume
+# state (segments must share one PPS); re-transcode with force.
+H264_ENTROPY: str = _env_str("VLOG_H264_ENTROPY", "cabac")
 # Frames per device-batch staged to HBM per encode dispatch. GOP size for the
 # all-intra encoder is a packaging concept (segment boundary), so this is a
 # pure throughput/memory knob.

@@ -33,14 +33,19 @@ def _compile() -> Path:
     src = _DIR / "cavlc.c"
     jpeg_src = _DIR / "jpeg_pack.c"
     hevc_src = _DIR / "hevc_cabac.c"
+    h264c_src = _DIR / "h264_cabac_enc.c"
+    engine_hdr = _DIR / "cabac_engine.h"
     so = _BUILD / "libvtnative.so"
-    from vlog_tpu.codecs.h264 import cavlc_tables
+    from vlog_tpu.codecs.h264 import cabac_ctx_tables, cavlc_tables
     from vlog_tpu.codecs.hevc import tables as hevc_tables
 
-    stamp_inputs = [src, jpeg_src, hevc_src, _DIR / "gen_tables.py",
+    stamp_inputs = [src, jpeg_src, hevc_src, h264c_src, engine_hdr,
+                    _DIR / "gen_tables.py",
                     _DIR / "gen_hevc_tables.py",
+                    _DIR / "gen_h264_cabac_tables.py",
                     Path(cavlc_tables.__file__),   # real inputs of the
-                    Path(hevc_tables.__file__)]    # two generators
+                    Path(hevc_tables.__file__),    # generators
+                    Path(cabac_ctx_tables.__file__)]
     if so.exists() and all(so.stat().st_mtime >= p.stat().st_mtime
                            for p in stamp_inputs):
         return so
@@ -49,6 +54,8 @@ def _compile() -> Path:
     # Per-process scratch names: multiple worker processes may race the
     # first build; each builds privately and os.replace publishes
     # atomically (last writer wins, all writers produce identical bits).
+    from vlog_tpu.native.gen_h264_cabac_tables import (
+        generate_c_header as gen_h264_hdr)
     from vlog_tpu.native.gen_hevc_tables import generate_c_header
 
     pid = os.getpid()
@@ -56,19 +63,23 @@ def _compile() -> Path:
     inc.write_text(generate())
     hevc_inc = _BUILD / f"hevc_tables.{pid}.inc"
     hevc_inc.write_text(generate_c_header())
+    h264c_inc = _BUILD / f"h264_cabac_tables.{pid}.inc"
+    h264c_inc.write_text(gen_h264_hdr())
     tmp_so = _BUILD / f"libvtnative.{pid}.so.tmp"
     cc = os.environ.get("CC", "g++")
     cmd = [cc, "-O3", "-fPIC", "-shared", "-x", "c++",
            f"-DVT_TABLES_INC=\"{inc.name}\"",
            f"-DVT_HEVC_TABLES_INC=\"{hevc_inc.name}\"",
-           str(src), str(jpeg_src), str(hevc_src),
-           "-I", str(_BUILD), "-o", str(tmp_so)]
+           f"-DVT_H264_CABAC_INC=\"{h264c_inc.name}\"",
+           str(src), str(jpeg_src), str(hevc_src), str(h264c_src),
+           "-I", str(_BUILD), "-I", str(_DIR), "-o", str(tmp_so)]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeBuildError(f"native build failed: {proc.stderr[:2000]}")
     os.replace(tmp_so, so)
     inc.rename(_BUILD / "cavlc_tables.inc")        # for reference/debugging
     hevc_inc.rename(_BUILD / "hevc_tables.inc")
+    h264c_inc.rename(_BUILD / "h264_cabac_tables.inc")
     return so
 
 
@@ -106,6 +117,24 @@ def get_lib() -> ctypes.CDLL | None:
             ctypes.c_int, ctypes.c_int,              # mbh, mbw
             i8, ctypes.c_int64,                      # header bytes
             ctypes.c_uint32, ctypes.c_int,           # header tail bits
+            i32,                                     # scratch
+            i8, ctypes.c_int64,                      # out buffer
+        ]
+        lib.vt_h264_cabac_i_slice.restype = ctypes.c_int64
+        lib.vt_h264_cabac_i_slice.argtypes = [
+            i32, i32, i32, i32,                      # level arrays
+            ctypes.c_int, ctypes.c_int,              # mbh, mbw
+            ctypes.c_int,                            # slice qp
+            i8, ctypes.c_int64,                      # header bytes
+            i32,                                     # scratch
+            i8, ctypes.c_int64,                      # out buffer
+        ]
+        lib.vt_h264_cabac_p_slice.restype = ctypes.c_int64
+        lib.vt_h264_cabac_p_slice.argtypes = [
+            i32, i32, i32, i32,                      # luma, cdc, cac, mv
+            ctypes.c_int, ctypes.c_int,              # mbh, mbw
+            ctypes.c_int,                            # slice qp
+            i8, ctypes.c_int64,                      # header bytes
             i32,                                     # scratch
             i8, ctypes.c_int64,                      # out buffer
         ]

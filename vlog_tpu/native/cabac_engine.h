@@ -1,0 +1,113 @@
+/* Shared CABAC arithmetic engine (H.264 9.3.4 == H.265 9.3.4).
+ *
+ * Used by hevc_cabac.c and h264_cabac_enc.c; the range/transition
+ * tables come from the HEVC generated header (they are the same
+ * normative tables in both standards). Context-count is the max of the
+ * two standards (H.264's 1024); callers initialize only their range.
+ */
+#ifndef VT_CABAC_ENGINE_H
+#define VT_CABAC_ENGINE_H
+
+#include <stdint.h>
+#include <string.h>
+
+typedef struct {
+    uint32_t low, range;
+    int outstanding, first_bit;
+    uint8_t *out;
+    int64_t cap, nbytes;
+    int cur, nbits;
+    int overflow;
+    uint8_t pstate[1024], mps[1024];
+} Cabac;
+
+static void cab_emit(Cabac *c, int bit) {
+    c->cur = (c->cur << 1) | bit;
+    if (++c->nbits == 8) {
+        if (c->nbytes < c->cap) c->out[c->nbytes++] = (uint8_t)c->cur;
+        else c->overflow = 1;
+        c->cur = 0; c->nbits = 0;
+    }
+}
+
+static void cab_put_bit(Cabac *c, int bit) {
+    if (c->first_bit) c->first_bit = 0;
+    else cab_emit(c, bit);
+    while (c->outstanding > 0) { cab_emit(c, 1 - bit); c->outstanding--; }
+}
+
+static void cab_renorm(Cabac *c) {
+    while (c->range < 256) {
+        if (c->low >= 512) { cab_put_bit(c, 1); c->low -= 512; }
+        else if (c->low < 256) cab_put_bit(c, 0);
+        else { c->outstanding++; c->low -= 256; }
+        c->low <<= 1; c->range <<= 1;
+    }
+}
+
+static void cab_start(Cabac *c, uint8_t *out, int64_t cap) {
+    c->low = 0; c->range = 510;
+    c->outstanding = 0; c->first_bit = 1;
+    c->out = out; c->cap = cap; c->nbytes = 0;
+    c->cur = 0; c->nbits = 0; c->overflow = 0;
+}
+
+/* tables provided by the including .c file's generated header */
+static void cab_bin(Cabac *c, int ctx, int bin) {
+    int p = c->pstate[ctx];
+    uint32_t rlps = HEVC_LPS[p * 4 + ((c->range >> 6) & 3)];
+    c->range -= rlps;
+    if (bin != c->mps[ctx]) {
+        c->low += c->range; c->range = rlps;
+        if (p == 0) c->mps[ctx] ^= 1;
+        c->pstate[ctx] = HEVC_LPS_NEXT[p];
+    } else {
+        c->pstate[ctx] = HEVC_MPS_NEXT[p];
+    }
+    cab_renorm(c);
+}
+
+static void cab_bypass(Cabac *c, int bin) {
+    c->low <<= 1;
+    if (bin) c->low += c->range;
+    if (c->low >= 1024) { cab_put_bit(c, 1); c->low -= 1024; }
+    else if (c->low < 512) cab_put_bit(c, 0);
+    else { c->outstanding++; c->low -= 512; }
+}
+
+static void cab_bypass_bits(Cabac *c, uint32_t v, int width) {
+    for (int i = width - 1; i >= 0; i--) cab_bypass(c, (v >> i) & 1);
+}
+
+static void cab_terminate(Cabac *c, int bin) {
+    c->range -= 2;
+    if (bin) {
+        c->low += c->range; c->range = 2;
+        cab_renorm(c);
+        cab_put_bit(c, (c->low >> 9) & 1);
+        cab_emit(c, (c->low >> 8) & 1);
+        cab_emit(c, 1);                  /* rbsp stop bit */
+    } else {
+        cab_renorm(c);
+    }
+}
+
+static int64_t cab_finish(Cabac *c) {
+    if (c->nbits) {
+        if (c->nbytes < c->cap)
+            c->out[c->nbytes++] = (uint8_t)(c->cur << (8 - c->nbits));
+        else c->overflow = 1;
+        c->cur = 0; c->nbits = 0;
+    }
+    return c->overflow ? -1 : c->nbytes;
+}
+
+/* k-th order Exp-Golomb in bypass (suffixes of UEG0/UEG3 and HEVC
+ * mvd/coeff escapes share this shape) */
+static void cab_eg_bypass(Cabac *c, int value, int k) {
+    while (value >= (1 << k)) { cab_bypass(c, 1); value -= 1 << k; k++; }
+    cab_bypass(c, 0);
+    for (int i = k - 1; i >= 0; i--) cab_bypass(c, (value >> i) & 1);
+}
+
+#endif /* VT_CABAC_ENGINE_H */

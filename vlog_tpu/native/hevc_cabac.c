@@ -18,47 +18,18 @@
 #define VT_HEVC_TABLES_INC "hevc_tables.inc"
 #endif
 #include VT_HEVC_TABLES_INC
+#include "cabac_engine.h"
 
-/* ---------------------------------------------------------------- engine */
-
-typedef struct {
-    uint32_t low, range;
-    int outstanding, first_bit;
-    uint8_t *out;
-    int64_t cap, nbytes;
-    int cur, nbits;
-    int overflow;
-    uint8_t pstate[199], mps[199];
-} Cabac;
-
-static void emit(Cabac *c, int bit) {
-    c->cur = (c->cur << 1) | bit;
-    if (++c->nbits == 8) {
-        if (c->nbytes < c->cap) c->out[c->nbytes++] = (uint8_t)c->cur;
-        else c->overflow = 1;
-        c->cur = 0; c->nbits = 0;
-    }
-}
-
-static void put_bit(Cabac *c, int bit) {
-    if (c->first_bit) c->first_bit = 0;
-    else emit(c, bit);
-    while (c->outstanding > 0) { emit(c, 1 - bit); c->outstanding--; }
-}
-
-static void renorm(Cabac *c) {
-    while (c->range < 256) {
-        if (c->low >= 512) { put_bit(c, 1); c->low -= 512; }
-        else if (c->low < 256) put_bit(c, 0);
-        else { c->outstanding++; c->low -= 256; }
-        c->low <<= 1; c->range <<= 1;
-    }
-}
+/* engine lives in cabac_engine.h (shared with h264_cabac_enc.c) */
+#define enc_bin cab_bin
+#define enc_bypass cab_bypass
+#define enc_bypass_bits cab_bypass_bits
+#define enc_terminate cab_terminate
+#define cabac_finish cab_finish
 
 static void cabac_init(Cabac *c, int qp, int init_type, uint8_t *out,
                        int64_t cap) {
-    memset(c, 0, sizeof(*c));
-    c->range = 510; c->first_bit = 1; c->out = out; c->cap = cap;
+    cab_start(c, out, cap);
     if (qp < 0) qp = 0; if (qp > 51) qp = 51;
     for (int i = 0; i < 199; i++) {
         int init_value = init_type ? HEVC_INIT_P[i] : HEVC_INIT_I[i];
@@ -69,55 +40,6 @@ static void cabac_init(Cabac *c, int qp, int init_type, uint8_t *out,
         if (pre <= 63) { c->pstate[i] = (uint8_t)(63 - pre); c->mps[i] = 0; }
         else { c->pstate[i] = (uint8_t)(pre - 64); c->mps[i] = 1; }
     }
-}
-
-static void enc_bin(Cabac *c, int ctx, int bin) {
-    int p = c->pstate[ctx];
-    uint32_t rlps = HEVC_LPS[p * 4 + ((c->range >> 6) & 3)];
-    c->range -= rlps;
-    if (bin != c->mps[ctx]) {
-        c->low += c->range; c->range = rlps;
-        if (p == 0) c->mps[ctx] ^= 1;
-        c->pstate[ctx] = HEVC_LPS_NEXT[p];
-    } else {
-        c->pstate[ctx] = HEVC_MPS_NEXT[p];
-    }
-    renorm(c);
-}
-
-static void enc_bypass(Cabac *c, int bin) {
-    c->low <<= 1;
-    if (bin) c->low += c->range;
-    if (c->low >= 1024) { put_bit(c, 1); c->low -= 1024; }
-    else if (c->low < 512) put_bit(c, 0);
-    else { c->outstanding++; c->low -= 512; }
-}
-
-static void enc_bypass_bits(Cabac *c, uint32_t v, int width) {
-    for (int i = width - 1; i >= 0; i--) enc_bypass(c, (v >> i) & 1);
-}
-
-static void enc_terminate(Cabac *c, int bin) {
-    c->range -= 2;
-    if (bin) {
-        c->low += c->range; c->range = 2;
-        renorm(c);
-        put_bit(c, (c->low >> 9) & 1);
-        emit(c, (c->low >> 8) & 1);
-        emit(c, 1);                      /* rbsp stop bit */
-    } else {
-        renorm(c);
-    }
-}
-
-static int64_t cabac_finish(Cabac *c) {
-    if (c->nbits) {
-        if (c->nbytes < c->cap)
-            c->out[c->nbytes++] = (uint8_t)(c->cur << (8 - c->nbits));
-        else c->overflow = 1;
-        c->cur = 0; c->nbits = 0;
-    }
-    return c->overflow ? -1 : c->nbytes;
 }
 
 /* ------------------------------------------------------------- residual */
