@@ -232,9 +232,15 @@ def test_verify_output_semantic_gates(tmp_path, y4m_source):
         return RunResult(rungs=[rung], frames_processed=1, duration_s=1.0)
 
     with _pytest.raises(VerificationError, match="target"):
+        # segment_count >= 5: the gate only judges settled encodes
         verify_output(master, with_rung(
-            achieved_bitrate=10_000_000, target_bitrate=600_000),
+            achieved_bitrate=10_000_000, target_bitrate=600_000,
+            segment_count=6),
             expect_cmaf=True)
+    # too short to judge: calibration transient must not fail the job
+    verify_output(master, with_rung(
+        achieved_bitrate=10_000_000, target_bitrate=600_000,
+        segment_count=2), expect_cmaf=True)
     with _pytest.raises(VerificationError, match="floor"):
         verify_output(master, with_rung(mean_psnr_y=5.0), expect_cmaf=True)
     with _pytest.raises(VerificationError, match="variant"):
