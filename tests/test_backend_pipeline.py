@@ -249,3 +249,35 @@ def test_verify_output_semantic_gates(tmp_path, y4m_source):
     # must not trip the gates
     verify_output(master, with_rung(mean_psnr_y=None, target_bitrate=0),
                   expect_cmaf=True)
+
+
+def test_resume_rejects_mismatched_init(tmp_path, y4m_source):
+    """A partial tree written under a different encoder configuration
+    (e.g. the entropy coder changed between runs) must restart from
+    segment 0, not append CABAC slices to a CAVLC PPS."""
+    import vlog_tpu.config as _cfg
+
+    from vlog_tpu.backends import select_backend
+    from vlog_tpu.media.probe import get_video_info
+
+    be = select_backend()
+    info = get_video_info(y4m_source)
+    out = tmp_path / "out"
+    old = _cfg.H264_ENTROPY
+    try:
+        _cfg.H264_ENTROPY = "cavlc"
+        plan = be.plan(info, None, out, thumbnail=False)
+        be.run(plan, resume=False)
+        seg = next((out / plan.rungs[0].name).glob("segment_*.m4s"))
+        first_mtime = seg.stat().st_mtime_ns
+
+        # same config: resume keeps the segments (no re-encode)
+        be.run(be.plan(info, None, out, thumbnail=False), resume=True)
+        assert seg.stat().st_mtime_ns == first_mtime
+
+        # flipped entropy: the init differs -> segments re-encoded
+        _cfg.H264_ENTROPY = "cabac"
+        be.run(be.plan(info, None, out, thumbnail=False), resume=True)
+        assert seg.stat().st_mtime_ns != first_mtime
+    finally:
+        _cfg.H264_ENTROPY = old

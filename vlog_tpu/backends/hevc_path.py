@@ -56,6 +56,7 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
     seg_durs: dict[str, list[float]] = {}
     bytes_written: dict[str, int] = {}
     psnr_acc: dict[str, list[float]] = {}
+    init_matched: dict[str, bool] = {}
     for rung in plan.rungs:
         enc = HevcEncoder(width=rung.width, height=rung.height,
                           fps_num=plan.fps_num, fps_den=plan.fps_den,
@@ -68,7 +69,12 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
             width=rung.width, height=rung.height)
         rdir = out / rung.name
         rdir.mkdir(parents=True, exist_ok=True)
-        atomic_write_bytes(rdir / "init.mp4", init_segment(tracks[rung.name]))
+        init = init_segment(tracks[rung.name])
+        try:
+            init_matched[rung.name] = (rdir / "init.mp4").read_bytes() == init
+        except OSError:
+            init_matched[rung.name] = False
+        atomic_write_bytes(rdir / "init.mp4", init)
         seg_counts[rung.name] = 0
         seg_durs[rung.name] = []
         bytes_written[rung.name] = 0
@@ -81,7 +87,8 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         if resume and src.exact_seek:
             start_segment = backend._resume_scan(plan, out, timescale,
                                                  seg_counts, seg_durs,
-                                                 bytes_written)
+                                                 bytes_written,
+                                                 init_matched)
         start_frame = start_segment * frames_per_seg
 
         from concurrent.futures import ThreadPoolExecutor

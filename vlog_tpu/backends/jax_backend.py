@@ -201,6 +201,7 @@ class JaxBackend:
         seg_durs: dict[str, list[float]] = {}
         bytes_written: dict[str, int] = {}
         psnr_acc: dict[str, list[float]] = {}
+        init_matched: dict[str, bool] = {}
         for rung in plan.rungs:
             enc = H264Encoder(width=rung.width, height=rung.height,
                               fps_num=plan.fps_num, fps_den=plan.fps_den,
@@ -215,8 +216,13 @@ class JaxBackend:
             rdir = out / rung.name
             rdir.mkdir(parents=True, exist_ok=True)
             if not ts_mode:
-                atomic_write_bytes(rdir / "init.mp4",
-                                   init_segment(tracks[rung.name]))
+                init = init_segment(tracks[rung.name])
+                try:
+                    init_matched[rung.name] = (
+                        (rdir / "init.mp4").read_bytes() == init)
+                except OSError:
+                    init_matched[rung.name] = False
+                atomic_write_bytes(rdir / "init.mp4", init)
             seg_counts[rung.name] = 0
             seg_durs[rung.name] = []
             bytes_written[rung.name] = 0
@@ -237,7 +243,7 @@ class JaxBackend:
                 plan, progress_cb, resume, t0, src, total, out, fps,
                 frames_per_seg, timescale, frame_dur, ts_mode, seg_ext,
                 encoders, tracks, seg_counts, seg_durs, bytes_written,
-                psnr_acc)
+                psnr_acc, init_matched)
         except BaseException:
             src.close()
             raise
@@ -245,12 +251,13 @@ class JaxBackend:
     def _run_with_source(self, plan, progress_cb, resume, t0, src, total,
                          out, fps, frames_per_seg, timescale, frame_dur,
                          ts_mode, seg_ext, encoders, tracks, seg_counts,
-                         seg_durs, bytes_written, psnr_acc) -> RunResult:
+                         seg_durs, bytes_written, psnr_acc,
+                         init_matched=None) -> RunResult:
         start_segment = 0
         if resume and not ts_mode and src.exact_seek:
             start_segment = self._resume_scan(plan, out, timescale,
                                               seg_counts, seg_durs,
-                                              bytes_written)
+                                              bytes_written, init_matched)
         start_frame = start_segment * frames_per_seg
 
         pending: dict[str, list[Sample]] = {r.name: [] for r in plan.rungs}
@@ -630,12 +637,23 @@ class JaxBackend:
 
     # ------------------------------------------------------------------
     def _resume_scan(self, plan, out, timescale, seg_counts, seg_durs,
-                     bytes_written) -> int:
+                     bytes_written, init_matched=None) -> int:
         """Reconstruct per-rung segment state from disk; returns the
         first segment index every rung still needs (shared by the H.264
-        and HEVC paths — both emit the same CMAF tree)."""
-        per_rung = {r.name: self._existing_segments(out / r.name)
-                    for r in plan.rungs}
+        and HEVC paths — both emit the same CMAF tree).
+
+        ``init_matched``: rung name -> True when the init segment on
+        disk before this run matched the one this run writes. Segments
+        from a run with a different init (entropy mode, QP base, SPS
+        shape changed between runs) cannot be appended to — they
+        reference another PPS — so such rungs restart from segment 0."""
+        per_rung = {}
+        for r in plan.rungs:
+            existing = self._existing_segments(out / r.name)
+            if existing and init_matched is not None \
+                    and not init_matched.get(r.name, False):
+                existing = []
+            per_rung[r.name] = existing
         start_segment = min(len(d) for d in per_rung.values())
         for rung in plan.rungs:
             durs = per_rung[rung.name][:start_segment]
