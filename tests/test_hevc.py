@@ -365,6 +365,105 @@ def test_p_intra_fallback_ctu(hevcdec, tmp_path):
     _ = cqp  # chroma QP unused: the intra CTB codes no chroma residual
 
 
+def test_p_two_part_ctu_oracle(hevcdec, tmp_path):
+    """2NxN / Nx2N inter CUs (pslice.write_ctu_inter_2part): per-PU
+    AMVP over the 16-cell grid, min-size part_mode binarization, and
+    the forced transform split (four TU16 luma + 8x8 chroma sub-TUs)
+    all decode bit-exactly in libavcodec."""
+    from vlog_tpu.codecs.hevc import syntax
+    from vlog_tpu.codecs.hevc.encoder import encode_frame
+    from vlog_tpu.codecs.hevc.pslice import PSliceWriter, p_nal
+    from vlog_tpu.codecs.hevc.transform import (chroma_qp, dequantize,
+                                                inverse_transform)
+
+    w, h, qp = 96, 64, 30
+    rng = np.random.default_rng(7)
+    y0 = rng.integers(40, 216, (h, w)).astype(np.uint8)
+    u0 = rng.integers(90, 166, (h // 2, w // 2)).astype(np.uint8)
+    v0 = rng.integers(90, 166, (h // 2, w // 2)).astype(np.uint8)
+    fr = encode_frame(y0, u0, v0, qp)
+    rows, cols = h // 32, w // 32
+    qpc = chroma_qp(qp)
+
+    def mc(p, my, mx):
+        hh, ww = p.shape
+        return p[np.clip(np.arange(hh)[:, None] + my, 0, hh - 1),
+                 np.clip(np.arange(ww)[None, :] + mx, 0, ww - 1)]
+
+    luma_tus, cb_tus, cr_tus = [], [], []
+    for i in range(4):
+        lt = np.zeros((16, 16), np.int32)
+        lt[rng.integers(0, 16, 6), rng.integers(0, 16, 6)] = \
+            rng.integers(-15, 16, 6)
+        if not np.any(lt):
+            lt[0, 0] = 3
+        luma_tus.append(lt)
+        cbt = np.zeros((8, 8), np.int32)
+        cbt[rng.integers(0, 8, 4), rng.integers(0, 8, 4)] = \
+            rng.integers(-9, 10, 4)
+        cb_tus.append(cbt if i != 2 and np.any(cbt) else None)
+        crt = np.zeros((8, 8), np.int32)
+        if i == 1:
+            crt[7, 7] = 2
+        cr_tus.append(crt if np.any(crt) else None)
+
+    none4 = [None] * 4
+    sw = PSliceWriter(qp, rows, cols)
+    exp_y = fr.recon_y.copy()
+    exp_u = fr.recon_u.copy()
+    exp_v = fr.recon_v.copy()
+    zpos = [(0, 0), (0, 1), (1, 0), (1, 1)]
+    for r in range(rows):
+        for c in range(cols):
+            last = r == rows - 1 and c == cols - 1
+            if (r, c) == (0, 0):
+                # 2NxN zero-MV halves, residuals in every sub-TU
+                sw.write_ctu_inter_2part(
+                    r, c, vertical=False, mv0=(0, 0), mv1=(0, 0),
+                    luma_tus=luma_tus, cb_tus=cb_tus, cr_tus=cr_tus,
+                    last_in_slice=last)
+                for i, (zy, zx) in enumerate(zpos):
+                    ly, lx = zy * 16, zx * 16
+                    res = inverse_transform(dequantize(luma_tus[i], qp))
+                    exp_y[ly:ly + 16, lx:lx + 16] = np.clip(
+                        fr.recon_y[ly:ly + 16, lx:lx + 16].astype(int)
+                        + res, 0, 255)
+                    cy, cx = zy * 8, zx * 8
+                    for tus, plane in ((cb_tus, exp_u), (cr_tus, exp_v)):
+                        if tus[i] is not None:
+                            rc = inverse_transform(
+                                dequantize(tus[i], qpc))
+                            base = (fr.recon_u if plane is exp_u
+                                    else fr.recon_v)
+                            plane[cy:cy + 8, cx:cx + 8] = np.clip(
+                                base[cy:cy + 8, cx:cx + 8].astype(int)
+                                + rc, 0, 255)
+            elif (r, c) == (0, 1):
+                # Nx2N, distinct even-integer MVs per PU (chroma stays
+                # on integer positions), no residual
+                sw.write_ctu_inter_2part(
+                    r, c, vertical=True, mv0=(8, 16), mv1=(-8, 0),
+                    luma_tus=none4, cb_tus=none4, cr_tus=none4,
+                    last_in_slice=last)
+                exp_y[0:32, 32:48] = mc(fr.recon_y, 2, 4)[0:32, 32:48]
+                exp_y[0:32, 48:64] = mc(fr.recon_y, -2, 0)[0:32, 48:64]
+                exp_u[0:16, 16:24] = mc(fr.recon_u, 1, 2)[0:16, 16:24]
+                exp_v[0:16, 16:24] = mc(fr.recon_v, 1, 2)[0:16, 16:24]
+                exp_u[0:16, 24:32] = mc(fr.recon_u, -1, 0)[0:16, 24:32]
+                exp_v[0:16, 24:32] = mc(fr.recon_v, -1, 0)[0:16, 24:32]
+            else:
+                sw.write_ctu_inter(r, c, (0, 0), None, None, None,
+                                   last_in_slice=last)
+    stream = syntax.annexb([
+        syntax.write_vps(60), syntax.write_sps(w, h), syntax.write_pps(),
+        fr.nal, p_nal(qp, 1, sw.payload())])
+    decoded = oracle_decode(hevcdec, stream, h, w, tmp_path)
+    assert len(decoded) == 2
+    np.testing.assert_array_equal(decoded[1][0], exp_y)
+    np.testing.assert_array_equal(decoded[1][1], exp_u)
+    np.testing.assert_array_equal(decoded[1][2], exp_v)
+
+
 def test_quality_monotonic_in_qp(hevcdec, tmp_path):
     frames = synthetic_yuv_frames(1, 64, 64)
     prev_bytes = None

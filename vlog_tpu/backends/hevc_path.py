@@ -26,6 +26,7 @@ import numpy as np
 
 from vlog_tpu import config
 from vlog_tpu.backends.base import RungResult, RunResult
+from vlog_tpu.backends.jax_backend import prepare_init_segment
 from vlog_tpu.backends.rate_control import RateController
 from vlog_tpu.backends.source import open_source
 from vlog_tpu.codecs.hevc.api import HevcEncoder
@@ -69,12 +70,8 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
             width=rung.width, height=rung.height)
         rdir = out / rung.name
         rdir.mkdir(parents=True, exist_ok=True)
-        init = init_segment(tracks[rung.name])
-        try:
-            init_matched[rung.name] = (rdir / "init.mp4").read_bytes() == init
-        except OSError:
-            init_matched[rung.name] = False
-        atomic_write_bytes(rdir / "init.mp4", init)
+        init_matched[rung.name] = prepare_init_segment(
+            rdir, init_segment(tracks[rung.name]))
         seg_counts[rung.name] = 0
         seg_durs[rung.name] = []
         bytes_written[rung.name] = 0

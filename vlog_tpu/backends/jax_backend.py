@@ -84,6 +84,28 @@ def _enable_persistent_compile_cache() -> None:
         pass
 
 
+def prepare_init_segment(rdir, init_bytes: bytes) -> bool:
+    """Write this run's init segment; returns True when the pre-existing
+    one was byte-identical (segments on disk may then be resumed onto).
+
+    On mismatch, stale ``segment_*.m4s`` files are DELETED before the
+    new init lands: they reference another PPS, and leaving them on disk
+    lets an interrupted restart be mistaken for resumable state on the
+    following run (init would match, stale tail segments would ship).
+    Deleting first keeps every crash window safe — no init on disk reads
+    as a mismatch next time, and the segments are already gone."""
+    init_path = rdir / "init.mp4"
+    try:
+        matched = init_path.read_bytes() == init_bytes
+    except OSError:
+        matched = False
+    if not matched:
+        for seg in rdir.glob("segment_*.m4s"):
+            seg.unlink(missing_ok=True)
+    atomic_write_bytes(init_path, init_bytes)
+    return matched
+
+
 class JaxBackend:
     """Runs the one-pass ladder on whatever devices JAX exposes."""
 
@@ -216,13 +238,8 @@ class JaxBackend:
             rdir = out / rung.name
             rdir.mkdir(parents=True, exist_ok=True)
             if not ts_mode:
-                init = init_segment(tracks[rung.name])
-                try:
-                    init_matched[rung.name] = (
-                        (rdir / "init.mp4").read_bytes() == init)
-                except OSError:
-                    init_matched[rung.name] = False
-                atomic_write_bytes(rdir / "init.mp4", init)
+                init_matched[rung.name] = prepare_init_segment(
+                    rdir, init_segment(tracks[rung.name]))
             seg_counts[rung.name] = 0
             seg_durs[rung.name] = []
             bytes_written[rung.name] = 0
@@ -252,7 +269,7 @@ class JaxBackend:
                          out, fps, frames_per_seg, timescale, frame_dur,
                          ts_mode, seg_ext, encoders, tracks, seg_counts,
                          seg_durs, bytes_written, psnr_acc,
-                         init_matched=None) -> RunResult:
+                         init_matched) -> RunResult:
         start_segment = 0
         if resume and not ts_mode and src.exact_seek:
             start_segment = self._resume_scan(plan, out, timescale,
@@ -637,7 +654,7 @@ class JaxBackend:
 
     # ------------------------------------------------------------------
     def _resume_scan(self, plan, out, timescale, seg_counts, seg_durs,
-                     bytes_written, init_matched=None) -> int:
+                     bytes_written, init_matched) -> int:
         """Reconstruct per-rung segment state from disk; returns the
         first segment index every rung still needs (shared by the H.264
         and HEVC paths — both emit the same CMAF tree).
@@ -650,8 +667,7 @@ class JaxBackend:
         per_rung = {}
         for r in plan.rungs:
             existing = self._existing_segments(out / r.name)
-            if existing and init_matched is not None \
-                    and not init_matched.get(r.name, False):
+            if existing and not init_matched.get(r.name, False):
                 existing = []
             per_rung[r.name] = existing
         start_segment = min(len(d) for d in per_rung.values())

@@ -68,41 +68,66 @@ def p_slice_header_bits(slice_qp: int, poc_lsb: int) -> BitWriter:
     return w
 
 
+def _has(levels) -> bool:
+    return levels is not None and np.any(levels)
+
+
 class MvpGrid:
-    """AMVP over a grid of CTB-sized PUs (encoder-side mirror of
-    8.5.3.2.6 for our shape). Tracks (is_inter, mv) per coded CTB."""
+    """AMVP over a 16x16-cell grid (encoder-side mirror of 8.5.3.2.6
+    for our shape: CTB-sized 2Nx2N PUs or two-half 2NxN/Nx2N PUs).
+    Tracks (is_inter, mv) per coded 16-cell; neighbor positions follow
+    the spec's PU-bounding-box rules."""
 
     def __init__(self, rows: int, cols: int) -> None:
-        self.rows, self.cols = rows, cols
-        self.inter = np.zeros((rows, cols), bool)
-        self._coded = np.zeros((rows, cols), bool)
-        self.mv = np.zeros((rows, cols, 2), np.int32)   # (x, y) qpel
+        self.rows, self.cols = rows * 2, cols * 2   # 16-cell grid
+        self.inter = np.zeros((self.rows, self.cols), bool)
+        self._coded = np.zeros((self.rows, self.cols), bool)
+        self.mv = np.zeros((self.rows, self.cols, 2), np.int32)  # (x, y)
 
     def _cand(self, r: int, c: int):
-        if 0 <= r < self.rows and 0 <= c < self.cols and self.inter[r, c]:
+        if 0 <= r < self.rows and 0 <= c < self.cols \
+                and self._coded[r, c] and self.inter[r, c]:
             return tuple(int(v) for v in self.mv[r, c])
         return None
 
-    def predictor(self, r: int, c: int) -> tuple[int, int]:
-        """mvp candidate 0 for the CU at CTB (r, c).
-
-        write_ctu_inter always signals mvp_l0_flag=0, so only the first
-        list entry matters: A if available, else B, else zero (the
+    def _predict_bbox(self, y0, y1, x0, x1) -> tuple[int, int]:
+        """mvp candidate 0 for a PU covering 16-cells rows y0..y1, cols
+        x0..x1. Only the first list entry matters (mvp_l0_flag is always
+        0): A1 if available, else the first of B0/B1/B2, else zero (the
         spec's A==B pruning and zero-fill only reorder entry 1)."""
-        a = self._cand(r, c - 1)                 # A1 (A0 is undecoded)
+        a = self._cand(y1, x0 - 1)               # A1 (A0 is undecoded)
         if a is not None:
             return a
-        for rc in ((r - 1, c + 1), (r - 1, c), (r - 1, c - 1)):  # B0 B1 B2
+        for rc in ((y0 - 1, x1 + 1), (y0 - 1, x1),
+                   (y0 - 1, x0 - 1)):            # B0, B1, B2
             b = self._cand(*rc)
             if b is not None:
                 return b
         return (0, 0)
 
+    def _pu_cells(self, r, c, vertical, pu):
+        y0, x0 = 2 * r, 2 * c
+        if vertical:                             # Nx2N: left/right 16x32
+            return y0, y0 + 1, x0 + pu, x0 + pu
+        return y0 + pu, y0 + pu, x0, x0 + 1      # 2NxN: top/bottom 32x16
+
+    def predictor(self, r: int, c: int) -> tuple[int, int]:
+        return self._predict_bbox(2 * r, 2 * r + 1, 2 * c, 2 * c + 1)
+
+    def predictor_2part(self, r, c, *, vertical, pu) -> tuple[int, int]:
+        return self._predict_bbox(*self._pu_cells(r, c, vertical, pu))
+
+    def _fill(self, y0, y1, x0, x1, inter, mv):
+        self.inter[y0:y1 + 1, x0:x1 + 1] = inter
+        self._coded[y0:y1 + 1, x0:x1 + 1] = True
+        self.mv[y0:y1 + 1, x0:x1 + 1] = mv
+
     def record(self, r: int, c: int, *, inter: bool,
                mv: tuple[int, int] = (0, 0)) -> None:
-        self.inter[r, c] = inter
-        self._coded[r, c] = True
-        self.mv[r, c] = mv
+        self._fill(2 * r, 2 * r + 1, 2 * c, 2 * c + 1, inter, mv)
+
+    def record_2part(self, r, c, *, vertical, pu, mv) -> None:
+        self._fill(*self._pu_cells(r, c, vertical, pu), True, mv)
 
 
 def _write_mvd(c: CabacEncoder, dx: int, dy: int) -> None:
@@ -150,6 +175,66 @@ class PSliceWriter:
         # ctxInc is always 0
         self.c.encode_bin(_SKIP, 0)
 
+    def write_ctu_inter_2part(self, r: int, col: int, *, vertical: bool,
+                              mv0, mv1, luma_tus, cb_tus, cr_tus,
+                              last_in_slice: bool) -> None:
+        """Inter CU split into two PUs: 2NxN (``vertical=False``, top/
+        bottom 32x16) or Nx2N (left/right 16x32). ``mv0``/``mv1`` are
+        (y, x) quarter-pel for the first/second PU. Residuals arrive as
+        four forced sub-TUs in z-order: ``luma_tus`` four 16x16 arrays
+        (or None), ``cb_tus``/``cr_tus`` four 8x8 arrays (or None) —
+        max_transform_hierarchy_depth_inter=0 with a non-2Nx2N part
+        forces the transform split (7.4.9.8 interSplitFlag)."""
+        c = self.c
+        self._common_p_prefix()
+        c.encode_bin(_PRED_MODE, 0)              # MODE_INTER
+        # part_mode (9.3.3.7, inter at MIN cb size — our CTB == minCB):
+        # 2NxN = '01'; Nx2N = '001' (the third bin distinguishes NxN)
+        c.encode_bin(_PART, 0)
+        c.encode_bin(_PART + 1, 0 if vertical else 1)
+        if vertical:
+            c.encode_bin(_PART + 2, 1)
+
+        # PU0 then PU1; AMVP per PU over the half-CTB (16-grid) cells
+        for pu, mv in ((0, mv0), (1, mv1)):
+            c.encode_bin(_MERGE, 0)
+            mvq = (int(mv[1]), int(mv[0]))       # bitstream (x, y)
+            pmx, pmy = self.grid.predictor_2part(
+                r, col, vertical=vertical, pu=pu)
+            _write_mvd(c, mvq[0] - pmx, mvq[1] - pmy)
+            c.encode_bin(_MVP, 0)
+            self.grid.record_2part(r, col, vertical=vertical, pu=pu,
+                                   mv=mvq)
+
+        root = any(_has(t) for tus in (luma_tus, cb_tus, cr_tus)
+                   for t in tus)
+        c.encode_bin(_ROOT_CBF, int(root))
+        if not root:
+            c.encode_terminate(1 if last_in_slice else 0)
+            return
+        # transform_tree depth 0: parent chroma cbfs cover the 16x16
+        # chroma; the split to four TU16s is inferred (interSplitFlag)
+        p_cb = any(_has(t) for t in cb_tus)
+        p_cr = any(_has(t) for t in cr_tus)
+        c.encode_bin(_CBF_CHROMA, int(p_cb))     # trafoDepth 0 ctx
+        c.encode_bin(_CBF_CHROMA, int(p_cr))
+        for i in range(4):                       # z-order sub-TUs
+            cbf_l = _has(luma_tus[i])
+            cbf_cb = _has(cb_tus[i])
+            cbf_cr = _has(cr_tus[i])
+            if p_cb:
+                c.encode_bin(_CBF_CHROMA + 1, int(cbf_cb))
+            if p_cr:
+                c.encode_bin(_CBF_CHROMA + 1, int(cbf_cr))
+            c.encode_bin(_CBF_LUMA, int(cbf_l))  # trafoDepth 1 ctx
+            if cbf_l:
+                write_residual(c, luma_tus[i], log2_size=4, c_idx=0)
+            if cbf_cb:
+                write_residual(c, cb_tus[i], log2_size=3, c_idx=1)
+            if cbf_cr:
+                write_residual(c, cr_tus[i], log2_size=3, c_idx=2)
+        c.encode_terminate(1 if last_in_slice else 0)
+
     def write_ctu_inter(self, r: int, col: int, mv_q: tuple[int, int],
                         luma, cb, cr, *, last_in_slice: bool) -> None:
         """mv_q = (y, x) QUARTER luma pels (DSP order)."""
@@ -164,10 +249,7 @@ class PSliceWriter:
         c.encode_bin(_MVP, 0)                    # mvp_l0_flag = cand 0
         self.grid.record(r, col, inter=True, mv=mvq)
 
-        def has(lv):
-            return lv is not None and np.any(lv)
-
-        cbf_l, cbf_cb, cbf_cr = has(luma), has(cb), has(cr)
+        cbf_l, cbf_cb, cbf_cr = _has(luma), _has(cb), _has(cr)
         root = cbf_l or cbf_cb or cbf_cr
         c.encode_bin(_ROOT_CBF, int(root))       # rqt_root_cbf
         if not root:
@@ -202,8 +284,8 @@ class PSliceWriter:
         # per-CTB decisions, unlike the all-intra slice's static pattern:
         #   A=26, B=DC -> list {26, DC, planar} -> mpm_idx 0
         #   A=B=DC     -> list {planar, DC, 26} -> mpm_idx 2
-        left_is_intra = (col > 0 and self.grid._coded[r, col - 1]
-                         and not self.grid.inter[r, col - 1])
+        left_is_intra = (col > 0 and self.grid._coded[2 * r, 2 * col - 1]
+                         and not self.grid.inter[2 * r, 2 * col - 1])
         prev_flag, mpm_idx = (1, 0) if left_is_intra else (1, 2)
         c.encode_bin(_PREV, prev_flag)
         if mpm_idx == 0:
@@ -213,10 +295,7 @@ class PSliceWriter:
             c.encode_bypass(mpm_idx - 1)
         c.encode_bin(_CHROMA, 0)                 # DM
 
-        def has(lv):
-            return lv is not None and np.any(lv)
-
-        cbf_cb, cbf_cr, cbf_l = has(cb), has(cr), has(luma)
+        cbf_cb, cbf_cr, cbf_l = _has(cb), _has(cr), _has(luma)
         c.encode_bin(_CBF_CHROMA, int(cbf_cb))
         c.encode_bin(_CBF_CHROMA, int(cbf_cr))
         c.encode_bin(_CBF_LUMA + 1, int(cbf_l))

@@ -1,9 +1,15 @@
 """HEVC residual_coding() writer (H.265 7.3.8.11 + 9.3.4.2/9.3.3.13).
 
-Covers exactly the TB shapes slice.py emits: 32x32 luma and 16x16
-chroma, diagonal scan (the mode-dependent horizontal/vertical scans
-only apply to 4x4 and luma-8x8 TBs, which this stream shape never
-codes), no transform-skip, no sign-data-hiding.
+Covers the TB shapes the slice writers emit: 32x32/16x16 luma, 16x16
+chroma, and 8x8 chroma (the forced sub-TUs of non-2Nx2N inter CUs,
+pslice.write_ctu_inter_2part). Diagonal scan throughout (the
+mode-dependent horizontal/vertical scans only apply to 4x4 and
+luma-8x8 TBs, which this stream shape never codes), no transform-skip,
+no sign-data-hiding.
+
+NOTE: the C port (native/hevc_cabac.c) covers the 2Nx2N shapes only
+(32 luma / 16 chroma); two-part CUs entropy-code through this Python
+reference until the C coder grows the sub-TU paths.
 
 The coefficient-group machinery: the TB is scanned as 4x4 coefficient
 groups in up-right diagonal order; coding runs backwards from the last
@@ -41,12 +47,23 @@ _GROUP_IDX = [0, 1, 2, 3, 4, 4, 5, 5, 6, 6, 6, 6, 7, 7, 7, 7,
 _MIN_IN_GROUP = [0, 1, 2, 3, 4, 6, 8, 12, 16, 24]
 
 
+# up-right diagonal over a 2x2 CG grid (8x8 TBs)
+DIAG_SCAN_2x2 = [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+
+def _cg_scan(n_cg: int):
+    if n_cg == 8:
+        return DIAG_SCAN_8x8
+    if n_cg == 4:
+        return DIAG_SCAN_4x4
+    return DIAG_SCAN_2x2
+
+
 def _scan_positions(log2_size: int) -> list[tuple[int, int]]:
     """Forward diagonal scan of the whole TB: CG-major, 4x4 inside."""
     n_cg = 1 << (log2_size - 2)
-    cg_scan = DIAG_SCAN_8x8 if n_cg == 8 else DIAG_SCAN_4x4
     out = []
-    for cx, cy in cg_scan[: n_cg * n_cg]:
+    for cx, cy in _cg_scan(n_cg)[: n_cg * n_cg]:
         for ix, iy in DIAG_SCAN_4x4:
             out.append((cx * 4 + ix, cy * 4 + iy))
     return out
@@ -82,8 +99,11 @@ def _write_remaining(c: CabacEncoder, value: int, rice: int) -> None:
             c.encode_bypass_bits(value, length)
 
 
-def _sig_ctx(x: int, y: int, c_idx: int, prev_csbf: int) -> int:
-    """sig_coeff_flag ctxIdxInc for TBs larger than 8x8 (9.3.4.2.5)."""
+def _sig_ctx(x: int, y: int, c_idx: int, prev_csbf: int,
+             chroma8: bool = False) -> int:
+    """sig_coeff_flag ctxIdxInc (9.3.4.2.5): luma 16/32, chroma 16 and
+    chroma 8x8 (``chroma8`` — the inter sub-TU case; 8x8 luma and the
+    4x4 map cases stay outside this stream shape)."""
     if x == 0 and y == 0:
         return 0 if c_idx == 0 else 27
     xp, yp = x & 3, y & 3
@@ -98,8 +118,8 @@ def _sig_ctx(x: int, y: int, c_idx: int, prev_csbf: int) -> int:
     if c_idx == 0:
         if (x >> 2) or (y >> 2):    # not the first coefficient group
             s += 3
-        return s + 21               # nTbS {16,32}
-    return 27 + s + 12
+        return s + 21               # luma nTbS {16,32}
+    return 27 + s + (9 if chroma8 else 12)
 
 
 def write_residual(c: CabacEncoder, levels: np.ndarray, *,
@@ -130,7 +150,7 @@ def write_residual(c: CabacEncoder, levels: np.ndarray, *,
         c.encode_bypass_bits(last_y - _MIN_IN_GROUP[gy], (gy >> 1) - 1)
 
     # ---- per-CG coefficient data, back from the last CG
-    cg_scan = (DIAG_SCAN_8x8 if n_cg == 8 else DIAG_SCAN_4x4)[: n_cg * n_cg]
+    cg_scan = _cg_scan(n_cg)[: n_cg * n_cg]
     csbf = np.zeros((n_cg, n_cg), dtype=bool)
     for cyy in range(n_cg):
         for cxx in range(n_cg):
@@ -170,7 +190,8 @@ def write_residual(c: CabacEncoder, levels: np.ndarray, *,
                 # csbf==1 promises a nonzero -> DC significance inferred
                 sigs.append((x, y))
                 continue
-            c.encode_bin(_SIG + _sig_ctx(x, y, c_idx, prev_csbf),
+            c.encode_bin(_SIG + _sig_ctx(x, y, c_idx, prev_csbf,
+                                         chroma8=(log2_size == 3)),
                          int(significant))
             if significant:
                 sigs.append((x, y))

@@ -46,6 +46,7 @@ def _matrix(n: int) -> np.ndarray:
 
 T32 = _matrix(32)
 T16 = _matrix(16)
+T8 = _matrix(8)          # chroma sub-TUs of forced-split inter CUs
 
 # structural self-check against the universally known small transforms
 assert T32[0].tolist() == [64] * 32
@@ -69,7 +70,11 @@ def chroma_qp(qp_y: int) -> int:
 
 
 def _mat_for(n: int) -> np.ndarray:
-    return T32 if n == 32 else T16
+    if n == 32:
+        return T32
+    if n == 16:
+        return T16
+    return T8
 
 
 def forward_transform(res: np.ndarray) -> np.ndarray:
