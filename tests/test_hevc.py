@@ -464,6 +464,52 @@ def test_p_two_part_ctu_oracle(hevcdec, tmp_path):
     np.testing.assert_array_equal(decoded[1][2], exp_v)
 
 
+def test_partitioned_chain_oracle(hevcdec, tmp_path):
+    """encode_chain(partitions=True) on split-motion content: the DSP
+    chooses 2NxN CTBs (two bands panning opposite ways), the streams
+    shrink materially vs single-MV CTBs, and everything stays bit-exact
+    through libavcodec (incl. the A0-priority AMVP the oracle pinned)."""
+    from vlog_tpu.codecs.hevc.api import HevcEncoder
+    from vlog_tpu.codecs.hevc.jax_core import encode_chain_dsp
+
+    h, w = 64, 128
+    rng = np.random.default_rng(3)
+    world = np.clip(
+        100 + 60 * np.sin(np.arange(w * 3)[None, :] / 19.0)
+        * np.cos(np.arange(h)[:, None] / 11.0)
+        + rng.normal(0, 2, (h, w * 3)), 0, 255).astype(np.uint8)
+    frames = []
+    for t in range(4):
+        y = np.empty((h, w), np.uint8)
+        y[:16] = world[:16, 64 + 3 * t:64 + 3 * t + w]
+        y[16:48] = world[16:48, 64 - 3 * t:64 - 3 * t + w]
+        y[48:] = world[48:, 64 + 3 * t:64 + 3 * t + w]
+        frames.append((y, np.full((h // 2, w // 2), 120, np.uint8),
+                       np.full((h // 2, w // 2), 130, np.uint8)))
+    y = np.stack([f[0] for f in frames])
+    u = np.stack([f[1] for f in frames])
+    v = np.stack([f[2] for f in frames])
+
+    _, (_, _, parts, _, _) = encode_chain_dsp(y, u, v, 8, 28, 30, True)
+    assert np.any(np.asarray(parts) != 0), "expected partitioned CTBs"
+
+    enc = HevcEncoder(width=w, height=h, qp=30)
+    chain_p = enc.encode_chain(y, u, v, search=8, partitions=True)
+    chain_s = enc.encode_chain(y, u, v, search=8, partitions=False)
+    p_bytes = sum(len(o.sample) for o in chain_p[1:])
+    s_bytes = sum(len(o.sample) for o in chain_s[1:])
+    assert p_bytes < 0.8 * s_bytes, (p_bytes, s_bytes)
+
+    decoded = oracle_decode(hevcdec, b"".join(o.annexb for o in chain_p),
+                            h, w, tmp_path)
+    assert len(decoded) == 4
+    for i, (dy, du, dv) in enumerate(decoded):
+        mse = np.mean((dy.astype(np.float64)
+                       - y[i].astype(np.float64)) ** 2)
+        psnr = 10 * np.log10(255.0 ** 2 / max(mse, 1e-12))
+        assert abs(psnr - chain_p[i].psnr_y) < 1e-6, f"frame {i}"
+
+
 def test_quality_monotonic_in_qp(hevcdec, tmp_path):
     frames = synthetic_yuv_frames(1, 64, 64)
     prev_bytes = None

@@ -139,8 +139,9 @@ class HevcEncoder:
 
     def encode_chain(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
                      pool: ThreadPoolExecutor | None = None, *,
-                     search: int = 16,
-                     chain_len: int | None = None) -> list[EncodedFrame]:
+                     search: int = 16, chain_len: int | None = None,
+                     partitions: bool | None = None
+                     ) -> list[EncodedFrame]:
         """Encode one I + P chain: y (T, H, W), u/v (T, H/2, W/2) uint8.
 
         Frame 0 is an IDR coded at qp-2 (the chain-anchor offset the
@@ -168,13 +169,21 @@ class HevcEncoder:
         t, h, w = y.shape
         rows, cols = h // CTB, w // CTB
         qp_i = max(10, self.qp - 2)
-        (intra, recon0), (plevels, mvs, precons) = encode_chain_dsp(
-            y, u, v, search, np.int32(qp_i), np.int32(self.qp))
+        if partitions is None:
+            from vlog_tpu import config
+
+            partitions = config.HEVC_PARTITIONS
+        (intra, recon0), (p32, p16, parts, mvs, precons) = \
+            encode_chain_dsp(y, u, v, search, np.int32(qp_i),
+                             np.int32(self.qp), partitions)
         recons = [recon0] + ([tuple(np.asarray(p[i]) for p in precons)
                               for i in range(t - 1)] if t > 1 else [])
         intra_np = tuple(np.asarray(a) for a in intra)
-        p_np = (tuple(np.asarray(a) for a in plevels)
-                if plevels is not None else None)
+        p32_np = (tuple(np.asarray(a) for a in p32)
+                  if p32 is not None else None)
+        p16_np = (tuple(np.asarray(a) for a in p16)
+                  if p16 is not None else None)
+        parts_np = np.asarray(parts) if parts is not None else None
         mv_np = np.asarray(mvs) if mvs is not None else None
 
         def psnr_of(i):
@@ -184,39 +193,78 @@ class HevcEncoder:
                            .astype(np.float64)) ** 2)
             return float(10 * np.log10(255.0 ** 2 / max(mse, 1e-12)))
 
-        def p_entropy(ly, lu, lvv, mvg) -> bytes:
-            """C P-slice coder when available (the DSP emits all-inter
-            slices, which is the C coder's contract); Python fallback."""
+        def p_entropy_c(ly, lu, lvv, mvg) -> bytes | None:
+            """C P-slice coder — all-2Nx2N slices only (its contract)."""
             from vlog_tpu.native.build import get_lib
 
             lib = get_lib()
-            if lib is not None:
-                import ctypes
+            if lib is None:
+                return None
+            import ctypes
 
-                la = np.ascontiguousarray(ly.reshape(-1), np.int16)
-                ua = np.ascontiguousarray(lu.reshape(-1), np.int16)
-                va = np.ascontiguousarray(lvv.reshape(-1), np.int16)
-                mva = np.ascontiguousarray(mvg.reshape(-1), np.int32)
-                scratch = np.empty(rows * cols * 2, np.int32)
-                cap = max(1 << 16, la.size * 4)
-                out = np.empty(cap, np.uint8)
-                i16p = ctypes.POINTER(ctypes.c_int16)
-                i32p = ctypes.POINTER(ctypes.c_int32)
-                u8p = ctypes.POINTER(ctypes.c_uint8)
-                n = lib.vt_hevc_encode_p_slice(
-                    la.ctypes.data_as(i16p), ua.ctypes.data_as(i16p),
-                    va.ctypes.data_as(i16p), mva.ctypes.data_as(i32p),
-                    rows, cols, self.qp, scratch.ctypes.data_as(i32p),
-                    out.ctypes.data_as(u8p), cap)
-                if n >= 0:
-                    return out[:n].tobytes()
+            la = np.ascontiguousarray(ly.reshape(-1), np.int16)
+            ua = np.ascontiguousarray(lu.reshape(-1), np.int16)
+            va = np.ascontiguousarray(lvv.reshape(-1), np.int16)
+            # CTB MV = any of its 4 identical 16-cells
+            mva = np.ascontiguousarray(
+                mvg[::2, ::2].reshape(-1), np.int32)
+            scratch = np.empty(rows * cols * 2, np.int32)
+            cap = max(1 << 16, la.size * 4)
+            out = np.empty(cap, np.uint8)
+            i16p = ctypes.POINTER(ctypes.c_int16)
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            n = lib.vt_hevc_encode_p_slice(
+                la.ctypes.data_as(i16p), ua.ctypes.data_as(i16p),
+                va.ctypes.data_as(i16p), mva.ctypes.data_as(i32p),
+                rows, cols, self.qp, scratch.ctypes.data_as(i32p),
+                out.ctypes.data_as(u8p), cap)
+            return out[:n].tobytes() if n >= 0 else None
+
+        def p_entropy(idx: int) -> bytes:
+            """One P frame's payload: the C coder for uniform-motion
+            frames, the Python writer (with 2NxN/Nx2N CUs) otherwise."""
+            from vlog_tpu.codecs.hevc.jax_core import (PART_2Nx2N,
+                                                       PART_Nx2N)
+
+            l32 = tuple(a[idx] for a in p32_np)
+            part = parts_np[idx]
+            mvg = mv_np[idx]                    # (2R, 2C, 2) 16-cell map
+            if not np.any(part != PART_2Nx2N):
+                payload = p_entropy_c(*l32, mvg)
+                if payload is not None:
+                    return payload
+            l16 = tuple(a[idx] for a in p16_np)
             sw = PSliceWriter(self.qp, rows, cols)
             for r in range(rows):
                 for c in range(cols):
-                    sw.write_ctu_inter(
-                        r, c, tuple(int(x) for x in mvg[r, c]),
-                        ly[r, c], lu[r, c], lvv[r, c],
-                        last_in_slice=(r == rows - 1 and c == cols - 1))
+                    last = r == rows - 1 and c == cols - 1
+                    p = int(part[r, c])
+                    if p == PART_2Nx2N:
+                        sw.write_ctu_inter(
+                            r, c, tuple(int(x) for x in mvg[2 * r, 2 * c]),
+                            l32[0][r, c], l32[1][r, c], l32[2][r, c],
+                            last_in_slice=last)
+                        continue
+                    vertical = p == PART_Nx2N
+                    if vertical:
+                        mv0 = mvg[2 * r, 2 * c]
+                        mv1 = mvg[2 * r, 2 * c + 1]
+                    else:
+                        mv0 = mvg[2 * r, 2 * c]
+                        mv1 = mvg[2 * r + 1, 2 * c]
+                    # sub-TUs in z-order from the 16-block grids
+                    zs = [(2 * r, 2 * c), (2 * r, 2 * c + 1),
+                          (2 * r + 1, 2 * c), (2 * r + 1, 2 * c + 1)]
+                    luma_tus = [l16[0][zy, zx] for zy, zx in zs]
+                    cb_tus = [l16[1][zy, zx] for zy, zx in zs]
+                    cr_tus = [l16[2][zy, zx] for zy, zx in zs]
+                    sw.write_ctu_inter_2part(
+                        r, c, vertical=vertical,
+                        mv0=tuple(int(x) for x in mv0),
+                        mv1=tuple(int(x) for x in mv1),
+                        luma_tus=luma_tus, cb_tus=cb_tus, cr_tus=cr_tus,
+                        last_in_slice=last)
             return sw.payload()
 
         def pack(i: int) -> EncodedFrame:
@@ -224,9 +272,7 @@ class HevcEncoder:
                 payload = self._entropy(*intra_np, rows, cols, qp_i)
                 nal = syntax.idr_nal(qp_i, payload)
             else:
-                payload = p_entropy(p_np[0][i - 1], p_np[1][i - 1],
-                                    p_np[2][i - 1], mv_np[i - 1])
-                nal = p_nal(self.qp, i, payload)
+                nal = p_nal(self.qp, i, p_entropy(i - 1))
             raw = nal.to_bytes()
             return EncodedFrame(
                 sample=len(raw).to_bytes(4, "big") + raw,

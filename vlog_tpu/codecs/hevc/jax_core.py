@@ -27,6 +27,7 @@ import numpy as np
 from vlog_tpu.codecs.hevc.transform import (
     LEVEL_SCALE,
     QUANT_SCALE,
+    T8,
     T16,
     T32,
     _QPC,
@@ -194,7 +195,9 @@ def _hfiltered_planes(refp, taps):
 
 def _mc_luma_qpel(hplanes, mv_q, *, pad, h, w, n=32):
     """Luma MC at quarter-pel MVs: per-pixel plane select (by fx) then
-    the vertical 8-tap as eight gathers with per-pixel weight rows."""
+    the vertical 8-tap as eight gathers with per-pixel weight rows.
+    ``mv_q`` is an (h/n, w/n, 2) grid — n=32 for CTB MVs, 16 for the
+    partitioned motion field."""
     dy = jnp.repeat(jnp.repeat(mv_q[..., 0], n, 0), n, 1)
     dx = jnp.repeat(jnp.repeat(mv_q[..., 1], n, 0), n, 1)
     iy, fy = dy >> 2, dy & 3
@@ -211,11 +214,13 @@ def _mc_luma_qpel(hplanes, mv_q, *, pad, h, w, n=32):
     return jnp.clip((pred + 32) >> 6, 0, 255)
 
 
-def _mc_chroma_qpel(cplanes, mv_q, *, pad, hc, wc):
+def _mc_chroma_qpel(cplanes, mv_q, *, pad, hc, wc, n=16):
     """Chroma MC: the luma quarter-pel value lands on the eighth-chroma
-    grid; 4-tap vertical over the fx-selected horizontal plane."""
-    dy = jnp.repeat(jnp.repeat(mv_q[..., 0], 16, 0), 16, 1)
-    dx = jnp.repeat(jnp.repeat(mv_q[..., 1], 16, 0), 16, 1)
+    grid; 4-tap vertical over the fx-selected horizontal plane. ``n``
+    is the chroma block size matching the MV grid (16 per CTB MV, 8
+    per 16-luma-cell MV)."""
+    dy = jnp.repeat(jnp.repeat(mv_q[..., 0], n, 0), n, 1)
+    dx = jnp.repeat(jnp.repeat(mv_q[..., 1], n, 0), n, 1)
     iy, fy = dy >> 3, dy & 7
     ix, fx = dx >> 3, dx & 7
     rows = jnp.arange(hc)[:, None] + iy + pad
@@ -230,12 +235,12 @@ def _mc_chroma_qpel(cplanes, mv_q, *, pad, hc, wc):
     return jnp.clip((pred + 32) >> 6, 0, 255)
 
 
-def _p_ctb_search(cur, refp, hplanes, *, search, pad, lam=2):
-    """Integer offset-scan ME per 32x32 CTB, then half- and quarter-pel
-    refinement through the real interpolation: (H, W) -> (R, C, 2) MVs
-    ((y, x), QUARTER pels)."""
+def _p_ctb_search(cur, refp, hplanes, *, search, pad, lam=2, n=32):
+    """Integer offset-scan ME per nxn block, then half- and quarter-pel
+    refinement through the real interpolation:
+    (H, W) -> ((H/n, W/n, 2) MVs in QUARTER pels, final SADs)."""
     h, w = cur.shape
-    rr, cc = h // 32, w // 32
+    rr, cc = h // n, w // n
     offsets = [(0, 0)] + [
         (dy, dx) for dy in range(-search, search + 1)
         for dx in range(-search, search + 1) if (dy, dx) != (0, 0)]
@@ -245,7 +250,7 @@ def _p_ctb_search(cur, refp, hplanes, *, search, pad, lam=2):
         best_sad, best_mv = carry
         shifted = jax.lax.dynamic_slice(
             refp, (pad + off[0], pad + off[1]), (h, w))
-        sad = jnp.abs(cur - shifted).reshape(rr, 32, cc, 32).sum(
+        sad = jnp.abs(cur - shifted).reshape(rr, n, cc, n).sum(
             axis=(1, 3))
         sad = sad + lam * 4 * (jnp.abs(off[0]) + jnp.abs(off[1]))
         better = sad < best_sad
@@ -265,9 +270,9 @@ def _p_ctb_search(cur, refp, hplanes, *, search, pad, lam=2):
         def rstep(carry, off):
             best_sad, best_mv = carry
             cand = base_q + step_q * off[None, None, :]
-            pred = _mc_luma_qpel(hplanes, cand, pad=pad, h=h, w=w)
+            pred = _mc_luma_qpel(hplanes, cand, pad=pad, h=h, w=w, n=n)
             sad = jnp.abs(cur - pred.astype(jnp.int32)).reshape(
-                rr, 32, cc, 32).sum(axis=(1, 3))
+                rr, n, cc, n).sum(axis=(1, 3))
             sad = sad + lam * (jnp.abs(cand[..., 0])
                                + jnp.abs(cand[..., 1]))
             better = sad < best_sad
@@ -278,62 +283,180 @@ def _p_ctb_search(cur, refp, hplanes, *, search, pad, lam=2):
         return mv, sad
 
     mv_q, sad_q = refine(mv_int * 4, int_sad, 2)
-    mv_q, _ = refine(mv_q, sad_q, 1)
-    return mv_q
+    mv_q, sad_q = refine(mv_q, sad_q, 1)
+    return mv_q, sad_q
 
 
-def encode_p_frame_dsp(y, u, v, ref_y, ref_u, ref_v, qp, *,
-                       search: int = 16):
-    """One P frame against the previous reconstruction. All CTBs inter
-    with quarter-pel MVs (pslice.py codes them); returns levels, MVs,
-    recon. Everything is ref-relative, so the whole frame is one
-    parallel pass — no intra row-scan needed."""
-    qp = jnp.asarray(qp, jnp.int32)
-    qpc = chroma_qp_traced(qp)
-    # luma pad: integer reach + 1 refinement pel + 4-tap reach + the
-    # 4-sample roll-wrap contamination ring of the horizontal filters
-    pad = search + 8
+def _p_residuals_and_recon(y, u, v, cur, hplanes, mv_map, part, qp, qpc,
+                           pad, search, ref_u, ref_v, partitions=True):
+    """MC + both residual codings + decision-consistent recon (shared by
+    the partitioned and single-MV paths of encode_p_frame_dsp)."""
     h, w = y.shape
-    cur = y.astype(jnp.int32)
-    refp = jnp.pad(ref_y.astype(jnp.int32), pad, mode="edge")
-    hplanes = _hfiltered_planes(refp, _LTAPS)
-    mv = _p_ctb_search(cur, refp, hplanes, search=search, pad=pad)
-
-    pred_y = _mc_luma_qpel(hplanes, mv, pad=pad, h=h, w=w).astype(
-        jnp.int32)
+    pred_y = _mc_luma_qpel(hplanes, mv_map, pad=pad, h=h, w=w,
+                           n=16).astype(jnp.int32)
     cpad = search // 2 + 6
     hc, wc = u.shape
     cplanes_u = _hfiltered_planes(
         jnp.pad(ref_u.astype(jnp.int32), cpad, mode="edge"), _CTAPS)
     cplanes_v = _hfiltered_planes(
         jnp.pad(ref_v.astype(jnp.int32), cpad, mode="edge"), _CTAPS)
-    pred_u = _mc_chroma_qpel(cplanes_u, mv, pad=cpad, hc=hc, wc=wc).astype(
-        jnp.int32)
-    pred_v = _mc_chroma_qpel(cplanes_v, mv, pad=cpad, hc=hc, wc=wc).astype(
-        jnp.int32)
+    pred_u = _mc_chroma_qpel(cplanes_u, mv_map, pad=cpad, hc=hc, wc=wc,
+                             n=8).astype(jnp.int32)
+    pred_v = _mc_chroma_qpel(cplanes_v, mv_map, pad=cpad, hc=hc, wc=wc,
+                             n=8).astype(jnp.int32)
 
-    def to_blocks(plane, n):
-        r2, c2 = plane.shape[0] // n, plane.shape[1] // n
-        return plane.reshape(r2, n, c2, n).transpose(0, 2, 1, 3)
+    # ---- both residual codings over the SAME prediction
+    cu = u.astype(jnp.int32)
+    cv = v.astype(jnp.int32)
+    ly32, ry32 = _code_blocks(to_blocks(cur, 32), to_blocks(pred_y, 32),
+                              qp, jnp.asarray(T32), 5)
+    lu16, ru16 = _code_blocks(to_blocks(cu, 16), to_blocks(pred_u, 16),
+                              qpc, jnp.asarray(T16), 4)
+    lv16, rv16 = _code_blocks(to_blocks(cv, 16), to_blocks(pred_v, 16),
+                              qpc, jnp.asarray(T16), 4)
+    if not partitions:
+        # single-MV path: the sub-TU codings would never be read — skip
+        # the transforms and the device->host level traffic entirely
+        return ((ly32, lu16, lv16), None, part, mv_map,
+                (from_blocks(ry32, 32).astype(jnp.uint8),
+                 from_blocks(ru16, 16).astype(jnp.uint8),
+                 from_blocks(rv16, 16).astype(jnp.uint8)))
+    ly16, ry16 = _code_blocks(to_blocks(cur, 16), to_blocks(pred_y, 16),
+                              qp, jnp.asarray(T16), 4)
+    lu8, ru8 = _code_blocks(to_blocks(cu, 8), to_blocks(pred_u, 8),
+                            qpc, jnp.asarray(T8), 3)
+    lv8, rv8 = _code_blocks(to_blocks(cv, 8), to_blocks(pred_v, 8),
+                            qpc, jnp.asarray(T8), 3)
 
-    def from_blocks(blk, n):
-        return blk.transpose(0, 2, 1, 3).reshape(blk.shape[0] * n,
-                                                 blk.shape[1] * n)
+    # ---- recon consistent with the per-CTB transform choice
+    def select(plane32, plane16, cells_per_ctb):
+        mask = jnp.repeat(jnp.repeat(part == PART_2Nx2N,
+                                     cells_per_ctb, 0), cells_per_ctb, 1)
+        return jnp.where(mask, plane32, plane16)
 
-    ly, ry = _code_blocks(to_blocks(cur, 32), to_blocks(pred_y, 32), qp,
-                          jnp.asarray(T32), 5)
-    lu, ru = _code_blocks(to_blocks(u.astype(jnp.int32), 16),
-                          to_blocks(pred_u, 16), qpc, jnp.asarray(T16), 4)
-    lv, rv = _code_blocks(to_blocks(v.astype(jnp.int32), 16),
-                          to_blocks(pred_v, 16), qpc, jnp.asarray(T16), 4)
-    return ((ly, lu, lv), mv,
-            (from_blocks(ry, 32).astype(jnp.uint8),
-             from_blocks(ru, 16).astype(jnp.uint8),
-             from_blocks(rv, 16).astype(jnp.uint8)))
+    ry = select(from_blocks(ry32, 32), from_blocks(ry16, 16), 32)
+    ru = select(from_blocks(ru16, 16), from_blocks(ru8, 8), 16)
+    rv = select(from_blocks(rv16, 16), from_blocks(rv8, 8), 16)
+    return ((ly32, lu16, lv16), (ly16, lu8, lv8), part, mv_map,
+            (ry.astype(jnp.uint8), ru.astype(jnp.uint8),
+             rv.astype(jnp.uint8)))
 
 
-@partial(jax.jit, static_argnums=(3,))
-def encode_chain_dsp(y, u, v, search, qp_i, qp_p):
+
+# partition codes per CTB
+PART_2Nx2N, PART_2NxN, PART_Nx2N = 0, 1, 2
+# mode decision penalty per extra MV (SAD units), scaled by 2^(qp/6)
+_PART_PENALTY = 24
+
+
+def to_blocks(plane, n):
+    r2, c2 = plane.shape[0] // n, plane.shape[1] // n
+    return plane.reshape(r2, n, c2, n).transpose(0, 2, 1, 3)
+
+
+def from_blocks(blk, n):
+    return blk.transpose(0, 2, 1, 3).reshape(blk.shape[0] * n,
+                                             blk.shape[1] * n)
+
+
+def encode_p_frame_dsp(y, u, v, ref_y, ref_u, ref_v, qp, *,
+                       search: int = 16, partitions: bool = True):
+    """One P frame against the previous reconstruction. Every CTB is
+    inter; per CTB the motion field is 2Nx2N (one MV), 2NxN or Nx2N
+    (two MVs) — chosen where the independently-refined 16-cell MVs
+    agree per half, so partition SADs are exact without extra
+    evaluations. Returns per-CTB partition codes, the 16-cell MV map,
+    BOTH residual codings (TU32+chroma16 for 2Nx2N; four TU16 + 8x8
+    chroma sub-TUs for two-part CTBs — entropy picks by partition), and
+    the recon consistent with the decision."""
+    qp = jnp.asarray(qp, jnp.int32)
+    qpc = chroma_qp_traced(qp)
+    # luma pad: integer reach + 1 refinement pel + 4-tap reach + the
+    # 4-sample roll-wrap contamination ring of the horizontal filters
+    pad = search + 8
+    h, w = y.shape
+    rr, cc = h // 32, w // 32
+    cur = y.astype(jnp.int32)
+    refp = jnp.pad(ref_y.astype(jnp.int32), pad, mode="edge")
+    hplanes = _hfiltered_planes(refp, _LTAPS)
+    mv32, sad32 = _p_ctb_search(cur, refp, hplanes, search=search,
+                                pad=pad, n=32)
+    if not partitions:
+        # single-MV CTBs only: skip the 16-cell search and hypothesis
+        # evaluations entirely (this is the production default until the
+        # mode-decision penalty is calibrated and the C entropy coder
+        # covers two-part CUs)
+        part = jnp.zeros((rr, cc), jnp.int32)
+        mv_map = jnp.repeat(jnp.repeat(mv32, 2, 0), 2, 1)
+        return _p_residuals_and_recon(
+            y, u, v, cur, hplanes, mv_map, part, qp, qpc, pad, search,
+            ref_u, ref_v, partitions=False)
+    mv16, _ = _p_ctb_search(cur, refp, hplanes, search=search,
+                            pad=pad, n=16)
+
+    # ---- partition decision. Each half of a two-part CTB must share
+    # ONE MV; candidates are the half's two refined 16-cell MVs, and
+    # each candidate is evaluated exactly (one MC pass per variant, the
+    # SADs summed per half), so the costs compared below are real.
+    def _sad16_under(mv_cells):
+        pred = _mc_luma_qpel(hplanes, mv_cells, pad=pad, h=h, w=w, n=16)
+        return jnp.abs(cur - pred.astype(jnp.int32)).reshape(
+            rr, 2, 16, cc, 2, 16).sum(axis=(2, 5))   # (R, ry, C, rx)
+
+    m = mv16.reshape(rr, 2, cc, 2, 2)            # (R, ry, C, rx, yx)
+
+    def half_costs(horizontal):
+        """Exact per-CTB cost + per-half MVs for 2NxN (horizontal=True,
+        halves are cell ROWS) or Nx2N (halves are cell COLUMNS)."""
+        if horizontal:
+            # candidate per (CTB row, half-row): the half's two cells
+            cand_a = m[:, :, :, 0]               # (R, ry, C, 2)
+            cand_b = m[:, :, :, 1]
+            expand = lambda cm: jnp.repeat(      # noqa: E731
+                cm.reshape(rr * 2, cc, 2), 2, 1)
+        else:
+            # candidate per (CTB row, half-col): transpose rx to front
+            mt = m.transpose(0, 3, 2, 1, 4)      # (R, rx, C, ry, yx)
+            cand_a = mt[:, :, :, 0]              # (R, rx, C, 2)
+            cand_b = mt[:, :, :, 1]
+            expand = lambda cm: jnp.repeat(      # noqa: E731
+                cm.transpose(0, 2, 1, 3).reshape(rr, cc * 2, 2), 2, 0)
+        s_a = _sad16_under(expand(cand_a))       # (R, ry, C, rx)
+        s_b = _sad16_under(expand(cand_b))
+        if horizontal:
+            ha = s_a.sum(axis=3)                 # (R, ry, C)
+            hb = s_b.sum(axis=3)
+        else:
+            ha = s_a.sum(axis=1).transpose(0, 2, 1)   # (R, rx, C)
+            hb = s_b.sum(axis=1).transpose(0, 2, 1)
+        best = jnp.minimum(ha, hb)
+        mv_best = jnp.where((hb < ha)[..., None], cand_b, cand_a)
+        return best.sum(axis=1), mv_best         # (R, C), (R, half, C, 2)
+
+    c_2nxn_raw, mv_h = half_costs(True)
+    c_nx2n_raw, mv_v = half_costs(False)
+    pen = _PART_PENALTY * (jnp.int32(1) << jnp.clip(qp // 6, 0, 8))
+    costs = jnp.stack([sad32, c_2nxn_raw + pen, c_nx2n_raw + pen])
+    part = jnp.argmin(costs, axis=0).astype(jnp.int32)   # (R, C)
+
+    # ---- the unified 16-cell MV map realizes every partition
+    mv32_cells = jnp.repeat(jnp.repeat(mv32, 2, 0), 2, 1)
+    mvh_cells = jnp.repeat(mv_h.reshape(rr * 2, cc, 2), 2, 1)
+    mvv_cells = jnp.repeat(
+        mv_v.transpose(0, 2, 1, 3).reshape(rr, cc * 2, 2), 2, 0)
+    part_cells = jnp.repeat(jnp.repeat(part, 2, 0), 2, 1)[..., None]
+    mv_map = jnp.where(part_cells == PART_2Nx2N, mv32_cells,
+                       jnp.where(part_cells == PART_2NxN, mvh_cells,
+                                 mvv_cells))
+
+    return _p_residuals_and_recon(
+        y, u, v, cur, hplanes, mv_map, part, qp, qpc, pad, search,
+        ref_u, ref_v)
+
+
+
+@partial(jax.jit, static_argnums=(3, 6))
+def encode_chain_dsp(y, u, v, search, qp_i, qp_p, partitions=False):
     """I + P chain: frame 0 intra (row-scan), frames 1.. inter against
     the running reconstruction (lax.scan carry). Inputs (T, H, W) padded
     planes; returns intra levels, per-P levels/MVs, and recons.
@@ -346,16 +469,17 @@ def encode_chain_dsp(y, u, v, search, qp_i, qp_p):
 
     def step(carry, frame):
         fy, fu, fv = frame
-        levels, mv, recon = encode_p_frame_dsp(
-            fy, fu, fv, *carry, qp_p, search=search)
-        return recon, (levels, mv, recon)
+        lv32, lv16, part, mv_map, recon = encode_p_frame_dsp(
+            fy, fu, fv, *carry, qp_p, search=search,
+            partitions=partitions)
+        return recon, (lv32, lv16, part, mv_map, recon)
 
     if y.shape[0] > 1:
-        _, (plevels, mvs, precons) = jax.lax.scan(
+        _, (p32, p16, parts, mvs, precons) = jax.lax.scan(
             step, (ry, ru, rv), (y[1:], u[1:], v[1:]))
     else:
-        plevels, mvs, precons = None, None, None
-    return ((li, lui, lvi), (ry, ru, rv)), (plevels, mvs, precons)
+        p32 = p16 = parts = mvs = precons = None
+    return ((li, lui, lvi), (ry, ru, rv)), (p32, p16, parts, mvs, precons)
 
 
 @partial(jax.jit, static_argnums=())

@@ -90,12 +90,24 @@ class MvpGrid:
             return tuple(int(v) for v in self.mv[r, c])
         return None
 
-    def _predict_bbox(self, y0, y1, x0, x1) -> tuple[int, int]:
+    def _predict_bbox(self, y0, y1, x0, x1) -> tuple:
         """mvp candidate 0 for a PU covering 16-cells rows y0..y1, cols
         x0..x1. Only the first list entry matters (mvp_l0_flag is always
         0): A1 if available, else the first of B0/B1/B2, else zero (the
-        spec's A==B pruning and zero-fill only reorder entry 1)."""
-        a = self._cand(y1, x0 - 1)               # A1 (A0 is undecoded)
+        spec's A==B pruning and zero-fill only reorder entry 1).
+
+        The second PU of a two-part CU may predict from the first
+        (verified against libavcodec: the merge-style same-CU exclusion
+        does NOT apply to AMVP), so PU0's cells — recorded before PU1
+        is coded — are legitimate candidates here.
+
+        A0 (below-left) precedes A1 in the spec scan; it is decoded
+        only for the TOP PU of a 2NxN CU (where below-left is the left
+        CTB's bottom half) — _cand's coded-gate makes probing it safe
+        everywhere."""
+        a = self._cand(y1 + 1, x0 - 1)           # A0 (below-left)
+        if a is None:
+            a = self._cand(y1, x0 - 1)           # A1
         if a is not None:
             return a
         for rc in ((y0 - 1, x1 + 1), (y0 - 1, x1),

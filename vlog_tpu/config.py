@@ -228,6 +228,11 @@ MOTION_SEARCH_RADIUS: int = _env_int("VLOG_MOTION_SEARCH", 8, lo=1, hi=32)
 # native C coders. Changing this mid-tree invalidates partial resume
 # state (segments must share one PPS); re-transcode with force.
 H264_ENTROPY: str = _env_str("VLOG_H264_ENTROPY", "cabac")
+# HEVC 2NxN/Nx2N inter partitions (oracle-proven; big wins on
+# split-motion content, but the mode-decision penalty is uncalibrated
+# for mixed content and partitioned slices entropy-code in Python —
+# opt-in until both are resolved).
+HEVC_PARTITIONS: bool = _env_bool("VLOG_HEVC_PARTITIONS", False)
 # Frames per device-batch staged to HBM per encode dispatch. GOP size for the
 # all-intra encoder is a packaging concept (segment boundary), so this is a
 # pure throughput/memory knob.

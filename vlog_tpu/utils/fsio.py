@@ -23,3 +23,25 @@ def atomic_write_bytes(path: str | Path, data: bytes) -> None:
 
 def atomic_write_text(path: str | Path, text: str) -> None:
     atomic_write_bytes(path, text.encode())
+
+
+def prepare_init_segment(rdir, init_bytes: bytes) -> bool:
+    """Write this run's init segment; returns True when the pre-existing
+    one was byte-identical (segments on disk may then be resumed onto).
+
+    On mismatch, stale ``segment_*.m4s`` files are DELETED before the
+    new init lands: they reference another PPS, and leaving them on disk
+    lets an interrupted restart be mistaken for resumable state on the
+    following run (init would match, stale tail segments would ship).
+    Deleting first keeps every crash window safe — no init on disk reads
+    as a mismatch next time, and the segments are already gone."""
+    init_path = rdir / "init.mp4"
+    try:
+        matched = init_path.read_bytes() == init_bytes
+    except OSError:
+        matched = False
+    if not matched:
+        for seg in rdir.glob("segment_*.m4s"):
+            seg.unlink(missing_ok=True)
+    atomic_write_bytes(init_path, init_bytes)
+    return matched
