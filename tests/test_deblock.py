@@ -1,0 +1,248 @@
+"""Deblocking: wavefront-vs-scalar equivalence + the libavcodec oracle.
+
+Layered like the rest of the codec tests: a straight-line numpy
+implementation of spec 8.7 in raster MB order (the ordering ffmpeg uses)
+checks the JAX wavefront's claim of exactness-by-construction; the
+encoder-level oracle tests (test_h264_oracle/test_h264_p) then pin the
+whole loop against libavcodec once deblocking is enabled in streams.
+"""
+
+import numpy as np
+import pytest
+
+from vlog_tpu.codecs.h264.deblock import (
+    ALPHA, BETA, TC0, deblock_frame, intra_bs, p_bs,
+)
+from vlog_tpu.codecs.h264.encoder import chroma_qp
+
+
+def _filter_line_luma(px, bs, alpha, beta, tc0_tab):
+    p3, p2, p1, p0, q0, q1, q2, q3 = [int(x) for x in px]
+    if bs == 0:
+        return px
+    if not (abs(p0 - q0) < alpha and abs(p1 - p0) < beta
+            and abs(q1 - q0) < beta):
+        return px
+    ap = abs(p2 - p0) < beta
+    aq = abs(q2 - q0) < beta
+    out = list(px)
+    if bs == 4:
+        if ap and abs(p0 - q0) < (alpha >> 2) + 2:
+            out[3] = (p2 + 2 * p1 + 2 * p0 + 2 * q0 + q1 + 4) >> 3
+            out[2] = (p2 + p1 + p0 + q0 + 2) >> 2
+            out[1] = (2 * p3 + 3 * p2 + p1 + p0 + q0 + 4) >> 3
+        else:
+            out[3] = (2 * p1 + p0 + q1 + 2) >> 2
+        if aq and abs(p0 - q0) < (alpha >> 2) + 2:
+            out[4] = (q2 + 2 * q1 + 2 * q0 + 2 * p0 + p1 + 4) >> 3
+            out[5] = (q2 + q1 + q0 + p0 + 2) >> 2
+            out[6] = (2 * q3 + 3 * q2 + q1 + q0 + p0 + 4) >> 3
+        else:
+            out[4] = (2 * q1 + q0 + p1 + 2) >> 2
+        return out
+    tc0 = int(tc0_tab[bs - 1])
+    tc = tc0 + int(ap) + int(aq)
+    delta = np.clip(((q0 - p0) * 4 + (p1 - q1) + 4) >> 3, -tc, tc)
+    out[3] = int(np.clip(p0 + delta, 0, 255))
+    out[4] = int(np.clip(q0 - delta, 0, 255))
+    if ap:
+        out[2] = p1 + int(np.clip((p2 + ((p0 + q0 + 1) >> 1) - 2 * p1) >> 1,
+                                  -tc0, tc0))
+    if aq:
+        out[5] = q1 + int(np.clip((q2 + ((p0 + q0 + 1) >> 1) - 2 * q1) >> 1,
+                                  -tc0, tc0))
+    return out
+
+
+def _filter_line_chroma(px, bs, alpha, beta, tc0_tab):
+    p1, p0, q0, q1 = [int(x) for x in px]
+    if bs == 0:
+        return px
+    if not (abs(p0 - q0) < alpha and abs(p1 - p0) < beta
+            and abs(q1 - q0) < beta):
+        return px
+    out = list(px)
+    if bs == 4:
+        out[1] = (2 * p1 + p0 + q1 + 2) >> 2
+        out[2] = (2 * q1 + q0 + p1 + 2) >> 2
+        return out
+    tc = int(tc0_tab[bs - 1]) + 1
+    delta = np.clip(((q0 - p0) * 4 + (p1 - q1) + 4) >> 3, -tc, tc)
+    out[1] = int(np.clip(p0 + delta, 0, 255))
+    out[2] = int(np.clip(q0 - delta, 0, 255))
+    return out
+
+
+def scalar_deblock(y, u, v, qp, bs_v, bs_h):
+    """Spec 8.7 in raster MB order (ffmpeg's order): the golden model."""
+    y = y.astype(np.int64).copy()
+    u = u.astype(np.int64).copy()
+    v = v.astype(np.int64).copy()
+    h, w = y.shape
+    mbh, mbw = h // 16, w // 16
+    al, be, tc = int(ALPHA[qp]), int(BETA[qp]), TC0[:, qp]
+    qpc = chroma_qp(qp)
+    alc, bec, tcc = int(ALPHA[qpc]), int(BETA[qpc]), TC0[:, qpc]
+    for r in range(mbh):
+        for c in range(mbw):
+            for i in range(4):                      # vertical edges
+                if c == 0 and i == 0:
+                    continue
+                x = 16 * c + 4 * i
+                for row in range(16):
+                    bs = int(bs_v[r, c, i, row // 4])
+                    px = y[16 * r + row, x - 4:x + 4]
+                    y[16 * r + row, x - 4:x + 4] = _filter_line_luma(
+                        px, bs, al, be, tc)
+                if i % 2 == 0:
+                    xc = 8 * c + 2 * i
+                    for row in range(8):
+                        bs = int(bs_v[r, c, i, row // 2])
+                        for pl in (u, v):
+                            px = pl[8 * r + row, xc - 2:xc + 2]
+                            pl[8 * r + row, xc - 2:xc + 2] = \
+                                _filter_line_chroma(px, bs, alc, bec, tcc)
+            for j in range(4):                      # horizontal edges
+                if r == 0 and j == 0:
+                    continue
+                yy = 16 * r + 4 * j
+                for col in range(16):
+                    bs = int(bs_h[r, c, j, col // 4])
+                    px = y[yy - 4:yy + 4, 16 * c + col]
+                    y[yy - 4:yy + 4, 16 * c + col] = _filter_line_luma(
+                        px, bs, al, be, tc)
+                if j % 2 == 0:
+                    yc = 8 * r + 2 * j
+                    for col in range(8):
+                        bs = int(bs_h[r, c, j, col // 2])
+                        for pl in (u, v):
+                            px = pl[yc - 2:yc + 2, 8 * c + col]
+                            pl[yc - 2:yc + 2, 8 * c + col] = \
+                                _filter_line_chroma(px, bs, alc, bec, tcc)
+    return y, u, v
+
+
+def _rand_frame(rng, h, w):
+    # blocky content with sharp 4x4/16x16 structure: exercises every
+    # filter decision branch (flat areas, strong edges, clip paths)
+    base = rng.integers(0, 256, (h // 4, w // 4)).astype(np.int32)
+    y = np.repeat(np.repeat(base, 4, 0), 4, 1)
+    y = np.clip(y + rng.integers(-6, 7, (h, w)), 0, 255).astype(np.uint8)
+    u = np.repeat(np.repeat(
+        rng.integers(0, 256, (h // 8, w // 8)).astype(np.int32), 4, 0),
+        4, 1)
+    u = np.clip(u + rng.integers(-4, 5, (h // 2, w // 2)), 0,
+                255).astype(np.uint8)
+    v = np.roll(u, 3, axis=1)
+    return y, u, v
+
+
+@pytest.mark.parametrize("qp", [20, 30, 44])
+def test_wavefront_matches_scalar_intra(qp):
+    rng = np.random.default_rng(qp)
+    h, w = 64, 96
+    y, u, v = _rand_frame(rng, h, w)
+    bs_v, bs_h = intra_bs(h // 16, w // 16)
+    got = deblock_frame(y, u, v, qp=qp, bs_v=bs_v, bs_h=bs_h)
+    exp = scalar_deblock(y, u, v, qp, np.asarray(bs_v), np.asarray(bs_h))
+    np.testing.assert_array_equal(np.asarray(got[0]), exp[0])
+    np.testing.assert_array_equal(np.asarray(got[1]), exp[1])
+    np.testing.assert_array_equal(np.asarray(got[2]), exp[2])
+
+
+def test_wavefront_matches_scalar_p_mixed_bs():
+    rng = np.random.default_rng(7)
+    h, w = 64, 96
+    mbh, mbw = h // 16, w // 16
+    y, u, v = _rand_frame(rng, h, w)
+    # random nonzero-coefficient map + motion field with real deltas
+    nz4 = rng.integers(0, 2, (4 * mbh, 4 * mbw)).astype(np.int32)
+    mv = (rng.integers(-2, 3, (mbh, mbw, 2)) * 4).astype(np.int32)
+    import jax.numpy as jnp
+
+    bs_v, bs_h = p_bs(jnp.asarray(nz4), jnp.asarray(mv))
+    qp = 32
+    got = deblock_frame(y, u, v, qp=qp, bs_v=bs_v, bs_h=bs_h)
+    exp = scalar_deblock(y, u, v, qp, np.asarray(bs_v), np.asarray(bs_h))
+    np.testing.assert_array_equal(np.asarray(got[0]), exp[0])
+    np.testing.assert_array_equal(np.asarray(got[1]), exp[1])
+    np.testing.assert_array_equal(np.asarray(got[2]), exp[2])
+
+
+def test_p_bs_rules():
+    """bS mapping: nz -> 2 beats mv -> 1; internal edges nz-only."""
+    import jax.numpy as jnp
+
+    mbh = mbw = 2
+    nz4 = np.zeros((8, 8), np.int32)
+    nz4[0, 4] = 1                     # block row 0, col 4: MB (0,1) i=0
+    mv = np.zeros((2, 2, 2), np.int32)
+    mv[0, 1] = (8, 0)                 # 2 integer pels vs MB (0,0)
+    bs_v, bs_h = p_bs(jnp.asarray(nz4), jnp.asarray(mv))
+    bs_v = np.asarray(bs_v)
+    assert bs_v[0, 1, 0, 0] == 2      # nz wins on the boundary edge
+    assert bs_v[0, 1, 0, 1] == 1      # other segments: mv-only -> 1
+    assert bs_v[0, 1, 1, 0] == 2      # internal edge right of coded block
+    assert bs_v[0, 1, 2, 0] == 0      # far internal edge: nothing
+    bs_h = np.asarray(bs_h)
+    assert bs_h[1, 1, 0, 0] == 1      # MB (1,1) top edge vs moved MB (0,1)
+
+
+# ---------------------------------------------------------------------------
+# The real oracle: libavcodec must reproduce our deblocked loop exactly
+# ---------------------------------------------------------------------------
+
+from tests.test_h264_oracle import avdec  # noqa: F401 (fixture)
+
+
+@pytest.mark.parametrize("qp", [26, 34])
+def test_deblocked_chain_oracle_bit_exact(qp, tmp_path, avdec):  # noqa: F811
+    """I + P chain with in-loop deblocking: streams signal idc=0, the
+    encoder's filtered reconstructions must equal libavcodec's decode of
+    the stream frame-for-frame (closed loop incl. bS derivation)."""
+    import jax.numpy as jnp
+
+    from tests.test_h264_oracle import oracle_decode
+    from tests.test_h264_p import moving_frames
+    from vlog_tpu.codecs.h264 import syntax
+    from vlog_tpu.codecs.h264.api import H264Encoder
+    from vlog_tpu.codecs.h264.cavlc import encode_p_slice, encode_slice
+    from vlog_tpu.codecs.h264.encoder import encode_frame, frame_levels
+    from vlog_tpu.codecs.h264.inter import encode_p_frame, p_frame_levels
+
+    h, w = 96, 128
+    mbh, mbw = h // 16, w // 16
+    frames = moving_frames(5, h, w)
+    enc = H264Encoder(width=w, height=h, qp=qp, deblock=True)
+
+    nals, recons = [], []
+    y0, u0, v0 = frames[0]
+    out = encode_frame(y0, u0, v0, qp=qp)
+    lv = frame_levels(out, qp)
+    nals.append(encode_slice(lv, qp=qp, init_qp=qp, frame_num=0, idr=True,
+                             deblock=True))
+    ibs_v, ibs_h = intra_bs(mbh, mbw)
+    ref = deblock_frame(out["recon_y"], out["recon_u"], out["recon_v"],
+                        qp=qp, bs_v=ibs_v, bs_h=ibs_h)
+    ref = tuple(np.asarray(p).astype(np.uint8) for p in ref)
+    recons.append(ref)
+    for i, (y, u, v) in enumerate(frames[1:], start=1):
+        pout = encode_p_frame(y, u, v, *ref, qp=qp, search=8)
+        plv = p_frame_levels(pout)
+        nals.append(encode_p_slice(plv, qp=qp, init_qp=qp, frame_num=i,
+                                   deblock=True))
+        nz = np.any(plv["luma"] != 0, axis=(-1, -2))      # (mbh,mbw,4,4)
+        nz4 = nz.transpose(0, 2, 1, 3).reshape(4 * mbh, 4 * mbw)
+        bsv, bsh = p_bs(jnp.asarray(nz4), jnp.asarray(plv["mv"]))
+        ref = deblock_frame(pout["recon_y"], pout["recon_u"],
+                            pout["recon_v"], qp=qp, bs_v=bsv, bs_h=bsh)
+        ref = tuple(np.asarray(p).astype(np.uint8) for p in ref)
+        recons.append(ref)
+
+    annexb = syntax.annexb([enc.sps, enc.pps] + nals)
+    decoded = oracle_decode(avdec, annexb, h, w, tmp_path)
+    assert len(decoded) == len(frames)
+    for i, ((dy, du, dv), (ry, ru, rv)) in enumerate(zip(decoded, recons)):
+        np.testing.assert_array_equal(dy, ry, err_msg=f"frame {i} luma")
+        np.testing.assert_array_equal(du, ru, err_msg=f"frame {i} cb")
+        np.testing.assert_array_equal(dv, rv, err_msg=f"frame {i} cr")

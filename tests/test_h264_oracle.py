@@ -135,3 +135,17 @@ def test_codec_string_shape():
     enc = H264Encoder(width=1280, height=720)
     assert enc.codec_string.startswith("avc1.42C0")
     assert len(enc.avcc_config) > 10
+
+
+def test_cabac_signals_main_profile():
+    """CABAC is prohibited in Baseline (spec A.2.1): the SPS, avcC and
+    RFC 6381 string must advertise Main (77) when entropy='cabac'."""
+    cavlc = H264Encoder(width=1280, height=720, entropy="cavlc")
+    cabac = H264Encoder(width=1280, height=720, entropy="cabac")
+    assert cavlc.codec_string.startswith("avc1.42C0")  # CBP, csets 0+1
+    assert cabac.codec_string.startswith("avc1.4D00")  # Main, csets 0
+    # SPS rbsp byte 0 is profile_idc, byte 1 the constraint flags
+    assert cavlc.sps.rbsp[0] == 66 and cavlc.sps.rbsp[1] == 0xC0
+    assert cabac.sps.rbsp[0] == 77 and cabac.sps.rbsp[1] == 0x00
+    # avcC mirrors the SPS bytes
+    assert cabac.avcc_config[1] == 77 and cavlc.avcc_config[1] == 66

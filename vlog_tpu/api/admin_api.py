@@ -26,7 +26,7 @@ from aiohttp import web
 from vlog_tpu import config
 from vlog_tpu.api import auth as authmod
 from vlog_tpu.api.settings import SettingsService, SettingsError
-from vlog_tpu.db.core import Database, now as db_now
+from vlog_tpu.db.core import Database, now as db_now, open_database
 from vlog_tpu.enums import JobKind, VideoStatus
 from vlog_tpu.jobs import claims, state as js, videos as vids
 from vlog_tpu.media.probe import ProbeError, get_video_info
@@ -633,7 +633,7 @@ async def serve(port: int | None = None, db_url: str | None = None,
     from vlog_tpu.db.schema import create_all
 
     config.ensure_dirs()
-    db = Database(db_url or config.DATABASE_URL)
+    db = open_database(db_url or config.DATABASE_URL)
     await db.connect()
     await create_all(db)
     app = build_admin_app(

@@ -20,7 +20,7 @@ from pathlib import Path
 from aiohttp import web
 
 from vlog_tpu import config
-from vlog_tpu.db.core import Database, now as db_now
+from vlog_tpu.db.core import Database, now as db_now, open_database
 from vlog_tpu.jobs import videos as vids
 
 log = logging.getLogger("vlog_tpu.public_api")
@@ -193,10 +193,11 @@ async def session_heartbeat(request: web.Request) -> web.Response:
 
 async def end_session(request: web.Request) -> web.Response:
     body = await request.json()
-    n = await request.app[DB].execute(
-        """
+    db = request.app[DB]
+    n = await db.execute(
+        f"""
         UPDATE playback_sessions
-        SET ended_at=:t, watch_time_s=MAX(watch_time_s, :w)
+        SET ended_at=:t, watch_time_s={db.greatest('watch_time_s', ':w')}
         WHERE session_token=:tok AND ended_at IS NULL
         """,
         {"t": db_now(), "tok": str(body.get("session") or ""),
@@ -265,7 +266,7 @@ async def serve(port: int | None = None, db_url: str | None = None,
     from vlog_tpu.db.schema import create_all
 
     config.ensure_dirs()
-    db = Database(db_url or config.DATABASE_URL)
+    db = open_database(db_url or config.DATABASE_URL)
     await db.connect()
     await create_all(db)
     app = build_public_app(db)

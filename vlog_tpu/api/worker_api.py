@@ -22,7 +22,7 @@ from aiohttp import web
 
 from vlog_tpu import config
 from vlog_tpu.api import auth as authmod
-from vlog_tpu.db.core import Database, Row, now as db_now
+from vlog_tpu.db.core import Database, Row, now as db_now, open_database
 from vlog_tpu.enums import AcceleratorKind, JobKind
 from vlog_tpu.jobs import claims, state as js, videos as vids
 from vlog_tpu.jobs.finalize import finalize_transcode, finalize_transcription
@@ -508,7 +508,7 @@ async def serve(port: int | None = None, db_url: str | None = None,
     from vlog_tpu.db.schema import create_all
 
     config.ensure_dirs()
-    db = Database(db_url or config.DATABASE_URL)
+    db = open_database(db_url or config.DATABASE_URL)
     await db.connect()
     await create_all(db)
     from vlog_tpu.jobs.webhooks import make_event_hook

@@ -115,6 +115,13 @@ class RunResult:
     variants: list = field(default_factory=list)
     fps: float = 0.0
     segment_duration_s: float = 0.0
+    # wall-clock accounting per pipeline stage (decode_wait_s /
+    # device_pull_s / entropy_s / package_s): where the e2e time went,
+    # so benches can report which stage bounds throughput
+    stage_s: dict = field(default_factory=dict)
+    # chain length the run actually used (plan_for's segment-divisor
+    # logic may pick a different value than config.GOP_LEN; 1 = intra)
+    gop_len: int = 1
 
 
 # progress_cb(frames_done, frames_total, message)

@@ -26,7 +26,7 @@ import numpy as np
 
 from vlog_tpu import config
 from vlog_tpu.backends.base import RungResult, RunResult
-from vlog_tpu.backends.jax_backend import prepare_init_segment
+from vlog_tpu.utils.fsio import prepare_init_segment
 from vlog_tpu.backends.rate_control import RateController
 from vlog_tpu.backends.source import open_source
 from vlog_tpu.codecs.hevc.api import HevcEncoder
@@ -158,6 +158,10 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                                       np.asarray(rv))
                     enc = encoders[rung.name]
                     enc.qp = controllers[rung.name].qp
+                    # dithered integer QPs realizing the controller's
+                    # fractional working point, so observe() is keyed to
+                    # what was actually encoded (per-frame slice_qp_delta)
+                    qps = controllers[rung.name].frame_qps(ry.shape[0])
                     if clen > 1:
                         frames = []
                         for c0 in range(0, ry.shape[0], clen):
@@ -165,10 +169,12 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                                 ry[c0:c0 + clen], ru[c0:c0 + clen],
                                 rv[c0:c0 + clen], pool=entropy_pool,
                                 search=config.MOTION_SEARCH_RADIUS,
-                                chain_len=clen))
+                                chain_len=clen,
+                                frame_qps=qps[c0:c0 + clen]))
                     else:
                         frames = enc.encode_batch(ry, ru, rv,
-                                                  pool=entropy_pool)
+                                                  pool=entropy_pool,
+                                                  frame_qps=qps)
                     controllers[rung.name].observe(
                         sum(len(f.sample) for f in frames), len(frames))
                     for f in frames:
@@ -248,4 +254,5 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         rungs=results, frames_processed=frames_done, duration_s=duration_s,
         thumbnail_path=thumb_path, wall_s=time.monotonic() - t0,
         variants=variants, fps=fps,
-        segment_duration_s=plan.segment_duration_s)
+        segment_duration_s=plan.segment_duration_s,
+        gop_len=plan.gop_len)

@@ -51,7 +51,7 @@ from vlog_tpu.codecs.jpeg import encode_jpeg_yuv420
 from vlog_tpu.media import hls
 from vlog_tpu.media.fmp4 import Sample, TrackConfig, avc1_sample_entry, init_segment, media_segment
 from vlog_tpu.media.probe import VideoInfo
-from vlog_tpu.utils.fsio import atomic_write_bytes, atomic_write_text
+from vlog_tpu.utils.fsio import atomic_write_bytes, atomic_write_text, prepare_init_segment
 from vlog_tpu.ops.colorspace import yuv420_to_rgb
 from vlog_tpu.ops.resize import resize_yuv420
 
@@ -203,9 +203,14 @@ class JaxBackend:
         psnr_acc: dict[str, list[float]] = {}
         init_matched: dict[str, bool] = {}
         for rung in plan.rungs:
+            # Chain mode runs the in-loop deblocking filter (the DSP and
+            # the slice headers' idc must agree — ladder_chain_program
+            # gets the same flag below); intra mode leaves it off.
             enc = H264Encoder(width=rung.width, height=rung.height,
                               fps_num=plan.fps_num, fps_den=plan.fps_den,
-                              qp=rung.qp, entropy=config.H264_ENTROPY)
+                              qp=rung.qp, entropy=config.H264_ENTROPY,
+                              deblock=(config.H264_DEBLOCK
+                                       and plan.gop_len > 1))
             encoders[rung.name] = enc
             tracks[rung.name] = TrackConfig(
                 track_id=1, handler="vide", timescale=timescale,
@@ -337,7 +342,8 @@ class JaxBackend:
             batch_n = clen * chains_per
             fn, mats = ladder_chain_program(
                 rungs_spec, src_h, src_w,
-                search=config.MOTION_SEARCH_RADIUS, mesh=mesh)
+                search=config.MOTION_SEARCH_RADIUS, mesh=mesh,
+                deblock=config.H264_DEBLOCK)
         else:
             fn, mats = ladder_encode_program(rungs_spec, src_h, src_w, mesh)
             # Fixed staged batch size (single compile; mesh-divisible).
@@ -351,6 +357,13 @@ class JaxBackend:
             for r in plan.rungs
         }
         npix = {r.name: r.height * r.width for r in plan.rungs}
+
+        # Stage accounting: decode_wait = blocked on the prefetch fifo;
+        # device_pull = blocked on np.asarray of dispatch outputs (device
+        # compute + d2h transfer, since dispatch is async); entropy =
+        # host slice coding; package = segment mux + fsync.
+        prof = {"decode_wait_s": 0.0, "device_pull_s": 0.0,
+                "entropy_s": 0.0, "package_s": 0.0}
 
         def dispatch(by, bu, bv):
             n_real = by.shape[0]
@@ -399,11 +412,14 @@ class JaxBackend:
             for rung in plan.rungs:
                 name = rung.name
                 ro = outs[name]
+                tp = time.perf_counter()
                 sse = np.asarray(ro["sse_y"])             # (nc, clen)
                 host = {k: np.asarray(ro[k]) for k in
                         ("i_luma_dc", "i_luma_ac", "i_chroma_dc",
                          "i_chroma_ac", "p_luma", "p_chroma_dc",
                          "p_chroma_ac", "mv")}
+                prof["device_pull_s"] += time.perf_counter() - tp
+                te = time.perf_counter()
                 qarr = np.asarray(qps[name])              # (nc, clen)
                 batch_bytes = 0
                 n_frames = 0
@@ -439,10 +455,13 @@ class JaxBackend:
                         batch_bytes += len(ef.avcc)
                     n_frames += keep
                 controllers[name].observe(batch_bytes, max(n_frames, 1))
+                prof["entropy_s"] += time.perf_counter() - te
+                tw = time.perf_counter()
                 while len(pending[name]) >= frames_per_seg:
                     chunk = pending[name][:frames_per_seg]
                     pending[name] = pending[name][frames_per_seg:]
                     write_segment(rung, chunk)
+                prof["package_s"] += time.perf_counter() - tw
             frames_done += n_real
             if progress_cb:
                 # total is an estimate for foreign sources; never report
@@ -458,11 +477,14 @@ class JaxBackend:
                 ro = outs[name]
                 # device ships int16 (halves the transfer); the CAVLC
                 # coders (C + Python) work on int32
+                tp = time.perf_counter()
                 levels = {
                     k: np.ascontiguousarray(np.asarray(ro[k])[:n_real],
                                             np.int32)
                     for k in ("luma_dc", "luma_ac", "chroma_dc", "chroma_ac")}
                 sse = np.asarray(ro["sse_y"])[:n_real]
+                prof["device_pull_s"] += time.perf_counter() - tp
+                te = time.perf_counter()
                 mse = np.maximum(sse / npix[name], 1e-12)
                 psnrs = np.where(mse < 1e-9, 99.0,
                                  10 * np.log10(255 ** 2 / mse))
@@ -476,10 +498,13 @@ class JaxBackend:
                     psnr_acc[name].append(ef.psnr_y)
                     batch_bytes += len(ef.avcc)
                 controllers[name].observe(batch_bytes, n_real)
+                prof["entropy_s"] += time.perf_counter() - te
+                tw = time.perf_counter()
                 while len(pending[name]) >= frames_per_seg:
                     chunk = pending[name][:frames_per_seg]
                     pending[name] = pending[name][frames_per_seg:]
                     write_segment(rung, chunk)
+                prof["package_s"] += time.perf_counter() - tw
             frames_done += n_real
             if progress_cb:
                 # total is an estimate for foreign sources; never report
@@ -525,7 +550,9 @@ class JaxBackend:
         first = True
         try:
             while True:
+                td = time.perf_counter()
                 item = fifo.get()
+                prof["decode_wait_s"] += time.perf_counter() - td
                 if item is eof:
                     break
                 if isinstance(item, BaseException):
@@ -628,6 +655,8 @@ class JaxBackend:
             wall_s=time.monotonic() - t0,
             variants=variants, fps=fps,
             segment_duration_s=plan.segment_duration_s,
+            stage_s={k: round(v, 3) for k, v in prof.items()},
+            gop_len=plan.gop_len,
         )
 
     # ------------------------------------------------------------------

@@ -116,8 +116,8 @@ class RateController:
             else:
                 # interpolate in log-bit space inside the bracket; the
                 # fractional result is realized by frame dithering
-                l_lo = math.log2(over[q_lo])
-                l_hi = math.log2(under[q_hi])
+                l_lo = math.log2(max(over[q_lo], 1e-9))
+                l_hi = math.log2(max(under[q_hi], 1e-9))
                 t = (math.log2(target) - l_lo) / (l_hi - l_lo)
                 nxt = q_lo + t * (q_hi - q_lo)
                 span = q_hi - q_lo

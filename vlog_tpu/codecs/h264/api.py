@@ -51,30 +51,44 @@ class H264Encoder:
     idr_period: int = 1          # every frame IDR by default
     entropy_threads: int = 8
     entropy: str = "cavlc"       # "cavlc" (C fast path) | "cabac"
+    # In-loop deblocking (spec 8.7): the chain path enables this — the
+    # DSP's reconstruction loop must apply codecs/h264/deblock.py when
+    # the slice headers signal idc=0, or prediction drifts vs decoders.
+    deblock: bool = False
     _frame_index: int = field(default=0, init=False)
     _idr_pic_id: int = field(default=0, init=False)
 
     def __post_init__(self):
         if self.entropy not in ("cavlc", "cabac"):
             raise ValueError(f"unknown entropy coder {self.entropy!r}")
+        # CABAC is prohibited in Baseline (spec A.2.1); signal Main so
+        # the SPS/avcC/RFC6381 string match the actual toolset.
+        profile = (syntax.PROFILE_MAIN if self.entropy == "cabac"
+                   else syntax.PROFILE_BASELINE)
         self.sps = syntax.make_sps(
             syntax.SpsConfig(
                 width=self.width, height=self.height,
                 fps_num=self.fps_num, fps_den=self.fps_den,
+                profile_idc=profile,
             )
         )
         self.pps = syntax.make_pps(init_qp=self.qp,
                                    cabac=self.entropy == "cabac")
 
     def _slice_fns(self):
+        from functools import partial
+
         if self.entropy == "cabac":
             from vlog_tpu.codecs.h264.cabac_enc import (
                 encode_p_slice_cabac, encode_slice_cabac)
 
-            return encode_slice_cabac, encode_p_slice_cabac
-        from vlog_tpu.codecs.h264.cavlc import encode_p_slice
+            i_fn, p_fn = encode_slice_cabac, encode_p_slice_cabac
+        else:
+            from vlog_tpu.codecs.h264.cavlc import encode_p_slice
 
-        return encode_slice, encode_p_slice
+            i_fn, p_fn = encode_slice, encode_p_slice
+        return (partial(i_fn, deblock=self.deblock),
+                partial(p_fn, deblock=self.deblock))
 
     # ---- stream metadata -------------------------------------------------
     @property

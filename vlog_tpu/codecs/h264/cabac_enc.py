@@ -323,7 +323,8 @@ def _native_cabac(kind: str, arrays: list, mbh: int, mbw: int, qp: int,
 
 def encode_p_slice_cabac(plevels: dict, *, qp: int, init_qp: int,
                          frame_num: int,
-                         log2_max_frame_num: int = 8) -> syntax.NalUnit:
+                         log2_max_frame_num: int = 8,
+                         deblock: bool = False) -> syntax.NalUnit:
     """Full P-slice NAL with CABAC (counterpart of cavlc.encode_p_slice:
     P_Skip / P_L0_16x16, quarter-pel MVDs against the median predictor).
 
@@ -339,7 +340,7 @@ def encode_p_slice_cabac(plevels: dict, *, qp: int, init_qp: int,
     syntax.write_slice_header(
         w, first_mb=0, slice_qp=qp, init_qp=init_qp, idr=False,
         frame_num=frame_num, log2_max_frame_num=log2_max_frame_num,
-        slice_type=syntax.SLICE_P, cabac=True)
+        slice_type=syntax.SLICE_P, cabac=True, deblock=deblock)
     w.byte_align(1)
     header = w.getvalue()
 
@@ -468,7 +469,8 @@ def encode_p_slice_cabac(plevels: dict, *, qp: int, init_qp: int,
 def encode_slice_cabac(levels, *, qp: int, init_qp: int,
                        frame_num: int = 0, idr: bool = True,
                        idr_pic_id: int = 0,
-                       log2_max_frame_num: int = 8) -> syntax.NalUnit:
+                       log2_max_frame_num: int = 8,
+                       deblock: bool = False) -> syntax.NalUnit:
     """Full I-slice NAL with CABAC entropy (counterpart of
     cavlc.encode_slice)."""
     mbh, mbw = levels.mb_height, levels.mb_width
@@ -476,7 +478,7 @@ def encode_slice_cabac(levels, *, qp: int, init_qp: int,
     syntax.write_slice_header(
         w, first_mb=0, slice_qp=qp, init_qp=init_qp, idr=idr,
         frame_num=frame_num, idr_pic_id=idr_pic_id,
-        log2_max_frame_num=log2_max_frame_num, cabac=True)
+        log2_max_frame_num=log2_max_frame_num, cabac=True, deblock=deblock)
     w.byte_align(1)                     # cabac_alignment_one_bit(s)
     header = w.getvalue()
     nal_type = syntax.NAL_IDR if idr else syntax.NAL_SLICE

@@ -235,6 +235,7 @@ def encode_slice(
     idr: bool = True,
     idr_pic_id: int = 0,
     log2_max_frame_num: int = 8,
+    deblock: bool = False,
 ) -> syntax.NalUnit:
     """Full slice NAL (header + slice_data) for one frame's levels.
 
@@ -247,7 +248,7 @@ def encode_slice(
     syntax.write_slice_header(
         w, first_mb=0, slice_qp=qp, init_qp=init_qp, idr=idr,
         frame_num=frame_num, idr_pic_id=idr_pic_id,
-        log2_max_frame_num=log2_max_frame_num,
+        log2_max_frame_num=log2_max_frame_num, deblock=deblock,
     )
     nal_type = syntax.NAL_IDR if idr else syntax.NAL_SLICE
 
@@ -449,6 +450,7 @@ def encode_p_slice(
     init_qp: int,
     frame_num: int,
     log2_max_frame_num: int = 8,
+    deblock: bool = False,
 ) -> syntax.NalUnit:
     """Full P-slice NAL for one frame's inter levels.
 
@@ -461,7 +463,7 @@ def encode_p_slice(
     syntax.write_slice_header(
         w, first_mb=0, slice_qp=qp, init_qp=init_qp, idr=False,
         frame_num=frame_num, log2_max_frame_num=log2_max_frame_num,
-        slice_type=syntax.SLICE_P,
+        slice_type=syntax.SLICE_P, deblock=deblock,
     )
     rbsp = _encode_p_slice_native(plevels, w)
     if rbsp is not None:

@@ -251,6 +251,7 @@ class SliceHeader:
     frame_num: int
     idr: bool
     qp: int
+    deblock: bool = False   # disable_deblocking_filter_idc == 0
 
 
 def parse_slice_header(r: BitReader, sps: Sps, pps: Pps, nal_type: int,
@@ -286,11 +287,18 @@ def parse_slice_header(r: BitReader, sps: Sps, pps: Pps, nal_type: int,
         if r.read_ue() != 0:             # cabac_init_idc
             raise UnsupportedStream("cabac_init_idc != 0 not supported")
     qp = pps.init_qp + r.read_se()
+    deblock = False
     if pps.deblocking_filter_control_present:
         idc = r.read_ue()
-        if idc != 1:
-            raise UnsupportedStream("in-loop deblocking not supported")
-    return SliceHeader(first_mb, slice_type, pps_id, frame_num, idr, qp)
+        if idc == 0:
+            deblock = True
+            if r.read_se() != 0 or r.read_se() != 0:
+                raise UnsupportedStream(
+                    "nonzero deblocking alpha/beta offsets not supported")
+        elif idc != 1:
+            raise UnsupportedStream(f"deblocking idc {idc} not supported")
+    return SliceHeader(first_mb, slice_type, pps_id, frame_num, idr, qp,
+                       deblock)
 
 
 # --------------------------------------------------------------------------
@@ -777,12 +785,15 @@ class H264Decoder:
             levels = decode_slice_data(r, self.sps, header)
         levels["is_p"] = is_p
         levels["qp"] = header.qp
+        levels["deblock"] = header.deblock
         return levels
 
     def _reconstruct(self, levels: dict) -> tuple:
         """Levels -> padded planes; updates the reference picture."""
         qp = levels.pop("qp")
-        if levels.pop("is_p", False):
+        deblock = levels.pop("deblock", False)
+        is_p = levels.pop("is_p", False)
+        if is_p:
             if self._ref is None:
                 raise DecodeError("P slice with no reference picture")
             mv_q = levels.pop("mv_q")                   # (mbh, mbw, 2) (x, y)
@@ -795,6 +806,26 @@ class H264Decoder:
             y, u, v = reconstruct_p_frame(levels, *self._ref, qp=qp)
         else:
             y, u, v = reconstruct_frame(levels, qp=qp)
+        if deblock:
+            # spec 8.7 in-loop filter — same JAX wavefront the encoder
+            # runs, with bS from the decoded syntax elements
+            from vlog_tpu.codecs.h264.deblock import (
+                deblock_frame, intra_bs, p_bs)
+
+            mbh, mbw = np.asarray(y).shape[0] // 16, \
+                np.asarray(y).shape[1] // 16
+            if is_p:
+                luma = np.asarray(levels["luma"])
+                nz = np.any(luma != 0, axis=(-1, -2))   # (mbh, mbw, 4, 4)
+                nz4 = nz.transpose(0, 2, 1, 3).reshape(4 * mbh, 4 * mbw)
+                bsv, bsh = p_bs(jnp.asarray(nz4),
+                                jnp.asarray(levels["mv_q"]))
+            else:
+                bsv, bsh = intra_bs(mbh, mbw)
+            y, u, v = deblock_frame(y, u, v, qp=qp, bs_v=bsv, bs_h=bsh)
+            y, u, v = (jnp.asarray(y).astype(jnp.uint8),
+                       jnp.asarray(u).astype(jnp.uint8),
+                       jnp.asarray(v).astype(jnp.uint8))
         self._ref = (np.asarray(y), np.asarray(u), np.asarray(v))
         return y, u, v
 
@@ -833,7 +864,9 @@ class H264Decoder:
         if not all_levels:
             return []
         qps = {lv["qp"] for lv in all_levels}
-        if len(qps) == 1 and not any(lv.get("is_p") for lv in all_levels):
+        if (len(qps) == 1
+                and not any(lv.get("is_p") for lv in all_levels)
+                and not any(lv.get("deblock") for lv in all_levels)):
             qp = qps.pop()
             stacked = {
                 k: np.stack([lv[k] for lv in all_levels])

@@ -186,13 +186,16 @@ def write_slice_header(
     log2_max_frame_num: int = 8,
     slice_type: int = SLICE_I,
     cabac: bool = False,
+    deblock: bool = False,
 ) -> None:
     """slice_header (spec 7.3.3) for our stream shape.
 
-    pic_order_cnt_type=2 and frame_mbs_only keep this short. Deblocking is
-    signalled off (idc=1) — the PPS sets
-    deblocking_filter_control_present_flag. P slices use the PPS default
-    single reference (no override, no list modification).
+    pic_order_cnt_type=2 and frame_mbs_only keep this short. The PPS
+    sets deblocking_filter_control_present_flag, so every slice signals
+    the filter explicitly: idc=0 (on, zero offsets — the in-loop filter
+    in codecs/h264/deblock.py mirrors the decoder exactly) or idc=1
+    (off). P slices use the PPS default single reference (no override,
+    no list modification).
     """
     is_p = slice_type in (0, 5)
     w.write_ue(first_mb)
@@ -213,8 +216,13 @@ def write_slice_header(
     if cabac and is_p:
         w.write_ue(0)    # cabac_init_idc
     w.write_se(slice_qp - init_qp)                 # slice_qp_delta
-    w.write_ue(1)                                  # disable_deblocking_filter_idc
-    # idc==1 -> no alpha/beta offsets
+    # disable_deblocking_filter_idc: 0 = filter on (zero offsets), 1 = off
+    if deblock:
+        w.write_ue(0)
+        w.write_se(0)                              # slice_alpha_c0_offset_div2
+        w.write_se(0)                              # slice_beta_offset_div2
+    else:
+        w.write_ue(1)
 
 
 def avcc_config(sps: NalUnit, pps: NalUnit) -> bytes:

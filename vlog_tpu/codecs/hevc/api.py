@@ -140,7 +140,8 @@ class HevcEncoder:
     def encode_chain(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
                      pool: ThreadPoolExecutor | None = None, *,
                      search: int = 16, chain_len: int | None = None,
-                     partitions: bool | None = None
+                     partitions: bool | None = None,
+                     frame_qps: np.ndarray | None = None
                      ) -> list[EncodedFrame]:
         """Encode one I + P chain: y (T, H, W), u/v (T, H/2, W/2) uint8.
 
@@ -153,7 +154,12 @@ class HevcEncoder:
         ``chain_len``: pad short tail chains (EOF) up to this length
         with replicated last frames so every dispatch reuses one
         compiled program; the padding frames are dropped from the
-        output."""
+        output.
+
+        ``frame_qps``: per-frame integer QPs (length >= T) realizing the
+        rate controller's fractional working point (rate_control
+        .frame_qps); slice_qp_delta signals each one. Defaults to a
+        constant ``self.qp``."""
         from vlog_tpu.codecs.hevc.jax_core import encode_chain_dsp
         from vlog_tpu.codecs.hevc.pslice import PSliceWriter, p_nal
 
@@ -168,14 +174,23 @@ class HevcEncoder:
             v = np.concatenate([v, np.repeat(v[-1:], reps, 0)])
         t, h, w = y.shape
         rows, cols = h // CTB, w // CTB
-        qp_i = max(10, self.qp - 2)
+        if frame_qps is None:
+            fqs = np.full((t,), self.qp, np.int32)
+        else:
+            fqs = np.asarray(frame_qps, np.int32).reshape(-1)
+            if fqs.shape[0] < t:    # tail-chain padding frames
+                fqs = np.concatenate(
+                    [fqs, np.full((t - fqs.shape[0],), fqs[-1], np.int32)])
+        qp_i = max(10, int(fqs[0]) - 2)
+        qp_p_vec = (fqs[1:] if t > 1
+                    else np.full((1,), self.qp, np.int32))
         if partitions is None:
             from vlog_tpu import config
 
             partitions = config.HEVC_PARTITIONS
         (intra, recon0), (p32, p16, parts, mvs, precons) = \
             encode_chain_dsp(y, u, v, search, np.int32(qp_i),
-                             np.int32(self.qp), partitions)
+                             qp_p_vec, partitions)
         recons = [recon0] + ([tuple(np.asarray(p[i]) for p in precons)
                               for i in range(t - 1)] if t > 1 else [])
         intra_np = tuple(np.asarray(a) for a in intra)
@@ -193,7 +208,7 @@ class HevcEncoder:
                            .astype(np.float64)) ** 2)
             return float(10 * np.log10(255.0 ** 2 / max(mse, 1e-12)))
 
-        def p_entropy_c(ly, lu, lvv, mvg) -> bytes | None:
+        def p_entropy_c(ly, lu, lvv, mvg, qp) -> bytes | None:
             """C P-slice coder — all-2Nx2N slices only (its contract)."""
             from vlog_tpu.native.build import get_lib
 
@@ -217,7 +232,7 @@ class HevcEncoder:
             n = lib.vt_hevc_encode_p_slice(
                 la.ctypes.data_as(i16p), ua.ctypes.data_as(i16p),
                 va.ctypes.data_as(i16p), mva.ctypes.data_as(i32p),
-                rows, cols, self.qp, scratch.ctypes.data_as(i32p),
+                rows, cols, qp, scratch.ctypes.data_as(i32p),
                 out.ctypes.data_as(u8p), cap)
             return out[:n].tobytes() if n >= 0 else None
 
@@ -230,12 +245,16 @@ class HevcEncoder:
             l32 = tuple(a[idx] for a in p32_np)
             part = parts_np[idx]
             mvg = mv_np[idx]                    # (2R, 2C, 2) 16-cell map
+            qp = int(fqs[idx + 1])
             if not np.any(part != PART_2Nx2N):
-                payload = p_entropy_c(*l32, mvg)
+                payload = p_entropy_c(*l32, mvg, qp)
                 if payload is not None:
                     return payload
-            l16 = tuple(a[idx] for a in p16_np)
-            sw = PSliceWriter(self.qp, rows, cols)
+            # sub-TU codings exist only when partitions were enabled;
+            # an all-2Nx2N frame (C-coder decline path) never reads them
+            l16 = (tuple(a[idx] for a in p16_np)
+                   if p16_np is not None else None)
+            sw = PSliceWriter(qp, rows, cols)
             for r in range(rows):
                 for c in range(cols):
                     last = r == rows - 1 and c == cols - 1
@@ -272,7 +291,7 @@ class HevcEncoder:
                 payload = self._entropy(*intra_np, rows, cols, qp_i)
                 nal = syntax.idr_nal(qp_i, payload)
             else:
-                nal = p_nal(self.qp, i, p_entropy(i - 1))
+                nal = p_nal(int(fqs[i]), i, p_entropy(i - 1))
             raw = nal.to_bytes()
             return EncodedFrame(
                 sample=len(raw).to_bytes(4, "big") + raw,
@@ -287,7 +306,8 @@ class HevcEncoder:
         return list(pool.map(pack, range(t_real)))
 
     def encode_batch(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
-                     pool: ThreadPoolExecutor | None = None
+                     pool: ThreadPoolExecutor | None = None,
+                     frame_qps: np.ndarray | None = None
                      ) -> list[EncodedFrame]:
         """Encode a batch of frames: y (B, H, W), u/v (B, H/2, W/2)
         uint8.  DSP runs as one device dispatch; entropy per frame in
@@ -299,7 +319,14 @@ class HevcEncoder:
         v = self._pad(np.asarray(v, np.uint8), CTB // 2)
         b, h, w = y.shape
         rows, cols = h // CTB, w // CTB
-        qps = np.full((b,), self.qp, np.int32)
+        if frame_qps is None:
+            qps = np.full((b,), self.qp, np.int32)
+        else:
+            qps = np.asarray(frame_qps, np.int32).reshape(-1)[:b]
+            if qps.shape[0] < b:    # same short-vector pad as encode_chain
+                qps = np.concatenate(
+                    [qps, np.full((b - qps.shape[0],), qps[-1] if qps.size
+                                  else self.qp, np.int32)])
         (ly, lu, lv), (ry, _, _) = encode_batch_dsp(y, u, v, qps)
         ly = np.asarray(ly)
         lu = np.asarray(lu)
@@ -307,8 +334,9 @@ class HevcEncoder:
         ry = np.asarray(ry)
 
         def pack(i: int) -> EncodedFrame:
-            payload = self._entropy(ly[i], lu[i], lv[i], rows, cols)
-            nal = syntax.idr_nal(self.qp, payload)
+            qp = int(qps[i])
+            payload = self._entropy(ly[i], lu[i], lv[i], rows, cols, qp)
+            nal = syntax.idr_nal(qp, payload)
             raw = nal.to_bytes()
             mse = np.mean(
                 (ry[i, :self.height, :self.width].astype(np.float64)

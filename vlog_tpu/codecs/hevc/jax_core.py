@@ -462,21 +462,27 @@ def encode_chain_dsp(y, u, v, search, qp_i, qp_p, partitions=False):
     planes; returns intra levels, per-P levels/MVs, and recons.
 
     ``qp_i`` is typically qp_p-2: a finer anchor pays off down the whole
-    chain (same offset the H.264 chain path ships, +0.3-0.4 dB)."""
+    chain (same offset the H.264 chain path ships, +0.3-0.4 dB).
+    ``qp_p`` may be a scalar or a (T-1,) per-frame vector — the rate
+    controller's fractional working point is realized by dithering
+    integer QPs across the chain (rate_control.frame_qps), so it rides
+    the scan as a per-step input."""
     qp_i = jnp.asarray(qp_i, jnp.int32)
-    qp_p = jnp.asarray(qp_p, jnp.int32)
+    t = y.shape[0]
+    qp_p = jnp.broadcast_to(jnp.asarray(qp_p, jnp.int32).reshape(-1),
+                            (max(t - 1, 1),))
     (li, lui, lvi), (ry, ru, rv) = encode_frame_dsp(y[0], u[0], v[0], qp_i)
 
     def step(carry, frame):
-        fy, fu, fv = frame
+        fy, fu, fv, qpf = frame
         lv32, lv16, part, mv_map, recon = encode_p_frame_dsp(
-            fy, fu, fv, *carry, qp_p, search=search,
+            fy, fu, fv, *carry, qpf, search=search,
             partitions=partitions)
         return recon, (lv32, lv16, part, mv_map, recon)
 
-    if y.shape[0] > 1:
+    if t > 1:
         _, (p32, p16, parts, mvs, precons) = jax.lax.scan(
-            step, (ry, ru, rv), (y[1:], u[1:], v[1:]))
+            step, (ry, ru, rv), (y[1:], u[1:], v[1:], qp_p))
     else:
         p32 = p16 = parts = mvs = precons = None
     return ((li, lui, lvi), (ry, ru, rv)), (p32, p16, parts, mvs, precons)

@@ -228,6 +228,12 @@ MOTION_SEARCH_RADIUS: int = _env_int("VLOG_MOTION_SEARCH", 8, lo=1, hi=32)
 # native C coders. Changing this mid-tree invalidates partial resume
 # state (segments must share one PPS); re-transcode with force.
 H264_ENTROPY: str = _env_str("VLOG_H264_ENTROPY", "cabac")
+# In-loop deblocking (spec 8.7) for the chain path: smooths block edges
+# inside the prediction loop (the reference gets this from x264, which
+# always deblocks). Costs a wavefront pass per reconstructed frame on
+# device; intra-only mode leaves it off (deblocking is display-only
+# there and the device pass is the headline bench).
+H264_DEBLOCK: bool = _env_bool("VLOG_H264_DEBLOCK", True)
 # HEVC 2NxN/Nx2N inter partitions (oracle-proven; big wins on
 # split-motion content, but the mode-decision penalty is uncalibrated
 # for mixed content and partitioned slices entropy-code in Python —

@@ -79,8 +79,36 @@ class Transaction:
         return await self._db._tx_fetch_all(sql, params)
 
 
+def open_database(url: str):
+    """Facade factory: sqlite (default) or Postgres by URL scheme.
+
+    ``postgres://`` / ``postgresql://`` URLs (and libpq keyword DSNs
+    containing ``host=``/``dbname=``) return the first-party libpq-backed
+    :class:`vlog_tpu.db.pg.PgDatabase` — real ``FOR UPDATE SKIP LOCKED``
+    claims for multi-node fleets (reference api/database.py:11). Anything
+    else is a sqlite path/URL served by :class:`Database`.
+    """
+    low = url.strip().lower()
+    if (low.startswith(("postgres://", "postgresql://"))
+            or ("dbname=" in low and not low.startswith("sqlite"))):
+        from vlog_tpu.db.pg import PgDatabase
+
+        return PgDatabase(url)
+    return Database(url)
+
+
 class Database:
     """Async sqlite facade; safe to share within one event loop."""
+
+    dialect = "sqlite"
+    # sqlite's single writer makes BEGIN IMMEDIATE the row lock; the PG
+    # facade overrides this with " FOR UPDATE SKIP LOCKED".
+    row_lock_suffix = ""
+
+    @staticmethod
+    def greatest(*exprs: str) -> str:
+        # two-arg MAX is sqlite's scalar max; PG spells it GREATEST
+        return f"MAX({', '.join(exprs)})"
 
     def __init__(self, url: str):
         self.path = parse_database_url(url)

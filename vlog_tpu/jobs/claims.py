@@ -127,6 +127,10 @@ async def claim_job(
     async with db.transaction() as tx:
         # sweep expired leases first so they are claimable below
         await tx.execute(SWEEP_EXPIRED_SQL, {"now": t})
+        # On Postgres the suffix is FOR UPDATE SKIP LOCKED: concurrent
+        # claimants contend on row locks and skip each other's picks —
+        # the reference's exact mechanism (worker_api.py:1494-1556). On
+        # sqlite it is empty (BEGIN IMMEDIATE already serializes).
         row = await tx.fetch_one(
             f"""
             SELECT * FROM jobs
@@ -136,7 +140,7 @@ async def claim_job(
               AND (required_accelerator IS NULL OR required_accelerator = :accel)
               AND (min_code_version IS NULL OR min_code_version <= :cv)
             ORDER BY priority DESC, created_at ASC
-            LIMIT 1
+            LIMIT 1{db.row_lock_suffix}
             """,
             {"now": t, "accel": accelerator.value, "cv": code_version},
         )
@@ -281,7 +285,8 @@ async def release_job(
             raise js.JobStateError(f"job {job_id} does not exist")
         # Same ownership rule as progress: only the claim holder may release.
         js.guard_progress(row, worker_name, now=t)
-        attempt_sql = "attempt=MAX(attempt - 1, 0)," if refund_attempt else ""
+        attempt_sql = (f"attempt={db.greatest('attempt - 1', '0')},"
+                       if refund_attempt else "")
         await tx.execute(
             f"""
             UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,

@@ -27,7 +27,7 @@ import aiohttp
 import aiohttp.abc
 
 from vlog_tpu import config
-from vlog_tpu.db.core import Database, Row, now as db_now
+from vlog_tpu.db.core import Database, Row, now as db_now, open_database
 
 log = logging.getLogger("vlog_tpu.webhooks")
 
@@ -294,7 +294,7 @@ class WebhookDeliverer:
 async def _amain() -> None:
     from vlog_tpu.db.schema import create_all
 
-    db = Database(config.DATABASE_URL)
+    db = open_database(config.DATABASE_URL)
     await db.connect()
     await create_all(db)
     deliverer = WebhookDeliverer(db)

@@ -63,7 +63,12 @@ def run(n_devices: int) -> None:
     from vlog_tpu.parallel.ladder import ladder_chain_program, ladder_matrices  # noqa: F401
 
     clen = 3
-    cfn, cmats = ladder_chain_program(rungs, h, w, search=4, mesh=mesh)
+    from vlog_tpu import config
+
+    # Match production: the in-loop wavefront filter must compile and
+    # shard with the chain exactly as the backend will dispatch it.
+    cfn, cmats = ladder_chain_program(rungs, h, w, search=4, mesh=mesh,
+                                      deblock=config.H264_DEBLOCK)
     cy = rng.integers(0, 256, (n_devices, clen, h, w)).astype(np.uint8)
     cu = rng.integers(0, 256, (n_devices, clen, h // 2, w // 2)).astype(np.uint8)
     cv = rng.integers(0, 256, (n_devices, clen, h // 2, w // 2)).astype(np.uint8)

@@ -131,7 +131,8 @@ def ladder_encode_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
 
 @functools.lru_cache(maxsize=8)
 def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
-                         search: int = 8, mesh: Mesh | None = None
+                         search: int = 8, mesh: Mesh | None = None,
+                         deblock: bool = False
                          ) -> tuple[Callable, dict]:
     """The I+P chain ladder step (GOP_MODE="p" production path).
 
@@ -145,11 +146,18 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
     prediction serializes frames within a chain, never across devices
     (SURVEY §2d.5 adapted for temporal dependence).
 
+    With ``deblock`` the spec 8.7 in-loop filter (codecs/h264/deblock.py
+    wavefront) runs on every reconstruction before it becomes the next
+    frame's reference — slice headers must then signal idc=0
+    (H264Encoder(deblock=True)), and SSE measures the filtered picture
+    (what a decoder displays).
+
     Per rung output (int16 levels, device-only recon):
       i_luma_dc/(n,4,4) i_luma_ac i_chroma_dc i_chroma_ac   — frame 0
       p_luma (n, clen-1, mbh, mbw, 4,4,4,4), p_chroma_dc, p_chroma_ac
       mv (n, clen-1, mbh, mbw, 2) int16, sse_y (n, clen) float32
     """
+    from vlog_tpu.codecs.h264.deblock import deblock_frame, intra_bs, p_bs
     from vlog_tpu.codecs.h264.encoder import encode_frame
     from vlog_tpu.codecs.h264.inter import encode_p_frame
 
@@ -162,12 +170,21 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
         unflat = lambda p: p.reshape((n, clen) + p.shape[1:])
         py, pu, pv = unflat(py), unflat(pu), unflat(pv)
         ry = unflat(ry)
+        mbh, mbw = py.shape[-2] // 16, py.shape[-1] // 16
 
         i_out = jax.vmap(
             lambda a, b, c, q: encode_frame(a, b, c, qp=q)
         )(py[:, 0], pu[:, 0], pv[:, 0], qps[:, 0])
+        i_rec = (i_out["recon_y"], i_out["recon_u"], i_out["recon_v"])
+        if deblock:
+            ibs_v, ibs_h = intra_bs(mbh, mbw)
+            i_rec = jax.vmap(
+                lambda a, b, c, q: deblock_frame(
+                    a, b, c, qp=q, bs_v=ibs_v, bs_h=ibs_h)
+            )(*i_rec, qps[:, 0])
+            i_rec = tuple(p.astype(jnp.uint8) for p in i_rec)
         sse0 = jnp.sum(
-            (i_out["recon_y"][:, :h, :w].astype(jnp.float32)
+            (i_rec[0][:, :h, :w].astype(jnp.float32)
              - ry[:, 0].astype(jnp.float32)) ** 2, axis=(1, 2))
 
         def step(carry, xs):
@@ -177,8 +194,21 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
                 lambda a, b, c, r1, r2, r3, qq: encode_p_frame(
                     a, b, c, r1, r2, r3, qp=qq, search=search)
             )(cy, cu, cv, ref_y, ref_u, ref_v, q)
+            rec = (pout["recon_y"], pout["recon_u"], pout["recon_v"])
+            if deblock:
+                # bS from what the decoder will see: the (decimated)
+                # coded levels and the per-MB motion field
+                nz = jnp.any(pout["luma"] != 0, axis=(-1, -2))
+                nz4 = jnp.transpose(nz, (0, 1, 3, 2, 4)).reshape(
+                    nz.shape[0], 4 * mbh, 4 * mbw)
+                bsv, bsh = jax.vmap(p_bs)(nz4, pout["mv"])
+                rec = jax.vmap(
+                    lambda a, b, c, q2, bv, bh: deblock_frame(
+                        a, b, c, qp=q2, bs_v=bv, bs_h=bh)
+                )(*rec, q, bsv, bsh)
+                rec = tuple(p.astype(jnp.uint8) for p in rec)
             sse = jnp.sum(
-                (pout["recon_y"][:, :h, :w].astype(jnp.float32)
+                (rec[0][:, :h, :w].astype(jnp.float32)
                  - src_y.astype(jnp.float32)) ** 2, axis=(1, 2))
             out = {
                 "luma": pout["luma"].astype(jnp.int16),
@@ -187,13 +217,12 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
                 "mv": pout["mv"].astype(jnp.int16),
                 "sse": sse,
             }
-            return ((pout["recon_y"], pout["recon_u"], pout["recon_v"]),
-                    out)
+            return (rec, out)
 
         t_axis = lambda p: jnp.moveaxis(p[:, 1:], 1, 0)  # (clen-1, n, ...)
         _, scanned = jax.lax.scan(
             step,
-            (i_out["recon_y"], i_out["recon_u"], i_out["recon_v"]),
+            i_rec,
             (t_axis(py), t_axis(pu), t_axis(pv),
              jnp.moveaxis(qps[:, 1:], 1, 0), t_axis(ry)),
         )

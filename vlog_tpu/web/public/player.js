@@ -203,7 +203,8 @@ export class CmafPlayer {
       ? audio[variants[0].audioGroup] : null;
 
     this.ms = new MediaSource();
-    this.video.src = URL.createObjectURL(this.ms);
+    this.msUrl = URL.createObjectURL(this.ms);
+    this.video.src = this.msUrl;
     await waitEvent(this.ms, "sourceopen");
 
     const v0 = 0; // open at the lowest rung; auto-switch climbs fast
@@ -285,6 +286,10 @@ export class CmafPlayer {
   }
 
   destroy() {
+    if (this.msUrl) {
+      try { URL.revokeObjectURL(this.msUrl); } catch (e) { /* ok */ }
+      this.msUrl = null;
+    }
     try { this.video.removeAttribute("src"); this.video.load(); } catch (e) { /* ok */ }
   }
 }

@@ -26,7 +26,7 @@ from pathlib import Path
 from typing import Any, Awaitable, Callable
 
 from vlog_tpu import config
-from vlog_tpu.db.core import Database, Row, now as db_now
+from vlog_tpu.db.core import Database, Row, now as db_now, open_database
 from vlog_tpu.enums import AcceleratorKind, JobKind, VideoStatus
 from vlog_tpu.jobs import claims, state as js, videos as vids
 
@@ -557,7 +557,7 @@ async def _amain(args: argparse.Namespace) -> None:
     from vlog_tpu.db.schema import create_all
 
     config.ensure_dirs()
-    db = Database(args.db)
+    db = open_database(args.db)
     await db.connect()
     await create_all(db)
 
