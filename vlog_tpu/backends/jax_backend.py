@@ -222,7 +222,10 @@ class JaxBackend:
             rdir.mkdir(parents=True, exist_ok=True)
             if not ts_mode:
                 init_matched[rung.name] = prepare_init_segment(
-                    rdir, init_segment(tracks[rung.name]))
+                    rdir, init_segment(tracks[rung.name]),
+                    config_tag=(f"h264:{config.H264_ENTROPY}"
+                                f":deblock={int(enc.deblock)}"
+                                f":gop={plan.gop_len}"))
             seg_counts[rung.name] = 0
             seg_durs[rung.name] = []
             bytes_written[rung.name] = 0
@@ -423,11 +426,15 @@ class JaxBackend:
                 qarr = np.asarray(qps[name])              # (nc, clen)
                 batch_bytes = 0
                 n_frames = 0
+                rc_qs = []   # P-frame dither values: the working-point
+                #              mix the controller must attribute to (the
+                #              I frames carry the -2 anchor, excluded)
                 for ci in range(chains_per):
                     base = ci * clen
                     if base >= n_real:
                         break
                     keep = min(clen, n_real - base)
+                    rc_qs.append(qarr[ci, 1:keep])
                     lv0 = FrameLevels(
                         luma_dc=i32(host["i_luma_dc"][ci]),
                         luma_ac=i32(host["i_luma_ac"][ci]),
@@ -454,7 +461,11 @@ class JaxBackend:
                         psnr_acc[name].append(ef.psnr_y)
                         batch_bytes += len(ef.avcc)
                     n_frames += keep
-                controllers[name].observe(batch_bytes, max(n_frames, 1))
+                rc_mix = (np.concatenate(rc_qs) if rc_qs else None)
+                if rc_mix is not None and rc_mix.size == 0:
+                    rc_mix = None
+                controllers[name].observe(batch_bytes, max(n_frames, 1),
+                                          frame_qps=rc_mix)
                 prof["entropy_s"] += time.perf_counter() - te
                 tw = time.perf_counter()
                 while len(pending[name]) >= frames_per_seg:
@@ -497,7 +508,8 @@ class JaxBackend:
                                duration=frame_dur, is_sync=ef.is_idr))
                     psnr_acc[name].append(ef.psnr_y)
                     batch_bytes += len(ef.avcc)
-                controllers[name].observe(batch_bytes, n_real)
+                controllers[name].observe(batch_bytes, n_real,
+                                          frame_qps=q_used)
                 prof["entropy_s"] += time.perf_counter() - te
                 tw = time.perf_counter()
                 while len(pending[name]) >= frames_per_seg:
@@ -547,7 +559,6 @@ class JaxBackend:
         decode_thread.start()
 
         inflight = None
-        first = True
         try:
             while True:
                 td = time.perf_counter()
@@ -564,12 +575,15 @@ class JaxBackend:
                     thumb_path = str(out / "thumbnail.jpg")
                     self._write_thumbnail(by[0], bu[0], bv[0], thumb_path)
                 staged = dispatch(by, bu, bv)
-                if first:
-                    # Calibration batch: consume synchronously so the rate
-                    # controllers' full-jump correction lands before batch
-                    # 2 is staged (costs one batch of overlap, once).
+                if any(controllers[r.name].hunting for r in plan.rungs):
+                    # Calibration/cliff hunt: consume synchronously so
+                    # every correction lands before the next batch is
+                    # staged — with a batch in flight each QP move lags
+                    # one extra batch, doubling any overshoot burn.
+                    if inflight is not None:
+                        consume(*inflight)
+                        inflight = None
                     consume(*staged)
-                    first = False
                     continue
                 # Consume the PREVIOUS batch while this one computes: host
                 # entropy/packaging overlaps device work (the reference's

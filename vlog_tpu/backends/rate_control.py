@@ -6,20 +6,26 @@ control loop is explicit: observe achieved bits after each GOP batch,
 pick the next QP. The DSP takes QP as a *traced* per-frame value
 (ops/transform.py), so stepping costs no recompile.
 
-Two structural choices make this robust where slope controllers fail:
+Design (round 4, replacing the log-bracket search): the rate curve of a
+real encoder is NOT smooth — MB decimation, skip thresholds, and dead
+zones produce CLIFFS where bits drop several-fold across one QP step
+(measured: 64k -> 8k bytes/frame between QP 27 and 28 on noisy content).
+Two structural choices make the controller exact there:
 
-- **Bracketing search** over the observed (QP -> bytes/frame) points.
-  The textbook "bits halve per +6 QP" rule only extrapolates while no
-  bracket exists (including the first calibration jump); once
-  observations straddle the target, the next QP interpolates between
-  the bracketing points in log-bit space, so response cliffs and
-  temporal drift cannot produce limit cycles.
-- **Fractional QP via frame dithering.** The working QP is continuous;
-  ``frame_qps(n)`` assigns each frame floor or ceil in a Bresenham
-  pattern matching the fraction. Rate mixes linearly in the frame
-  count, so targets BETWEEN two integer QPs' rates — exactly the cliff
-  case where no single QP lands near the target — are reachable. This
-  is the frame-level analog of x264's adaptive quantization.
+- **Integer-QP rate estimates.** ``frame_qps(n)`` realizes a fractional
+  working point q as a Bresenham mix of floor(q) and floor(q)+1 frames,
+  so the achieved rate is a LINEAR blend of the two integers' rates.
+  The controller therefore estimates bytes/frame per INTEGER QP (EMA,
+  updated by attributing each batch observation to the two integers in
+  proportion to their mix), instead of curve-fitting fractional points.
+- **Analytic dither fraction.** Once adjacent integers (qa, qa+1)
+  bracket the target, the mix fraction is solved directly:
+  f = (r(qa) - target) / (r(qa) - r(qa+1)), and q = qa + f. One step
+  lands ON target even when the target sits inside a cliff no single QP
+  can reach. Non-adjacent brackets bisect at integers; no bracket
+  extrapolates on the textbook bits-halve-per-6-QP slope, clamped to
+  ±2*max_step per batch — calibration included, so a cliff can cost at
+  most one bounded-error batch, never a 5x overshoot burn.
 """
 
 from __future__ import annotations
@@ -40,18 +46,28 @@ class RateController:
     init_qp: int
     min_qp: int = 10
     max_qp: int = 48
-    damping: float = 0.6       # kept for API compat (unused by search)
-    max_step: int = 4          # extrapolation step clamp (x2 applied)
+    damping: float = 0.6       # kept for API compat (unused)
+    max_step: int = 4          # extrapolation clamp (x2 applied)
     ema_alpha: float = 0.5     # per-QP estimate update weight
     band: float = 0.15         # +-15% of target counts as converged
 
     _q: float = field(init=False)
-    _obs: dict = field(default_factory=dict, init=False)  # q -> bpf EMA
+    _obs: dict = field(default_factory=dict, init=False)   # int qp -> bpf
     _order: list = field(default_factory=list, init=False)
     _calibrating: bool = field(default=True, init=False)
+    _hunting: bool = field(default=True, init=False)
 
     def __post_init__(self) -> None:
         self._q = float(self.init_qp)
+
+    @property
+    def hunting(self) -> bool:
+        """True until an observation lands within 1.5x of target. While
+        hunting, the backend consumes batches SYNCHRONOUSLY (no
+        one-batch-in-flight overlap): with a batch in flight every
+        correction lags one extra batch, and a calibration jump past a
+        rate cliff would burn two 5x batches instead of one."""
+        return self.target_bps > 0 and self._hunting
 
     @property
     def qp(self) -> int:
@@ -77,63 +93,148 @@ class RateController:
             np.int32)
 
     # ------------------------------------------------------------------
-    def _record(self, q: float, bpf: float) -> None:
-        key = round(q, 2)
-        if key in self._obs:
-            self._obs[key] += self.ema_alpha * (bpf - self._obs[key])
-            self._order.remove(key)
-        else:
-            self._obs[key] = bpf
-        self._order.append(key)
-        while len(self._order) > 8:            # bounded, recency-kept
-            self._obs.pop(self._order.pop(0))
+    def _touch(self, q: int) -> None:
+        if q in self._order:
+            self._order.remove(q)
+        self._order.append(q)
+        while len(self._order) > 12:          # bounded, recency-kept
+            self._obs.pop(self._order.pop(0), None)
 
-    def observe(self, bytes_out: int, n_frames: int) -> int:
-        """Feed achieved bytes for ``n_frames`` frames; returns next QP."""
+    def _upd(self, q: int, bpf: float, weight: float = 1.0) -> None:
+        bpf = max(bpf, 1.0)
+        if q in self._obs:
+            self._obs[q] += self.ema_alpha * weight * (bpf - self._obs[q])
+        else:
+            self._obs[q] = bpf
+        self._touch(q)
+
+    def _attribute(self, bpf: float, lo: int, f: float) -> None:
+        """Fold one batch observation into the integer estimates for the
+        realized (lo, lo+1) mix with fraction ``f`` of frames at lo+1."""
+        lo = int(min(max(lo, self.min_qp), self.max_qp))
+        hi = int(min(lo + 1, self.max_qp))
+        if f < 1e-6 or hi == lo:
+            self._upd(lo, bpf)
+            return
+        rlo, rhi = self._obs.get(lo), self._obs.get(hi)
+        if rlo is None and rhi is None:
+            self._upd(lo, bpf)
+            self._upd(hi, bpf)
+            return
+        if rlo is None:
+            if f < 0.85:       # enough mass at lo to imply its rate
+                self._upd(lo, (bpf - f * rhi) / (1.0 - f))
+            else:              # nearly all frames ran at hi
+                self._upd(hi, bpf)
+            return
+        if rhi is None:
+            if f > 0.15:       # enough mass at hi to imply its rate
+                self._upd(hi, (bpf - (1.0 - f) * rlo) / f)
+            else:
+                self._upd(lo, bpf)
+            return
+        # both known: distribute the prediction error by mix share
+        pred = (1.0 - f) * rlo + f * rhi
+        err = bpf - pred
+        self._upd(lo, rlo + (1.0 - f) * err)
+        self._upd(hi, rhi + f * err)
+
+    def _predicted(self) -> float | None:
+        lo = math.floor(self._q)
+        f = self._q - lo
+        rlo, rhi = self._obs.get(lo), self._obs.get(lo + 1)
+        if f < 1e-6:
+            return rlo
+        if rlo is None or rhi is None:
+            return None
+        return (1.0 - f) * rlo + f * rhi
+
+    def observe(self, bytes_out: int, n_frames: int,
+                frame_qps: np.ndarray | None = None) -> int:
+        """Feed achieved bytes for ``n_frames`` frames; returns next QP.
+
+        ``frame_qps``: the integer QPs the batch was ACTUALLY encoded at
+        (the array ``frame_qps()`` returned when the batch was staged).
+        The backend runs one batch in flight, so by observe time the
+        working point has already moved — attributing to ``self._q``
+        would mislabel every observation by one batch (the failure mode
+        ADVICE round-3 flagged on the HEVC path). Without it the current
+        working point is assumed."""
         if self.target_bps <= 0 or n_frames <= 0 or self.fps <= 0:
             return self.qp
         bpf = bytes_out / n_frames
-        self._record(self._q, bpf)
+        if frame_qps is not None and len(frame_qps) > 0:
+            qs = np.asarray(frame_qps).reshape(-1)[:n_frames]
+            lo = int(qs.min())
+            f = float(np.mean(qs > lo))
+            q_real = lo + f
+        else:
+            lo = math.floor(self._q)
+            f = self._q - lo
+            q_real = self._q
+        self._attribute(bpf, lo, f)
         target = max(self.target_bytes_per_frame, 1e-9)
-
-        est = self._obs[round(self._q, 2)]
-        ratio = max(est, 1e-9) / target
         calibrating, self._calibrating = self._calibrating, False
-        if abs(math.log2(ratio)) <= math.log2(1 + self.band):
-            return self.qp                      # converged: hold
+        self._hunting = abs(math.log2(max(bpf, 1.0) / target)) > math.log2(1.5)
 
-        over = {q: b for q, b in self._obs.items() if b > target}
-        under = {q: b for q, b in self._obs.items() if b <= target}
-        nxt = None
+        # converged: the just-measured rate sits inside the band
+        if abs(math.log2(max(bpf, 1.0) / target)) <= math.log2(
+                1 + self.band):
+            return self.qp
+
+        over = {q: r for q, r in self._obs.items() if r > target}
+        under = {q: r for q, r in self._obs.items() if r <= target}
         if over and under:
-            q_lo = max(over)                    # highest QP still over
-            q_hi = min(under)                   # lowest QP at/under
-            if q_lo >= q_hi:
+            qa = max(over)                     # highest QP still over
+            qb = min(under)                    # lowest QP at/under
+            if qa >= qb:
                 # contradicts bits-decrease-with-QP: the content moved;
-                # trust only what we just measured
-                self._obs = {round(self._q, 2): est}
-                self._order = [round(self._q, 2)]
+                # keep only what this batch just taught us — the
+                # REALIZED (lo, lo+1) pair, which with a batch in flight
+                # is not floor(self._q)
+                keep = {q: self._obs[q]
+                        for q in (lo, lo + 1) if q in self._obs}
+                self._obs = dict(keep)
+                self._order = list(keep)
+            elif qb - qa == 1:
+                # adjacent bracket: rate mixes linearly in the dither
+                # fraction — solve it exactly (cliff-proof)
+                f = (over[qa] - target) / max(over[qa] - under[qb], 1e-9)
+                self._q = qa + min(max(f, 0.0), 0.999)
+                return self.qp
             else:
-                # interpolate in log-bit space inside the bracket; the
-                # fractional result is realized by frame dithering
-                l_lo = math.log2(max(over[q_lo], 1e-9))
-                l_hi = math.log2(max(under[q_hi], 1e-9))
-                t = (math.log2(target) - l_lo) / (l_hi - l_lo)
-                nxt = q_lo + t * (q_hi - q_lo)
-                span = q_hi - q_lo
-                nxt = min(max(nxt, q_lo + 0.05 * span),
-                          q_hi - 0.05 * span)
-        if nxt is None:
-            # no (usable) bracket: extrapolate on the textbook slope;
-            # the calibration jump goes the whole way (the init QP is a
-            # ladder-wide default, often far off), later ones clamp. If
-            # the jump lands past a response cliff, that one batch is
-            # the unavoidable probe cost — the bracket formed from it
-            # pulls the very next batch onto the interpolated point.
-            step = 6.0 * math.log2(ratio)
-            if not calibrating:
-                cap = 2.0 * self.max_step
-                step = max(-cap, min(cap, step))
-            nxt = self._q + step
-        self._q = min(max(nxt, float(self.min_qp)), float(self.max_qp))
+                # wide bracket: log-rate interpolation, snapped to an
+                # INTEGER probe strictly inside (smooth content lands
+                # near the answer in one step; cliffs degenerate toward
+                # bisection, and every probe tightens the bracket)
+                l_lo = math.log2(max(over[qa], 1.0))
+                l_hi = math.log2(max(under[qb], 1.0))
+                t = (math.log2(target) - l_lo) / min(l_hi - l_lo, -1e-9)
+                probe = round(qa + t * (qb - qa))
+                self._q = float(min(max(probe, qa + 1), qb - 1))
+                return self.qp
+
+        # No (usable) bracket: textbook slope, ASYMMETRICALLY capped.
+        # Downward moves (spending more bits) walk at most max_step per
+        # batch: a rate cliff below costs a mildly-under batch instead
+        # of a 5x overshoot burn (each step lands a bracket point, so
+        # the analytic dither takes over the moment the target is
+        # straddled). Upward moves (cutting bits) jump the whole way
+        # while calibrating — overshoot recovery must be immediate.
+        ratio = max(bpf, 1.0) / target
+        step = 6.0 * math.log2(ratio)
+        if step < 0:
+            # halve the remaining distance on bracketless downward moves
+            # while far from target: any target is reached in O(log)
+            # batches of cheap UNDER-target encodes, and a cliff at the
+            # far end is approached, never leapt onto (the 5x-burn batch
+            # a full jump used to cost)
+            step = step / 2.0 if self._hunting or calibrating \
+                else max(step, -float(self.max_step))
+        elif not calibrating:
+            step = min(step, 2.0 * self.max_step)
+        base = q_real if frame_qps is not None else self._q
+        self._q = float(int(round(
+            min(max(base + step, float(self.min_qp)),
+                float(self.max_qp)))))
         return self.qp

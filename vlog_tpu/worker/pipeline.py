@@ -59,12 +59,17 @@ def verify_output(master_path, run, *, expect_cmaf: bool) -> None:
         if (r.target_bitrate and r.achieved_bitrate
                 and r.segment_count >= 5):
             # undershoot is fine (easy content hits the min-QP quality
-            # cap below target); runaway overshoot means control broke
+            # cap below target); overshoot means control broke. Short
+            # outputs tolerate more: one bounded calibration-probe batch
+            # (a rate cliff costs up to ~5x target for one batch) still
+            # dominates a 5-segment average, and washes out by ~10.
+            cap = 2.0 if r.segment_count < 10 else 1.5
             ratio = r.achieved_bitrate / r.target_bitrate
-            if ratio > 4.0:
+            if ratio > cap:
                 raise VerificationError(
                     f"{r.name}: achieved {r.achieved_bitrate} bps is "
-                    f"{ratio:.1f}x the {r.target_bitrate} bps target")
+                    f"{ratio:.1f}x the {r.target_bitrate} bps target "
+                    f"(cap {cap}x at {r.segment_count} segments)")
         if r.mean_psnr_y is not None and r.mean_psnr_y < 18.0:
             raise VerificationError(
                 f"{r.name}: mean PSNR-Y {r.mean_psnr_y:.1f} dB below the "
