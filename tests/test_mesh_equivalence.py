@@ -28,10 +28,14 @@ assert len(jax.devices()) == 1, jax.devices()
 from vlog_tpu import config
 from vlog_tpu.worker.pipeline import process_video
 kw = {}
-if sys.argv[3] == "p":
+mode = sys.argv[3]
+if mode.endswith("+h265"):
+    mode = mode[:-5]
+    kw["codec"] = "h265"
+if mode == "p":
     kw["rungs"] = (config.QualityRung("360p", 360, 0, 0, base_qp=30),)
 process_video(sys.argv[1], sys.argv[2], audio=False, segment_duration_s=1.0,
-              gop_mode=sys.argv[3], **kw)
+              gop_mode=mode, **kw)
 """
 
 
@@ -47,7 +51,9 @@ def _compare_runs(tmp_path, src, gop_mode: str, mesh_kwargs: dict):
 
     mesh_out = tmp_path / "mesh8"
     process_video(src, mesh_out, audio=False, segment_duration_s=1.0,
-                  gop_mode=gop_mode, **mesh_kwargs)
+                  gop_mode=gop_mode.removesuffix("+h265"),
+                  **({"codec": "h265"} if gop_mode.endswith("+h265") else {}),
+                  **mesh_kwargs)
 
     single_out = tmp_path / "single"
     env = dict(os.environ)
@@ -99,3 +105,19 @@ def test_backend_run_on_mesh_matches_single_device_chains(tmp_path):
                    fps=10)
     rung = config.QualityRung("360p", 360, 0, 0, base_qp=30)  # constant QP
     _compare_runs(tmp_path, src, "p", {"rungs": (rung,)})
+
+
+@pytest.mark.slow
+def test_hevc_backend_run_on_mesh_matches_single_device(tmp_path):
+    """Fused HEVC chain ladder: byte identity across device counts at
+    constant QP (same invariant as the H.264 chain test — compute
+    determinism; the QP *schedule* is rate-control-free here)."""
+    import jax
+
+    from vlog_tpu import config
+
+    assert len(jax.devices()) == 8
+    src = make_y4m(tmp_path / "src.y4m", n_frames=30, width=128, height=96,
+                   fps=10)
+    rung = config.QualityRung("360p", 360, 0, 0, base_qp=30)  # constant QP
+    _compare_runs(tmp_path, src, "p+h265", {"rungs": (rung,)})

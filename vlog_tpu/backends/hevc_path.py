@@ -71,7 +71,9 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         rdir = out / rung.name
         rdir.mkdir(parents=True, exist_ok=True)
         init_matched[rung.name] = prepare_init_segment(
-            rdir, init_segment(tracks[rung.name]))
+            rdir, init_segment(tracks[rung.name]),
+            config_tag=(f"hevc:partitions={int(config.HEVC_PARTITIONS)}"
+                        f":gop={plan.gop_len}"))
         seg_counts[rung.name] = 0
         seg_durs[rung.name] = []
         bytes_written[rung.name] = 0
@@ -88,9 +90,11 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                                                  init_matched)
         start_frame = start_segment * frames_per_seg
 
+        import jax
         from concurrent.futures import ThreadPoolExecutor
 
-        from vlog_tpu.ops.resize import resize_yuv420
+        from vlog_tpu.parallel.hevc_ladder import hevc_chain_ladder_program
+        from vlog_tpu.parallel.mesh import make_mesh, shard_frames
 
         # one long-lived entropy pool shared by every (rung, batch) call
         # — per-call pools would churn threads (same reason as the H.264
@@ -113,10 +117,25 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         eof = object()
         stop = threading.Event()
 
-        # chain-aligned batches: segments are gop_len multiples, so each
-        # batch holds whole chains (the last may be short at EOF)
+        # --- fused all-rungs chain ladder (parallel/hevc_ladder.py): one
+        # dispatch per batch emits every hvc1 rung; chains shard over the
+        # mesh when >1 device (SURVEY §2d.2/§2d.5 applied to HEVC).
+        src_h, src_w = plan.source.height, plan.source.width
+        rungs_spec = tuple((r.name, r.height, r.width, r.qp)
+                           for r in plan.rungs)
+        n_dev = len(jax.devices())
+        mesh = make_mesh() if n_dev > 1 else None
         clen = max(1, plan.gop_len)
-        batch_n = clen * max(1, plan.frame_batch // clen)
+        chains_per = max(1, -(-plan.frame_batch // clen))
+        dev = max(n_dev, 1)
+        chains_per = max(dev, chains_per + (-chains_per) % dev)
+        batch_n = clen * chains_per
+        fn, mats = hevc_chain_ladder_program(
+            rungs_spec, src_h, src_w,
+            search=config.MOTION_SEARCH_RADIUS, mesh=mesh)
+        npix = {r.name: r.height * r.width for r in plan.rungs}
+        rows_cols = {r.name: ((r.height + 31) // 32, (r.width + 31) // 32)
+                     for r in plan.rungs}
 
         def producer() -> None:
             try:
@@ -136,6 +155,81 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         threading.Thread(target=producer, daemon=True,
                          name="vlog-hevc-decode").start()
 
+        def dispatch(by, bu, bv):
+            n_real = by.shape[0]
+            if n_real < batch_n:   # tail: replicate last frame, drop later
+                reps = batch_n - n_real
+                by = np.concatenate([by, np.repeat(by[-1:], reps, axis=0)])
+                bu = np.concatenate([bu, np.repeat(bu[-1:], reps, axis=0)])
+                bv = np.concatenate([bv, np.repeat(bv[-1:], reps, axis=0)])
+            chain = lambda p: p.reshape((chains_per, clen) + p.shape[1:])
+            by, bu, bv = chain(by), chain(bu), chain(bv)
+            qps = {}
+            for r in plan.rungs:
+                q = controllers[r.name].frame_qps(
+                    chains_per * clen).reshape(chains_per, clen)
+                qps[r.name] = q       # the program applies the I -2 anchor
+            if mesh is not None:
+                by, bu, bv = shard_frames(mesh, by, bu, bv)
+                qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
+            return fn(by, bu, bv, mats, qps), n_real, qps
+
+        def consume(outs, n_real, qps):
+            nonlocal frames_done
+            for rung in plan.rungs:
+                name = rung.name
+                ro = outs[name]
+                rows, cols = rows_cols[name]
+                host = {k: np.asarray(ro[k]) for k in
+                        ("i_luma", "i_cb", "i_cr", "p_luma", "p_cb",
+                         "p_cr", "mv")}
+                sse = np.asarray(ro["sse_y"])            # (nc, clen)
+                qarr = np.asarray(qps[name])
+                batch_bytes = 0
+                n_frames = 0
+                rc_qs = []   # realized working-point dither (the HEVC
+                #              program applies its I -2 anchor internally,
+                #              so qarr IS the controller's mix)
+                for ci in range(chains_per):
+                    base = ci * clen
+                    if base >= n_real:
+                        break
+                    keep = min(clen, n_real - base)
+                    rc_qs.append(qarr[ci, :keep])
+                    mse = np.maximum(sse[ci, :keep] / npix[name], 1e-12)
+                    psnrs = np.where(mse < 1e-9, 99.0,
+                                     10 * np.log10(255.0 ** 2 / mse))
+                    frames = encoders[name].entropy_chain(
+                        (host["i_luma"][ci], host["i_cb"][ci],
+                         host["i_cr"][ci]),
+                        (host["p_luma"][ci], host["p_cb"][ci],
+                         host["p_cr"][ci]) if clen > 1 else None,
+                        None, None,
+                        host["mv"][ci] if clen > 1 else None,
+                        qarr[ci], rows, cols, psnrs,
+                        t_real=keep, pool=entropy_pool)
+                    for f in frames:
+                        psnr_acc[name].append(f.psnr_y)
+                        pending[name].append(
+                            Sample(data=f.sample, duration=frame_dur,
+                                   is_sync=f.is_idr))
+                        batch_bytes += len(f.sample)
+                    n_frames += keep
+                controllers[name].observe(
+                    batch_bytes, max(n_frames, 1),
+                    frame_qps=(np.concatenate(rc_qs) if rc_qs else None))
+                while len(pending[name]) >= frames_per_seg:
+                    chunk = pending[name][:frames_per_seg]
+                    pending[name] = pending[name][frames_per_seg:]
+                    backend._write_segment(out, rung, tracks[name],
+                                           seg_counts, seg_durs,
+                                           bytes_written, chunk,
+                                           timescale)
+            frames_done += n_real
+            if progress_cb is not None:
+                progress_cb(frames_done, total, "hevc ladder")
+
+        inflight = None
         try:
             while True:
                 item = fifo.get()
@@ -147,52 +241,21 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                 if plan.thumbnail and thumb_path is None:
                     thumb_path = str(out / "thumbnail.jpg")
                     backend._write_thumbnail(by[0], bu[0], bv[0], thumb_path)
-                for rung in plan.rungs:
-                    if (rung.height, rung.width) == (by.shape[1],
-                                                     by.shape[2]):
-                        ry, ru, rv = by, bu, bv
-                    else:
-                        ry, ru, rv = resize_yuv420(by, bu, bv, rung.height,
-                                                   rung.width)
-                        ry, ru, rv = (np.asarray(ry), np.asarray(ru),
-                                      np.asarray(rv))
-                    enc = encoders[rung.name]
-                    enc.qp = controllers[rung.name].qp
-                    # dithered integer QPs realizing the controller's
-                    # fractional working point, so observe() is keyed to
-                    # what was actually encoded (per-frame slice_qp_delta)
-                    qps = controllers[rung.name].frame_qps(ry.shape[0])
-                    if clen > 1:
-                        frames = []
-                        for c0 in range(0, ry.shape[0], clen):
-                            frames.extend(enc.encode_chain(
-                                ry[c0:c0 + clen], ru[c0:c0 + clen],
-                                rv[c0:c0 + clen], pool=entropy_pool,
-                                search=config.MOTION_SEARCH_RADIUS,
-                                chain_len=clen,
-                                frame_qps=qps[c0:c0 + clen]))
-                    else:
-                        frames = enc.encode_batch(ry, ru, rv,
-                                                  pool=entropy_pool,
-                                                  frame_qps=qps)
-                    controllers[rung.name].observe(
-                        sum(len(f.sample) for f in frames), len(frames))
-                    for f in frames:
-                        psnr_acc[rung.name].append(f.psnr_y)
-                        pending[rung.name].append(
-                            Sample(data=f.sample, duration=frame_dur,
-                                   is_sync=f.is_idr))
-                    while len(pending[rung.name]) >= frames_per_seg:
-                        chunk = pending[rung.name][:frames_per_seg]
-                        pending[rung.name] = pending[rung.name][
-                            frames_per_seg:]
-                        backend._write_segment(out, rung, tracks[rung.name],
-                                               seg_counts, seg_durs,
-                                               bytes_written, chunk,
-                                               timescale)
-                frames_done += by.shape[0]
-                if progress_cb is not None:
-                    progress_cb(frames_done, total, "hevc ladder")
+                staged = dispatch(by, bu, bv)
+                if any(controllers[r.name].hunting for r in plan.rungs):
+                    # calibration/cliff hunt: consume synchronously so
+                    # corrections land before the next batch stages
+                    # (same shape as jax_backend)
+                    if inflight is not None:
+                        consume(*inflight)
+                        inflight = None
+                    consume(*staged)
+                    continue
+                if inflight is not None:
+                    consume(*inflight)
+                inflight = staged
+            if inflight is not None:
+                consume(*inflight)
             for rung in plan.rungs:
                 if pending[rung.name]:
                     backend._write_segment(out, rung, tracks[rung.name],

@@ -208,6 +208,27 @@ class HevcEncoder:
                            .astype(np.float64)) ** 2)
             return float(10 * np.log10(255.0 ** 2 / max(mse, 1e-12)))
 
+        psnrs = np.array([psnr_of(i) for i in range(t_real)])
+        return self.entropy_chain(intra_np, p32_np, p16_np, parts_np,
+                                  mv_np, fqs, rows, cols, psnrs,
+                                  t_real=t_real, pool=pool)
+
+    def entropy_chain(self, intra_np, p32_np, p16_np, parts_np, mv_np,
+                      fqs, rows, cols, psnrs,
+                      t_real: int, pool: ThreadPoolExecutor | None = None
+                      ) -> list[EncodedFrame]:
+        """Host entropy for one chain's device outputs.
+
+        Shared by :meth:`encode_chain` (which ran the single-rung DSP)
+        and the fused all-rungs ladder program
+        (parallel/hevc_ladder.py), whose consumer calls this per chain
+        with already-materialized numpy levels. ``fqs`` are the realized
+        per-frame QPs, ``psnrs`` per-frame luma PSNR (display region).
+        """
+        from vlog_tpu.codecs.hevc.pslice import PSliceWriter, p_nal
+
+        qp_i = max(10, int(fqs[0]) - 2)
+
         def p_entropy_c(ly, lu, lvv, mvg, qp) -> bytes | None:
             """C P-slice coder — all-2Nx2N slices only (its contract)."""
             from vlog_tpu.native.build import get_lib
@@ -243,10 +264,12 @@ class HevcEncoder:
                                                        PART_Nx2N)
 
             l32 = tuple(a[idx] for a in p32_np)
-            part = parts_np[idx]
+            # parts_np is None when partitions were disabled at the DSP
+            # (the fused ladder ships no all-2Nx2N partition map)
+            part = parts_np[idx] if parts_np is not None else None
             mvg = mv_np[idx]                    # (2R, 2C, 2) 16-cell map
             qp = int(fqs[idx + 1])
-            if not np.any(part != PART_2Nx2N):
+            if part is None or not np.any(part != PART_2Nx2N):
                 payload = p_entropy_c(*l32, mvg, qp)
                 if payload is not None:
                     return payload
@@ -258,7 +281,8 @@ class HevcEncoder:
             for r in range(rows):
                 for c in range(cols):
                     last = r == rows - 1 and c == cols - 1
-                    p = int(part[r, c])
+                    p = (PART_2Nx2N if part is None
+                         else int(part[r, c]))
                     if p == PART_2Nx2N:
                         sw.write_ctu_inter(
                             r, c, tuple(int(x) for x in mvg[2 * r, 2 * c]),
@@ -298,7 +322,7 @@ class HevcEncoder:
                 annexb=syntax.annexb(
                     ([self.vps, self.sps, self.pps] if i == 0 else [])
                     + [nal]),
-                is_idr=(i == 0), psnr_y=psnr_of(i))
+                is_idr=(i == 0), psnr_y=float(psnrs[i]))
 
         if pool is None:
             with ThreadPoolExecutor(self.entropy_threads) as p:

@@ -84,9 +84,21 @@ def run(n_devices: int) -> None:
         assert ro["mv"].shape[:2] == (n_devices, clen - 1)
         assert ro["sse_y"].shape == (n_devices, clen)
 
+    # The fused HEVC chain ladder (codec="h265" re-encodes), sharded the
+    # same way on the chain axis.
+    from vlog_tpu.parallel.hevc_ladder import hevc_chain_ladder_program
+
+    hfn, hmats = hevc_chain_ladder_program(rungs, h, w, search=4, mesh=mesh)
+    houts = hfn(cy, cu, cv, hmats, qps)
+    jax.block_until_ready(houts)
+    for name, _, _, _ in rungs:
+        ro = houts[name]
+        assert ro["p_luma"].shape[:2] == (n_devices, clen - 1)
+        assert ro["sse_y"].shape == (n_devices, clen)
+
     print(f"dryrun ok: {n_devices} devices, rungs "
           f"{[(r[0], round(float(stats[r[0]]), 2)) for r in rungs]}, "
-          f"chain clen={clen} ok")
+          f"chain clen={clen} ok, hevc chain ok")
 
 
 if __name__ == "__main__":
