@@ -247,6 +247,67 @@ def run_x264(frames: np.ndarray, h: int, w: int, fps: int, bps: int,
     }
 
 
+def wer(ref_words: list[str], hyp_words: list[str]) -> float:
+    """Word error rate: Levenshtein(ref, hyp) / len(ref)."""
+    n, m = len(ref_words), len(hyp_words)
+    if n == 0:
+        return 0.0 if m == 0 else float("inf")
+    prev = list(range(m + 1))
+    for i in range(1, n + 1):
+        cur = [i] + [0] * m
+        for j in range(1, m + 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (ref_words[i - 1] != hyp_words[j - 1]))
+        prev = cur
+    return prev[m] / n
+
+
+def _norm_words(text: str) -> list[str]:
+    import re
+
+    return re.findall(r"[a-z0-9']+", text.lower())
+
+
+def run_asr(audio_path: str, ref_path: str, beam: int) -> dict:
+    """Transcribe ``audio_path`` with VLOG_WHISPER_DIR weights and score
+    WER against the reference transcript — the north-star caption metric
+    (BASELINE config #4: WER parity with faster-whisper beam-5 + VAD).
+    Runs the full production path: VAD -> mel -> batched beam decode ->
+    cue stitching."""
+    import numpy as np
+
+    from vlog_tpu import config
+    from vlog_tpu.asr import mel as melmod
+    from vlog_tpu.asr.load import load_whisper
+    from vlog_tpu.media.audio import extract_audio, resample, to_mono
+    from vlog_tpu.worker.transcribe import transcribe_audio
+
+    model_dir = config.WHISPER_DIR or os.environ.get("VLOG_WHISPER_DIR")
+    if not model_dir:
+        sys.exit("asr bench needs VLOG_WHISPER_DIR pointing at Whisper "
+                 "weights (HF layout); none configured")
+    assets = load_whisper(model_dir)
+    audio = extract_audio(audio_path)
+    if audio is None or not audio.pcm.size:
+        sys.exit(f"{audio_path}: no audio track")
+    audio = resample(to_mono(audio), melmod.SAMPLE_RATE)
+    samples = np.ascontiguousarray(audio.pcm[0], np.float32)
+    config.WHISPER_BEAM = beam
+    t0 = time.perf_counter()
+    cues, language = transcribe_audio(samples, assets)
+    wall = time.perf_counter() - t0
+    hyp = " ".join(c.text for c in cues)
+    ref = Path(ref_path).read_text()
+    score = wer(_norm_words(ref), _norm_words(hyp))
+    return {
+        "metric": "asr_wer", "value": round(score, 4), "unit": "wer",
+        "beam": beam, "language": language,
+        "audio_s": round(len(samples) / 16_000, 1),
+        "wall_s": round(wall, 1), "hyp_words": len(_norm_words(hyp)),
+        "ref_words": len(_norm_words(ref)),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--frames", type=int, default=96)
@@ -254,7 +315,20 @@ def main() -> None:
     ap.add_argument("--rungs", default="360p,480p,720p")
     ap.add_argument("--h265", action="store_true",
                     help="add a codec=h265 row for the first rung")
+    ap.add_argument("--asr", metavar="AUDIO",
+                    help="WER mode: transcribe AUDIO (wav/mp4) with "
+                         "VLOG_WHISPER_DIR weights instead of video PSNR")
+    ap.add_argument("--ref", metavar="TXT",
+                    help="reference transcript for --asr")
+    ap.add_argument("--beam", type=int, default=5)
     args = ap.parse_args()
+
+    if args.asr:
+        if not args.ref:
+            sys.exit("--asr requires --ref transcript.txt")
+        rec = run_asr(args.asr, args.ref, args.beam)
+        print(json.dumps(rec))
+        return
 
     from vlog_tpu import config
 

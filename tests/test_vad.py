@@ -1,0 +1,83 @@
+"""VAD: speech-like content survives, silence/hum/noise drop.
+
+The detector's contract mirrors faster-whisper's vad_filter decisions
+(reference worker/transcription.py:92-133): dead air and steady noise
+never reach the model; anything speech-shaped does — with hangover so
+onsets/decays aren't clipped.
+"""
+
+import numpy as np
+
+from vlog_tpu.asr.vad import (
+    HANGOVER_S, speech_mask, speech_spans, window_has_speech,
+)
+
+SR = 16_000
+
+
+def _speechlike(n_s: float, rng) -> np.ndarray:
+    """Harmonic tone with syllabic (4 Hz) amplitude modulation + formant
+    band — spectrally peaky, low-band dominant, like voiced speech."""
+    t = np.arange(int(n_s * SR)) / SR
+    f0 = 140 + 20 * np.sin(2 * np.pi * 2.3 * t)
+    sig = sum(np.sin(2 * np.pi * k * f0 * t + k) / k for k in (1, 2, 3, 4))
+    am = 0.55 + 0.45 * np.sin(2 * np.pi * 4.0 * t)
+    return (0.25 * am * sig + 0.002 * rng.standard_normal(t.size)
+            ).astype(np.float32)
+
+
+def test_silence_has_no_speech():
+    assert speech_spans(np.zeros(SR * 4, np.float32)) == []
+
+
+def test_white_noise_rejected():
+    rng = np.random.default_rng(0)
+    noise = (0.05 * rng.standard_normal(SR * 4)).astype(np.float32)
+    mask = speech_mask(noise)
+    assert mask.mean() < 0.1        # flatness kills broadband noise
+
+
+def test_speechlike_burst_detected_with_hangover():
+    rng = np.random.default_rng(1)
+    audio = np.zeros(SR * 6, np.float32)
+    burst = _speechlike(2.0, rng)
+    audio[SR * 2:SR * 4] = burst
+    spans = speech_spans(audio)
+    assert spans, "speech-like burst not detected"
+    s, e = spans[0][0], spans[-1][1]
+    # covers the burst, padded by at most ~2 hangovers each side
+    assert s <= 2.1 and e >= 3.9
+    assert s >= 2.0 - 3 * HANGOVER_S - 0.1
+    assert e <= 4.0 + 3 * HANGOVER_S + 0.1
+
+
+def test_speech_over_noise_floor():
+    """Speech sitting on a noise bed must still trigger (adaptive floor)."""
+    rng = np.random.default_rng(2)
+    audio = (0.01 * rng.standard_normal(SR * 8)).astype(np.float32)
+    audio[SR * 3:SR * 5] += _speechlike(2.0, rng)
+    spans = speech_spans(audio)
+    assert spans
+    assert window_has_speech(spans, 3.0, 5.0)
+    assert not window_has_speech(spans, 0.0, 2.0)
+
+
+def test_window_overlap_logic():
+    spans = [(10.0, 12.0)]
+    assert window_has_speech(spans, 0.0, 10.5)
+    assert window_has_speech(spans, 11.0, 30.0)
+    assert not window_has_speech(spans, 0.0, 9.9)
+    assert not window_has_speech(spans, 12.1, 20.0)
+
+
+def test_wer_metric():
+    """quality_bench's WER: classic substitution/insert/delete counting."""
+    import quality_bench as qb
+
+    assert qb.wer("a b c".split(), "a b c".split()) == 0.0
+    assert qb.wer("a b c".split(), "a x c".split()) == 1 / 3
+    assert qb.wer("a b c".split(), "a c".split()) == 1 / 3
+    assert qb.wer("a b".split(), "a b c".split()) == 0.5
+    assert qb.wer([], []) == 0.0
+    assert qb._norm_words("Hello, World! it's 2x") == [
+        "hello", "world", "it's", "2x"]

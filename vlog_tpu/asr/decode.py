@@ -148,11 +148,119 @@ def _generate_jit(params, mel, prompt, suppress_vec, begin_suppress_vec,
     return jnp.transpose(toks), no_speech_prob        # (B, max_new)
 
 
+# --------------------------------------------------------------------------
+# Beam search (the reference's quality bar: faster-whisper beam_size=5,
+# worker/transcription.py:92-133)
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg", "sot", "eot", "ts_begin",
+                                   "no_speech", "max_new", "timestamps",
+                                   "beam"))
+def _generate_beam_jit(params, mel, prompt, suppress_vec, begin_suppress_vec,
+                       *, cfg: WhisperConfig, sot: int, eot: int,
+                       ts_begin: int, no_speech: int, max_new: int,
+                       timestamps: bool, beam: int):
+    """Batched beam search over B windows x K beams (flattened to B*K
+    cache rows). One ``lax.scan`` over steps; each step scores all K*V
+    continuations per window, takes the global top-K, and gathers the KV
+    cache rows of the winning parents. Finished beams persist with
+    frozen scores (only EOT continues, at zero cost). Selection
+    normalizes by generated length (CTranslate2's length_penalty=1)."""
+    enc = encode(params, mel, cfg)
+    ckv = cross_kv(params, enc, cfg)
+    b = mel.shape[0]
+    k = beam
+    bk = b * k
+    neg = jnp.finfo(jnp.float32).min
+
+    # beams share the window's audio: tile cross-KV rows K-fold
+    ckv = [(jnp.repeat(ck, k, axis=0), jnp.repeat(cv, k, axis=0))
+           for ck, cv in ckv]
+    plen = prompt.shape[0]
+    max_len = plen + max_new
+    cache = DecoderCache.create(cfg, bk, max_len)
+
+    logits = None
+    for i in range(plen):
+        tok = jnp.broadcast_to(prompt[i], (bk,))
+        logits, cache = decoder_step(params, tok, jnp.int32(i), cache,
+                                     ckv, cfg)
+    probs0 = jax.nn.softmax(logits.reshape(b, k, -1)[:, 0], axis=-1)
+    no_speech_prob = (probs0[:, no_speech] if no_speech >= 0
+                      else jnp.zeros(b))
+
+    # beam 0 live at score 0; the rest start at -inf so step 0 fans out
+    scores0 = jnp.tile(jnp.concatenate(
+        [jnp.zeros((1,), jnp.float32),
+         jnp.full((k - 1,), neg, jnp.float32)]), (b,))          # (bk,)
+
+    def step(carry, step_idx):
+        cache, logits, scores, seqs, last, penult, last_ts, finished = carry
+        lg = logits + suppress_vec
+        lg = jnp.where(step_idx == 0, lg + begin_suppress_vec, lg)
+        if timestamps:
+            lg = apply_timestamp_rules(lg, last, penult, last_ts, step_idx,
+                                       ts_begin=ts_begin, eot=eot)
+        lp = jax.nn.log_softmax(lg, axis=-1)                    # (bk, V)
+        v = lp.shape[-1]
+        ids = jnp.arange(v)
+        # finished beams: only EOT continues, score unchanged
+        lp = jnp.where(finished[:, None],
+                       jnp.where(ids[None, :] == eot, 0.0, neg), lp)
+        total = scores[:, None] + lp                            # (bk, V)
+        top_s, top_i = jax.lax.top_k(total.reshape(b, k * v), k)  # (b, k)
+        parent = top_i // v                                     # (b, k)
+        token = (top_i % v).astype(jnp.int32)
+        gparent = (parent + jnp.arange(b)[:, None] * k).reshape(bk)
+
+        def take(x):
+            return jnp.take(x, gparent, axis=0)
+
+        token = token.reshape(bk)
+        scores = top_s.reshape(bk)
+        seqs = take(seqs).at[:, step_idx].set(token)
+        penult = take(last)
+        last = token
+        last_ts = jnp.where(token >= ts_begin, token, take(last_ts))
+        finished = take(finished) | (token == eot)
+        cache = DecoderCache(
+            k=jnp.take(cache.k, gparent, axis=1),
+            v=jnp.take(cache.v, gparent, axis=1))
+        nxt_logits, cache = decoder_step(
+            params, token, (plen + step_idx).astype(jnp.int32), cache,
+            ckv, cfg)
+        return ((cache, nxt_logits, scores, seqs, last, penult, last_ts,
+                 finished), finished)
+
+    seqs0 = jnp.full((bk, max_new), eot, jnp.int32)
+    init = (cache, logits, scores0, seqs0,
+            jnp.full((bk,), prompt[-1], jnp.int32),
+            jnp.full((bk,), prompt[-2] if plen >= 2 else sot, jnp.int32),
+            jnp.full((bk,), ts_begin - 1, jnp.int32),
+            jnp.zeros((bk,), bool))
+    (cache, logits, scores, seqs, *_rest), fin_hist = jax.lax.scan(
+        step, init, jnp.arange(max_new))
+    finished = _rest[-1]
+
+    # length-normalized selection per window (generated tokens before EOT)
+    lens = jnp.sum(seqs != eot, axis=1).astype(jnp.float32)
+    norm = scores / jnp.maximum(lens, 1.0)
+    # prefer finished beams: unfinished get a -1e9 handicap
+    norm = jnp.where(finished, norm, norm - 1e9)
+    best = jnp.argmax(norm.reshape(b, k), axis=1)               # (b,)
+    best_rows = best + jnp.arange(b) * k
+    return (jnp.take(seqs, best_rows, axis=0), no_speech_prob)
+
+
 def generate_batch(assets: WhisperAssets, mel: jnp.ndarray, *,
                    language: str = "en", task: str = "transcribe",
-                   max_new: int | None = None, timestamps: bool = True
-                   ) -> tuple[np.ndarray, np.ndarray]:
-    """Greedy-decode a batch of 30 s mel windows -> (tokens, no_speech_prob)."""
+                   max_new: int | None = None, timestamps: bool = True,
+                   beam: int = 1) -> tuple[np.ndarray, np.ndarray]:
+    """Decode a batch of 30 s mel windows -> (tokens, no_speech_prob).
+
+    ``beam=1`` is the greedy scan; ``beam>1`` runs batched beam search
+    with length-normalized selection (config.WHISPER_BEAM wires the
+    production default; the reference runs beam-5)."""
     st = assets.tokens
     cfg = assets.cfg
     if max_new is None:
@@ -167,12 +275,17 @@ def generate_batch(assets: WhisperAssets, mel: jnp.ndarray, *,
     vocab = cfg.vocab_size
     sup = _suppress_vector(vocab, st.suppress + (st.no_timestamps,))
     bsup = _suppress_vector(vocab, st.begin_suppress)
-    toks, nsp = _generate_jit(
-        assets.params, jnp.asarray(mel), jnp.asarray(prompt, jnp.int32),
-        jnp.asarray(sup), jnp.asarray(bsup),
+    kwargs = dict(
         cfg=cfg, sot=st.sot, eot=st.eot, ts_begin=st.timestamp_begin,
         no_speech=st.no_speech if st.no_speech is not None else -1,
         max_new=int(max_new), timestamps=timestamps)
+    args = (assets.params, jnp.asarray(mel),
+            jnp.asarray(prompt, jnp.int32), jnp.asarray(sup),
+            jnp.asarray(bsup))
+    if beam > 1:
+        toks, nsp = _generate_beam_jit(*args, beam=int(beam), **kwargs)
+    else:
+        toks, nsp = _generate_jit(*args, **kwargs)
     return np.asarray(toks), np.asarray(nsp)
 
 

@@ -182,6 +182,9 @@ WHISPER_MODEL: str = _env_str("VLOG_WHISPER_MODEL", "small")
 WHISPER_DIR: str = _env_str("VLOG_WHISPER_DIR", "")
 WHISPER_CHUNK_S: float = 30.0       # model window
 WHISPER_OVERLAP_S: float = 5.0      # chunk overlap for stitching
+# Beam width for decoding. The reference runs faster-whisper beam_size=5
+# (worker/transcription.py:92-133); 1 = the cheaper greedy scan.
+WHISPER_BEAM: int = _env_int("VLOG_WHISPER_BEAM", 5, lo=1, hi=16)
 TRANSCRIPTION_ENABLED: bool = _env_bool("VLOG_TRANSCRIPTION_ENABLED", True)
 
 # --------------------------------------------------------------------------

@@ -82,9 +82,15 @@ def transcribe_audio(
     window_s = window_s or config.WHISPER_CHUNK_S
     overlap_s = overlap_s if overlap_s is not None else config.WHISPER_OVERLAP_S
     windows = _cut_windows(samples, window_s=window_s, overlap_s=overlap_s)
-    # energy gate: decode only windows with signal
-    live = [i for i, (_, w) in enumerate(windows)
-            if w.size and float(np.sqrt(np.mean(w ** 2))) > SILENCE_RMS]
+    # VAD: decode only windows that overlap detected speech (the
+    # reference's faster-whisper vad_filter analog, asr/vad.py); the RMS
+    # gate stays as a cheap pre-filter for all-silence windows
+    from vlog_tpu.asr.vad import speech_spans, window_has_speech
+
+    spans = speech_spans(samples)
+    live = [i for i, (t0, w) in enumerate(windows)
+            if w.size and float(np.sqrt(np.mean(w ** 2))) > SILENCE_RMS
+            and window_has_speech(spans, t0, t0 + window_s)]
     per_window_cues: list[list[Cue]] = [[] for _ in windows]
     tokenizer = assets.tokenizer
     st = assets.tokens
@@ -121,7 +127,8 @@ def transcribe_audio(
 
             (feats,) = shard_frames(mesh, feats)
         toks, no_speech = generate_batch(assets, feats, language=language,
-                                         max_new=max_new)
+                                         max_new=max_new,
+                                         beam=config.WHISPER_BEAM)
         toks, no_speech = toks[:n_real], no_speech[:n_real]
         for row, nsp, i in zip(toks, no_speech, idxs):
             if st.no_speech is not None and nsp > 0.6:
