@@ -290,7 +290,10 @@ def _attempt(mode: str, platform: str, timeout_s: int) -> tuple[str | None, bool
     if proc.returncode != 0:
         print(f"bench: {platform} {mode} rc={proc.returncode}",
               file=sys.stderr)
-        return None, False
+    # Scan stdout regardless of exit status: a crash after the device
+    # section printed its record (e.g. the e2e section lost the tunnel)
+    # must not discard a finished measurement. The platform-guard exit
+    # (rc=3) prints no JSON, so mislabeled-platform runs yield None.
     return _json_line(proc.stdout), False
 
 

@@ -63,17 +63,117 @@ def _qnum(query, name: str, default, *, lo=None, hi=None, cast=int):
 
 AUDIT = web.AppKey("audit", object)
 
+# --------------------------------------------------------------------------
+# Cookie sessions + CSRF (reference admin.py:1088-1234): the admin SPA
+# logs in once with the secret and holds an HttpOnly session cookie;
+# state-changing requests must echo the session's CSRF token in a header
+# (cookies ride along on cross-site requests, custom headers cannot).
+# Header-secret auth (X-Admin-Secret) stays for API clients/automation.
+# --------------------------------------------------------------------------
+
+SESSION_COOKIE = "vlog_admin_session"
+SESSION_TTL_S = 12 * 3600
+
+
+def _hash_token(token: str) -> str:
+    import hashlib
+
+    return hashlib.sha256(token.encode()).hexdigest()
+
+
+async def _session_for(request: web.Request) -> dict | None:
+    token = request.cookies.get(SESSION_COOKIE)
+    if not token:
+        return None
+    db = request.app[DB]
+    row = await db.fetch_one(
+        "SELECT * FROM admin_sessions WHERE token_hash=:h AND "
+        "expires_at > :now", {"h": _hash_token(token), "now": db_now()})
+    if row is not None:
+        await db.execute(
+            "UPDATE admin_sessions SET last_used_at=:t WHERE id=:i",
+            {"t": db_now(), "i": row["id"]})
+    return row
+
+
+async def login(request: web.Request) -> web.Response:
+    """POST {secret} -> session cookie + CSRF token."""
+    import secrets as pysecrets
+
+    body = await request.json()
+    if not authmod.check_admin_secret(str(body.get("secret") or ""),
+                                      config.ADMIN_SECRET):
+        audit = request.app.get(AUDIT)
+        if audit is not None:
+            audit.record("auth.login_failed", remote=request.remote)
+        return _json_error(403, "bad admin secret")
+    token = pysecrets.token_urlsafe(32)
+    csrf = pysecrets.token_urlsafe(32)
+    t = db_now()
+    db = request.app[DB]
+    await db.execute(
+        """
+        INSERT INTO admin_sessions (token_hash, csrf_token, created_at,
+                                    expires_at)
+        VALUES (:h, :c, :t, :exp)
+        """, {"h": _hash_token(token), "c": csrf, "t": t,
+              "exp": t + SESSION_TTL_S})
+    # opportunistic GC of expired sessions
+    await db.execute("DELETE FROM admin_sessions WHERE expires_at <= :t",
+                     {"t": t})
+    resp = web.json_response({"ok": True, "csrf_token": csrf,
+                              "expires_in_s": SESSION_TTL_S})
+    resp.set_cookie(SESSION_COOKIE, token, httponly=True, samesite="Lax",
+                    max_age=SESSION_TTL_S, path="/")
+    return resp
+
+
+async def logout(request: web.Request) -> web.Response:
+    token = request.cookies.get(SESSION_COOKIE)
+    if token:
+        await request.app[DB].execute(
+            "DELETE FROM admin_sessions WHERE token_hash=:h",
+            {"h": _hash_token(token)})
+    resp = web.json_response({"ok": True})
+    resp.del_cookie(SESSION_COOKIE, path="/")
+    return resp
+
+
+async def session_info(request: web.Request) -> web.Response:
+    row = await _session_for(request)
+    if row is None:
+        return _json_error(401, "no live session")
+    return web.json_response({
+        "ok": True, "csrf_token": row["csrf_token"],
+        "expires_at": row["expires_at"]})
+
 
 @web.middleware
 async def admin_auth_middleware(request: web.Request, handler):
     from vlog_tpu.web import is_ui_path
 
     # The static UI shell (login page + assets) must load without the
-    # secret; every /api route below still requires it.
-    if request.path == "/healthz" or is_ui_path(request.path):
+    # secret; every /api route below still requires it. /api/auth/login
+    # and /api/auth/session are how a session starts/renews.
+    if (request.path == "/healthz" or is_ui_path(request.path)
+            or request.path in ("/api/auth/login", "/api/auth/session")):
         return await handler(request)
-    if not authmod.check_admin_secret(request.headers.get("X-Admin-Secret"),
-                                      config.ADMIN_SECRET):
+    authed = authmod.check_admin_secret(
+        request.headers.get("X-Admin-Secret"), config.ADMIN_SECRET)
+    if not authed:
+        session = await _session_for(request)
+        if session is not None:
+            if request.method in ("GET", "HEAD", "OPTIONS"):
+                authed = True
+            else:
+                # cookie-authed mutation: CSRF header must match
+                # (constant-time — the token IS the protection here)
+                import hmac
+
+                authed = hmac.compare_digest(
+                    request.headers.get("X-CSRF-Token") or "",
+                    session["csrf_token"])
+    if not authed:
         audit = request.app.get(AUDIT)
         if audit is not None:
             audit.record("auth.denied", method=request.method,
@@ -617,7 +717,15 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_post("/api/videos/{video_id:\\d+}/chapters/detect",
                detect_chapters)
     r.add_get("/api/analytics/summary", analytics_summary)
+    r.add_post("/api/auth/login", login)
+    r.add_post("/api/auth/logout", logout)
+    r.add_get("/api/auth/session", session_info)
     r.add_get("/healthz", healthz)
+    # catalog long tail: playlists, custom fields, thumbnails,
+    # transcripts, bulk ops (api/catalog.py)
+    from vlog_tpu.api.catalog import mount as mount_catalog
+
+    mount_catalog(r)
     from vlog_tpu.web import attach_ui
 
     attach_ui(app, "admin")

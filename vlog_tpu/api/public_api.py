@@ -206,6 +206,148 @@ async def end_session(request: web.Request) -> web.Response:
 
 
 # --------------------------------------------------------------------------
+# Discovery: related videos, tags, playlists (public.py:1498-1991)
+# --------------------------------------------------------------------------
+
+async def related_videos(request: web.Request) -> web.Response:
+    """Same-category + shared-tag scoring, newest first within score
+    (reference public.py:1498 related_videos)."""
+    import json as _json
+
+    db = request.app[DB]
+    row = await vids.get_video_by_slug(db, request.match_info["slug"])
+    if row is None or row["status"] != "ready" or row["deleted_at"]:
+        return _json_error(404, "no such video")
+    limit = _qnum(request.query, "limit", 12, lo=1, hi=50)
+    tags = set(_json.loads(row["tags"] or "[]"))
+    candidates = await db.fetch_all(
+        f"""
+        SELECT * FROM videos
+        WHERE {READY} AND id != :id
+        ORDER BY created_at DESC LIMIT 500
+        """, {"id": row["id"]})
+    scored = []
+    for c in candidates:
+        score = 0
+        if row["category"] and c["category"] == row["category"]:
+            score += 2
+        score += len(tags & set(_json.loads(c["tags"] or "[]")))
+        if score:
+            scored.append((score, c["created_at"], c))
+    scored.sort(key=lambda s: (-s[0], -s[1]))
+    out = [_public_video(c) for _, _, c in scored[:limit]]
+    if len(out) < limit:
+        # back-fill with recency so the rail is never empty
+        seen = {v["id"] for v in out} | {row["id"]}
+        for c in candidates:
+            if c["id"] not in seen:
+                out.append(_public_video(c))
+                if len(out) >= limit:
+                    break
+    return web.json_response({"videos": out})
+
+
+async def tags(request: web.Request) -> web.Response:
+    """Tag cloud: every tag on a ready video with its count
+    (public.py:1636 tags browsing). Scans only the tags column of the
+    newest 5000 videos — bounded work per unauthenticated request."""
+    import json as _json
+    from collections import Counter
+
+    rows = await request.app[DB].fetch_all(
+        f"SELECT tags FROM videos WHERE {READY} "
+        "ORDER BY created_at DESC LIMIT 5000")
+    counts = Counter(t for r in rows
+                     for t in _json.loads(r["tags"] or "[]"))
+    return web.json_response({"tags": [
+        {"tag": t, "count": n} for t, n in counts.most_common(200)]})
+
+
+async def videos_by_tag(request: web.Request) -> web.Response:
+    import json as _json
+
+    tag = request.match_info["tag"]
+    limit = _qnum(request.query, "limit", 24, lo=1, hi=100)
+    offset = _qnum(request.query, "offset", 0, lo=0)
+    # SQL prefilter on the JSON text (tags are a JSON string array), then
+    # exact membership in Python over a bounded candidate set
+    rows = await request.app[DB].fetch_all(
+        f"""
+        SELECT * FROM videos WHERE {READY} AND tags LIKE :pat
+        ORDER BY created_at DESC LIMIT 500
+        """, {"pat": f'%"{tag}"%'})
+    hits = [r for r in rows if tag in _json.loads(r["tags"] or "[]")]
+    page = hits[offset:offset + limit]
+    return web.json_response({
+        "videos": [_public_video(r) for r in page],
+        "total": len(hits), "limit": limit, "offset": offset})
+
+
+async def public_playlists(request: web.Request) -> web.Response:
+    rows = await request.app[DB].fetch_all(
+        """
+        SELECT p.slug, p.title, p.description, p.updated_at,
+               COUNT(v.id) AS video_count
+        FROM playlists p
+        LEFT JOIN playlist_items i ON i.playlist_id = p.id
+        LEFT JOIN videos v ON v.id = i.video_id
+             AND v.status = 'ready' AND v.deleted_at IS NULL
+        WHERE p.visibility = 'public'
+        GROUP BY p.id ORDER BY p.updated_at DESC LIMIT 100
+        """)
+    return web.json_response({"playlists": rows})
+
+
+async def public_playlist_detail(request: web.Request) -> web.Response:
+    db = request.app[DB]
+    row = await db.fetch_one(
+        "SELECT * FROM playlists WHERE slug=:s AND visibility IN "
+        "('public','unlisted')", {"s": request.match_info["plslug"]})
+    if row is None:
+        return _json_error(404, "no such playlist")
+    items = await db.fetch_all(
+        f"""
+        SELECT v.* FROM playlist_items i
+        JOIN videos v ON v.id = i.video_id
+        WHERE i.playlist_id = :p AND {READY.replace('status', 'v.status')
+                                      .replace('deleted_at', 'v.deleted_at')}
+        ORDER BY i.position
+        """, {"p": row["id"]})
+    return web.json_response({
+        "playlist": {k: row[k] for k in
+                     ("slug", "title", "description", "updated_at")},
+        "videos": [_public_video(v) for v in items]})
+
+
+async def display_config(request: web.Request) -> web.Response:
+    """Player/display knobs the SPA reads at boot (public.py:1992-2258:
+    watermark + display config, served from the settings table)."""
+    svc = request.app.get(SETTINGS_SVC)
+    cfg = {
+        "site_title": "vlog",
+        "watermark": {"enabled": False, "text": "", "position":
+                      "bottom-right", "opacity": 0.5},
+        "player": {"autoplay": False, "default_quality": "auto",
+                   "downloads_enabled": config.DOWNLOADS_ENABLED},
+        "theme": {"accent": "#3b82f6"},
+    }
+    if svc is not None:
+        for key in ("site_title",):
+            v = await svc.get(f"display.{key}")
+            if v is not None:
+                cfg[key] = v
+        for section in ("watermark", "player", "theme"):
+            for k in list(cfg[section]):
+                v = await svc.get(f"display.{section}.{k}")
+                if v is not None:
+                    cfg[section][k] = v
+    return web.json_response(cfg)
+
+
+SETTINGS_SVC = web.AppKey("settings_svc", object)
+
+
+# --------------------------------------------------------------------------
 # Media static serving with correct MIME (HLSStaticFiles analog)
 # --------------------------------------------------------------------------
 
@@ -242,14 +384,23 @@ async def healthz(request: web.Request) -> web.Response:
 
 def build_public_app(db: Database, *, video_dir: Path | None = None
                      ) -> web.Application:
+    from vlog_tpu.api.settings import SettingsService
+
     app = web.Application()
     app[DB] = db
     app[VIDEO_DIR] = Path(video_dir or config.VIDEO_DIR)
+    app[SETTINGS_SVC] = SettingsService(db)
     r = app.router
     r.add_get("/api/videos", list_videos)
     r.add_get("/api/videos/{slug}", video_detail)
     r.add_get("/api/videos/{slug}/transcript", transcript)
+    r.add_get("/api/videos/{slug}/related", related_videos)
     r.add_get("/api/categories", categories)
+    r.add_get("/api/tags", tags)
+    r.add_get("/api/tags/{tag}/videos", videos_by_tag)
+    r.add_get("/api/playlists", public_playlists)
+    r.add_get("/api/playlists/{plslug}", public_playlist_detail)
+    r.add_get("/api/config", display_config)
     r.add_post("/api/videos/{slug}/session", start_session)
     r.add_post("/api/sessions/heartbeat", session_heartbeat)
     r.add_post("/api/sessions/end", end_session)

@@ -4,11 +4,14 @@ Replaces the parameter-set machinery ffmpeg/x264 provided for the
 reference (codec strings extracted in worker/hwaccel.py:864-981 come from
 exactly these bytes). Spec: ITU-T H.264 7.3 (syntax), annex A (profiles).
 
-We emit Constrained Baseline (profile_idc 66, constraint_set0+1), 4:2:0,
-frame MBs, pic_order_cnt_type 2 (output order == decode order — right for
-all-intra and low-delay), deblocking disabled per-slice (we do not run the
-in-loop filter; disable_deblocking_filter_idc=1 keeps encoder/decoder
-reconstructions identical).
+We emit Constrained Baseline (profile_idc 66, constraint_set0+1) for
+CAVLC streams and Main (77) for CABAC (CABAC is prohibited in Baseline,
+spec A.2.1), 4:2:0, frame MBs, pic_order_cnt_type 2 (output order ==
+decode order — right for all-intra and low-delay). Deblocking is
+signalled per slice: chain mode runs the in-loop filter
+(codecs/h264/deblock.py, disable_deblocking_filter_idc=0), intra mode
+leaves it off (idc=1); either way encoder/decoder reconstructions stay
+identical.
 """
 
 from __future__ import annotations

@@ -245,6 +245,77 @@ MIGRATIONS: list[tuple[int, list[str]]] = [
             "CREATE INDEX IF NOT EXISTS idx_commands_pending ON worker_commands(worker_name, picked_up_at)",
         ],
     ),
+    (
+        4,
+        [
+            # -- playlists (reference: admin.py:7534-8056 + public
+            #    playlist browsing, public.py:1636-1991) ----------------
+            """
+            CREATE TABLE IF NOT EXISTS playlists (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                slug TEXT NOT NULL UNIQUE,
+                title TEXT NOT NULL,
+                description TEXT NOT NULL DEFAULT '',
+                visibility TEXT NOT NULL DEFAULT 'public',
+                created_at REAL NOT NULL,
+                updated_at REAL NOT NULL,
+                CHECK (visibility IN ('public','unlisted','private'))
+            )
+            """,
+            """
+            CREATE TABLE IF NOT EXISTS playlist_items (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                playlist_id INTEGER NOT NULL
+                    REFERENCES playlists(id) ON DELETE CASCADE,
+                video_id INTEGER NOT NULL
+                    REFERENCES videos(id) ON DELETE CASCADE,
+                position INTEGER NOT NULL,
+                added_at REAL NOT NULL,
+                UNIQUE (playlist_id, video_id)
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS idx_playlist_items ON playlist_items(playlist_id, position)",
+            # -- custom metadata fields (reference: admin.py:6688-7533) --
+            """
+            CREATE TABLE IF NOT EXISTS custom_fields (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                name TEXT NOT NULL UNIQUE,
+                label TEXT NOT NULL,
+                field_type TEXT NOT NULL DEFAULT 'text',
+                required INTEGER NOT NULL DEFAULT 0,
+                options TEXT NOT NULL DEFAULT '[]',
+                position INTEGER NOT NULL DEFAULT 0,
+                created_at REAL NOT NULL,
+                CHECK (field_type IN
+                       ('text','number','boolean','select','date','url'))
+            )
+            """,
+            """
+            CREATE TABLE IF NOT EXISTS video_custom_values (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                video_id INTEGER NOT NULL
+                    REFERENCES videos(id) ON DELETE CASCADE,
+                field_id INTEGER NOT NULL
+                    REFERENCES custom_fields(id) ON DELETE CASCADE,
+                value TEXT,
+                updated_at REAL NOT NULL,
+                UNIQUE (video_id, field_id)
+            )
+            """,
+            # -- cookie sessions for the admin UI (reference:
+            #    admin.py:1088-1234 session auth + CSRF) ----------------
+            """
+            CREATE TABLE IF NOT EXISTS admin_sessions (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                token_hash TEXT NOT NULL UNIQUE,
+                csrf_token TEXT NOT NULL,
+                created_at REAL NOT NULL,
+                expires_at REAL NOT NULL,
+                last_used_at REAL
+            )
+            """,
+        ],
+    ),
 ]
 
 
