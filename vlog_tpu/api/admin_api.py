@@ -344,11 +344,11 @@ async def reencode(request: web.Request) -> web.Response:
     codec = body.get("codec", "h264")
     if fmt not in ("cmaf", "hls_ts"):
         return _json_error(400, f"unknown streaming_format {fmt!r}")
-    if codec not in ("h264", "h265"):
+    if codec not in ("h264", "h265", "av1"):
         return _json_error(
             400, f"codec {codec!r} has no first-party encoder")
-    if codec == "h265" and fmt != "cmaf":
-        return _json_error(400, "h265 output is CMAF-only")
+    if codec in ("h265", "av1") and fmt != "cmaf":
+        return _json_error(400, f"{codec} output is CMAF-only")
     try:
         job_id = await claims.enqueue_job(
             db, video["id"], JobKind.REENCODE,

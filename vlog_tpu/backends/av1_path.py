@@ -1,0 +1,312 @@
+"""AV1 ladder execution path (codec="av1" re-encodes) — delegated encode.
+
+Reference parity: AV1 in the reference is hardware-delegated encoding
+(av1_vaapi selection, worker/hwaccel.py:555-646). This path draws the
+same boundary: resize runs on the device (matmul lanczos), the AV1 bits
+come from the system encoder libraries through the native shim
+(native/av1enc.c — libaom-av1/SVT-AV1 via libavcodec), and the product
+plane (CMAF av01 segments, playlists, resume validation, re-encode
+flips) is all first-party and identical in shape to the H.264/HEVC
+paths. H.264 and HEVC remain first-party TPU encoders; a first-party
+AV1 entropy coder is descoped in this environment (COVERAGE.md row 5:
+the spec's default CDF tables cannot be sourced from the stripped
+system libraries with zero egress).
+
+The delegated encoder owns its own rate control (bitrate target per
+rung, VBR); keyframes are forced at segment boundaries so the CMAF tree
+stays chain-aligned and resumable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import queue as queue_mod
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from vlog_tpu import config
+from vlog_tpu.backends.base import RungResult, RunResult
+from vlog_tpu.backends.source import open_source
+from vlog_tpu.codecs.av1 import codec_string_from_tu, parse_seq_header
+from vlog_tpu.media import hls
+from vlog_tpu.media.fmp4 import (
+    Sample,
+    TrackConfig,
+    av01_sample_entry,
+    av1c_record,
+    init_segment,
+)
+from vlog_tpu.utils.fsio import atomic_write_text, prepare_init_segment
+
+
+class Av1Unavailable(RuntimeError):
+    """No system AV1 encoder (shim unbuildable or encoders absent)."""
+
+
+class _ShimEncoder:
+    """One delegated AV1 encoder instance (one per rung)."""
+
+    def __init__(self, lib, w: int, h: int, fps_num: int, fps_den: int,
+                 bitrate: int, gop_len: int):
+        self.lib = lib
+        self.w, self.h = w, h
+        self.handle = lib.vt_av1_open(
+            w, h, fps_num, fps_den,
+            bitrate or 2_000_000, max(gop_len, 1),
+            int(config.AV1_SPEED))
+        if not self.handle:
+            raise Av1Unavailable("vt_av1_open failed (no AV1 encoder)")
+        self._out = np.empty(max(1 << 20, w * h * 2), np.uint8)
+        self._u8p = ctypes.POINTER(ctypes.c_uint8)
+        self._closed = False
+
+    def send(self, y: np.ndarray, u: np.ndarray, v: np.ndarray,
+             force_key: bool) -> None:
+        p = self._u8p
+        ya = np.ascontiguousarray(y, np.uint8)
+        ua = np.ascontiguousarray(u, np.uint8)
+        va = np.ascontiguousarray(v, np.uint8)
+        rc = self.lib.vt_av1_send(
+            self.handle, ya.ctypes.data_as(p), ua.ctypes.data_as(p),
+            va.ctypes.data_as(p), 1 if force_key else 0)
+        if rc != 0:
+            raise RuntimeError(f"av1 send failed rc={rc}")
+
+    def receive(self) -> list[tuple[bytes, bool]]:
+        out = []
+        is_key = ctypes.c_int()
+        pts = ctypes.c_int64()
+        while True:
+            n = self.lib.vt_av1_receive(
+                self.handle, self._out.ctypes.data_as(self._u8p),
+                self._out.size, ctypes.byref(is_key), ctypes.byref(pts))
+            if n == -2:    # grow and retry
+                self._out = np.empty(self._out.size * 2, np.uint8)
+                continue
+            if n <= 0:
+                if n == -3:
+                    raise RuntimeError("av1 encoder error")
+                return out
+            out.append((self._out[:n].tobytes(), bool(is_key.value)))
+
+    def flush(self) -> list[tuple[bytes, bool]]:
+        self.lib.vt_av1_flush(self.handle)
+        return self.receive()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self.lib.vt_av1_close(self.handle)
+
+
+def run_av1(backend, plan, progress_cb, resume: bool, t0: float
+            ) -> RunResult:
+    if plan.streaming_format != "cmaf":
+        raise ValueError("av1 output is CMAF-only")
+    from vlog_tpu.native.avbuild import get_av_lib
+
+    lib = get_av_lib()
+    if lib is None:
+        raise Av1Unavailable(
+            "AV1 re-encode needs the libav shim (system libavcodec with "
+            "an AV1 encoder); it is unavailable or disabled")
+
+    out = Path(plan.out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    fps = plan.fps_num / plan.fps_den
+    frames_per_seg = max(1, round(plan.segment_duration_s * fps))
+    timescale = plan.fps_num * 1000
+    frame_dur = plan.fps_den * 1000
+
+    encoders: dict[str, _ShimEncoder] = {}
+    tracks: dict[str, TrackConfig] = {}
+    meta: dict[str, dict] = {}        # rung -> {profile, level, tier}
+    seg_counts: dict[str, int] = {}
+    seg_durs: dict[str, list[float]] = {}
+    bytes_written: dict[str, int] = {}
+    pending: dict[str, list[Sample]] = {}
+    frame_idx: dict[str, int] = {}
+
+    def _close_all() -> None:
+        for enc in encoders.values():
+            enc.close()
+
+    try:
+        for rung in plan.rungs:
+            encoders[rung.name] = _ShimEncoder(
+                lib, rung.width, rung.height, plan.fps_num, plan.fps_den,
+                rung.video_bitrate, frames_per_seg)
+            seg_counts[rung.name] = 0
+            seg_durs[rung.name] = []
+            bytes_written[rung.name] = 0
+            pending[rung.name] = []
+            frame_idx[rung.name] = 0
+        src = open_source(plan.source.path)
+    except BaseException:
+        _close_all()
+        raise
+    try:
+        total = src.frame_count
+        # resume: AV1 tracks are written by a third-party encoder whose
+        # bitstream state we cannot reconstruct mid-stream — restart
+        # clean (the tree is still atomically replaced per segment)
+        start_frame = 0
+
+        from vlog_tpu.ops.resize import resize_yuv420
+
+        fifo: queue_mod.Queue = queue_mod.Queue(maxsize=1)
+        eof = object()
+        stop = threading.Event()
+        batch_n = max(1, plan.frame_batch)
+
+        def producer() -> None:
+            try:
+                for item in src.read_batches(batch_n, start_frame):
+                    while not stop.is_set():
+                        try:
+                            fifo.put(item, timeout=0.5)
+                            break
+                        except queue_mod.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                fifo.put(eof)
+            except BaseException as exc:  # noqa: BLE001 — relayed below
+                fifo.put(exc)
+
+        threading.Thread(target=producer, daemon=True,
+                         name="vlog-av1-decode").start()
+
+        frames_done = 0
+        thumb_path = None
+
+        def ensure_track(rung, first_tu: bytes) -> None:
+            """Build the av01 track from the first keyframe TU's
+            sequence header (libaom leaves extradata to the bitstream)."""
+            if rung.name in tracks:
+                return
+            prof, level, tier = parse_seq_header(first_tu)
+            meta[rung.name] = {"profile": prof, "level": level,
+                               "tier": tier}
+            tracks[rung.name] = TrackConfig(
+                track_id=1, handler="vide", timescale=timescale,
+                sample_entry=av01_sample_entry(
+                    rung.width, rung.height,
+                    av1c_record(prof, level, tier)),
+                width=rung.width, height=rung.height)
+            rdir = out / rung.name
+            rdir.mkdir(parents=True, exist_ok=True)
+            # AV1 never resumes (a third-party encoder's mid-stream
+            # state is unreconstructable): always purge stale segments
+            for seg in rdir.glob("segment_*.m4s"):
+                seg.unlink(missing_ok=True)
+            prepare_init_segment(
+                rdir, init_segment(tracks[rung.name]),
+                config_tag=f"av1:delegated:gop={frames_per_seg}")
+
+        def drain(rung, pkts) -> None:
+            for data, is_key in pkts:
+                ensure_track(rung, data)
+                pending[rung.name].append(
+                    Sample(data=data, duration=frame_dur, is_sync=is_key))
+            while len(pending[rung.name]) >= frames_per_seg:
+                chunk = pending[rung.name][:frames_per_seg]
+                pending[rung.name] = pending[rung.name][frames_per_seg:]
+                backend._write_segment(out, rung, tracks[rung.name],
+                                       seg_counts, seg_durs,
+                                       bytes_written, chunk, timescale)
+
+        try:
+            while True:
+                item = fifo.get()
+                if item is eof:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                by, bu, bv = item
+                if plan.thumbnail and thumb_path is None:
+                    thumb_path = str(out / "thumbnail.jpg")
+                    backend._write_thumbnail(by[0], bu[0], bv[0],
+                                             thumb_path)
+                for rung in plan.rungs:
+                    if (rung.height, rung.width) == (by.shape[1],
+                                                     by.shape[2]):
+                        ry, ru, rv = by, bu, bv
+                    else:
+                        ry, ru, rv = resize_yuv420(
+                            by, bu, bv, rung.height, rung.width)
+                        ry, ru, rv = (np.asarray(ry), np.asarray(ru),
+                                      np.asarray(rv))
+                    enc = encoders[rung.name]
+                    for i in range(ry.shape[0]):
+                        fi = frame_idx[rung.name]
+                        enc.send(ry[i], ru[i], rv[i],
+                                 force_key=(fi % frames_per_seg == 0))
+                        frame_idx[rung.name] = fi + 1
+                        drain(rung, enc.receive())
+                frames_done += by.shape[0]
+                if progress_cb is not None:
+                    progress_cb(frames_done, max(total, frames_done),
+                                "av1 ladder")
+            for rung in plan.rungs:
+                drain(rung, encoders[rung.name].flush())
+                if pending[rung.name]:
+                    backend._write_segment(out, rung, tracks[rung.name],
+                                           seg_counts, seg_durs,
+                                           bytes_written,
+                                           pending[rung.name], timescale)
+                    pending[rung.name] = []
+        finally:
+            stop.set()
+            while True:
+                try:
+                    fifo.get_nowait()
+                except queue_mod.Empty:
+                    break
+            for enc in encoders.values():
+                enc.close()
+    finally:
+        src.close()
+
+    true_total = total if src.exact_seek else frames_done
+    duration_s = true_total / fps if fps else 0.0
+    results, variants = [], []
+    for rung in plan.rungs:
+        name = rung.name
+        cstr = codec_string_from_tu(meta.get(name))
+        playlist = hls.media_playlist(
+            [hls.SegmentRef(uri=f"segment_{i + 1:05d}.m4s",
+                            duration_s=seg_durs[name][i])
+             for i in range(seg_counts[name])],
+            target_duration_s=plan.segment_duration_s,
+            init_uri="init.mp4")
+        ppath = out / name / "playlist.m3u8"
+        atomic_write_text(ppath, playlist)
+        total_dur = sum(seg_durs[name])
+        achieved = (int(bytes_written[name] * 8 / total_dur)
+                    if total_dur else 0)
+        results.append(RungResult(
+            name=name, width=rung.width, height=rung.height,
+            codec_string=cstr, segment_count=seg_counts[name],
+            bytes_written=bytes_written[name], mean_psnr_y=None,
+            achieved_bitrate=achieved, playlist_path=str(ppath),
+            target_bitrate=rung.video_bitrate))
+        variants.append(hls.VariantRef(
+            name=name, uri=f"{name}/playlist.m3u8",
+            bandwidth=max(achieved, 1), width=rung.width,
+            height=rung.height, codecs=cstr, frame_rate=fps,
+            audio_group=(f"aud{rung.audio_bitrate // 1000}"
+                         if rung.audio_bitrate else "")))
+    atomic_write_text(out / "master.m3u8", hls.master_playlist(variants))
+    atomic_write_text(out / "manifest.mpd", hls.dash_manifest(
+        variants, duration_s=duration_s,
+        segment_duration_s=plan.segment_duration_s))
+    return RunResult(
+        rungs=results, frames_processed=frames_done,
+        duration_s=duration_s, thumbnail_path=thumb_path,
+        wall_s=time.monotonic() - t0, variants=variants, fps=fps,
+        segment_duration_s=plan.segment_duration_s,
+        gop_len=frames_per_seg)

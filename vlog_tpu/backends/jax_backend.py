@@ -127,10 +127,12 @@ class JaxBackend:
             plan_rung_geometry(source.width, source.height, r) for r in rungs
         )
         codec = opts.get("codec", "h264")
-        if codec in ("h265", "hevc"):
+        if codec == "hevc":
+            codec = "h265"
+        if codec in ("h265", "av1"):
             from dataclasses import replace
 
-            planned = tuple(replace(r, codec="h265") for r in planned)
+            planned = tuple(replace(r, codec=codec) for r in planned)
         elif codec != "h264":
             raise ValueError(f"unknown codec {codec!r}")
         from vlog_tpu.media.y4m import fps_to_fraction
@@ -184,6 +186,10 @@ class JaxBackend:
             from vlog_tpu.backends.hevc_path import run_hevc
 
             return run_hevc(self, plan, progress_cb, resume, t0)
+        if any(r.codec == "av1" for r in plan.rungs):
+            from vlog_tpu.backends.av1_path import run_av1
+
+            return run_av1(self, plan, progress_cb, resume, t0)
         out = plan.out_dir
         out.mkdir(parents=True, exist_ok=True)
 

@@ -237,6 +237,10 @@ H264_ENTROPY: str = _env_str("VLOG_H264_ENTROPY", "cabac")
 # device; intra-only mode leaves it off (deblocking is display-only
 # there and the device pass is the headline bench).
 H264_DEBLOCK: bool = _env_bool("VLOG_H264_DEBLOCK", True)
+# AV1 delegated-encoder speed (libaom cpu-used 0-8 / SVT preset): the
+# reference's AV1 is hardware-delegated (hwaccel.py:555-646); ours rides
+# the system encoder libraries (backends/av1_path.py).
+AV1_SPEED: int = _env_int("VLOG_AV1_SPEED", 8, lo=0, hi=8)
 # HEVC 2NxN/Nx2N inter partitions (oracle-proven; big wins on
 # split-motion content, but the mode-decision penalty is uncalibrated
 # for mixed content and partitioned slices entropy-code in Python —

@@ -37,14 +37,16 @@ class VtAvInfo(ctypes.Structure):
 
 def _compile() -> Path:
     _BUILD.mkdir(exist_ok=True)
-    src = _DIR / "avshim.c"
+    srcs = [_DIR / "avshim.c", _DIR / "av1enc.c"]
     so = _BUILD / "libvtav.so"
-    if so.exists() and so.stat().st_mtime >= src.stat().st_mtime:
+    if so.exists() and all(so.stat().st_mtime >= s.stat().st_mtime
+                           for s in srcs):
         return so
     pid = os.getpid()
     tmp_so = _BUILD / f"libvtav.{pid}.so.tmp"
     cc = os.environ.get("CC", "gcc")
-    cmd = [cc, "-O2", "-fPIC", "-shared", str(src), "-o", str(tmp_so),
+    cmd = [cc, "-O2", "-fPIC", "-shared", *map(str, srcs), "-o",
+           str(tmp_so),
            "-lavformat", "-lavcodec", "-lavutil", "-lswscale"]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
@@ -85,5 +87,22 @@ def get_av_lib() -> ctypes.CDLL | None:
         lib.vt_av_close.argtypes = [ctypes.c_void_p]
         lib.vt_av_audio_to_f32.restype = ctypes.c_int64
         lib.vt_av_audio_to_f32.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.vt_av1_open.restype = ctypes.c_void_p
+        lib.vt_av1_open.argtypes = [ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int64, ctypes.c_int,
+                                    ctypes.c_int]
+        lib.vt_av1_send.restype = ctypes.c_int
+        lib.vt_av1_send.argtypes = [ctypes.c_void_p, u8p, u8p, u8p,
+                                    ctypes.c_int]
+        lib.vt_av1_flush.restype = ctypes.c_int
+        lib.vt_av1_flush.argtypes = [ctypes.c_void_p]
+        lib.vt_av1_receive.restype = ctypes.c_int64
+        lib.vt_av1_receive.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int64)]
+        lib.vt_av1_close.restype = None
+        lib.vt_av1_close.argtypes = [ctypes.c_void_p]
         _LIB = lib
         return _LIB

@@ -428,14 +428,13 @@ class WorkerDaemon:
         payload = _json.loads(job["payload"] or "{}")
         fmt = payload.get("streaming_format", "cmaf")
         codec = payload.get("codec", "h264")
-        if codec not in ("h264", "h265"):
+        if codec not in ("h264", "h265", "av1"):
             await self._fail(job, video,
-                             f"codec {codec!r} has no first-party encoder",
-                             permanent=True)
+                             f"codec {codec!r} has no encoder", permanent=True)
             return
-        if codec == "h265" and fmt != "cmaf":
+        if codec in ("h265", "av1") and fmt != "cmaf":
             await self._fail(job, video,
-                             "h265 output is CMAF-only", permanent=True)
+                             f"{codec} output is CMAF-only", permanent=True)
             return
         source = video["source_path"]
         if not source or not Path(source).exists():
