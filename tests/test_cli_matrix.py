@@ -1,0 +1,91 @@
+"""CLI subcommand matrix against the live HTTP stack.
+
+Fills VERDICT #34's remaining gap: every subcommand exercised, including
+the lifecycle verbs, webhook management, and error paths (bad ids, bad
+arguments), via the same live admin/public servers the SPA uses.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.fixtures.media import make_y4m
+from tests.test_product_apis import stack  # noqa: F401 (fixture)
+
+
+@pytest.fixture
+def cli(stack, monkeypatch):
+    from vlog_tpu.cli import main as climod
+
+    monkeypatch.setattr(climod, "ADMIN_URL", stack["admin"])
+    monkeypatch.setattr(climod, "PUBLIC_URL", stack["public"])
+    return climod
+
+
+def _upload(cli, capsys, tmp_path, title="Clip"):
+    src = make_y4m(tmp_path / f"{title}.y4m", n_frames=8, width=64,
+                   height=48)
+    cli.main(["upload", str(src), "--title", title])
+    out = capsys.readouterr().out
+    vid = int(out.split("video ")[1].split()[0].rstrip(":"))
+    return vid
+
+
+def test_cli_delete_restore_cycle(run, tmp_path, stack, cli, capsys):
+    vid = _upload(cli, capsys, tmp_path, "DelMe")
+    cli.main(["delete", str(vid)])
+    assert "deleted" in capsys.readouterr().out
+    row = run(stack["db"].fetch_one(
+        "SELECT deleted_at FROM videos WHERE id=:i", {"i": vid}))
+    assert row["deleted_at"] is not None
+    cli.main(["restore", str(vid)])
+    assert "restored" in capsys.readouterr().out
+    row = run(stack["db"].fetch_one(
+        "SELECT deleted_at FROM videos WHERE id=:i", {"i": vid}))
+    assert row["deleted_at"] is None
+
+
+def test_cli_retranscode(run, tmp_path, stack, cli, capsys):
+    vid = _upload(cli, capsys, tmp_path, "Again")
+    cli.main(["retranscode", str(vid)])
+    out = capsys.readouterr().out
+    assert "requeued" in out or "job" in out
+
+
+def test_cli_bad_video_id_exits_nonzero(cli, capsys):
+    with pytest.raises(SystemExit):
+        cli.main(["status", "999999"])
+
+
+def test_cli_webhooks_roundtrip(cli, capsys):
+    cli.main(["webhooks", "add", "https://hooks.example.com/x",
+              "--events", "video.ready"])
+    out = capsys.readouterr().out
+    assert "webhook" in out
+    wid = out.split("webhook ")[1].split()[0]
+    cli.main(["webhooks", "list"])
+    out = capsys.readouterr().out
+    assert "hooks.example.com" in out and "video.ready" in out
+    cli.main(["webhooks", "rm", "--webhook-id", wid])
+    cli.main(["webhooks", "list"])
+    assert "hooks.example.com" not in capsys.readouterr().out
+
+
+def test_cli_settings_unset(cli, capsys):
+    cli.main(["settings", "set", "x.y", "7"])
+    capsys.readouterr()
+    cli.main(["settings", "unset", "x.y"])
+    cli.main(["settings", "list"])
+    assert "x.y" not in capsys.readouterr().out
+
+
+def test_cli_worker_revoke_unknown_is_noop(cli, capsys):
+    cli.main(["worker-revoke", "ghost-worker"])
+    assert "revoked 0 key(s)" in capsys.readouterr().out
+
+
+def test_cli_unknown_command_fails():
+    from vlog_tpu.cli import main as climod
+
+    with pytest.raises(SystemExit):
+        climod.main(["frobnicate"])

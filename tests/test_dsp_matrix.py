@@ -1,0 +1,208 @@
+"""DSP + infrastructure property matrix: resize, colorspace, mesh
+helpers, fsio atomicity, JPEG structure, AAC framing, TS packets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+# --------------------------------------------------------------------------
+# Resize
+# --------------------------------------------------------------------------
+
+def test_resize_identity_shapes():
+    from vlog_tpu.ops.resize import resize_yuv420
+
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 256, (2, 96, 128)).astype(np.uint8)
+    u = rng.integers(0, 256, (2, 48, 64)).astype(np.uint8)
+    v = rng.integers(0, 256, (2, 48, 64)).astype(np.uint8)
+    ry, ru, rv = resize_yuv420(y, u, v, 48, 64)
+    assert np.asarray(ry).shape == (2, 48, 64)
+    assert np.asarray(ru).shape == (2, 24, 32)
+    assert np.asarray(rv).shape == (2, 24, 32)
+    assert np.asarray(ry).dtype == np.uint8
+
+
+def test_resize_flat_field_preserved():
+    """A constant plane must stay constant through the lanczos matrices
+    (windowed-sinc rows sum to 1)."""
+    from vlog_tpu.ops.resize import resize_yuv420
+
+    y = np.full((1, 96, 128), 137, np.uint8)
+    u = np.full((1, 48, 64), 90, np.uint8)
+    v = np.full((1, 48, 64), 201, np.uint8)
+    ry, ru, rv = resize_yuv420(y, u, v, 64, 96)
+    assert int(np.asarray(ry).min()) >= 136 and int(np.asarray(ry).max()) <= 138
+    assert abs(int(np.asarray(ru)[0, 10, 10]) - 90) <= 1
+    assert abs(int(np.asarray(rv)[0, 10, 10]) - 201) <= 1
+
+
+def test_plan_rung_geometry_even_and_aspect():
+    from vlog_tpu.backends.base import plan_rung_geometry
+    from vlog_tpu.config import QualityRung
+
+    r = QualityRung("360p", 360, 600_000, 96_000)
+    p = plan_rung_geometry(1920, 1080, r)
+    assert p.height == 360 and p.width == 640
+    assert p.width % 2 == 0 and p.height % 2 == 0
+    # odd-ish aspect stays even and near-proportional
+    p2 = plan_rung_geometry(1366, 768, r)
+    assert p2.width % 2 == 0
+    assert abs(p2.width / p2.height - 1366 / 768) < 0.05
+
+
+# --------------------------------------------------------------------------
+# Colorspace
+# --------------------------------------------------------------------------
+
+def test_yuv_rgb_grey_point():
+    from vlog_tpu.ops.colorspace import yuv420_to_rgb
+
+    y = np.full((16, 16), 128, np.uint8)
+    u = np.full((8, 8), 128, np.uint8)
+    v = np.full((8, 8), 128, np.uint8)
+    rgb = np.asarray(yuv420_to_rgb(y, u, v, standard="bt709"))
+    assert rgb.shape == (16, 16, 3)
+    # mid-grey: all three channels equal within rounding
+    assert np.all(np.abs(rgb[..., 0] - rgb[..., 1]) < 0.02)
+    assert np.all(np.abs(rgb[..., 1] - rgb[..., 2]) < 0.02)
+
+
+# --------------------------------------------------------------------------
+# Mesh helpers
+# --------------------------------------------------------------------------
+
+def test_make_mesh_axis_spec():
+    import jax
+
+    from vlog_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh("data:-1", devices=jax.devices())
+    assert mesh.axis_names == ("data",)
+    assert mesh.devices.size == len(jax.devices())
+
+
+def test_pad_batch_rounds_up():
+    from vlog_tpu.parallel.mesh import pad_batch
+
+    x = np.arange(10, dtype=np.int32)
+    (padded,), real = pad_batch(8, x)
+    assert real == 10
+    assert padded.shape[0] == 16
+    np.testing.assert_array_equal(padded[:10], x)
+    # padding replicates the tail value
+    assert padded[10] == x[-1]
+
+
+def test_shard_frames_preserves_values():
+    import jax
+
+    from vlog_tpu.parallel.mesh import make_mesh, shard_frames
+
+    mesh = make_mesh("data:-1", devices=jax.devices())
+    n = len(jax.devices())
+    x = np.arange(n * 3, dtype=np.int32).reshape(n, 3)
+    (sx,) = shard_frames(mesh, x)
+    np.testing.assert_array_equal(np.asarray(sx), x)
+
+
+# --------------------------------------------------------------------------
+# fsio atomicity
+# --------------------------------------------------------------------------
+
+def test_atomic_write_replaces_whole_file(tmp_path):
+    from vlog_tpu.utils.fsio import atomic_write_bytes, atomic_write_text
+
+    p = tmp_path / "f.bin"
+    atomic_write_bytes(p, b"one")
+    atomic_write_bytes(p, b"twotwo")
+    assert p.read_bytes() == b"twotwo"
+    atomic_write_text(tmp_path / "t.txt", "hello")
+    assert (tmp_path / "t.txt").read_text() == "hello"
+    # no stray temp files left behind
+    assert {f.name for f in tmp_path.iterdir()} == {"f.bin", "t.txt"}
+
+
+def test_prepare_init_segment_tag_invalidation(tmp_path):
+    from vlog_tpu.utils.fsio import prepare_init_segment
+
+    rdir = tmp_path
+    (rdir / "segment_00001.m4s").write_bytes(b"old")
+    assert prepare_init_segment(rdir, b"INIT", config_tag="cfg-a") is False
+    (rdir / "segment_00001.m4s").write_bytes(b"seg1")
+    # same init + same tag: resumable, segments kept
+    assert prepare_init_segment(rdir, b"INIT", config_tag="cfg-a") is True
+    assert (rdir / "segment_00001.m4s").exists()
+    # same init bytes, DIFFERENT tag (e.g. deblock flag flipped):
+    # stale segments must be purged
+    assert prepare_init_segment(rdir, b"INIT", config_tag="cfg-b") is False
+    assert not (rdir / "segment_00001.m4s").exists()
+
+
+# --------------------------------------------------------------------------
+# JPEG structure
+# --------------------------------------------------------------------------
+
+def test_jpeg_markers_and_dims():
+    from vlog_tpu.codecs.jpeg import encode_jpeg_rgb
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, (32, 48, 3)).astype(np.uint8)
+    data = encode_jpeg_rgb(img, quality=80)
+    assert data[:2] == b"\xff\xd8" and data[-2:] == b"\xff\xd9"
+    i = data.find(b"\xff\xc0")        # SOF0
+    assert i > 0
+    h = int.from_bytes(data[i + 5:i + 7], "big")
+    w = int.from_bytes(data[i + 7:i + 9], "big")
+    assert (h, w) == (32, 48)
+
+
+@pytest.mark.parametrize("q_lo,q_hi", [(30, 90)])
+def test_jpeg_quality_monotone_size(q_lo, q_hi):
+    from vlog_tpu.codecs.jpeg import encode_jpeg_rgb
+
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 256, (64, 64, 3)).astype(np.uint8)
+    assert len(encode_jpeg_rgb(img, quality=q_lo)) < \
+        len(encode_jpeg_rgb(img, quality=q_hi))
+
+
+# --------------------------------------------------------------------------
+# AAC / ADTS framing
+# --------------------------------------------------------------------------
+
+def test_adts_frame_split_and_headers():
+    from vlog_tpu.codecs.aac import AacEncoder
+    from vlog_tpu.codecs.aac.adts import split_adts_frames
+
+    enc = AacEncoder(sample_rate=48000, channels=1)
+    pcm = (0.25 * np.sin(np.arange(4096 * 4) / 20)).astype(np.float32)
+    adts = enc.encode_adts(pcm[None, :])
+    frames = split_adts_frames(adts)
+    assert len(frames) >= 3
+    for f in frames:
+        assert f[0] == 0xFF and (f[1] & 0xF0) == 0xF0   # syncword
+        flen = ((f[3] & 3) << 11) | (f[4] << 3) | (f[5] >> 5)
+        assert flen == len(f)
+
+
+# --------------------------------------------------------------------------
+# MPEG-TS packets
+# --------------------------------------------------------------------------
+
+def test_ts_packets_188_aligned_and_pat_first():
+    from vlog_tpu.media.ts import TsMuxer, TsSample
+
+    mux = TsMuxer(has_video=True, has_audio=False)
+    seg = mux.mux_segment(video=[
+        TsSample(b"\x00\x00\x00\x01\x65" + b"\x11" * 64, pts=0,
+                 is_idr=True)])
+    assert len(seg) % 188 == 0
+    assert seg[0] == 0x47                 # sync byte
+    pid0 = ((seg[1] & 0x1F) << 8) | seg[2]
+    assert pid0 == 0                      # PAT rides first
+    # every packet starts with the sync byte
+    assert all(seg[i] == 0x47 for i in range(0, len(seg), 188))

@@ -1,0 +1,419 @@
+"""Dense unit matrices: config parsing, auth, webhooks, VTT, SQL/python
+state agreement, codec tables — the long tail of behavior pins.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sqlite3
+
+import numpy as np
+import pytest
+
+from vlog_tpu import config as cfg
+
+
+# --------------------------------------------------------------------------
+# Config env parsers
+# --------------------------------------------------------------------------
+
+def test_env_int_parses(monkeypatch):
+    monkeypatch.setenv("X_INT", "42")
+    assert cfg._env_int("X_INT", 1) == 42
+
+
+def test_env_int_default(monkeypatch):
+    monkeypatch.delenv("X_INT", raising=False)
+    assert cfg._env_int("X_INT", 7) == 7
+
+
+@pytest.mark.parametrize("raw", ["nope", "1.5", ""])
+def test_env_int_rejects_garbage(monkeypatch, raw):
+    monkeypatch.setenv("X_INT", raw)
+    with pytest.raises(cfg.ConfigError):
+        cfg._env_int("X_INT", 1)
+
+
+@pytest.mark.parametrize("raw,lo,hi", [("0", 1, None), ("99", None, 50)])
+def test_env_int_range_enforced(monkeypatch, raw, lo, hi):
+    monkeypatch.setenv("X_INT", raw)
+    with pytest.raises(cfg.ConfigError):
+        cfg._env_int("X_INT", 10, lo=lo, hi=hi)
+
+
+@pytest.mark.parametrize("raw,expected", [
+    ("1", True), ("true", True), ("YES", True), ("on", True),
+    ("0", False), ("false", False), ("No", False), ("off", False),
+])
+def test_env_bool_forms(monkeypatch, raw, expected):
+    monkeypatch.setenv("X_B", raw)
+    assert cfg._env_bool("X_B", not expected) is expected
+
+
+def test_env_bool_rejects_garbage(monkeypatch):
+    monkeypatch.setenv("X_B", "maybe")
+    with pytest.raises(cfg.ConfigError):
+        cfg._env_bool("X_B", True)
+
+
+def test_env_float_range(monkeypatch):
+    monkeypatch.setenv("X_F", "0.05")
+    with pytest.raises(cfg.ConfigError):
+        cfg._env_float("X_F", 1.0, lo=0.1)
+
+
+@pytest.mark.parametrize("h,expected_names", [
+    (2160, 6), (1080, 4), (720, 3), (480, 2), (360, 1), (144, 1),
+])
+def test_ladder_for_source_rung_counts(h, expected_names):
+    assert len(cfg.ladder_for_source(h)) == expected_names
+
+
+def test_timeout_envelope_clamps():
+    assert cfg.transcode_timeout_s(1.0, "360p") == cfg.TIMEOUT_MIN_S
+    assert cfg.transcode_timeout_s(10 * 3600, "2160p") == cfg.TIMEOUT_MAX_S
+    mid = cfg.transcode_timeout_s(600, "1080p")
+    assert cfg.TIMEOUT_MIN_S < mid < cfg.TIMEOUT_MAX_S
+
+
+# --------------------------------------------------------------------------
+# SQL fragments agree with the python state predicates
+# --------------------------------------------------------------------------
+
+def _rows():
+    now = 1000.0
+    cases = [
+        dict(claimed_by=None, claim_expires_at=None, completed_at=None,
+             failed_at=None, attempt=0),
+        dict(claimed_by="w", claim_expires_at=now + 5, completed_at=None,
+             failed_at=None, attempt=1),
+        dict(claimed_by="w", claim_expires_at=now - 5, completed_at=None,
+             failed_at=None, attempt=1),
+        dict(claimed_by=None, claim_expires_at=None, completed_at=now,
+             failed_at=None, attempt=1),
+        dict(claimed_by=None, claim_expires_at=None, completed_at=None,
+             failed_at=now, attempt=3),
+        dict(claimed_by="w", claim_expires_at=None, completed_at=None,
+             failed_at=None, attempt=1),
+    ]
+    return now, cases
+
+
+def test_sql_claimable_matches_python():
+    from vlog_tpu.jobs import state as js
+
+    now, cases = _rows()
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE jobs (claimed_by, claim_expires_at, "
+                 "completed_at, failed_at, attempt)")
+    for c in cases:
+        conn.execute("INSERT INTO jobs VALUES (?,?,?,?,?)",
+                     (c["claimed_by"], c["claim_expires_at"],
+                      c["completed_at"], c["failed_at"], c["attempt"]))
+    got = [bool(r[0]) for r in conn.execute(
+        f"SELECT ({js.SQL_CLAIMABLE}) FROM jobs", {"now": now})]
+    want = [js.is_claimable(c, now=now) for c in cases]
+    assert got == want
+
+
+def test_sql_expired_matches_python():
+    from vlog_tpu.enums import JobState
+    from vlog_tpu.jobs import state as js
+
+    now, cases = _rows()
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE jobs (claimed_by, claim_expires_at, "
+                 "completed_at, failed_at, attempt)")
+    for c in cases:
+        conn.execute("INSERT INTO jobs VALUES (?,?,?,?,?)",
+                     (c["claimed_by"], c["claim_expires_at"],
+                      c["completed_at"], c["failed_at"], c["attempt"]))
+    got = [bool(r[0]) for r in conn.execute(
+        f"SELECT ({js.SQL_EXPIRED_CLAIM}) FROM jobs", {"now": now})]
+    want = [js.derive_state(c, now=now) is JobState.EXPIRED for c in cases]
+    assert got == want
+
+
+# --------------------------------------------------------------------------
+# Webhook SSRF vetting
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("url,ok", [
+    ("https://hooks.example.com/x", True),
+    ("http://hooks.example.com/x", True),
+    ("ftp://hooks.example.com/x", False),
+    ("https://user:pw@example.com/x", False),
+    ("https://127.0.0.1/x", False),
+    ("https://10.0.0.8/x", False),
+    ("https://192.168.1.1/x", False),
+    ("https://169.254.169.254/latest/meta-data", False),
+    ("https://[::1]/x", False),
+    ("", False),
+    ("not-a-url", False),
+])
+def test_webhook_url_vetting(url, ok):
+    from vlog_tpu.jobs.webhooks import url_allowed
+
+    assert url_allowed(url, allow_private=False) is ok
+
+
+def test_webhook_signature_is_hmac_sha256():
+    import hashlib
+    import hmac as hm
+
+    from vlog_tpu.jobs.webhooks import sign_payload
+
+    body = b'{"event": "video.ready"}'
+    sig = sign_payload("s3cret", body)
+    assert sig == "sha256=" + hm.new(b"s3cret", body,
+                                     hashlib.sha256).hexdigest()
+
+
+# --------------------------------------------------------------------------
+# VTT formatting
+# --------------------------------------------------------------------------
+
+def test_vtt_timestamps_and_escaping():
+    from vlog_tpu.asr.vtt import format_vtt
+    from vlog_tpu.worker.transcribe import Cue
+
+    cues = [Cue(0.0, 1.5, "hello"), Cue(61.25, 3661.5, "a & b < c")]
+    out = format_vtt(cues)
+    assert out.startswith("WEBVTT")
+    assert "00:00:00.000 --> 00:00:01.500" in out
+    assert "00:01:01.250 --> 01:01:01.500" in out
+    assert "&amp;" in out and "&lt;" in out
+
+
+def test_vtt_empty():
+    from vlog_tpu.asr.vtt import format_vtt
+
+    assert format_vtt([]).startswith("WEBVTT")
+
+
+# --------------------------------------------------------------------------
+# Worker auth
+# --------------------------------------------------------------------------
+
+def test_key_prefix_format_and_verify(run, db):
+    from vlog_tpu.api import auth
+
+    async def go():
+        key = await auth.create_worker_key(db, "kw")
+        assert key.startswith("vlwk_")
+        ident = await auth.verify_key(db, key)
+        assert ident is not None and ident.worker_name == "kw"
+        for bad in (key[:-2] + "zz", "vlwk_tooshort", ""):
+            with pytest.raises(auth.AuthError):
+                await auth.verify_key(db, bad)
+
+    run(go())
+
+
+def test_key_verify_cache_hits(run, db):
+    from vlog_tpu.api import auth
+
+    async def go():
+        key = await auth.create_worker_key(db, "kc")
+        a = await auth.verify_key(db, key)
+        b = await auth.verify_key(db, key)     # served by the TTL cache
+        assert a.worker_name == b.worker_name
+
+    run(go())
+
+
+# --------------------------------------------------------------------------
+# Codec table invariants
+# --------------------------------------------------------------------------
+
+def test_h264_chroma_qp_table_monotone():
+    from vlog_tpu.codecs.h264.encoder import chroma_qp
+
+    vals = [chroma_qp(q) for q in range(52)]
+    assert vals[:30] == list(range(30))          # identity below 30
+    assert all(b - a >= 0 for a, b in zip(vals, vals[1:]))
+    assert vals[51] == 39                        # table 8-15 endpoint
+
+
+def test_deblock_tables_spec_landmarks():
+    from vlog_tpu.codecs.h264.deblock import ALPHA, BETA, TC0
+
+    assert ALPHA[15] == 0 and ALPHA[16] == 4 and ALPHA[51] == 255
+    assert BETA[15] == 0 and BETA[16] == 2 and BETA[51] == 18
+    assert TC0.shape == (3, 52)
+    assert TC0[2, 17] == 1 and TC0[2, 51] == 25
+    # monotone non-decreasing in qp and in bS
+    assert all(np.diff(ALPHA) >= 0) and all(np.diff(BETA) >= 0)
+    assert (np.diff(TC0, axis=1) >= 0).all()
+    assert (np.diff(TC0, axis=0) >= 0).all()
+
+
+def test_h264_zigzag_is_permutation():
+    from vlog_tpu.codecs.h264.cavlc_tables import ZIGZAG_4x4
+
+    assert sorted((r, c) for r, c in ZIGZAG_4x4) == [
+        (r, c) for r in range(4) for c in range(4)]
+    assert list(ZIGZAG_4x4[:4]) == [(0, 0), (0, 1), (1, 0), (2, 0)]
+
+
+@pytest.mark.parametrize("qp", [0, 10, 26, 40, 51])
+def test_h264_transform_roundtrip_zero_residual(qp):
+    """All-zero residual stays zero through quant/dequant/inverse."""
+    import jax.numpy as jnp
+
+    from vlog_tpu.ops.transform import (
+        core_transform, dequantize, inverse_core_transform, quantize)
+
+    z = jnp.zeros((1, 1, 4, 4), jnp.int32)
+    lv = quantize(core_transform(z), qp=qp, intra=True)
+    assert int(jnp.abs(lv).max()) == 0
+    rec = inverse_core_transform(dequantize(lv, qp=qp))
+    assert int(jnp.abs(rec).max()) == 0
+
+
+@pytest.mark.parametrize("qp", [10, 30, 48])
+def test_h264_transform_dc_recovery(qp):
+    """A flat residual block survives the transform loop to within the
+    quantization step size."""
+    import jax.numpy as jnp
+
+    from vlog_tpu.ops.transform import (
+        core_transform, dequantize, inverse_core_transform, quantize)
+
+    for amp in (16, 60):
+        blk = jnp.full((1, 1, 4, 4), amp, jnp.int32)
+        lv = quantize(core_transform(blk), qp=qp, intra=True)
+        rec = inverse_core_transform(dequantize(lv, qp=qp))
+        step = 2 ** (qp / 6)
+        assert abs(int(rec[0, 0, 0, 0]) - amp) <= max(4, step)
+
+
+def test_hevc_level_for_resolutions():
+    from vlog_tpu.codecs.hevc.syntax import level_idc_for
+
+    assert level_idc_for(3840, 2160) >= 150   # >= level 5.0
+    assert level_idc_for(640, 360) <= 120
+
+
+def test_h264_level_for_resolutions():
+    from vlog_tpu.codecs.h264.syntax import _level_for
+
+    assert _level_for(3840, 2160, 30) >= 50
+    assert _level_for(320, 240, 30) <= 21
+
+
+# --------------------------------------------------------------------------
+# fmp4 structure
+# --------------------------------------------------------------------------
+
+def _boxes(data: bytes):
+    out = []
+    pos = 0
+    while pos + 8 <= len(data):
+        size = int.from_bytes(data[pos:pos + 4], "big")
+        out.append(data[pos + 4:pos + 8].decode("latin1"))
+        pos += max(size, 8)
+    return out
+
+
+def test_init_segment_box_layout():
+    from vlog_tpu.media.fmp4 import (
+        TrackConfig, avc1_sample_entry, init_segment)
+
+    entry = avc1_sample_entry(64, 48, b"\x01avcCstub")
+    tc = TrackConfig(track_id=1, handler="vide", timescale=30_000,
+                     sample_entry=entry, width=64, height=48)
+    init = init_segment(tc)
+    assert _boxes(init)[:2] == ["ftyp", "moov"]
+    for four in (b"mvhd", b"trak", b"mdia", b"stbl", b"avc1", b"trex"):
+        assert four in init
+
+
+def test_media_segment_box_layout_and_sync():
+    from vlog_tpu.media.fmp4 import (
+        Sample, TrackConfig, avc1_sample_entry, media_segment)
+
+    tc = TrackConfig(track_id=1, handler="vide", timescale=30_000,
+                     sample_entry=avc1_sample_entry(64, 48, b"x"),
+                     width=64, height=48)
+    seg = media_segment(tc, 1, 0,
+                        [Sample(data=b"AAAA", duration=1000, is_sync=True),
+                         Sample(data=b"BB", duration=1000, is_sync=False)])
+    names = _boxes(seg)
+    assert "moof" in names and "mdat" in names
+    assert b"AAAABB" in seg                   # sample payloads packed
+    assert b"tfdt" in seg and b"trun" in seg
+
+
+def test_av01_sample_entry_and_record():
+    from vlog_tpu.media.fmp4 import av01_sample_entry, av1c_record
+
+    rec = av1c_record(0, 8, 0)
+    assert rec[0] == 0x81 and len(rec) == 4
+    assert (rec[1] >> 5) == 0 and (rec[1] & 0x1F) == 8
+    entry = av01_sample_entry(128, 96, rec)
+    assert b"av01" in entry and b"av1C" in entry
+
+
+# --------------------------------------------------------------------------
+# Rate controller plants
+# --------------------------------------------------------------------------
+
+def _drive(rc, plant, n=14):
+    for _ in range(n):
+        qs = rc.frame_qps(8)
+        bpf = float(np.mean([plant(int(q)) for q in qs]))
+        rc.observe(int(bpf * 8), 8, frame_qps=qs)
+    return rc
+
+
+@pytest.mark.parametrize("edge,hi,lo", [
+    (28, 60_000.0, 9_000.0),
+    (23, 40_000.0, 3_000.0),
+])
+def test_rate_controller_handles_cliff_plants(edge, hi, lo):
+    """Targets INSIDE a rate cliff are reachable only by dithering across
+    it; the integer-bracket controller must land within the band."""
+    from vlog_tpu.backends.rate_control import RateController
+
+    target_bpf = (hi + lo) / 2
+    rc = RateController(target_bps=int(target_bpf * 8 * 30), fps=30.0,
+                        init_qp=40)
+    plant = lambda q: hi if q < edge else lo
+    _drive(rc, plant)
+    qs = rc.frame_qps(64)
+    achieved = float(np.mean([plant(int(q)) for q in qs]))
+    assert abs(achieved - target_bpf) / target_bpf < 0.2, (
+        rc._q, rc._obs, achieved)
+
+
+def test_rate_controller_never_runs_away_upward():
+    """Overshoot recovery: an absurdly hot start drops within a few
+    batches and never exceeds the start rate again."""
+    from vlog_tpu.backends.rate_control import RateController
+
+    rc = RateController(target_bps=240_000, fps=30.0, init_qp=12)
+    plant = lambda q: 90_000.0 * 2 ** (-(q - 12) / 6)
+    rates = []
+    for _ in range(10):
+        qs = rc.frame_qps(8)
+        bpf = float(np.mean([plant(int(q)) for q in qs]))
+        rates.append(bpf)
+        rc.observe(int(bpf * 8), 8, frame_qps=qs)
+    assert min(rates[2:]) < rates[0] / 10     # dropped hard
+    assert max(rates[3:]) <= rates[0] * 1.05  # and never ran away again
+
+
+def test_rate_controller_tracks_content_drift():
+    from vlog_tpu.backends.rate_control import RateController
+
+    rc = RateController(target_bps=480_000, fps=30.0, init_qp=30)
+    t = rc.target_bytes_per_frame
+    scale = {"easy": 40_000.0, "hard": 160_000.0}
+    for phase in ("easy", "hard", "easy"):
+        for _ in range(10):
+            qs = rc.frame_qps(8)
+            bpf = float(np.mean(
+                [scale[phase] * 2 ** (-int(q) / 6) for q in qs]))
+            rc.observe(int(bpf * 8), 8, frame_qps=qs)
+        assert abs(bpf - t) / t < 0.35, (phase, bpf, t)

@@ -530,6 +530,9 @@ async def bulk_videos(request: web.Request) -> web.Response:
     if (not isinstance(ids, list) or not ids
             or not all(isinstance(i, int) for i in ids) or len(ids) > 500):
         return _json_error(400, "video_ids (1..500 ints) required")
+    if action not in ("delete", "restore", "set_category"):
+        return _json_error(400, "action must be delete | restore | "
+                                "set_category")
     t = db_now()
     done, missing = [], []
     for vid in ids:
@@ -550,9 +553,6 @@ async def bulk_videos(request: web.Request) -> web.Response:
             await db.execute(
                 "UPDATE videos SET category=:c, updated_at=:t WHERE id=:v",
                 {"c": body.get("category"), "t": t, "v": vid})
-        else:
-            return _json_error(400, "action must be delete | restore | "
-                                    "set_category")
         done.append(vid)
     return web.json_response({"ok": True, "done": done, "missing": missing})
 

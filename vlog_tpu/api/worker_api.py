@@ -337,9 +337,24 @@ async def release(request: web.Request) -> web.Response:
 
 
 async def download_source(request: web.Request) -> web.StreamResponse:
-    """Bulk source download (reference worker_api.py:2193)."""
+    """Bulk source download (reference worker_api.py:2193).
+
+    Gated to the claim holder: a worker may read exactly the sources of
+    videos whose jobs it is actively leasing — an API key must not be a
+    skeleton key to the whole library."""
     db = request.app[DB]
-    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    ident = request[IDENTITY]
+    video_id = int(request.match_info["video_id"])
+    holder = await db.fetch_one(
+        """
+        SELECT id FROM jobs
+        WHERE video_id=:v AND claimed_by=:w AND completed_at IS NULL
+          AND claim_expires_at > :now
+        """,
+        {"v": video_id, "w": ident.worker_name, "now": db_now()})
+    if holder is None:
+        return _json_error(403, "no active claim on this video")
+    video = await vids.get_video(db, video_id)
     if video is None or not video["source_path"]:
         return _json_error(404, "no source")
     path = Path(video["source_path"])

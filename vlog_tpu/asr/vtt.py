@@ -28,6 +28,14 @@ def _ts(t: float) -> str:
     return f"{h:02d}:{m:02d}:{s:06.3f}"
 
 
+def _escape_cue_text(text: str) -> str:
+    """WebVTT cue text treats & and < as markup starters (WebVTT 3.4);
+    transcripts with literal ampersands/angle brackets must escape or
+    conformant parsers drop/garble the cue."""
+    return (text.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
+
+
 def format_vtt(cues: list[Cue]) -> str:
     lines = ["WEBVTT", ""]
     for c in cues:
@@ -35,7 +43,7 @@ def format_vtt(cues: list[Cue]) -> str:
         if not text:
             continue
         lines.append(f"{_ts(c.start_s)} --> {_ts(max(c.end_s, c.start_s))}")
-        lines.append(text)
+        lines.append(_escape_cue_text(text))
         lines.append("")
     return "\n".join(lines) + ("\n" if lines[-1] else "")
 

@@ -59,6 +59,7 @@ class WorkerAPIClient:
                  retries: int = 3):
         self.base_url = base_url.rstrip("/")
         self.retries = retries
+        self.api_key = api_key
         self._client = httpx.AsyncClient(
             base_url=self.base_url, timeout=timeout,
             headers={"Authorization": f"Bearer {api_key}"})
