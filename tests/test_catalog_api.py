@@ -209,9 +209,40 @@ def test_bulk_video_ops(run, stack):
         assert "Bulk 0" not in {v["title"] for v in vis}
 
 
+def test_playlist_reorder_missing_playlist_is_404(run, stack):
+    with httpx.Client(base_url=stack["admin"]) as c:
+        # empty permutation over a nonexistent playlist must not 200
+        r = c.put("/api/playlists/999/order", json={"video_ids": []})
+        assert r.status_code == 404
+
+
 # --------------------------------------------------------------------------
 # Cookie sessions + CSRF
 # --------------------------------------------------------------------------
+
+def test_login_backoff_throttles_guessing(run, stack, monkeypatch):
+    from vlog_tpu.api import admin_api
+
+    monkeypatch.setattr(config, "ADMIN_SECRET", "s3cret")
+    monkeypatch.setattr(admin_api, "_LOGIN_FAILS", {})
+    with httpx.Client(base_url=stack["admin"]) as c:
+        for _ in range(admin_api._LOGIN_FREE_ATTEMPTS):
+            assert c.post("/api/auth/login",
+                          json={"secret": "nope"}).status_code == 403
+        # next attempt is locked out even with the RIGHT secret
+        r = c.post("/api/auth/login", json={"secret": "s3cret"})
+        assert r.status_code == 429
+        assert "retry" in r.json()["error"]
+    # backoff expires -> correct secret succeeds and resets the counter
+    # (patch the module-local clock alias, not the process-wide
+    # time.monotonic the asyncio loop depends on)
+    monkeypatch.setattr(admin_api, "_now",
+                        lambda t=admin_api._now(): t + 3600)
+    with httpx.Client(base_url=stack["admin"]) as c:
+        assert c.post("/api/auth/login",
+                      json={"secret": "s3cret"}).status_code == 200
+        assert admin_api._LOGIN_FAILS == {}
+
 
 def test_session_login_csrf_flow(run, stack, monkeypatch):
     monkeypatch.setattr(config, "ADMIN_SECRET", "s3cret")

@@ -32,6 +32,29 @@ def test_param_translation_ignores_casts_and_plain_text():
     assert sql2 == "SELECT 1" and order2 == []
 
 
+def test_param_translation_skips_quoted_regions():
+    # colon-words inside string literals are data, not placeholders
+    sql, order = pg.translate_params(
+        "SELECT * FROM t WHERE tag=':notaparam' AND id=:id")
+    assert sql == "SELECT * FROM t WHERE tag=':notaparam' AND id=$1"
+    assert order == ["id"]
+    # '' escape keeps the literal open across the embedded quote
+    sql, order = pg.translate_params(
+        "UPDATE t SET s='it''s :x o''clock' WHERE a=:a")
+    assert sql == "UPDATE t SET s='it''s :x o''clock' WHERE a=$1"
+    assert order == ["a"]
+    # quoted identifiers pass through too
+    sql, order = pg.translate_params(
+        'SELECT ":notcol" FROM t WHERE b=:b')
+    assert sql == 'SELECT ":notcol" FROM t WHERE b=$1'
+    assert order == ["b"]
+    # E'' strings honor backslash escapes
+    sql, order = pg.translate_params(
+        r"SELECT E'a\':x' WHERE c=:c")
+    assert sql == r"SELECT E'a\':x' WHERE c=$1"
+    assert order == ["c"]
+
+
 def test_ddl_translation():
     src = ("CREATE TABLE IF NOT EXISTS t (\n"
            "  id INTEGER PRIMARY KEY AUTOINCREMENT,\n"

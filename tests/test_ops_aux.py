@@ -62,7 +62,7 @@ def test_reencode_unknown_codec_fails_permanently(run, db, tmp_path):
     job = run(db.fetch_one(
         "SELECT * FROM jobs WHERE video_id=:v", {"v": video["id"]}))
     assert job["failed_at"] is not None
-    assert "no first-party encoder" in job["error"]
+    assert "has no encoder" in job["error"]
 
 
 # --------------------------------------------------------------------------

@@ -267,6 +267,41 @@ def test_remote_worker_completes_transcode_over_http(run, db, tmp_path, api):
     assert sprite is not None
 
 
+def test_remote_worker_reencodes_to_h265_over_http(run, db, tmp_path, api):
+    """Codec passthrough on the remote plane: a REENCODE job with
+    payload codec=h265 claims over HTTP and the server tree flips to
+    hvc1 CMAF (remote workers were h264-only before round 5)."""
+    src = make_y4m(tmp_path / "r.y4m", n_frames=8, width=128, height=96,
+                   fps=24)
+    video = run(vids.create_video(db, "Upgrade", source_path=str(src)))
+    run(claims.enqueue_job(db, video["id"], JobKind.REENCODE,
+                           payload={"codec": "h265"}))
+    worker = RemoteWorker(
+        api["client"], name="rw1", work_dir=tmp_path / "work",
+        kinds=(JobKind.REENCODE,), progress_min_interval_s=0.0)
+    run(worker.poll_once())
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE video_id=:v",
+                           {"v": video["id"]}))
+    assert job["completed_at"] is not None, job["error"]
+    master = (api["video_dir"] / video["slug"] / "master.m3u8").read_text()
+    assert "hvc1" in master and "avc1" not in master
+
+
+def test_remote_worker_rejects_unknown_codec(run, db, tmp_path, api):
+    src = make_y4m(tmp_path / "r.y4m", n_frames=6, width=64, height=48)
+    video = run(vids.create_video(db, "Bad", source_path=str(src)))
+    run(claims.enqueue_job(db, video["id"], JobKind.REENCODE,
+                           payload={"codec": "vp8"}))
+    worker = RemoteWorker(
+        api["client"], name="rw1", work_dir=tmp_path / "work",
+        kinds=(JobKind.REENCODE,), progress_min_interval_s=0.0)
+    run(worker.poll_once())
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE video_id=:v",
+                           {"v": video["id"]}))
+    assert job["failed_at"] is not None
+    assert "has no encoder" in job["error"]
+
+
 def test_remote_worker_processes_sprites(run, db, tmp_path, api):
     src = make_y4m(tmp_path / "s.y4m", n_frames=12, width=64, height=48)
     video = run(vids.create_video(db, "RS", source_path=str(src)))

@@ -18,12 +18,14 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 import uuid
 from pathlib import Path
 
 from aiohttp import web
 
 from vlog_tpu import config
+from vlog_tpu.codecs import validate_codec_format
 from vlog_tpu.api import auth as authmod
 from vlog_tpu.api.settings import SettingsService, SettingsError
 from vlog_tpu.db.core import Database, now as db_now, open_database
@@ -96,17 +98,80 @@ async def _session_for(request: web.Request) -> dict | None:
     return row
 
 
+# Online-guessing throttle: per-IP exponential backoff after repeated
+# failed logins (in-process state — one admin API process owns the
+# port; the reference throttles at the same tier). Successful login
+# resets. Known trade-off of keying on the peer address: clients behind
+# one NAT share a bucket, so a hostile neighbor can deny logins from
+# that address for up to the 300s cap per wrong guess — accepted, since
+# the alternative (no throttle) leaves the secret open to unbounded
+# online guessing. Deployments that front this with a proxy must
+# preserve client addresses (or disable via a long ADMIN_SECRET).
+_LOGIN_FAILS: dict[str, tuple[int, float]] = {}
+_LOGIN_FREE_ATTEMPTS = 5
+_LOGIN_LOCK_CAP_S = 300.0
+_LOGIN_STALE_S = 3600.0
+
+# Indirection so tests can shift this module's clock without freezing
+# the process-wide time.monotonic the asyncio loop runs on.
+_now = time.monotonic
+
+
+def _login_throttled(ip: str) -> float:
+    """Seconds the caller must still wait, 0 if allowed."""
+    count, last = _LOGIN_FAILS.get(ip, (0, 0.0))
+    if count < _LOGIN_FREE_ATTEMPTS:
+        return 0.0
+    # exponent clamped BEFORE **: an attacker feeding one failure per
+    # window for weeks would otherwise push 2.0**n past float range
+    # (OverflowError -> unhandled 500 ahead of the credential check)
+    lock = min(2.0 ** min(count - _LOGIN_FREE_ATTEMPTS, 9),
+               _LOGIN_LOCK_CAP_S)
+    return max(0.0, last + lock - _now())
+
+
+def _login_failed(ip: str) -> None:
+    t = _now()
+    if len(_LOGIN_FAILS) > 10_000:
+        # Bound memory under address churn (e.g. an IPv6 /64 spraying
+        # junk failures) WITHOUT wiping hot entries — clearing
+        # everything would let a locked-out attacker reset their own
+        # backoff by flooding from throwaway addresses.
+        stale = [k for k, (_, ts) in _LOGIN_FAILS.items()
+                 if t - ts > _LOGIN_STALE_S]
+        for k in stale:
+            del _LOGIN_FAILS[k]
+        if len(_LOGIN_FAILS) > 10_000:   # all hot: drop the oldest half
+            for k in sorted(_LOGIN_FAILS,
+                            key=lambda k: _LOGIN_FAILS[k][1])[:5_000]:
+                del _LOGIN_FAILS[k]
+    count, _ = _LOGIN_FAILS.get(ip, (0, 0.0))
+    _LOGIN_FAILS[ip] = (count + 1, t)
+
+
 async def login(request: web.Request) -> web.Response:
     """POST {secret} -> session cookie + CSRF token."""
     import secrets as pysecrets
 
+    ip = request.remote or "?"
+    wait = _login_throttled(ip)
+    if wait > 0:
+        # keep the audit trail alive during an active brute-force: the
+        # operator must see throttled attempts, not silence
+        audit = request.app.get(AUDIT)
+        if audit is not None:
+            audit.record("auth.login_throttled", remote=ip)
+        return _json_error(429, f"too many failed logins; retry in "
+                                f"{wait:.0f}s")
     body = await request.json()
     if not authmod.check_admin_secret(str(body.get("secret") or ""),
                                       config.ADMIN_SECRET):
+        _login_failed(ip)
         audit = request.app.get(AUDIT)
         if audit is not None:
             audit.record("auth.login_failed", remote=request.remote)
         return _json_error(403, "bad admin secret")
+    _LOGIN_FAILS.pop(ip, None)
     token = pysecrets.token_urlsafe(32)
     csrf = pysecrets.token_urlsafe(32)
     t = db_now()
@@ -124,6 +189,7 @@ async def login(request: web.Request) -> web.Response:
     resp = web.json_response({"ok": True, "csrf_token": csrf,
                               "expires_in_s": SESSION_TTL_S})
     resp.set_cookie(SESSION_COOKIE, token, httponly=True, samesite="Lax",
+                    secure=config.ADMIN_COOKIE_SECURE,
                     max_age=SESSION_TTL_S, path="/")
     return resp
 
@@ -344,11 +410,9 @@ async def reencode(request: web.Request) -> web.Response:
     codec = body.get("codec", "h264")
     if fmt not in ("cmaf", "hls_ts"):
         return _json_error(400, f"unknown streaming_format {fmt!r}")
-    if codec not in ("h264", "h265", "av1"):
-        return _json_error(
-            400, f"codec {codec!r} has no first-party encoder")
-    if codec in ("h265", "av1") and fmt != "cmaf":
-        return _json_error(400, f"{codec} output is CMAF-only")
+    cerr = validate_codec_format(codec, fmt)
+    if cerr is not None:
+        return _json_error(400, cerr)
     try:
         job_id = await claims.enqueue_job(
             db, video["id"], JobKind.REENCODE,

@@ -197,6 +197,9 @@ async def playlist_reorder(request: web.Request) -> web.Response:
     if (not isinstance(order, list)
             or not all(isinstance(v, int) for v in order)):
         return _json_error(400, "video_ids (list of int) required")
+    if await db.fetch_one("SELECT id FROM playlists WHERE id=:i",
+                          {"i": pid}) is None:
+        return _json_error(404, "no such playlist")
     rows = await db.fetch_all(
         "SELECT video_id FROM playlist_items WHERE playlist_id=:p",
         {"p": pid})
@@ -205,8 +208,6 @@ async def playlist_reorder(request: web.Request) -> web.Response:
         return _json_error(400, "video_ids must be a permutation of the "
                                 "playlist's current members")
     async with db.transaction() as tx:
-        # two-phase rewrite: offset first so UNIQUE-free position swaps
-        # can't collide mid-update
         for pos, vid in enumerate(order):
             await tx.execute(
                 "UPDATE playlist_items SET position=:pos "

@@ -75,7 +75,7 @@ class _ShimEncoder:
         if rc != 0:
             raise RuntimeError(f"av1 send failed rc={rc}")
 
-    def receive(self) -> list[tuple[bytes, bool]]:
+    def receive(self) -> list[tuple[bytes, bool, int]]:
         out = []
         is_key = ctypes.c_int()
         pts = ctypes.c_int64()
@@ -90,9 +90,10 @@ class _ShimEncoder:
                 if n == -3:
                     raise RuntimeError("av1 encoder error")
                 return out
-            out.append((self._out[:n].tobytes(), bool(is_key.value)))
+            out.append((self._out[:n].tobytes(), bool(is_key.value),
+                        int(pts.value)))
 
-    def flush(self) -> list[tuple[bytes, bool]]:
+    def flush(self) -> list[tuple[bytes, bool, int]]:
         self.lib.vt_av1_flush(self.handle)
         return self.receive()
 
@@ -207,8 +208,23 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
                 rdir, init_segment(tracks[rung.name]),
                 config_tag=f"av1:delegated:gop={frames_per_seg}")
 
+        next_pts: dict[str, int] = {r.name: 0 for r in plan.rungs}
+
         def drain(rung, pkts) -> None:
-            for data, is_key in pkts:
+            for data, is_key, pts in pkts:
+                # The muxer packages packets in arrival order with
+                # uniform durations, and segment boundaries assume the
+                # forced keyframes land where they were asked. Both
+                # break silently if the encoder reorders or delays
+                # output, so every encoder is opened low-delay
+                # (av1enc.c) and this asserts the contract held.
+                if pts != next_pts[rung.name]:
+                    raise RuntimeError(
+                        f"{rung.name}: delegated AV1 encoder emitted "
+                        f"pts {pts}, expected {next_pts[rung.name]} — "
+                        "out-of-order/delayed output breaks CMAF "
+                        "timing (encoder not in low-delay mode?)")
+                next_pts[rung.name] = pts + 1
                 ensure_track(rung, data)
                 pending[rung.name].append(
                     Sample(data=data, duration=frame_dur, is_sync=is_key))

@@ -10,3 +10,25 @@ standard bitstreams.
   I_PCM and Intra_16x16+CAVLC) and a matching decoder for verification and
   for re-ingesting our own outputs.
 """
+
+# Codecs the product plane can encode to (h264/h265 first-party on device,
+# av1 via the delegated system-encoder shim). Every rejection site uses
+# no_encoder_error() so operators see one canonical message.
+ENCODER_CODECS = ("h264", "h265", "av1")
+
+
+def no_encoder_error(codec: str) -> str:
+    return (f"codec {codec!r} has no encoder "
+            f"(supported: {', '.join(ENCODER_CODECS)})")
+
+
+def validate_codec_format(codec: str, streaming_format: str) -> str | None:
+    """One rulebook for codec/container constraints across every plane
+    (admin API, local daemon, remote worker). Returns an error message,
+    or None when the combination is encodable. h265/av1 are CMAF-only:
+    neither has a standard MPEG-TS mapping worth carrying."""
+    if codec not in ENCODER_CODECS:
+        return no_encoder_error(codec)
+    if codec in ("h265", "av1") and streaming_format != "cmaf":
+        return f"{codec} output is CMAF-only"
+    return None

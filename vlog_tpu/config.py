@@ -206,6 +206,11 @@ ADMIN_PORT: int = _env_int("VLOG_ADMIN_PORT", 9001, lo=1, hi=65535)
 WORKER_API_PORT: int = _env_int("VLOG_WORKER_API_PORT", 9002, lo=1, hi=65535)
 WORKER_API_URL: str = _env_str("VLOG_WORKER_API_URL", f"http://127.0.0.1:{WORKER_API_PORT}")
 ADMIN_SECRET: str = _env_str("VLOG_ADMIN_SECRET", "")
+# Set behind TLS: marks the admin session cookie Secure so the 12h
+# bearer token never rides a cleartext hop. Off by default only because
+# Secure cookies are silently dropped by browsers on plain-HTTP dev
+# deployments.
+ADMIN_COOKIE_SECURE: bool = _env_bool("VLOG_ADMIN_COOKIE_SECURE", False)
 DOWNLOADS_ENABLED: bool = _env_bool("VLOG_DOWNLOADS_ENABLED", False)
 # SSRF guard: webhook targets on private/loopback networks are refused
 # unless explicitly allowed (reference webhook_service.py:143).

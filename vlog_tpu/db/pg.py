@@ -128,21 +128,36 @@ class PgError(RuntimeError):
 
 # -- SQL translation --------------------------------------------------------
 
-_PARAM_RE = re.compile(r"(?<![:\w]):([a-zA-Z_][a-zA-Z0-9_]*)")
+# Alternation order matters: quoted regions (single-quoted literals with
+# '' escapes, double-quoted identifiers, E'' strings with backslash
+# escapes) match first and pass through verbatim, so a literal colon-word
+# inside a string ('tag:foo', time formats) is never rewritten.
+_PARAM_OR_QUOTE_RE = re.compile(
+    r"""
+    (?P<quote> (?<!\w)[eE]'(?:[^'\\]|''|\\.)*'   # E'' string (\ escapes;
+                                          # \w guard: LIKE'x' is not E'')
+             | '(?:[^']|'')*'             # standard literal ('' escapes)
+             | "(?:[^"]|"")*" )           # quoted identifier
+    | (?<![:\w]):(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)
+    """, re.X)
 
 
 def translate_params(sql: str) -> tuple[str, list[str]]:
     """Rewrite ``:name`` placeholders to ``$1..$n``; returns the ordered
-    parameter-name list (repeated names reuse their positional)."""
+    parameter-name list (repeated names reuse their positional).
+    Quoted regions are skipped — ``::casts`` are already excluded by the
+    lookbehind."""
     order: list[str] = []
 
     def sub(m: re.Match) -> str:
-        name = m.group(1)
+        if m.group("quote") is not None:
+            return m.group("quote")
+        name = m.group("name")
         if name not in order:
             order.append(name)
         return f"${order.index(name) + 1}"
 
-    return _PARAM_RE.sub(sub, sql), order
+    return _PARAM_OR_QUOTE_RE.sub(sub, sql), order
 
 
 _DDL_REWRITES = [

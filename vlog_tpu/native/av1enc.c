@@ -45,6 +45,12 @@ void *vt_av1_open(int w, int h, int fps_num, int fps_den,
     e->ctx->framerate = (AVRational){fps_num, fps_den};
     e->ctx->pix_fmt = AV_PIX_FMT_YUV420P;
     e->ctx->bit_rate = bitrate;
+    /* Bound the one-pass VBR: without maxrate/bufsize the system
+     * encoders overshoot freely on hard content and trip the product
+     * plane's rate-verification cap (a miss our controller can't
+     * influence). 1.5x maxrate over a ~1s window tracks the cap. */
+    e->ctx->rc_max_rate = bitrate + bitrate / 2;
+    e->ctx->rc_buffer_size = (int)(bitrate + bitrate / 2);
     e->ctx->gop_size = gop_len;
     e->ctx->max_b_frames = 0;
     e->ctx->thread_count = 0;
@@ -64,6 +70,15 @@ void *vt_av1_open(int w, int h, int fps_num, int fps_den,
         char sp[8];
         snprintf(sp, sizeof sp, "%d", speed < 0 ? 8 : speed);
         av_opt_set(e->ctx->priv_data, "preset", sp, 0);
+        /* low-delay pred structure, no lookahead: packets come back in
+         * presentation order with no delay, matching the muxer's
+         * arrival-order CMAF packaging (same contract lag-in-frames=0
+         * gives libaom above). */
+        av_opt_set(e->ctx->priv_data, "svtav1-params",
+                   "pred-struct=1:lookahead=0", 0);
+    } else if (!strcmp(enc->name, "librav1e")) {
+        av_opt_set(e->ctx->priv_data, "rav1e-params",
+                   "low_latency=true", 0);
     }
     if (avcodec_open2(e->ctx, enc, NULL) < 0) {
         avcodec_free_context(&e->ctx);

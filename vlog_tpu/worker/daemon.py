@@ -26,6 +26,7 @@ from pathlib import Path
 from typing import Any, Awaitable, Callable
 
 from vlog_tpu import config
+from vlog_tpu.codecs import validate_codec_format
 from vlog_tpu.db.core import Database, Row, now as db_now, open_database
 from vlog_tpu.enums import AcceleratorKind, JobKind, VideoStatus
 from vlog_tpu.jobs import claims, state as js, videos as vids
@@ -428,13 +429,9 @@ class WorkerDaemon:
         payload = _json.loads(job["payload"] or "{}")
         fmt = payload.get("streaming_format", "cmaf")
         codec = payload.get("codec", "h264")
-        if codec not in ("h264", "h265", "av1"):
-            await self._fail(job, video,
-                             f"codec {codec!r} has no encoder", permanent=True)
-            return
-        if codec in ("h265", "av1") and fmt != "cmaf":
-            await self._fail(job, video,
-                             f"{codec} output is CMAF-only", permanent=True)
+        err = validate_codec_format(codec, fmt)
+        if err is not None:
+            await self._fail(job, video, err, permanent=True)
             return
         source = video["source_path"]
         if not source or not Path(source).exists():

@@ -64,6 +64,13 @@ def verify_output(master_path, run, *, expect_cmaf: bool) -> None:
             # (a rate cliff costs up to ~5x target for one batch) still
             # dominates a 5-segment average, and washes out by ~10.
             cap = 2.0 if r.segment_count < 10 else 1.5
+            if (r.codec_string or "").startswith("av01"):
+                # Delegated AV1: the system encoder's own one-pass VBR,
+                # not our control loop. The shim bounds it with
+                # maxrate/bufsize (av1enc.c) but libaom/SVT still ride
+                # above target on hard content in ways we can't steer —
+                # gate only the runaway case.
+                cap = 2.5
             ratio = r.achieved_bitrate / r.target_bitrate
             if ratio > cap:
                 raise VerificationError(

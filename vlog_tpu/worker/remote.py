@@ -28,6 +28,7 @@ from typing import Any
 import httpx
 
 from vlog_tpu import config
+from vlog_tpu.codecs import validate_codec_format
 from vlog_tpu.enums import AcceleratorKind, JobKind
 from vlog_tpu.worker.daemon import DaemonStats, JobCancelled
 
@@ -554,10 +555,9 @@ class RemoteWorker:
         payload = job.get("payload") or {}
         fmt = payload.get("streaming_format", "cmaf")
         codec = payload.get("codec", "h264")
-        if codec != "h264":
-            await self._safe_fail(
-                job["id"], f"codec {codec!r} has no first-party encoder yet",
-                permanent=True)
+        err = validate_codec_format(codec, fmt)
+        if err is not None:
+            await self._safe_fail(job["id"], err, permanent=True)
             return
         src = await self._fetch_source(video)
         out_dir = self._job_dir(video) / "out"
@@ -574,7 +574,7 @@ class RemoteWorker:
             return process_video(src, out_dir, backend=self.backend,
                                  progress_cb=cb, rungs=rungs,
                                  keep_original=False, resume=False,
-                                 streaming_format=fmt)
+                                 streaming_format=fmt, codec=codec)
 
         try:
             result = await self._run_with_timeout(work, timeout, "reencode")
