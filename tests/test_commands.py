@@ -100,3 +100,71 @@ def test_admin_command_endpoints(run, db, tmp_path):
         await srv.close()
 
     run(go())
+
+
+def test_get_logs_and_metrics_verbs(run, db, tmp_path):
+    """Round-5 verbs (reference command_listener.py:244-448): log-ring
+    tail and process/device metrics through the daemon's handler."""
+    import logging
+
+    daemon = WorkerDaemon(db, name="mgmtw", video_dir=tmp_path)
+
+    async def go():
+        # warning(): passes the default WARNING root level in the test
+        # env (production main() runs basicConfig(level=INFO))
+        logging.getLogger("vlog.test").warning("breadcrumb-xyzzy")
+        logs = await daemon.handle_command("get_logs", {"lines": 50})
+        assert any("breadcrumb-xyzzy" in ln for ln in logs["lines"])
+        # level filter drops sub-ERROR noise
+        errlogs = await daemon.handle_command(
+            "get_logs", {"lines": 50, "level": "error"})
+        assert not any("breadcrumb-xyzzy" in ln for ln in errlogs["lines"])
+
+        m = await daemon.handle_command("get_metrics", {})
+        assert m["worker"] == "mgmtw"
+        assert m["rss_mb"] > 0 and m["threads"] >= 1
+        assert m["uptime_s"] >= 0
+        assert "device" in m          # no jax import required to answer
+
+        up = await daemon.handle_command("update", {})
+        assert "not supported" in up["error"]
+
+    run(go())
+
+
+def test_restart_verb_sets_exit_contract(run, db, tmp_path):
+    daemon = WorkerDaemon(db, name="rstw", video_dir=tmp_path,
+                          heartbeat_interval_s=0.05, poll_interval_s=0.05)
+
+    async def go():
+        rid = await cmds.send_command(db, "rstw", "restart")
+        task = asyncio.create_task(daemon.run())
+        await asyncio.wait_for(task, 10.0)    # restart stops the loop
+        resp = (await cmds.get_command(db, rid))["response"]
+        assert resp["restarting"] and resp["exit_code"] == 64
+        assert daemon.restart_requested      # _amain exits with code 64
+
+    run(go())
+
+
+def test_remote_worker_mgmt_verbs(run, db, tmp_path):
+    """Same verbs across the HTTP plane (worker parity guard)."""
+    from vlog_tpu.worker.remote import RemoteWorker
+
+    class _StubClient:
+        pass
+
+    worker = RemoteWorker.__new__(RemoteWorker)
+    worker.name = "rmgmt"
+    worker.stats = type("S", (), {"completed": 3, "failed": 1})()
+
+    async def go():
+        m = await RemoteWorker.handle_command(worker, "get_metrics", {})
+        assert m["worker"] == "rmgmt" and m["completed"] == 3
+        logs = await RemoteWorker.handle_command(worker, "get_logs",
+                                                 {"lines": 5})
+        assert isinstance(logs["lines"], list)
+        up = await RemoteWorker.handle_command(worker, "update", {})
+        assert "not supported" in up["error"]
+
+    run(go())

@@ -1,0 +1,271 @@
+"""Support tier: db retry, error sanitization, keyset pagination,
+playback-session maintenance.
+
+Reference analogs: api/db_retry.py (421 LoC), api/errors.py (241),
+api/pagination.py (99), api/partition_manager.py (302).
+"""
+
+from __future__ import annotations
+
+import httpx
+import pytest
+
+from vlog_tpu.api import errors as errs, pagination as pgn
+from vlog_tpu.db import retry as dbr
+from vlog_tpu.db.core import now as db_now
+from vlog_tpu.jobs import sessions as sess
+
+from tests.test_product_apis import stack  # noqa: F401 (fixture)
+
+
+# --------------------------------------------------------------------------
+# retry
+# --------------------------------------------------------------------------
+
+def test_retry_classification():
+    from vlog_tpu.db.pg import PgError
+
+    assert dbr.is_retryable(RuntimeError("database is locked"))
+    assert dbr.is_retryable(PgError("boom", "40P01"))
+    assert dbr.is_retryable(PgError("deadlock detected", None))
+    assert not dbr.is_retryable(RuntimeError("no such table: nope"))
+    assert not dbr.is_retryable(PgError("syntax error", "42601"))
+
+
+def test_retry_succeeds_after_transient(run):
+    calls = {"n": 0}
+
+    async def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("database is locked")
+        return "ok"
+
+    async def go():
+        return await dbr.with_retries(flaky, base_delay_s=0.001)
+
+    assert run(go()) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_gives_up_and_propagates(run):
+    async def always():
+        raise RuntimeError("database is locked")
+
+    async def go():
+        with pytest.raises(dbr.RetriesExhausted):
+            await dbr.with_retries(always, max_attempts=3,
+                                   base_delay_s=0.001)
+
+    run(go())
+
+
+def test_retry_nonretryable_is_immediate(run):
+    calls = {"n": 0}
+
+    async def bad():
+        calls["n"] += 1
+        raise ValueError("nope")
+
+    async def go():
+        with pytest.raises(ValueError):
+            await dbr.with_retries(bad, base_delay_s=0.001)
+
+    run(go())
+    assert calls["n"] == 1
+
+
+# --------------------------------------------------------------------------
+# error sanitization
+# --------------------------------------------------------------------------
+
+def test_sanitize_strips_paths_and_internals():
+    out = errs.sanitize_error(
+        "decode failed: /srv/vlog/uploads/x.mp4: No such file or directory")
+    assert "/srv" not in out and "x.mp4" not in out
+    out = errs.sanitize_error('File "/app/vlog_tpu/worker/pipeline.py", '
+                              "line 88, in run")
+    assert ".py" not in out and "line" not in out.lower()
+    out = errs.sanitize_error("sqlite3.OperationalError: database is locked")
+    assert "sqlite" not in out.lower()
+
+
+def test_sanitize_passes_clean_messages_truncated():
+    assert errs.sanitize_error("title is required") == "title is required"
+    long = "x" * 1000
+    assert len(errs.sanitize_error(long)) <= errs.ERROR_MAX_LEN
+
+
+def test_public_500_is_sanitized(run, stack, monkeypatch):
+    """An unexpected exception inside a public handler must not leak
+    its path-laden repr to the client."""
+    from vlog_tpu.api import public_api
+
+    async def boom(request):
+        raise RuntimeError("open('/etc/passwd') failed: Permission denied")
+
+    # Patch a handler at the route table level: easiest is monkeypatching
+    # the categories handler's dependency — instead, hit a route whose
+    # handler we patch directly on the module (route table holds the ref,
+    # so patch before app build won't apply; use the middleware directly).
+    from vlog_tpu.api.public_api import error_middleware
+
+    async def go():
+        resp = await error_middleware(
+            _FakeRequest(), lambda r: boom(r))
+        import json as _json
+
+        body = _json.loads(resp.text)
+        assert "passwd" not in body["error"]
+        assert "/etc" not in body["error"]
+        assert resp.status == 500
+
+    class _FakeRequest:
+        method = "GET"
+        path = "/api/test"
+
+    run(go())
+
+
+# --------------------------------------------------------------------------
+# pagination
+# --------------------------------------------------------------------------
+
+def test_cursor_roundtrip_and_garbage():
+    ts = db_now()
+    tok = pgn.encode_cursor(ts, 42)
+    assert pgn.decode_cursor(tok) == (ts, 42)
+    for bad in ("", "!!!!", "bm9wZQ", pgn.encode_cursor(ts, 1)[:-4] + "xxxx"):
+        with pytest.raises(pgn.CursorError):
+            pgn.decode_cursor(bad)
+
+
+def test_public_cursor_pagination_walks_all_rows(run, stack):
+    from vlog_tpu.jobs import videos as vids
+
+    async def seed():
+        db = stack["db"]
+        for i in range(7):
+            row = await vids.create_video(db, f"V{i:02d}")
+            # force created_at ties to exercise the id tie-break
+            await db.execute(
+                "UPDATE videos SET status='ready', created_at=:t "
+                "WHERE id=:i", {"t": 1000.0 + (i // 2), "i": row["id"]})
+
+    run(seed())
+    seen, cursor, pages = [], None, 0
+    with httpx.Client(base_url=stack["public"]) as c:
+        while True:
+            params = {"limit": 3}
+            if cursor:
+                params["cursor"] = cursor
+            r = c.get("/api/videos", params=params)
+            assert r.status_code == 200, r.text
+            data = r.json()
+            seen += [v["title"] for v in data["videos"]]
+            assert data["total"] == 7      # total ignores the cursor
+            pages += 1
+            cursor = data["next_cursor"]
+            if not cursor:
+                break
+    assert pages == 3
+    assert len(seen) == len(set(seen)) == 7   # no dup, no skip
+
+    with httpx.Client(base_url=stack["public"]) as c:
+        assert c.get("/api/videos",
+                     params={"cursor": "garbage!"}).status_code == 400
+
+
+def test_admin_cursor_pagination(run, stack):
+    from vlog_tpu.jobs import videos as vids
+
+    async def seed():
+        for i in range(4):
+            await vids.create_video(stack["db"], f"A{i}")
+
+    run(seed())
+    with httpx.Client(base_url=stack["admin"]) as c:
+        r = c.get("/api/videos", params={"limit": 3}).json()
+        assert len(r["videos"]) == 3 and r["next_cursor"]
+        r2 = c.get("/api/videos", params={"limit": 3,
+                                          "cursor": r["next_cursor"]}).json()
+        ids1 = {v["id"] for v in r["videos"]}
+        ids2 = {v["id"] for v in r2["videos"]}
+        assert not (ids1 & ids2)
+        assert r2["next_cursor"] is None
+
+
+# --------------------------------------------------------------------------
+# session maintenance
+# --------------------------------------------------------------------------
+
+def _mk_session(run, db, vid, *, started, hb=None, ended=None, watch=10.0):
+    import uuid
+
+    run(db.execute(
+        """
+        INSERT INTO playback_sessions (video_id, session_token, started_at,
+                                       last_heartbeat_at, ended_at,
+                                       watch_time_s)
+        VALUES (:v, :tok, :s, :hb, :e, :w)
+        """, {"v": vid, "tok": uuid.uuid4().hex, "s": started,
+              "hb": hb if hb is not None else started, "e": ended,
+              "w": watch}))
+
+
+def test_close_stale_and_prune(run, stack):
+    from vlog_tpu.jobs import videos as vids
+
+    db = stack["db"]
+    v = run(vids.create_video(db, "S"))
+    t = db_now()
+    _mk_session(run, db, v["id"], started=t - 50, hb=t - 10)          # live
+    _mk_session(run, db, v["id"], started=t - 4000, hb=t - 3600)      # stale
+    _mk_session(run, db, v["id"], started=t - 400 * 86400,
+                hb=t - 400 * 86400, ended=t - 400 * 86400)            # old
+    _mk_session(run, db, v["id"], started=t - 500 * 86400,
+                hb=t - 500 * 86400, ended=t - 500 * 86400)            # older
+
+    assert run(sess.close_stale_sessions(db)) == 1
+    live = run(db.fetch_one(
+        "SELECT * FROM playback_sessions WHERE ended_at IS NULL"))
+    assert live is not None and live["last_heartbeat_at"] >= t - 11
+
+    assert run(sess.prune_sessions(db)) == 2
+    left = run(db.fetch_val("SELECT COUNT(*) FROM playback_sessions"))
+    assert left == 2                       # retention kept recent rows
+    assert run(sess.prune_sessions(db)) == 0   # idempotent
+
+
+def test_month_stats_buckets(run, stack):
+    from vlog_tpu.jobs import videos as vids
+
+    db = stack["db"]
+    v = run(vids.create_video(db, "M"))
+    t = db_now()
+    _mk_session(run, db, v["id"], started=t, watch=30.0)
+    _mk_session(run, db, v["id"], started=t, watch=12.0)
+    stats = run(sess.month_stats(db, months=2))
+    assert len(stats) == 2
+    assert stats[0]["sessions"] == 2
+    assert stats[0]["watch_time_s"] == 42.0
+    assert stats[1]["sessions"] in (0, 2)   # month boundary tolerance
+
+
+def test_analytics_month_endpoints(run, stack):
+    with httpx.Client(base_url=stack["admin"]) as c:
+        r = c.get("/api/analytics/sessions/months")
+        assert r.status_code == 200
+        assert len(r.json()["months"]) == 12
+        r = c.post("/api/analytics/sessions/prune")
+        assert r.status_code == 200
+        assert r.json()["ok"] is True
+
+
+def test_month_bounds_validation():
+    lo, hi = sess.month_bounds(2026, 7)
+    assert hi > lo
+    with pytest.raises(ValueError):
+        sess.month_bounds(1999, 1)
+    with pytest.raises(ValueError):
+        sess.month_bounds(2026, 13)

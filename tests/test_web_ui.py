@@ -80,3 +80,85 @@ def test_ui_files_reference_only_served_assets():
             p = WEB_ROOT / which / ref
             shared = WEB_ROOT / "shared" / ref
             assert p.is_file() or shared.is_file(), f"{which}: {ref}"
+
+
+# --------------------------------------------------------------------------
+# Round-5 screens: every new API family has UI, and each screen's
+# endpoints answer. (No JS runtime in the image: pytest validates the
+# screen<->endpoint contract; in-browser behavior is driven manually.)
+# --------------------------------------------------------------------------
+
+def _admin_js():
+    return (WEB_ROOT / "admin" / "app.js").read_text()
+
+
+def _admin_html():
+    return (WEB_ROOT / "admin" / "index.html").read_text()
+
+
+def test_admin_playlists_screen(stack):  # noqa: F811
+    html, js = _admin_html(), _admin_js()
+    assert 'data-tab="playlists"' in html and "pl-videos-table" in html
+    for ep in ("/api/playlists",):
+        assert ep in js
+    with httpx.Client(base_url=stack["admin"]) as c:
+        assert c.get("/api/playlists").status_code == 200
+
+
+def test_admin_fields_screen(stack):  # noqa: F811
+    html, js = _admin_html(), _admin_js()
+    assert 'data-tab="fields"' in html and "cf-create" in html
+    assert "/api/custom-fields" in js
+    with httpx.Client(base_url=stack["admin"]) as c:
+        assert c.get("/api/custom-fields").status_code == 200
+
+
+def test_admin_analytics_screen(stack):  # noqa: F811
+    html, js = _admin_html(), _admin_js()
+    assert 'data-tab="analytics"' in html and "an-months" in html
+    assert "/api/analytics/sessions/months" in js
+    with httpx.Client(base_url=stack["admin"]) as c:
+        assert c.get("/api/analytics/sessions/months").status_code == 200
+        assert c.get("/api/analytics/summary").status_code == 200
+
+
+def test_admin_video_drawer(stack):  # noqa: F811
+    html, js = _admin_html(), _admin_js()
+    for marker in ("dr-thumb-grab", "dr-tr-save", "dr-cf-save"):
+        assert marker in html
+    for ep in ("/thumbnail/from-time", "/transcript", "/custom-fields"):
+        assert ep in js
+    # thumbnail preview must fetch with the auth header (an <img> src
+    # cannot carry it) — regression marker for the blob-URL approach
+    assert "createObjectURL" in js
+
+
+def test_admin_worker_mgmt_buttons(stack):  # noqa: F811
+    js = _admin_js()
+    for verb in ("get_logs", "get_metrics", "restart"):
+        assert f'cmd("{verb}")' in js
+
+
+def test_public_discovery_screens(stack):  # noqa: F811
+    html = (WEB_ROOT / "public" / "index.html").read_text()
+    js = (WEB_ROOT / "public" / "app.js").read_text()
+    assert "tagstrip" in html and "playlists-row" in html
+    assert 'id="related"' in html
+    for ep in ("/api/tags", "/api/playlists", "/related"):
+        assert ep in js
+    with httpx.Client(base_url=stack["public"]) as c:
+        assert c.get("/api/tags").status_code == 200
+        assert c.get("/api/playlists").status_code == 200
+
+
+def test_player_abr_is_buffer_aware(stack):  # noqa: F811
+    """The ABR rule is a pure exported function with buffer hysteresis,
+    stall reaction, and cooldown — not bare bandwidth matching."""
+    js = (WEB_ROOT / "public" / "player.js").read_text()
+    assert "export function abrDecision" in js
+    for marker in ("UP_MIN_BUFFER_S", "DOWN_BUFFER_S", "SWITCH_COOLDOWN_S",
+                   "stalled"):
+        assert marker in js
+    # the player feeds real state into the rule
+    assert "abrDecision({" in js and "bufferedAhead" in js
+    assert '"waiting"' in js            # stall listener wired

@@ -347,16 +347,32 @@ async def list_videos(request: web.Request) -> web.Response:
     if q.get("status"):
         where.append("status=:status")
         params["status"] = q["status"]
+    base_where, base_params = list(where), {
+        k: v for k, v in params.items() if k not in ("limit", "offset")}
+    if q.get("cursor"):
+        # keyset page (api/pagination.py); ignores offset
+        from vlog_tpu.api.pagination import (CursorError, decode_cursor,
+                                             keyset_clause)
+
+        try:
+            cur_ts, cur_id = decode_cursor(q["cursor"])
+        except CursorError as exc:
+            return _json_error(400, str(exc))
+        where.append(keyset_clause("created_at", "id"))
+        params.update({"cur_ts": cur_ts, "cur_id": cur_id, "offset": 0})
     rows = await db.fetch_all(
         f"""
         SELECT * FROM videos WHERE {' AND '.join(where)}
-        ORDER BY created_at DESC LIMIT :limit OFFSET :offset
+        ORDER BY created_at DESC, id DESC LIMIT :limit OFFSET :offset
         """, params)
     total = await db.fetch_val(
-        f"SELECT COUNT(*) FROM videos WHERE {' AND '.join(where)}",
-        {k: v for k, v in params.items() if k not in ("limit", "offset")})
+        f"SELECT COUNT(*) FROM videos WHERE {' AND '.join(base_where)}",
+        base_params)
+    from vlog_tpu.api.pagination import next_cursor_from
+
     return web.json_response({"videos": rows, "total": total,
-                              "limit": limit, "offset": offset})
+                              "limit": limit, "offset": offset,
+                              "next_cursor": next_cursor_from(rows, limit)})
 
 
 async def video_detail(request: web.Request) -> web.Response:
@@ -493,9 +509,15 @@ async def sse_progress(request: web.Request) -> web.StreamResponse:
     """Server-Sent-Events stream of job progress (admin.py:5291 analog).
 
     The DB is the shared truth between API and worker processes, so this
-    polls it and pushes deltas — same contract as the reference's
-    Redis-pub/sub-backed stream, minus the Redis dependency.
+    reads it and pushes deltas — same contract as the reference's
+    Redis-pub/sub-backed stream, minus the Redis dependency. Wakeups
+    ride the event plane (jobs/events.py: LISTEN/NOTIFY on Postgres,
+    in-process bus on sqlite), so deltas flush the moment a worker
+    reports; the ``poll`` interval is the safety net for deployments
+    where events can't cross processes.
     """
+    from vlog_tpu.jobs.events import CH_PROGRESS, bus_for
+
     db = request.app[DB]
     resp = web.StreamResponse(headers={
         "Content-Type": "text/event-stream",
@@ -504,6 +526,9 @@ async def sse_progress(request: web.Request) -> web.StreamResponse:
     await resp.prepare(request)
     last: dict[int, tuple] = {}
     poll_s = _qnum(request.query, "poll", 1.0, lo=0.1, hi=30.0, cast=float)
+    bus = bus_for(db)
+    await bus.start()
+    sub = bus.subscribe(CH_PROGRESS)
     try:
         while True:
             t = db_now()
@@ -524,9 +549,17 @@ async def sse_progress(request: web.Request) -> web.StreamResponse:
                 await resp.write(
                     f"event: progress\ndata: {json.dumps(payload)}\n\n"
                     .encode())
-            await asyncio.sleep(poll_s)
+            # wake on the next progress event; re-read the DB either way
+            # (events are hints, the rows are the truth). The floor
+            # coalesces event bursts so a chatty worker can't drive
+            # this client into back-to-back full-table reads.
+            await asyncio.sleep(0.1)
+            await sub.get(timeout=poll_s)
+            sub.drain()
     except (ConnectionResetError, asyncio.CancelledError):
         pass
+    finally:
+        sub.close()
     return resp
 
 
@@ -738,6 +771,30 @@ async def analytics_summary(request: web.Request) -> web.Response:
     return web.json_response({"videos": rows})
 
 
+async def analytics_months(request: web.Request) -> web.Response:
+    """Per-month session volume (jobs/sessions.py month_stats — the
+    reference's partition-stats analog) plus maintenance knobs."""
+    from vlog_tpu.jobs import sessions as sess
+
+    months = _qnum(request.query, "months", 12, lo=1, hi=36)
+    stats = await sess.month_stats(request.app[DB], months=months)
+    return web.json_response({
+        "months": stats,
+        "retention_days": sess.RETENTION_DAYS,
+    })
+
+
+async def analytics_prune(request: web.Request) -> web.Response:
+    """POST: run session maintenance now (close stale + prune)."""
+    from vlog_tpu.jobs import sessions as sess
+
+    db = request.app[DB]
+    closed = await sess.close_stale_sessions(db)
+    pruned = await sess.prune_sessions(db)
+    return web.json_response({"ok": True, "closed": closed,
+                              "pruned": pruned})
+
+
 async def healthz(request: web.Request) -> web.Response:
     return web.json_response({"ok": True, "db": request.app[DB].connected})
 
@@ -746,10 +803,28 @@ async def healthz(request: web.Request) -> web.Response:
 # App assembly
 # --------------------------------------------------------------------------
 
+@web.middleware
+async def admin_error_middleware(request: web.Request, handler):
+    """An authed operator gets real 4xx validation text, but an
+    unexpected 500's repr must still not leak paths into a browser
+    (api/errors.py; reference sanitizes at the same tier)."""
+    from vlog_tpu.api.errors import sanitize_error
+
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except Exception as exc:   # noqa: BLE001 — boundary sanitizer
+        log.exception("unhandled admin error on %s %s", request.method,
+                      request.path)
+        return _json_error(500, sanitize_error(exc))
+
+
 def build_admin_app(db: Database, *, upload_dir: Path | None = None,
                     video_dir: Path | None = None,
                     audit_path: Path | str | None = None) -> web.Application:
-    app = web.Application(middlewares=[admin_auth_middleware],
+    app = web.Application(middlewares=[admin_error_middleware,
+                                       admin_auth_middleware],
                           client_max_size=config.MAX_UPLOAD_SIZE_BYTES)
     app[DB] = db
     app[UPLOAD_DIR] = Path(upload_dir or config.UPLOAD_DIR)
@@ -781,6 +856,8 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_post("/api/videos/{video_id:\\d+}/chapters/detect",
                detect_chapters)
     r.add_get("/api/analytics/summary", analytics_summary)
+    r.add_get("/api/analytics/sessions/months", analytics_months)
+    r.add_post("/api/analytics/sessions/prune", analytics_prune)
     r.add_post("/api/auth/login", login)
     r.add_post("/api/auth/logout", logout)
     r.add_get("/api/auth/session", session_info)
@@ -828,14 +905,32 @@ async def serve(port: int | None = None, db_url: str | None = None,
 
     deliverer = WebhookDeliverer(db)
     delivery_task = asyncio.create_task(deliverer.run())
+    maintenance_task = asyncio.create_task(_session_maintenance_loop(db))
     try:
         await asyncio.Event().wait()
     finally:
         deliverer.request_stop()
         delivery_task.cancel()
-        await asyncio.gather(delivery_task, return_exceptions=True)
+        maintenance_task.cancel()
+        await asyncio.gather(delivery_task, maintenance_task,
+                             return_exceptions=True)
         await runner.cleanup()
         await db.disconnect()
+
+
+async def _session_maintenance_loop(db: Database,
+                                    interval_s: float = 3600.0) -> None:
+    """Hourly analytics upkeep (reference partition_manager's cron
+    analog): close heartbeat-dead sessions, prune past retention."""
+    from vlog_tpu.jobs import sessions as sess
+
+    while True:
+        try:
+            await sess.close_stale_sessions(db)
+            await sess.prune_sessions(db)
+        except Exception:   # noqa: BLE001 — next pass retries
+            log.exception("session maintenance pass failed")
+        await asyncio.sleep(interval_s)
 
 
 def main() -> None:

@@ -418,6 +418,22 @@ async def set_thumbnail_from_time(request: web.Request) -> web.Response:
     return web.json_response({"ok": True, "thumbnail": str(dst)})
 
 
+async def get_thumbnail(request: web.Request) -> web.Response:
+    """Serve the current thumbnail to the admin UI (the public plane
+    serves it from the media tree; the admin plane is a different
+    origin/port, so it needs its own authenticated route)."""
+    db = request.app[DB]
+    vid = int(request.match_info["video_id"])
+    row = await db.fetch_one("SELECT * FROM videos WHERE id=:v", {"v": vid})
+    if row is None or not row["thumbnail_path"]:
+        return _json_error(404, "no thumbnail")
+    p = Path(row["thumbnail_path"])
+    if not p.is_file():
+        return _json_error(404, "thumbnail file missing")
+    return web.FileResponse(p, headers={
+        "Content-Type": "image/jpeg", "Cache-Control": "no-cache"})
+
+
 async def upload_thumbnail(request: web.Request) -> web.Response:
     """Accept a custom JPEG thumbnail body (content-type image/jpeg)."""
     db = request.app[DB]
@@ -579,6 +595,7 @@ def mount(r: web.UrlDispatcher) -> None:
               put_video_custom_values)
     r.add_post("/api/videos/{video_id:\\d+}/thumbnail/from-time",
                set_thumbnail_from_time)
+    r.add_get("/api/videos/{video_id:\\d+}/thumbnail", get_thumbnail)
     r.add_put("/api/videos/{video_id:\\d+}/thumbnail", upload_thumbnail)
     r.add_get("/api/videos/{video_id:\\d+}/transcript",
               get_transcript_admin)

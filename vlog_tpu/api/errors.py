@@ -1,0 +1,71 @@
+"""Error sanitization for API responses.
+
+Reference analog: api/errors.py (241 LoC) — detailed errors go to logs
+and the jobs table (operators need the truth); what crosses the API
+boundary to clients is scrubbed of internal detail (filesystem paths,
+driver/module names, stack-trace fragments) and truncated. The public
+API sanitizes everything; the admin API sanitizes only 5xx bodies (an
+authenticated operator gets real 4xx validation messages, but an
+unexpected exception's repr still must not leak paths to a browser).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+log = logging.getLogger("vlog.api.errors")
+
+ERROR_MAX_LEN = 300
+
+# Anything matching these marks a message as "internal" — it gets the
+# generic text for its category instead of a scrubbed passthrough.
+_INTERNAL_PATTERNS = [
+    re.compile(p, re.I) for p in (
+        r"(/[\w.\-]+){2,}",              # absolute filesystem paths
+        r'File "[^"]+"',                 # traceback frames
+        r"line \d+",
+        r"\bsqlite3?\b",
+        r"\blibpq\b|\bpostgres\b|\bsqlstate\b",
+        r"\bTraceback\b",
+        r"\bctypes\b|\bnumpy\b|\bjax\b",
+        r"Permission denied|No such file or directory",
+        r"UNIQUE constraint|FOREIGN KEY constraint",
+        r"\.py:\d+",
+    )
+]
+
+# category fragment -> client-safe message
+_GENERIC = (
+    ("decode", "The source file could not be read."),
+    ("encode", "Video processing failed."),
+    ("transcode", "Video processing failed."),
+    ("database", "A storage error occurred. Please retry."),
+    ("locked", "The service is busy. Please retry."),
+    ("timeout", "The operation timed out. Please retry."),
+    ("connect", "A backend service is unreachable."),
+)
+_FALLBACK = "An internal error occurred."
+
+
+def sanitize_error(message: str | BaseException,
+                   *, max_len: int = ERROR_MAX_LEN) -> str:
+    """Client-safe rendering of an error: internal details replaced by
+    a generic category message, everything truncated."""
+    msg = str(message) or _FALLBACK
+    if any(p.search(msg) for p in _INTERNAL_PATTERNS):
+        low = msg.lower()
+        for frag, generic in _GENERIC:
+            if frag in low:
+                return generic
+        return _FALLBACK
+    if len(msg) > max_len:
+        return msg[: max_len - 1] + "…"
+    return msg
+
+
+def public_job_error(error: str | None) -> str | None:
+    """What the public API may say about a failed job."""
+    if not error:
+        return None
+    return sanitize_error(error)

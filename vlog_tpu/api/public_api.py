@@ -87,6 +87,17 @@ READY = "status='ready' AND deleted_at IS NULL"
 
 
 async def list_videos(request: web.Request) -> web.Response:
+    """Browse listing. Two pagination modes (reference pagination.py):
+    classic limit/offset, and keyset via ``cursor`` (the token from a
+    previous page's ``next_cursor``) — O(page) however deep, stable
+    under concurrent publishes. Cursor mode ignores ``offset``."""
+    from vlog_tpu.api.pagination import (
+        CursorError,
+        decode_cursor,
+        keyset_clause,
+        next_cursor_from,
+    )
+
     db = request.app[DB]
     q = request.query
     limit = _qnum(q, "limit", 24, lo=1, hi=100)
@@ -99,17 +110,28 @@ async def list_videos(request: web.Request) -> web.Response:
     if q.get("category"):
         where.append("category=:cat")
         params["cat"] = q["category"]
+    base_where = list(where)        # total counts the whole listing,
+    base_params = {k: v for k, v in params.items()
+                   if k not in ("limit", "offset")}
+    if q.get("cursor"):             # ...the cursor only scopes the page
+        try:
+            cur_ts, cur_id = decode_cursor(q["cursor"])
+        except CursorError as exc:
+            return _json_error(400, str(exc))
+        where.append(keyset_clause("created_at", "id"))
+        params.update({"cur_ts": cur_ts, "cur_id": cur_id, "offset": 0})
     rows = await db.fetch_all(
         f"""
         SELECT * FROM videos WHERE {' AND '.join(where)}
-        ORDER BY created_at DESC LIMIT :limit OFFSET :offset
+        ORDER BY created_at DESC, id DESC LIMIT :limit OFFSET :offset
         """, params)
     total = await db.fetch_val(
-        f"SELECT COUNT(*) FROM videos WHERE {' AND '.join(where)}",
-        {k: v for k, v in params.items() if k not in ("limit", "offset")})
+        f"SELECT COUNT(*) FROM videos WHERE {' AND '.join(base_where)}",
+        base_params)
     return web.json_response({
         "videos": [_public_video(r) for r in rows],
-        "total": total, "limit": limit, "offset": offset})
+        "total": total, "limit": limit, "offset": offset,
+        "next_cursor": next_cursor_from(rows, limit)})
 
 
 async def video_detail(request: web.Request) -> web.Response:
@@ -382,11 +404,28 @@ async def healthz(request: web.Request) -> web.Response:
     return web.json_response({"ok": True, "db": request.app[DB].connected})
 
 
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    """Unexpected exceptions become sanitized 500s (api/errors.py):
+    the truth goes to the log, the client gets no paths/driver detail.
+    HTTPException subclasses (the framework's own 404s etc.) pass."""
+    from vlog_tpu.api.errors import sanitize_error
+
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except Exception as exc:   # noqa: BLE001 — boundary sanitizer
+        log.exception("unhandled error on %s %s", request.method,
+                      request.path)
+        return _json_error(500, sanitize_error(exc))
+
+
 def build_public_app(db: Database, *, video_dir: Path | None = None
                      ) -> web.Application:
     from vlog_tpu.api.settings import SettingsService
 
-    app = web.Application()
+    app = web.Application(middlewares=[error_middleware])
     app[DB] = db
     app[VIDEO_DIR] = Path(video_dir or config.VIDEO_DIR)
     app[SETTINGS_SVC] = SettingsService(db)

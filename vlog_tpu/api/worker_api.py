@@ -23,6 +23,7 @@ from aiohttp import web
 from vlog_tpu import config
 from vlog_tpu.api import auth as authmod
 from vlog_tpu.db.core import Database, Row, now as db_now, open_database
+from vlog_tpu.db.retry import with_retries
 from vlog_tpu.enums import AcceleratorKind, JobKind
 from vlog_tpu.jobs import claims, state as js, videos as vids
 from vlog_tpu.jobs.finalize import finalize_transcode, finalize_transcription
@@ -185,9 +186,16 @@ async def claim(request: web.Request) -> web.Response:
                   or [k.value for k in JobKind])
     accel = AcceleratorKind(body.get("accelerator", "cpu"))
     db = request.app[DB]
-    row = await claims.claim_job(
-        db, request[IDENTITY].worker_name, kinds=kinds, accelerator=accel,
-        code_version=body.get("code_version", config.CODE_VERSION))
+    # the claim transaction is the fleet's contention point: on Postgres
+    # two claimants can deadlock on row-lock order (resolved by killing
+    # one), on sqlite a busy writer surfaces as "database is locked" —
+    # both are retry-then-succeed, and claim_job re-reads its inputs
+    row = await with_retries(
+        lambda: claims.claim_job(
+            db, request[IDENTITY].worker_name, kinds=kinds,
+            accelerator=accel,
+            code_version=body.get("code_version", config.CODE_VERSION)),
+        label="claim")
     if row is None:
         return web.Response(status=204)
     video = await vids.get_video(db, row["video_id"])
@@ -205,11 +213,13 @@ async def progress(request: web.Request) -> web.Response:
     db = request.app[DB]
     job_id = int(request.match_info["job_id"])
     try:
-        row = await claims.update_progress(
-            db, job_id, request[IDENTITY].worker_name,
-            progress=body.get("progress"),
-            current_step=body.get("current_step"),
-            checkpoint=body.get("checkpoint"))
+        row = await with_retries(
+            lambda: claims.update_progress(
+                db, job_id, request[IDENTITY].worker_name,
+                progress=body.get("progress"),
+                current_step=body.get("current_step"),
+                checkpoint=body.get("checkpoint")),
+            label="progress")
     except js.JobStateError as exc:
         return _json_error(409, str(exc))
     for quality, qp in (body.get("qualities") or {}).items():
@@ -260,7 +270,9 @@ async def complete(request: web.Request) -> web.Response:
         # Terminal-state transition FIRST: complete_job atomically re-checks
         # ownership inside its transaction, so a stale worker that lost the
         # claim gets its 409 before any published state changes.
-        await claims.complete_job(db, job_id, worker)
+        await with_retries(
+            lambda: claims.complete_job(db, job_id, worker),
+            label="complete")
         if kind in (JobKind.TRANSCODE, JobKind.REENCODE):
             reenc = kind is JobKind.REENCODE
             qualities = [
@@ -309,10 +321,12 @@ async def fail(request: web.Request) -> web.Response:
     db = request.app[DB]
     job_id = int(request.match_info["job_id"])
     try:
-        row = await claims.fail_job(
-            db, job_id, request[IDENTITY].worker_name,
-            str(body.get("error") or "unspecified"),
-            permanent=bool(body.get("permanent")))
+        row = await with_retries(
+            lambda: claims.fail_job(
+                db, job_id, request[IDENTITY].worker_name,
+                str(body.get("error") or "unspecified"),
+                permanent=bool(body.get("permanent"))),
+            label="fail")
     except js.JobStateError as exc:
         return _json_error(409, str(exc))
     terminal = row["failed_at"] is not None

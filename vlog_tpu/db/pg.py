@@ -38,6 +38,7 @@ import asyncio
 import ctypes
 import ctypes.util
 import re
+import threading
 from collections.abc import AsyncIterator, Iterable, Mapping
 from contextlib import asynccontextmanager
 from typing import Any
@@ -116,8 +117,25 @@ def load_libpq() -> ctypes.CDLL:
     lib.PQcmdTuples.argtypes = [ctypes.c_void_p]
     lib.PQlibVersion.restype = ctypes.c_int
     lib.PQlibVersion.argtypes = []
+    # LISTEN/NOTIFY plumbing (jobs/events.py PgNotifyBus)
+    lib.PQsocket.restype = ctypes.c_int
+    lib.PQsocket.argtypes = [ctypes.c_void_p]
+    lib.PQconsumeInput.restype = ctypes.c_int
+    lib.PQconsumeInput.argtypes = [ctypes.c_void_p]
+    lib.PQnotifies.restype = ctypes.POINTER(PGnotify)
+    lib.PQnotifies.argtypes = [ctypes.c_void_p]
+    lib.PQfreemem.restype = None
+    lib.PQfreemem.argtypes = [ctypes.c_void_p]
     _LIBPQ = lib
     return lib
+
+
+class PGnotify(ctypes.Structure):
+    """libpq-fe.h pgNotify (public prefix; trailing private fields are
+    never touched through this layout)."""
+    _fields_ = [("relname", ctypes.c_char_p),
+                ("be_pid", ctypes.c_int),
+                ("extra", ctypes.c_char_p)]
 
 
 class PgError(RuntimeError):
@@ -459,3 +477,81 @@ class PgTransaction:
     async def fetch_all(self, sql: str, params: Params = None) -> list[Row]:
         rows, _ = await asyncio.to_thread(self._conn.query, sql, params)
         return rows
+
+
+class PgListener:
+    """Dedicated LISTEN connection feeding a callback from a daemon
+    thread (select on PQsocket -> PQconsumeInput -> drain PQnotifies).
+
+    The callback fires on the listener thread; PgNotifyBus marshals
+    into the event loop. A dropped connection is retried with backoff —
+    wakeups are hints, so a gap only costs poll latency."""
+
+    def __init__(self, dsn: str, channels: tuple[str, ...],
+                 callback) -> None:
+        self.dsn = dsn
+        self.channels = channels
+        self.callback = callback
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self, ready_timeout: float = 10.0) -> None:
+        """Spawn the listener and block until the LISTEN statements are
+        in place — a notify published right after start() must not fall
+        in the subscribe gap. Timing out (server down) is non-fatal:
+        the thread keeps retrying and wakeups degrade to poll latency."""
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="vlog-pg-listen")
+        self._thread.start()
+        self._ready.wait(ready_timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        import select as select_mod
+
+        backoff = 0.5
+        while not self._stop.is_set():
+            conn = None
+            try:
+                conn = _PgConn(self.dsn)
+                for ch in self.channels:
+                    # identifiers can't be bound parameters; channels
+                    # are compile-time constants (events.py CH_*)
+                    conn.query(f'LISTEN "{ch}"', None)
+                self._ready.set()
+                sock = conn.lib.PQsocket(conn.ptr)
+                backoff = 0.5
+                while not self._stop.is_set():
+                    r, _, _ = select_mod.select([sock], [], [], 0.25)
+                    if not r:
+                        continue
+                    if not conn.lib.PQconsumeInput(conn.ptr):
+                        raise PgError("listen connection lost")
+                    while True:
+                        note = conn.lib.PQnotifies(conn.ptr)
+                        if not note:
+                            break
+                        try:
+                            ch = (note.contents.relname or b"").decode()
+                            extra = (note.contents.extra or b"").decode()
+                        finally:
+                            conn.lib.PQfreemem(note)
+                        try:
+                            self.callback(ch, extra)
+                        except Exception:   # noqa: BLE001
+                            pass
+            except Exception:               # noqa: BLE001 — reconnect
+                if self._stop.wait(backoff):
+                    break
+                backoff = min(backoff * 2, 10.0)
+            finally:
+                if conn is not None:
+                    conn.close()

@@ -20,6 +20,7 @@ from vlog_tpu import config
 from vlog_tpu.db.core import Database, Row, now as db_now
 from vlog_tpu.enums import AcceleratorKind, JobKind
 from vlog_tpu.jobs import state as js
+from vlog_tpu.jobs.events import CH_JOBS, CH_PROGRESS, wake as _wake
 
 
 async def enqueue_job(
@@ -55,7 +56,7 @@ async def enqueue_job(
             "t": t,
         }
         if existing is None:
-            return await tx.execute(
+            jid = await tx.execute(
                 """
                 INSERT INTO jobs (video_id, kind, priority, payload, max_attempts,
                                   required_accelerator, created_at, updated_at)
@@ -63,27 +64,33 @@ async def enqueue_job(
                 """,
                 {**params, "v": video_id, "k": kind.value},
             )
-        if not force and js.derive_state(existing, now=t) is js.JobState.CLAIMED:
-            raise js.JobStateError(
-                f"job {existing['id']} is actively claimed by "
-                f"{existing['claimed_by']!r}; pass force=True to reset anyway"
+        else:
+            if (not force
+                    and js.derive_state(existing, now=t) is js.JobState.CLAIMED):
+                raise js.JobStateError(
+                    f"job {existing['id']} is actively claimed by "
+                    f"{existing['claimed_by']!r}; pass force=True to reset anyway"
+                )
+            # Reset: clear claim + terminal markers + progress, keep id stable.
+            await tx.execute(
+                """
+                UPDATE jobs SET priority=:p, payload=:pl, max_attempts=:ma,
+                    required_accelerator=:ra, claimed_by=NULL, claimed_at=NULL,
+                    claim_expires_at=NULL, started_at=NULL, completed_at=NULL,
+                    failed_at=NULL, error=NULL, attempt=0, current_step=NULL,
+                    last_checkpoint='{}', progress=0.0, updated_at=:t
+                WHERE id=:id
+                """,
+                {**params, "id": existing["id"]},
             )
-        # Reset: clear claim + terminal markers + progress, keep id stable.
-        await tx.execute(
-            """
-            UPDATE jobs SET priority=:p, payload=:pl, max_attempts=:ma,
-                required_accelerator=:ra, claimed_by=NULL, claimed_at=NULL,
-                claim_expires_at=NULL, started_at=NULL, completed_at=NULL,
-                failed_at=NULL, error=NULL, attempt=0, current_step=NULL,
-                last_checkpoint='{}', progress=0.0, updated_at=:t
-            WHERE id=:id
-            """,
-            {**params, "id": existing["id"]},
-        )
-        await tx.execute(
-            "DELETE FROM quality_progress WHERE job_id=:id", {"id": existing["id"]}
-        )
-        return int(existing["id"])
+            await tx.execute(
+                "DELETE FROM quality_progress WHERE job_id=:id",
+                {"id": existing["id"]},
+            )
+            jid = int(existing["id"])
+    # after commit, so a woken claimant always sees the row
+    _wake(db, CH_JOBS, {"job_id": jid, "kind": kind.value})
+    return jid
 
 
 # Shared by sweep_expired_claims and the sweep phase inside claim_job, so
@@ -201,7 +208,10 @@ async def update_progress(
         await tx.execute(f"UPDATE jobs SET {', '.join(sets)} WHERE id=:id", params)
         out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         assert out is not None
-        return out
+    _wake(db, CH_PROGRESS, {"job_id": job_id, "event": "progress",
+                            "progress": out["progress"],
+                            "step": out["current_step"]})
+    return out
 
 
 async def complete_job(db: Database, job_id: int, worker_name: str) -> Row:
@@ -222,7 +232,8 @@ async def complete_job(db: Database, job_id: int, worker_name: str) -> Row:
         )
         out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         assert out is not None
-        return out
+    _wake(db, CH_PROGRESS, {"job_id": job_id, "event": "completed"})
+    return out
 
 
 async def fail_job(
@@ -262,7 +273,12 @@ async def fail_job(
         )
         out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         assert out is not None
-        return out
+    _wake(db, CH_PROGRESS, {"job_id": job_id,
+                            "event": "failed" if exhausted else "retrying"})
+    if not exhausted:
+        # back in the claimable pool — wake sleeping workers
+        _wake(db, CH_JOBS, {"job_id": job_id})
+    return out
 
 
 async def release_job(
@@ -297,7 +313,8 @@ async def release_job(
         )
         out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         assert out is not None
-        return out
+    _wake(db, CH_JOBS, {"job_id": job_id})   # claimable again
+    return out
 
 
 async def upsert_quality_progress(

@@ -124,6 +124,10 @@ async def trigger_event(db: Database, event: str, payload: dict) -> int:
             """,
             {"w": h["id"], "e": event, "p": json.dumps(body), "t": t})
         n += 1
+    if n:
+        from vlog_tpu.jobs.events import CH_WEBHOOKS, wake
+
+        wake(db, CH_WEBHOOKS, {"event": event})
     return n
 
 
@@ -262,10 +266,18 @@ class WebhookDeliverer:
         """Poll-and-drain until stopped (background task in the admin API,
         reference webhook_service.py:809-847). Old terminal rows are
         pruned roughly hourly so the table stays bounded."""
+        from vlog_tpu.jobs.events import CH_WEBHOOKS, bus_for
+
+        bus = bus_for(self.db)
+        await bus.start()
+        sub = bus.subscribe(CH_WEBHOOKS)
         passes = 0
         cleanup_every = max(1, int(3600 / max(self.poll_interval_s, 0.1)))
         try:
             while not self._stop.is_set():
+                sub.drain()   # the pass below covers anything queued;
+                #               hints arriving DURING it stay queued and
+                #               skip the sleep
                 try:
                     await self.deliver_pending()
                     if passes % cleanup_every == 0:
@@ -273,12 +285,9 @@ class WebhookDeliverer:
                 except Exception:
                     log.exception("webhook drain pass failed")
                 passes += 1
-                try:
-                    await asyncio.wait_for(self._stop.wait(),
-                                           self.poll_interval_s)
-                except asyncio.TimeoutError:
-                    pass
+                await sub.wait_or(self._stop, self.poll_interval_s)
         finally:
+            sub.close()
             await self.aclose()
 
     async def cleanup(self, *, keep_days: float = 30.0) -> int:

@@ -104,6 +104,7 @@ $("logout").onclick = () => {
 const loaders = {
   dashboard: loadDashboard, videos: loadVideos, jobs: loadJobs,
   workers: loadWorkers, settings: loadSettings, webhooks: loadWebhooks,
+  playlists: loadPlaylists, fields: loadFields, analytics: loadAnalytics,
 };
 
 function switchTab(name) {
@@ -239,6 +240,7 @@ async function loadVideos() {
     const acts = document.createElement("div");
     acts.className = "row-actions";
     acts.append(
+      actionBtn("detail", async () => openDrawer(v)),
       actionBtn("retranscode", async () => {
         await api(`/api/videos/${v.id}/retranscode`, {
           method: "POST", headers: { "Content-Type": "application/json" },
@@ -319,6 +321,323 @@ $("upload-form").addEventListener("submit", (ev) => {
   xhr.send(fd);
 });
 
+/* ------------------------------------------------- playlists ---------- */
+
+let plDetailId = null;
+
+async function loadPlaylists() {
+  const d = await api("/api/playlists");
+  const tb = $("playlists-table").tBodies[0];
+  tb.textContent = "";
+  for (const p of d.playlists) {
+    const tr = document.createElement("tr");
+    const acts = document.createElement("div");
+    acts.className = "row-actions";
+    acts.append(
+      actionBtn("open", async () => openPlaylist(p.id)),
+      actionBtn(p.visibility === "private" ? "publish" : "private",
+        async () => {
+          await api(`/api/playlists/${p.id}`, {
+            method: "PATCH", headers: { "Content-Type": "application/json" },
+            body: JSON.stringify({
+              visibility: p.visibility === "private" ? "public" : "private" }),
+          });
+          loadPlaylists();
+        }),
+      actionBtn("delete", async () => {
+        await api(`/api/playlists/${p.id}`, { method: "DELETE" });
+        if (plDetailId === p.id) $("pl-detail").hidden = true;
+        loadPlaylists();
+      }, "danger"),
+    );
+    cells(tr, [p.id, p.title, p.slug, p.visibility, p.video_count, acts]);
+    tb.appendChild(tr);
+  }
+}
+
+async function openPlaylist(id) {
+  plDetailId = id;
+  const d = await api(`/api/playlists/${id}`);
+  $("pl-detail").hidden = false;
+  $("pl-detail-title").textContent = `#${id} ${d.playlist ? d.playlist.title : d.title || ""}`;
+  const vids = d.videos || [];
+  const tb = $("pl-videos-table").tBodies[0];
+  tb.textContent = "";
+  vids.forEach((v, idx) => {
+    const tr = document.createElement("tr");
+    const acts = document.createElement("div");
+    acts.className = "row-actions";
+    const reorder = async (swapWith) => {
+      const order = vids.map((x) => x.id);
+      [order[idx], order[swapWith]] = [order[swapWith], order[idx]];
+      await api(`/api/playlists/${id}/order`, {
+        method: "PUT", headers: { "Content-Type": "application/json" },
+        body: JSON.stringify({ video_ids: order }),
+      });
+      openPlaylist(id);
+    };
+    acts.append(
+      idx > 0 ? actionBtn("↑", () => reorder(idx - 1)) : document.createTextNode(""),
+      idx < vids.length - 1 ? actionBtn("↓", () => reorder(idx + 1)) : document.createTextNode(""),
+      actionBtn("remove", async () => {
+        await api(`/api/playlists/${id}/videos/${v.id}`, { method: "DELETE" });
+        openPlaylist(id);
+        loadPlaylists();
+      }),
+    );
+    cells(tr, [idx + 1, v.id, v.title, acts]);
+    tb.appendChild(tr);
+  });
+}
+
+$("pl-create").onclick = async () => {
+  const title = $("pl-title").value.trim();
+  if (!title) return;
+  try {
+    await api("/api/playlists", {
+      method: "POST", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ title, visibility: $("pl-visibility").value }),
+    });
+    $("pl-title").value = "";
+    loadPlaylists();
+  } catch (e) { toast(e.message, true); }
+};
+
+$("pl-add").onclick = async () => {
+  const vid = parseInt($("pl-add-id").value, 10);
+  if (!plDetailId || !vid) return;
+  try {
+    await api(`/api/playlists/${plDetailId}/videos`, {
+      method: "POST", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ video_id: vid }),
+    });
+    $("pl-add-id").value = "";
+    openPlaylist(plDetailId);
+    loadPlaylists();
+  } catch (e) { toast(e.message, true); }
+};
+
+/* ------------------------------------------------- custom fields ------ */
+
+async function loadFields() {
+  const d = await api("/api/custom-fields");
+  const tb = $("fields-table").tBodies[0];
+  tb.textContent = "";
+  for (const f of d.fields) {
+    const tr = document.createElement("tr");
+    cells(tr, [f.id, f.name, f.label, f.field_type,
+      f.required ? "yes" : "no",
+      (f.options || []).join(", ") || "—",
+      actionBtn("delete", async () => {
+        await api(`/api/custom-fields/${f.id}`, { method: "DELETE" });
+        loadFields();
+      }, "danger")]);
+    tb.appendChild(tr);
+  }
+}
+
+$("cf-create").onclick = async () => {
+  const name = $("cf-name").value.trim();
+  if (!name) return;
+  try {
+    await api("/api/custom-fields", {
+      method: "POST", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({
+        name,
+        label: $("cf-label").value || name,
+        field_type: $("cf-type").value,
+        required: $("cf-required").checked,
+        options: $("cf-options").value.split(",").map((s) => s.trim()).filter(Boolean),
+      }),
+    });
+    $("cf-name").value = $("cf-label").value = $("cf-options").value = "";
+    loadFields();
+  } catch (e) { toast(e.message, true); }
+};
+
+/* ------------------------------------------------- analytics ---------- */
+
+async function loadAnalytics() {
+  const m = await api("/api/analytics/sessions/months");
+  const wrap = $("an-months");
+  wrap.textContent = "";
+  const months = m.months.slice().reverse();   // oldest -> newest
+  const peak = Math.max(1, ...months.map((x) => x.sessions));
+  for (const row of months) {
+    const col = document.createElement("div");
+    col.className = "bar";
+    const fill = document.createElement("div");
+    fill.className = "bar-fill";
+    fill.style.height = `${Math.round((row.sessions / peak) * 100)}%`;
+    fill.title = `${row.month}: ${row.sessions} sessions, ` +
+      `${(row.watch_time_s / 3600).toFixed(1)}h watched`;
+    const lbl = document.createElement("div");
+    lbl.className = "bar-label";
+    lbl.textContent = row.month.slice(2);
+    col.append(fill, lbl);
+    wrap.appendChild(col);
+  }
+  const d = await api("/api/analytics/summary");
+  const tb = $("an-table").tBodies[0];
+  tb.textContent = "";
+  for (const v of d.videos.slice(0, 50)) {
+    const tr = document.createElement("tr");
+    cells(tr, [v.title, v.sessions, v.live_now,
+      `${(v.watch_time_s / 60).toFixed(1)} min`]);
+    tb.appendChild(tr);
+  }
+}
+
+$("an-prune").onclick = async () => {
+  try {
+    const r = await api("/api/analytics/sessions/prune", { method: "POST" });
+    $("an-prune-msg").textContent =
+      `closed ${r.closed} stale, pruned ${r.pruned} old sessions`;
+    loadAnalytics();
+  } catch (e) { toast(e.message, true); }
+};
+
+/* ------------------------------------------------- video drawer ------- */
+
+let drawerVideoId = null;
+
+async function refreshThumb(id) {
+  // <img src> cannot carry the X-Admin-Secret header: fetch -> blob URL
+  const img = $("dr-thumb");
+  if (img.dataset.blob) URL.revokeObjectURL(img.dataset.blob);
+  img.removeAttribute("src");
+  try {
+    const r = await fetch(`/api/videos/${id}/thumbnail`, {
+      headers: { "X-Admin-Secret": secret } });
+    if (!r.ok) return;
+    const url = URL.createObjectURL(await r.blob());
+    img.dataset.blob = url;
+    img.src = url;
+  } catch (e) { /* no thumbnail yet */ }
+}
+
+async function openDrawer(v) {
+  drawerVideoId = v.id;
+  $("drawer").hidden = false;
+  $("dr-title").textContent = `#${v.id} ${v.title}`;
+  refreshThumb(v.id);
+  $("dr-tr-msg").textContent = "";
+  try {
+    const tr = await api(`/api/videos/${v.id}/transcript`);
+    $("dr-transcript").value = tr.transcript ? tr.transcript.text || "" : "";
+  } catch (e) { $("dr-transcript").value = ""; }
+  // custom field editor: one input per defined field, typed
+  const defs = (await api("/api/custom-fields")).fields;
+  const valRows = (await api(`/api/videos/${v.id}/custom-fields`)).values || [];
+  const vals = {};
+  for (const r of valRows) {
+    if (r.value != null) {
+      try { vals[r.name] = JSON.parse(r.value); }
+      catch (e) { vals[r.name] = r.value; }
+    }
+  }
+  const wrap = $("dr-fields");
+  wrap.textContent = "";
+  for (const f of defs) {
+    const row = document.createElement("div");
+    row.className = "formrow";
+    const lbl = document.createElement("label");
+    lbl.className = "dim";
+    lbl.textContent = f.label + (f.required ? " *" : "");
+    lbl.style.minWidth = "12em";
+    let input;
+    if (f.field_type === "select") {
+      input = document.createElement("select");
+      for (const o of [""].concat(f.options || [])) {
+        const opt = document.createElement("option");
+        opt.value = o; opt.textContent = o || "—";
+        input.appendChild(opt);
+      }
+      input.value = vals[f.name] != null ? String(vals[f.name]) : "";
+    } else if (f.field_type === "boolean") {
+      input = document.createElement("input");
+      input.type = "checkbox";
+      input.checked = !!vals[f.name];
+    } else {
+      input = document.createElement("input");
+      input.type = f.field_type === "number" ? "number"
+        : f.field_type === "date" ? "date" : "text";
+      input.value = vals[f.name] != null ? String(vals[f.name]) : "";
+    }
+    input.dataset.field = f.name;
+    input.dataset.ftype = f.field_type;
+    row.append(lbl, input);
+    wrap.appendChild(row);
+  }
+}
+
+$("dr-close").onclick = () => { $("drawer").hidden = true; drawerVideoId = null; };
+
+$("dr-thumb-grab").onclick = async () => {
+  const t = parseFloat($("dr-thumb-time").value || "0");
+  try {
+    await api(`/api/videos/${drawerVideoId}/thumbnail/from-time`, {
+      method: "POST", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ time_s: t }),
+    });
+    toast("thumbnail regenerated");
+    refreshThumb(drawerVideoId);
+  } catch (e) { toast(e.message, true); }
+};
+
+$("dr-thumb-upload").onclick = async () => {
+  const file = $("dr-thumb-file").files[0];
+  if (!file) return;
+  try {
+    const r = await fetch(`/api/videos/${drawerVideoId}/thumbnail`, {
+      method: "PUT",
+      headers: { "X-Admin-Secret": secret, "Content-Type": "image/jpeg" },
+      body: file,
+    });
+    if (!r.ok) throw new Error((await r.json()).error || `HTTP ${r.status}`);
+    toast("thumbnail uploaded");
+    refreshThumb(drawerVideoId);
+  } catch (e) { toast(e.message, true); }
+};
+
+$("dr-tr-save").onclick = async () => {
+  try {
+    await api(`/api/videos/${drawerVideoId}/transcript`, {
+      method: "PUT", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ text: $("dr-transcript").value }),
+    });
+    $("dr-tr-msg").textContent = "saved";
+  } catch (e) { toast(e.message, true); }
+};
+
+$("dr-tr-delete").onclick = async () => {
+  try {
+    await api(`/api/videos/${drawerVideoId}/transcript`, { method: "DELETE" });
+    $("dr-transcript").value = "";
+    $("dr-tr-msg").textContent = "deleted; transcription requeued on next run";
+  } catch (e) { toast(e.message, true); }
+};
+
+$("dr-cf-save").onclick = async () => {
+  const values = {};
+  for (const input of $("dr-fields").querySelectorAll("[data-field]")) {
+    const t = input.dataset.ftype;
+    // null is part of the contract: it DELETES the stored value
+    // (omitting the key would leave a cleared field resurrected)
+    if (t === "boolean") values[input.dataset.field] = input.checked;
+    else if (t === "number") values[input.dataset.field] = input.value === "" ? null : Number(input.value);
+    else values[input.dataset.field] = input.value === "" ? null : input.value;
+  }
+  try {
+    // the PUT body IS the {field: value} map (catalog.py contract)
+    await api(`/api/videos/${drawerVideoId}/custom-fields`, {
+      method: "PUT", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify(values),
+    });
+    $("dr-cf-msg").textContent = "saved";
+  } catch (e) { toast(e.message, true); }
+};
+
 /* ------------------------------------------------- jobs --------------- */
 
 async function loadJobs() {
@@ -360,7 +679,8 @@ async function loadWorkers() {
         $("cmd-pre").textContent = JSON.stringify(r.commands.slice(0, 3), null, 2);
       }, 3000);
     });
-    acts.append(cmd("ping"), cmd("stats"), cmd("stop"),
+    acts.append(cmd("ping"), cmd("stats"), cmd("get_logs"),
+      cmd("get_metrics"), cmd("restart"), cmd("stop"),
       actionBtn("revoke", async () => {
         await api(`/api/workers/${encodeURIComponent(w.name)}/revoke`, { method: "POST" });
         toast(`revoked ${w.name}`);

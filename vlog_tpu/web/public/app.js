@@ -7,7 +7,8 @@ import { CmafPlayer } from "/ui/player.js";
 
 const $ = (id) => document.getElementById(id);
 const PAGE = 24;
-let state = { offset: 0, total: 0, q: "", category: "" };
+let state = { offset: 0, total: 0, q: "", category: "", tag: "",
+              playlist: "" };
 let player = null;
 let session = null;        // {token, timer, watched}
 let watchCleanup = [];     // undo-list for listeners/timers of the open video
@@ -39,12 +40,67 @@ async function loadCategories() {
   } catch (e) { /* category filter is optional */ }
 }
 
+async function loadTags() {
+  try {
+    const d = await j("/api/tags");
+    const strip = $("tagstrip");
+    strip.textContent = "";
+    for (const t of d.tags.slice(0, 20)) {
+      const b = document.createElement("button");
+      b.className = "tagchip" + (state.tag === t.tag ? " active" : "");
+      b.textContent = `#${t.tag} (${t.count})`;
+      b.onclick = () => {
+        state.tag = state.tag === t.tag ? "" : t.tag;
+        state.offset = 0;
+        loadTags();
+        loadGrid();
+      };
+      strip.appendChild(b);
+    }
+  } catch (e) { /* tag strip is optional */ }
+}
+
+async function loadPlaylistsRow() {
+  try {
+    const d = await j("/api/playlists");
+    const row = $("playlists-row");
+    row.textContent = "";
+    for (const p of d.playlists.slice(0, 12)) {
+      const b = document.createElement("button");
+      b.className = "tagchip pl" + (state.playlist === p.slug ? " active" : "");
+      b.textContent = `▸ ${p.title} (${p.video_count})`;
+      b.onclick = () => {
+        state.playlist = state.playlist === p.slug ? "" : p.slug;
+        state.offset = 0;
+        loadPlaylistsRow();
+        loadGrid();
+      };
+      row.appendChild(b);
+    }
+  } catch (e) { /* playlists row is optional */ }
+}
+
 async function loadGrid() {
-  const p = new URLSearchParams({ limit: PAGE, offset: state.offset });
-  if (state.q) p.set("q", state.q);
-  if (state.category) p.set("category", state.category);
   const seq = ++gridSeq;
-  const d = await j(`/api/videos?${p}`);
+  let d;
+  const heading = $("browse-heading");
+  if (state.playlist) {
+    const pd = await j(`/api/playlists/${encodeURIComponent(state.playlist)}`);
+    d = { videos: pd.videos, total: pd.videos.length };
+    heading.hidden = false;
+    heading.textContent = `Playlist: ${pd.playlist.title}`;
+  } else if (state.tag) {
+    const p = new URLSearchParams({ limit: PAGE, offset: state.offset });
+    d = await j(`/api/tags/${encodeURIComponent(state.tag)}/videos?${p}`);
+    heading.hidden = false;
+    heading.textContent = `#${state.tag}`;
+  } else {
+    const p = new URLSearchParams({ limit: PAGE, offset: state.offset });
+    if (state.q) p.set("q", state.q);
+    if (state.category) p.set("category", state.category);
+    d = await j(`/api/videos?${p}`);
+    heading.hidden = true;
+  }
   if (seq !== gridSeq) return;   // a newer query superseded this response
   state.total = d.total;
   const grid = $("grid");
@@ -230,7 +286,33 @@ async function openWatch(slug) {
     player.onerror(e);
   }
   loadTranscript(slug, video);
+  loadRelated(slug);
   startAnalytics(slug, video);
+}
+
+async function loadRelated(slug) {
+  const el = $("related");
+  el.textContent = "—";
+  el.classList.add("dim");
+  try {
+    const d = await j(`/api/videos/${encodeURIComponent(slug)}/related`);
+    if (!d.videos.length) return;
+    el.textContent = "";
+    el.classList.remove("dim");
+    for (const v of d.videos.slice(0, 8)) {
+      const a = document.createElement("a");
+      a.className = "related-item";
+      a.href = `#/v/${v.slug}`;
+      const t = document.createElement("span");
+      t.className = "title";
+      t.textContent = v.title;
+      const m = document.createElement("span");
+      m.className = "dim";
+      m.textContent = fmtDur(v.duration_s);
+      a.append(t, m);
+      el.appendChild(a);
+    }
+  } catch (e) { /* related rail is optional */ }
 }
 
 function closeWatch() {
@@ -274,4 +356,6 @@ $("next").onclick = () => { state.offset += PAGE; loadGrid(); };
 window.addEventListener("hashchange", route);
 
 loadCategories();
+loadTags();
+loadPlaylistsRow();
 route();

@@ -17,6 +17,43 @@
 const AHEAD_S = 30;          // keep this much buffered past the playhead
 const BW_SAFETY = 1.3;       // only switch up if est bandwidth > 1.3x need
 const EWMA_ALPHA = 0.35;
+// ABR hysteresis (abrDecision): a healthy buffer earns an up-switch,
+// a draining one forces a down-switch, and a cooldown stops oscillation
+const UP_MIN_BUFFER_S = 10;
+const DOWN_BUFFER_S = 5;
+const SWITCH_COOLDOWN_S = 3;
+
+/* Pure rate-adaptation rule — kept side-effect-free so it is testable
+ * outside a browser. state: {variant, bandwidths[], bwEst, bufferS,
+ * sinceSwitchS, stalled}. Returns the target variant index. */
+export function abrDecision(state) {
+  const { variant, bandwidths, bwEst, bufferS, sinceSwitchS, stalled } = state;
+  const sustainable = () => {
+    let best = 0;
+    for (let i = 0; i < bandwidths.length; i++) {
+      if (bandwidths[i] * BW_SAFETY <= bwEst) best = i;
+    }
+    return best;
+  };
+  if (stalled) {
+    // playback caught the buffer: drop straight to what the link can
+    // actually carry (no cooldown — a stall IS the evidence)
+    return Math.min(variant, sustainable());
+  }
+  if (!bwEst || sinceSwitchS < SWITCH_COOLDOWN_S) return variant;
+  const want = sustainable();
+  if (want > variant) {
+    // climb one rung at a time, and only from a healthy buffer: a
+    // mis-estimate then costs one rung, not a stall
+    return bufferS >= UP_MIN_BUFFER_S ? variant + 1 : variant;
+  }
+  if (want < variant) {
+    // down-switch when the buffer is draining or the link clearly
+    // cannot carry the current rung
+    if (bufferS < DOWN_BUFFER_S || bwEst < bandwidths[variant]) return want;
+  }
+  return variant;
+}
 
 function parseAttrs(s) {
   // ATTR=VAL,ATTR="quoted,val" ...
@@ -191,6 +228,8 @@ export class CmafPlayer {
     this.bwEst = 0;
     this.variant = -1;
     this._switching = false;
+    this._lastSwitchAt = 0;    // performance.now()/1000 of last switch
+    this._stalled = false;
   }
 
   async load() {
@@ -218,6 +257,12 @@ export class CmafPlayer {
       try { this.ms.duration = this.videoTrack.playlist.duration; } catch (e) { /* ok */ }
     }
     this.video.addEventListener("timeupdate", () => this.pump());
+    // a rebuffer is hard evidence the current rung is too heavy
+    this.video.addEventListener("waiting", () => {
+      this._stalled = true;
+      this.pump();
+    });
+    this.video.addEventListener("playing", () => { this._stalled = false; });
     this.video.addEventListener("seeking", () => {
       const t = this.video.currentTime;
       this.videoTrack.seekTo(t);
@@ -261,20 +306,24 @@ export class CmafPlayer {
     this.bwEst = this.bwEst ? EWMA_ALPHA * bps + (1 - EWMA_ALPHA) * this.bwEst : bps;
   }
 
-  bestVariantFor(bps) {
-    let best = 0;
-    for (let i = 0; i < this.variants.length; i++) {
-      if (this.variants[i].bandwidth * BW_SAFETY <= bps) best = i;
-    }
-    return best;
-  }
-
   pump() {
     if (!this.videoTrack || this._switching) return;
     const now = this.video.currentTime;
-    if (this.auto && this.bwEst) {
-      const want = this.bestVariantFor(this.bwEst);
-      if (want !== this.variant) { this._switchTo(want); return; }
+    if (this.auto && this.variants.length > 1) {
+      const want = abrDecision({
+        variant: this.variant,
+        bandwidths: this.variants.map((v) => v.bandwidth),
+        bwEst: this.bwEst,
+        bufferS: this.videoTrack.bufferedAhead(now),
+        sinceSwitchS: performance.now() / 1000 - this._lastSwitchAt,
+        stalled: this._stalled,
+      });
+      if (want !== this.variant) {
+        this._stalled = false;
+        this._lastSwitchAt = performance.now() / 1000;
+        this._switchTo(want);
+        return;
+      }
     }
     this.videoTrack.step(now);
     if (this.audioTrack) this.audioTrack.step(now);

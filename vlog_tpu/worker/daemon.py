@@ -96,10 +96,16 @@ class WorkerDaemon:
 
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
+        self.restart_requested = False     # restart verb → exit code 64
         self._stop = asyncio.Event()
         self._cancel = threading.Event()   # aborts the in-flight compute
         self._cancel_reason = ""
         self._current_job_id: int | None = None
+        # recent-log ring so the get_logs command verb can answer
+        # without a log file (utils/logring.py)
+        from vlog_tpu.utils.logring import install_ring
+
+        install_ring()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -186,23 +192,53 @@ class WorkerDaemon:
             # cancelling the heartbeat task that is writing it.
             asyncio.get_running_loop().call_later(0.5, self.request_stop)
             return {"stopping": True}
+        from vlog_tpu.worker import mgmt
+
+        if command == "get_logs":
+            return mgmt.get_logs(args)
+        if command == "get_metrics":
+            return mgmt.get_metrics({
+                "worker": self.name, "current_job_id": self._current_job_id,
+                "completed": self.stats.completed,
+                "failed": self.stats.failed})
+        if command == "restart":
+            log.info("remote restart command received")
+            self.restart_requested = True
+            asyncio.get_running_loop().call_later(0.5, self.request_stop)
+            return {"restarting": True,
+                    "exit_code": mgmt.RESTART_EXIT_CODE}
+        if command == "update":
+            return {"error": "update is not supported: deploys are "
+                             "image-based; roll the image and restart"}
         return {"error": f"unknown command {command!r}"}
 
     async def run(self) -> None:
-        """Main loop: poll → claim → process, until ``request_stop``."""
+        """Main loop: poll → claim → process, until ``request_stop``.
+
+        Dispatch is event-driven with a poll safety net: between empty
+        polls the loop sleeps on the job wakeup channel
+        (jobs/events.py; LISTEN/NOTIFY on Postgres, in-process bus on
+        sqlite), so enqueue→claim latency is milliseconds when events
+        flow and at worst ``poll_interval_s`` when they don't."""
+        from vlog_tpu.jobs.events import CH_JOBS, bus_for
+
         await self.startup()
+        bus = bus_for(self.db)
+        await bus.start()
+        jobs_sub = bus.subscribe(CH_JOBS)
         hb = asyncio.create_task(self._heartbeat_loop())
         try:
             while not self._stop.is_set():
                 worked = await self.poll_once()
                 if worked or self._stop.is_set():
+                    # a poll that found work already consumed the queue
+                    # head; stale wakeups would only cause a hot no-op
+                    # loop, so clear them
+                    jobs_sub.drain()
                     continue
-                try:
-                    await asyncio.wait_for(self._stop.wait(),
-                                           self.poll_interval_s)
-                except asyncio.TimeoutError:
-                    pass
+                await jobs_sub.wait_or(self._stop, self.poll_interval_s)
         finally:
+            jobs_sub.close()
             self._stop.set()
             hb.cancel()
             await asyncio.gather(hb, return_exceptions=True)
@@ -212,9 +248,13 @@ class WorkerDaemon:
 
     async def poll_once(self) -> bool:
         """Claim and process at most one job. Returns True if one ran."""
-        job = await claims.claim_job(
-            self.db, self.name, kinds=self.kinds,
-            accelerator=self.accelerator)
+        from vlog_tpu.db.retry import with_retries
+
+        job = await with_retries(
+            lambda: claims.claim_job(
+                self.db, self.name, kinds=self.kinds,
+                accelerator=self.accelerator),
+            label="daemon-claim")
         if job is None:
             return False
         if self._stop.is_set():
@@ -609,6 +649,12 @@ async def _amain(args: argparse.Namespace) -> None:
                           f"worker {args.name} stopping: {daemon.stats}")
         await health.stop()
         await db.disconnect()
+    if daemon.restart_requested:
+        # cooperative restart (mgmt.py): the supervisor unit maps this
+        # exit status to an immediate relaunch
+        from vlog_tpu.worker.mgmt import RESTART_EXIT_CODE
+
+        raise SystemExit(RESTART_EXIT_CODE)
     log.info("worker %s stopped: %s", args.name, daemon.stats)
 
 

@@ -1,0 +1,94 @@
+"""Shared management-command verbs for local and remote workers.
+
+Reference parity: worker/command_listener.py:244-448 — beyond
+ping/stats/stop, operators can pull a worker's recent logs and
+process/device metrics over the command channel (surfaced at
+admin.py:5164-5290), and ask for a restart. Both worker flavors
+(worker/daemon.py, worker/remote.py) delegate these verbs here so the
+two planes can never drift.
+
+``restart`` is cooperative: the worker stops cleanly and exits with
+:data:`RESTART_EXIT_CODE`; the supervisor (systemd ``Restart=always``
+unit / k8s restartPolicy) brings it back with the current image. The
+reference's in-place ``update`` verb (git pull + re-exec) has no analog
+in image-based deploys and is reported as unsupported.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import time
+
+from vlog_tpu.utils.logring import install_ring
+
+RESTART_EXIT_CODE = 64     # systemd RestartForceExitStatus target
+
+_started_at = time.time()
+
+
+def get_logs(args: dict) -> dict:
+    """Tail the in-process log ring (utils/logring.py)."""
+    ring = install_ring()
+    n = max(1, min(int(args.get("lines", 100) or 100), 2000))
+    level = args.get("level")
+    lines = ring.tail(n, level=level)
+    return {"lines": lines, "count": len(lines),
+            "level": level or "all"}
+
+
+def _proc_status() -> dict:
+    """RSS/threads/fds from /proc (no psutil in the image)."""
+    out: dict = {}
+    try:
+        with open("/proc/self/status") as fp:
+            for line in fp:
+                if line.startswith("VmRSS:"):
+                    out["rss_mb"] = round(
+                        int(line.split()[1]) / 1024.0, 1)
+                elif line.startswith("Threads:"):
+                    out["threads"] = int(line.split()[1])
+    except OSError:
+        pass
+    try:
+        out["open_fds"] = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        pass
+    return out
+
+
+def _device_info() -> dict:
+    """Accelerator summary WITHOUT importing jax (a metrics probe must
+    never pay — or hang on — accelerator init; report what the process
+    already knows)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {"initialized": False}
+    try:
+        devs = jax.devices()
+        info: dict = {"initialized": True,
+                      "platform": devs[0].platform,
+                      "device_count": len(devs)}
+        stats = getattr(devs[0], "memory_stats", lambda: None)()
+        if stats:
+            info["bytes_in_use"] = stats.get("bytes_in_use")
+            info["bytes_limit"] = stats.get("bytes_limit")
+        return info
+    except Exception:   # noqa: BLE001 — metrics are best-effort
+        return {"initialized": True, "error": "device query failed"}
+
+
+def get_metrics(extra: dict | None = None) -> dict:
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    out = {
+        "uptime_s": round(time.time() - _started_at, 1),
+        "cpu_user_s": round(ru.ru_utime, 2),
+        "cpu_system_s": round(ru.ru_stime, 2),
+        **_proc_status(),
+        "device": _device_info(),
+    }
+    if extra:
+        out.update(extra)
+    return out

@@ -312,9 +312,13 @@ class RemoteWorker:
 
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
+        self.restart_requested = False
         self._stop = asyncio.Event()
         self._cancel = threading.Event()
         self._cancel_reason = ""
+        from vlog_tpu.utils.logring import install_ring
+
+        install_ring()
 
     def request_stop(self) -> None:
         self._stop.set()
@@ -378,6 +382,24 @@ class RemoteWorker:
             # cancelling the heartbeat task that is writing it.
             asyncio.get_running_loop().call_later(0.5, self.request_stop)
             return {"stopping": True}
+        from vlog_tpu.worker import mgmt
+
+        if command == "get_logs":
+            return mgmt.get_logs(args)
+        if command == "get_metrics":
+            return mgmt.get_metrics({
+                "worker": self.name,
+                "completed": self.stats.completed,
+                "failed": self.stats.failed})
+        if command == "restart":
+            log.info("remote restart command received")
+            self.restart_requested = True
+            asyncio.get_running_loop().call_later(0.5, self.request_stop)
+            return {"restarting": True,
+                    "exit_code": mgmt.RESTART_EXIT_CODE}
+        if command == "update":
+            return {"error": "update is not supported: deploys are "
+                             "image-based; roll the image and restart"}
         return {"error": f"unknown command {command!r}"}
 
     async def poll_once(self) -> bool:
@@ -681,6 +703,10 @@ async def _amain(args: argparse.Namespace) -> None:
         await health.stop()
         await client.aclose()
     log.info("remote worker stopped: %s", worker.stats)
+    if worker.restart_requested:
+        from vlog_tpu.worker.mgmt import RESTART_EXIT_CODE
+
+        raise SystemExit(RESTART_EXIT_CODE)
 
 
 def main(argv: list[str] | None = None) -> None:
