@@ -41,6 +41,19 @@ REPO = Path(__file__).parent
 FIXTURES = REPO / "tests" / "fixtures"
 
 
+def _have_encoder(name: str) -> bool:
+    import ctypes
+    import ctypes.util
+
+    try:
+        lib = ctypes.CDLL(ctypes.util.find_library("avcodec")
+                          or "libavcodec.so")
+        lib.avcodec_find_encoder_by_name.restype = ctypes.c_void_p
+        return bool(lib.avcodec_find_encoder_by_name(name.encode()))
+    except OSError:
+        return False
+
+
 def build_tool(name: str, tmp: Path) -> Path:
     cc = shutil.which("gcc") or shutil.which("cc")
     if cc is None:
@@ -70,17 +83,26 @@ def moving_scene(n: int, h: int, w: int, *, seed: int = 0) -> np.ndarray:
              + 40 * ((xx // 64 + yy // 64) % 2)
              + rng.normal(0, 3.0, (wh, ww))).astype(np.float32)
     frames = np.empty((n, h * w * 3 // 2), np.uint8)
+    # scene cuts every ~4 s: encoders must recover from a full-frame
+    # change mid-chain (panning alone never stresses that path); noise
+    # bursts model sensor gain-ups / confetti that break rate control
+    # on real footage
+    cut_every = 96
     for t in range(n):
-        ox = int(4.2 * t) % 512          # 2.1 px/frame in half-pel steps
-        oy = int(2.6 * t) % 512          # 1.3 px/frame
+        cut = (t // cut_every) % 2
+        ox = (int(4.2 * t) + cut * 977) % 512    # cuts jump the camera
+        oy = (int(2.6 * t) + cut * 491) % 512
         y = world[oy:oy + 2 * h:2, ox:ox + 2 * w:2].copy()
+        if cut:
+            y = 255.0 - y                        # hard visual change
         # two moving objects
         bx = int((w - 80) * (0.5 + 0.4 * np.sin(t / 14.0)))
         by = int((h - 80) * (0.5 + 0.4 * np.cos(t / 19.0)))
         y[by:by + 64, bx:bx + 64] = 210.0
         bx2 = int((w - 48) * (0.5 + 0.45 * np.cos(t / 9.0)))
         y[h // 4:h // 4 + 32, bx2:bx2 + 32] = 40.0
-        y += rng.normal(0, 1.5, y.shape)
+        burst = 6.0 if (t % 64) >= 58 else 1.5   # periodic noise bursts
+        y += rng.normal(0, burst, y.shape)
         yq = np.clip(y, 0, 255).astype(np.uint8)
         u = np.full((h // 2, w // 2), 118, np.uint8)
         v = np.full((h // 2, w // 2), 138, np.uint8)
@@ -108,20 +130,27 @@ def decode_annexb(avdec: Path, annexb: Path, h: int, w: int,
     return data[: len(data) // fs * fs].reshape(-1, fs)
 
 
-def run_ours(frames: np.ndarray, h: int, w: int, fps: int, rung,
-             tmp: Path, avdec: Path) -> dict:
-    """Encode through the production backend; decode with the oracle."""
+def write_scene_y4m(frames, h: int, w: int, path: Path, fps: int) -> None:
+    """Serialize packed I420 scene frames once per rung (shared by the
+    production-encode paths and the codec-specific sections)."""
     from vlog_tpu.media.y4m import write_y4m
-    from vlog_tpu.worker.pipeline import process_video
 
     fs = h * w
-    y4m = tmp / "src.y4m"
-    write_y4m(y4m, [
+    write_y4m(path, [
         (f[:fs].reshape(h, w),
          f[fs:fs + fs // 4].reshape(h // 2, w // 2),
          f[fs + fs // 4:].reshape(h // 2, w // 2))
         for f in frames
     ], fps_num=fps, fps_den=1)
+
+
+def run_ours(frames: np.ndarray, h: int, w: int, fps: int, rung,
+             tmp: Path, avdec: Path) -> dict:
+    """Encode through the production backend; decode with the oracle."""
+    from vlog_tpu.worker.pipeline import process_video
+
+    y4m = tmp / "src.y4m"
+    write_scene_y4m(frames, h, w, y4m, fps)
     out = tmp / "ours"
     t0 = time.perf_counter()
     result = process_video(y4m, out, audio=False, thumbnail=False,
@@ -227,22 +256,81 @@ def run_ours_h265(frames: np.ndarray, h: int, w: int, y4m: Path, rung,
 
 
 def run_x264(frames: np.ndarray, h: int, w: int, fps: int, bps: int,
-             tmp: Path, x264: Path, avdec: Path, preset: str = "medium"
-             ) -> dict:
+             tmp: Path, x264: Path, avdec: Path, preset: str = "medium",
+             encoder: str = "libx264") -> dict:
+    """Anchor encode at the same average bitrate (libx264 by default,
+    libx265 for the HEVC anchor) + oracle decode + PSNR."""
     raw = tmp / "src.yuv"
-    frames.tofile(raw)
-    out = tmp / "x264.h264"
+    if not (raw.exists() and raw.stat().st_size == frames.nbytes):
+        frames.tofile(raw)      # shared between x264/x265 anchor calls
+    out = tmp / f"{encoder}.bin"
     t0 = time.perf_counter()
-    subprocess.run([str(x264), str(raw), str(w), str(h), str(fps),
-                    str(bps), preset, str(out)], check=True,
-                   capture_output=True)
+    proc = subprocess.run([str(x264), str(raw), str(w), str(h), str(fps),
+                           str(bps), preset, str(out), encoder],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        sys.exit(f"anchor encode failed ({encoder}): "
+                 f"{proc.stderr.strip()[:300]}")
     wall = time.perf_counter() - t0
-    dec = decode_annexb(avdec, out, h, w, tmp)
+    dec = decode_annexb(avdec, out, h, w, tmp,
+                        codec="hevc" if encoder == "libx265" else "h264")
     dur = frames.shape[0] / fps
     return {
-        "encoder": f"libx264 -preset {preset}",
+        "encoder": f"{encoder} -preset {preset}",
         "bitrate_kbps": int(out.stat().st_size * 8 / dur) // 1000,
         "psnr_y": round(psnr_y(frames, dec, h, w), 2),
+        "wall_s": round(wall, 1),
+    }
+
+
+def run_ours_av1(frames: np.ndarray, h: int, w: int, y4m: Path, rung,
+                 tmp: Path) -> dict | None:
+    """codec=av1 through the product plane (delegated system encoder,
+    backends/av1_path.py); round-trip the av01 CMAF tree through the
+    libav shim for PSNR. None when the host has no AV1 encoder."""
+    from vlog_tpu.backends.source import open_source
+    from vlog_tpu.native.avbuild import get_av_lib
+    from vlog_tpu.worker.pipeline import process_video
+
+    lib = get_av_lib()
+    if lib is None:
+        print("av1: libav shim unavailable", file=sys.stderr)
+        return None
+    hdl = lib.vt_av1_open(64, 64, 24, 1, 200_000, 8, 8)
+    if not hdl:
+        print("av1: no system AV1 encoder in libavcodec", file=sys.stderr)
+        return None
+    lib.vt_av1_close(hdl)
+
+    out = tmp / "oursav1"
+    t0 = time.perf_counter()
+    result = process_video(y4m, out, audio=False, thumbnail=False,
+                           rungs=(rung,), codec="av1")
+    wall = time.perf_counter() - t0
+    rr = result.run.rungs[0]
+    rdir = out / rung.name
+    stream = tmp / "av1round.mp4"
+    stream.write_bytes((rdir / "init.mp4").read_bytes() + b"".join(
+        s.read_bytes() for s in sorted(rdir.glob("segment_*.m4s"))))
+    src = open_source(stream)
+    try:
+        dec = []
+        for y, u, v in src.read_batches(16):
+            for i in range(y.shape[0]):
+                dec.append(np.concatenate([
+                    np.asarray(y[i]).ravel(), np.asarray(u[i]).ravel(),
+                    np.asarray(v[i]).ravel()]))
+    finally:
+        src.close()
+    if not dec:
+        print("av1: shim could not decode the av01 round-trip; skipping",
+              file=sys.stderr)
+        return None
+    dec_arr = np.stack(dec)
+    return {
+        "encoder": "delegated system AV1 (libaom/SVT via av1_path)",
+        "bitrate_kbps": rr.achieved_bitrate // 1000,
+        "psnr_y": round(psnr_y(frames, dec_arr, h, w), 2),
         "wall_s": round(wall, 1),
     }
 
@@ -315,6 +403,17 @@ def main() -> None:
     ap.add_argument("--rungs", default="360p,480p,720p")
     ap.add_argument("--h265", action="store_true",
                     help="add a codec=h265 row for the first rung")
+    ap.add_argument("--h265-rungs", default="",
+                    help="comma list: codec=h265 rows vs a libx265 "
+                         "anchor at the same bitrate")
+    ap.add_argument("--av1-rungs", default="",
+                    help="comma list: delegated codec=av1 rows")
+    ap.add_argument("--append", action="store_true",
+                    help="append sections to QUALITY.md instead of "
+                         "rewriting it")
+    ap.add_argument("--skip-h264", action="store_true",
+                    help="skip the H.264-vs-x264 base rows (codec-"
+                         "specific runs reuse --rungs for geometry only)")
     ap.add_argument("--asr", metavar="AUDIO",
                     help="WER mode: transcribe AUDIO (wav/mp4) with "
                          "VLOG_WHISPER_DIR weights instead of video PSNR")
@@ -336,72 +435,160 @@ def main() -> None:
     avdec = build_tool("avdec", tmp)
     x264 = build_tool("x264enc", tmp)
 
+    geoms = {"360p": (360, 640), "480p": (480, 854), "720p": (720, 1280),
+             "1080p": (1080, 1920), "1440p": (1440, 2560),
+             "2160p": (2160, 3840)}
+
+    def scene_for(rung):
+        g = geoms[rung.name]
+        h, w = g[0], g[1] - g[1] % 16
+        return h, w, moving_scene(args.frames, h, w)
+
     rows = []
-    h265_row = None
-    for name in args.rungs.split(","):
-        rung = config.LADDER_BY_NAME[name.strip()]
-        geom = {"360p": (360, 640), "480p": (480, 854), "720p": (720, 1280),
-                "1080p": (1080, 1920), "1440p": (1440, 2560),
-                "2160p": (2160, 3840)}[rung.name]
-        h, w = geom[0], geom[1] - geom[1] % 16
-        frames = moving_scene(args.frames, h, w)
+    h265_rows = []
+    av1_rows = []
+    h265_wanted = {s.strip() for s in args.h265_rungs.split(",")
+                   if s.strip()}
+    av1_wanted = {s.strip() for s in args.av1_rungs.split(",")
+                  if s.strip()}
+    rung_names = [s.strip() for s in args.rungs.split(",") if s.strip()]
+    if (h265_wanted or args.h265) and not _have_encoder("libx265"):
+        print("libx265 not in system libavcodec; skipping HEVC anchor "
+              "rows", file=sys.stderr)
+        h265_wanted = set()
+        args.h265 = False
+    stray = (h265_wanted | av1_wanted) - set(rung_names)
+    if stray:
+        sys.exit(f"--h265-rungs/--av1-rungs entries {sorted(stray)} are "
+                 f"not in --rungs {rung_names} (codec rows piggyback on "
+                 "the per-rung scene/geometry loop)")
+    for name in rung_names:
+        rung = config.LADDER_BY_NAME[name]
+        h, w, frames = scene_for(rung)
         rtmp = tmp / rung.name
         rtmp.mkdir()
-        ours = run_ours(frames, h, w, args.fps, rung, rtmp, avdec)
-        anchor = run_x264(frames, h, w, args.fps, rung.video_bitrate,
-                          rtmp, x264, avdec)
-        rows.append({"rung": rung.name,
-                     "target_kbps": rung.video_bitrate // 1000,
-                     "ours": ours, "x264": anchor,
-                     "psnr_gap_db": round(anchor["psnr_y"] - ours["psnr_y"],
-                                          2)})
-        print(f"{rung.name}: ours {ours['psnr_y']} dB @ "
-              f"{ours['bitrate_kbps']} kbps | x264 {anchor['psnr_y']} dB @ "
-              f"{anchor['bitrate_kbps']} kbps", file=sys.stderr)
-        if args.h265 and h265_row is None:
-            h265_row = {"rung": rung.name,
-                        "target_kbps": rung.video_bitrate // 1000,
-                        **run_ours_h265(frames, h, w, rtmp / "src.y4m",
-                                        rung, rtmp, avdec)}
-            print(f"{rung.name} h265: {h265_row['psnr_y']} dB @ "
-                  f"{h265_row['bitrate_kbps']} kbps", file=sys.stderr)
-
-    lines = [
-        "# Quality parity: PSNR at the ladder bitrate vs libx264",
-        "",
-        f"Content: synthetic panning scene with moving objects "
-        f"({args.frames} frames @ {args.fps} fps). Decoded by the system "
-        "libavcodec oracle; PSNR-Y vs the pristine source.",
-        "",
-        "| rung | target | ours kbps | ours PSNR-Y | x264 kbps | "
-        "x264 PSNR-Y | gap (dB) |",
-        "|---|---|---|---|---|---|---|",
-    ]
-    for r in rows:
-        lines.append(
-            f"| {r['rung']} | {r['target_kbps']}k "
-            f"| {r['ours']['bitrate_kbps']} | {r['ours']['psnr_y']} "
-            f"| {r['x264']['bitrate_kbps']} | {r['x264']['psnr_y']} "
-            f"| {r['psnr_gap_db']} |")
-    if h265_row is not None:
+        if args.skip_h264:
+            # codec-specific sections still need the serialized source
+            write_scene_y4m(frames, h, w, rtmp / "src.y4m", args.fps)
+        else:
+            ours = run_ours(frames, h, w, args.fps, rung, rtmp, avdec)
+            anchor = run_x264(frames, h, w, args.fps, rung.video_bitrate,
+                              rtmp, x264, avdec)
+            rows.append({"rung": rung.name,
+                         "target_kbps": rung.video_bitrate // 1000,
+                         "ours": ours, "x264": anchor,
+                         "psnr_gap_db": round(
+                             anchor["psnr_y"] - ours["psnr_y"], 2)})
+            print(f"{rung.name}: ours {ours['psnr_y']} dB @ "
+                  f"{ours['bitrate_kbps']} kbps | x264 "
+                  f"{anchor['psnr_y']} dB @ "
+                  f"{anchor['bitrate_kbps']} kbps", file=sys.stderr)
+        if args.h265 and not h265_rows and not h265_wanted:
+            h265_wanted = {rung.name}        # legacy flag: first rung
+        if rung.name in h265_wanted:
+            ours265 = run_ours_h265(frames, h, w, rtmp / "src.y4m",
+                                    rung, rtmp, avdec)
+            x265 = run_x264(frames, h, w, args.fps, rung.video_bitrate,
+                            rtmp, x264, avdec, encoder="libx265")
+            h265_rows.append({
+                "rung": rung.name,
+                "target_kbps": rung.video_bitrate // 1000,
+                "ours": ours265, "x265": x265,
+                "psnr_gap_db": round(x265["psnr_y"] - ours265["psnr_y"],
+                                     2)})
+            print(f"{rung.name} h265: ours {ours265['psnr_y']} dB @ "
+                  f"{ours265['bitrate_kbps']} kbps | x265 "
+                  f"{x265['psnr_y']} dB @ {x265['bitrate_kbps']} kbps",
+                  file=sys.stderr)
+        if rung.name in av1_wanted:
+            av1 = run_ours_av1(frames, h, w, rtmp / "src.y4m", rung, rtmp)
+            if av1 is None:
+                print(f"{rung.name} av1: unavailable (see message above);"
+                      " skipping row", file=sys.stderr)
+            else:
+                av1_rows.append({
+                    "rung": rung.name,
+                    "target_kbps": rung.video_bitrate // 1000, **av1})
+                print(f"{rung.name} av1: {av1['psnr_y']} dB @ "
+                      f"{av1['bitrate_kbps']} kbps", file=sys.stderr)
+    qpath = REPO / "QUALITY.md"
+    appending = args.append and qpath.exists()
+    lines = []
+    if not appending:
         lines += [
+            "# Quality parity: PSNR at the ladder bitrate vs libx264",
             "",
-            "## First-party HEVC (codec=h265 re-encode path)",
+            "Content: synthetic panning scene with moving objects"
+            + (", scene cuts" if args.frames > 96 else "")
+            + (" and noise bursts" if args.frames >= 64 else "")
+            + f" ({args.frames} frames @ {args.fps} fps). Decoded by the "
+            "system libavcodec oracle; PSNR-Y vs the pristine source.",
+            "",
+        ]
+    if rows:
+        lines += [
+            f"## H.264 vs libx264-medium ({args.frames} frames @ "
+            f"{args.fps} fps)",
+            "",
+            "| rung | target | ours kbps | ours PSNR-Y | x264 kbps | "
+            "x264 PSNR-Y | gap (dB) |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for r in rows:
+            lines.append(
+                f"| {r['rung']} | {r['target_kbps']}k "
+                f"| {r['ours']['bitrate_kbps']} | {r['ours']['psnr_y']} "
+                f"| {r['x264']['bitrate_kbps']} | {r['x264']['psnr_y']} "
+                f"| {r['psnr_gap_db']} |")
+        lines.append("")
+    if h265_rows:
+        lines += [
+            f"## First-party HEVC (codec=h265) vs libx265-medium "
+            f"({args.frames} frames @ {args.fps} fps)",
+            "",
+            "| rung | target | ours kbps | ours PSNR-Y | x265 kbps | "
+            "x265 PSNR-Y | gap (dB) |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for r in h265_rows:
+            lines.append(
+                f"| {r['rung']} | {r['target_kbps']}k "
+                f"| {r['ours']['bitrate_kbps']} | {r['ours']['psnr_y']} "
+                f"| {r['x265']['bitrate_kbps']} | {r['x265']['psnr_y']} "
+                f"| {r['psnr_gap_db']} |")
+        lines.append("")
+    if av1_rows:
+        lines += [
+            f"## Delegated AV1 (codec=av1, system encoder through "
+            f"av1_path) ({args.frames} frames @ {args.fps} fps)",
             "",
             "| rung | target | kbps | PSNR-Y | encoder |",
             "|---|---|---|---|---|",
-            f"| {h265_row['rung']} | {h265_row['target_kbps']}k | "
-            f"{h265_row['bitrate_kbps']} | {h265_row['psnr_y']} | "
-            f"{h265_row['encoder']} |",
         ]
-    lines += ["", f"Generated by quality_bench.py "
-              f"(frames={args.frames}, fps={args.fps})."]
-    (REPO / "QUALITY.md").write_text("\n".join(lines) + "\n")
-    print(json.dumps({"metric": "psnr_gap_vs_x264_db",
-                      "value": max(r["psnr_gap_db"] for r in rows),
-                      "unit": "dB_worst_rung",
-                      "rows": rows,
-                      **({"h265": h265_row} if h265_row else {})}))
+        for r in av1_rows:
+            lines.append(
+                f"| {r['rung']} | {r['target_kbps']}k "
+                f"| {r['bitrate_kbps']} | {r['psnr_y']} "
+                f"| {r['encoder']} |")
+        lines.append("")
+    lines += [f"Generated by quality_bench.py "
+              f"(frames={args.frames}, fps={args.fps}).", ""]
+    if appending:
+        qpath.write_text(qpath.read_text() + "\n" + "\n".join(lines))
+    else:
+        qpath.write_text("\n".join(lines))
+    rec = {"metric": "psnr_gap_vs_x264_db",
+           "value": (max(r["psnr_gap_db"] for r in rows) if rows
+                     else None),
+           "unit": "dB_worst_rung",
+           "rows": rows}
+    if h265_rows:
+        rec["h265_rows"] = h265_rows
+        rec["h265_worst_gap_db"] = max(r["psnr_gap_db"]
+                                       for r in h265_rows)
+    if av1_rows:
+        rec["av1_rows"] = av1_rows
+    print(json.dumps(rec))
     shutil.rmtree(tmp, ignore_errors=True)
 
 

@@ -1,12 +1,14 @@
-/* Test/bench-only H.264 anchor encoder against system libavcodec+libx264.
+/* Test/bench-only anchor encoder against system libavcodec.
  *
- * Usage: x264enc <in.yuv (I420)> <w> <h> <fps> <bitrate_bps> <preset> <out.h264>
+ * Usage: x264enc <in.yuv (I420)> <w> <h> <fps> <bitrate_bps> <preset>
+ *                <out.bin> [encoder_name]
  *
- * Produces the libx264 bitstream the reference's GPU/CPU workers would
- * emit (worker/hwaccel.py builds `-c:v libx264 -b:v <ladder>` command
- * lines), so the quality bench can put a number on our encoder's
- * PSNR-at-bitrate against the industry anchor. NOT part of the product —
- * the production encoder is first-party (vlog_tpu/codecs/h264).
+ * encoder_name defaults to libx264 (the reference's CPU worker path,
+ * worker/hwaccel.py `-c:v libx264 -b:v <ladder>`); libx265 gives the
+ * HEVC anchor the same way. The quality bench uses this to put a
+ * number on our encoders' PSNR-at-bitrate against the industry
+ * anchors. NOT part of the product — the production encoders are
+ * first-party (vlog_tpu/codecs/h264, /hevc chains).
  */
 #include <libavcodec/avcodec.h>
 #include <libavutil/opt.h>
@@ -17,8 +19,9 @@
 static void die(const char *msg) { fprintf(stderr, "%s\n", msg); exit(1); }
 
 int main(int argc, char **argv) {
-    if (argc != 8)
-        die("usage: x264enc <in.yuv> <w> <h> <fps> <bps> <preset> <out.h264>");
+    if (argc != 8 && argc != 9)
+        die("usage: x264enc <in.yuv> <w> <h> <fps> <bps> <preset> <out> "
+            "[encoder]");
     int w = atoi(argv[2]), h = atoi(argv[3]), fps = atoi(argv[4]);
     long bps = atol(argv[5]);
     FILE *in = fopen(argv[1], "rb");
@@ -26,8 +29,9 @@ int main(int argc, char **argv) {
     FILE *out = fopen(argv[7], "wb");
     if (!out) die("cannot open output");
 
-    const AVCodec *codec = avcodec_find_encoder_by_name("libx264");
-    if (!codec) die("no libx264 encoder");
+    const char *enc_name = argc == 9 ? argv[8] : "libx264";
+    const AVCodec *codec = avcodec_find_encoder_by_name(enc_name);
+    if (!codec) die("encoder not found");
     AVCodecContext *ctx = avcodec_alloc_context3(codec);
     ctx->width = w;
     ctx->height = h;
@@ -38,6 +42,8 @@ int main(int argc, char **argv) {
     ctx->gop_size = fps * 6;              /* 6 s segments, reference parity */
     ctx->max_b_frames = 2;
     av_opt_set(ctx->priv_data, "preset", argv[6], 0);
+    if (!strcmp(enc_name, "libx265"))
+        av_opt_set(ctx->priv_data, "x265-params", "log-level=error", 0);
     if (avcodec_open2(ctx, codec, NULL) < 0) die("open failed");
 
     AVFrame *frame = av_frame_alloc();

@@ -167,3 +167,44 @@ def test_backend_chain_mode_rate_control(tmp_path_factory):
     settled = seg_bits[len(seg_bits) // 2:-2]
     for b in settled:
         assert abs(b - target) / target < 0.5, seg_bits
+
+
+def test_controller_pays_back_burst_debt():
+    """Bursty content (scene cuts / noise bursts spiking bits 3x every
+    few batches) must converge in LONG-RUN AVERAGE, not just per quiet
+    batch — the round-5 quality bench caught the loop sitting 25-60%
+    hot on cut/burst content while every quiet batch read in-band."""
+    rc = RateController(target_bps=800_000, fps=30.0, init_qp=34)
+    target_bpf = rc.target_bytes_per_frame
+    total_bytes = 0.0
+    total_frames = 0
+    for i in range(60):
+        spike = 3.0 if i % 4 == 3 else 1.0       # cut every 4th batch
+        bpf = _model_plant(rc.qp) * spike
+        rc.observe(int(bpf * 8), 8)
+        if i >= 12:                               # steady state only
+            total_bytes += bpf * 8
+            total_frames += 8
+    avg = total_bytes / total_frames
+    # the spikes average 1.5x alone; debt payback must absorb them
+    assert abs(avg - target_bpf) / target_bpf < 0.15, (
+        f"avg {avg:.0f} vs target {target_bpf:.0f}")
+
+
+def test_controller_recovers_undershoot_debt_too():
+    """Symmetric: a stretch of trivially-easy content banks budget that
+    later hard content may spend (setpoint rises, capped at 1.5x)."""
+    rc = RateController(target_bps=800_000, fps=30.0, init_qp=28)
+    target_bpf = rc.target_bytes_per_frame
+    # easy stretch: plant emits a third of the model rate
+    for _ in range(10):
+        rc.observe(int(_model_plant(rc.qp) * 8 / 3), 8)
+    total = 0.0
+    n = 0
+    for _ in range(30):
+        bpf = _model_plant(rc.qp)
+        rc.observe(int(bpf * 8), 8)
+        total += bpf * 8
+        n += 8
+    # after the banked credit drains, normal content re-converges
+    assert abs(total / n - target_bpf) / target_bpf < 0.35

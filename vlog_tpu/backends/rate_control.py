@@ -50,12 +50,20 @@ class RateController:
     max_step: int = 4          # extrapolation clamp (x2 applied)
     ema_alpha: float = 0.5     # per-QP estimate update weight
     band: float = 0.15         # +-15% of target counts as converged
+    # Debt payback horizon: overspend from a scene cut / noise burst is
+    # recovered over this many frames by steering the working setpoint
+    # below nominal (and vice versa for undershoot). Without it the
+    # loop re-converges to NOMINAL after every spike, so bursty content
+    # averages 25-60% hot even though each quiet batch sits in-band —
+    # x264's VBR pays its debt back the same way.
+    payback_horizon_frames: float = 96.0
 
     _q: float = field(init=False)
     _obs: dict = field(default_factory=dict, init=False)   # int qp -> bpf
     _order: list = field(default_factory=list, init=False)
     _calibrating: bool = field(default=True, init=False)
     _hunting: bool = field(default=True, init=False)
+    _debt_bytes: float = field(default=0.0, init=False)
 
     def __post_init__(self) -> None:
         self._q = float(self.init_qp)
@@ -173,9 +181,31 @@ class RateController:
             f = self._q - lo
             q_real = self._q
         self._attribute(bpf, lo, f)
-        target = max(self.target_bytes_per_frame, 1e-9)
+        nominal = max(self.target_bytes_per_frame, 1e-9)
+        # Anti-windup: the debt integral is clamped to the largest value
+        # the (clamped) setpoint offset can actually pay back, so a long
+        # stretch of un-payable credit/debt (content pinned at a QP rail)
+        # cannot bank thousands of frames of rail-riding for later.
+        debt_cap = 0.5 * nominal * self.payback_horizon_frames
+        self._debt_bytes += float(bytes_out) - nominal * int(n_frames)
+        self._debt_bytes = min(max(self._debt_bytes, -debt_cap), debt_cap)
         calibrating, self._calibrating = self._calibrating, False
-        self._hunting = abs(math.log2(max(bpf, 1.0) / target)) > math.log2(1.5)
+        self._hunting = (abs(math.log2(max(bpf, 1.0) / nominal))
+                         > math.log2(1.5))
+        # Steady-state setpoint = nominal minus accumulated debt
+        # amortized over the payback horizon, clamped to [0.5, 1.5]x
+        # nominal so a giant spike can't spiral QP to the rails. Debt
+        # accrues always (calibration bits were really spent) and
+        # steers every post-calibration batch — a scene-cut batch that
+        # blows past 1.5x nominal is exactly when payback must engage,
+        # not pause. Only the calibration batch itself is exempt (its
+        # step math is the direction-asymmetric transient logic).
+        if calibrating:
+            target = nominal
+        else:
+            target = min(max(
+                nominal - self._debt_bytes / self.payback_horizon_frames,
+                0.5 * nominal), 1.5 * nominal)
 
         # converged: the just-measured rate sits inside the band
         if abs(math.log2(max(bpf, 1.0) / target)) <= math.log2(
