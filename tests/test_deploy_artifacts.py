@@ -1,0 +1,118 @@
+"""Deploy artifacts stay structurally valid (reference analog: the
+13-manifest k8s/ tree + grafana/vlog-dashboard.json). These files are
+dead weight unless something fails the build when they rot; this is
+that something."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+DEPLOY = Path(__file__).parent.parent / "deploy"
+
+try:
+    import yaml
+    HAVE_YAML = True
+except ImportError:                      # pragma: no cover
+    HAVE_YAML = False
+
+
+def _docs(path):
+    return [d for d in yaml.safe_load_all(path.read_text())
+            if d is not None]
+
+
+@pytest.mark.skipif(not HAVE_YAML, reason="pyyaml not in image")
+def test_all_k8s_manifests_parse_with_kind_and_name():
+    files = sorted((DEPLOY / "k8s").glob("*.yaml"))
+    assert len(files) >= 5
+    kinds = set()
+    for f in files:
+        for doc in _docs(f):
+            assert doc.get("apiVersion"), f
+            assert doc.get("kind"), f
+            assert doc.get("metadata", {}).get("name"), f
+            kinds.add(doc["kind"])
+    # the fleet-management families the reference ships
+    assert {"Deployment", "HorizontalPodAutoscaler",
+            "PodDisruptionBudget", "NetworkPolicy",
+            "CronJob"} <= kinds
+
+
+@pytest.mark.skipif(not HAVE_YAML, reason="pyyaml not in image")
+def test_hpa_targets_existing_deployment():
+    hpa_docs = _docs(DEPLOY / "k8s" / "worker-autoscaling.yaml")
+    hpa = next(d for d in hpa_docs
+               if d["kind"] == "HorizontalPodAutoscaler")
+    target = hpa["spec"]["scaleTargetRef"]["name"]
+    deploy_names = set()
+    for f in (DEPLOY / "k8s").glob("*.yaml"):
+        for d in _docs(f):
+            if d["kind"] == "Deployment":
+                deploy_names.add(d["metadata"]["name"])
+    assert target in deploy_names
+    assert hpa["spec"]["minReplicas"] >= 1
+
+
+@pytest.mark.skipif(not HAVE_YAML, reason="pyyaml not in image")
+def test_pdb_selectors_match_deployment_labels():
+    labels = {}
+    pdbs = []
+    for f in (DEPLOY / "k8s").glob("*.yaml"):
+        for d in _docs(f):
+            if d["kind"] == "Deployment":
+                labels[d["metadata"]["name"]] = (
+                    d["spec"]["selector"]["matchLabels"])
+            elif d["kind"] == "PodDisruptionBudget":
+                pdbs.append(d)
+    assert pdbs
+    all_selector_sets = list(labels.values())
+    for p in pdbs:
+        sel = p["spec"]["selector"]["matchLabels"]
+        assert sel in all_selector_sets, p["metadata"]["name"]
+
+
+@pytest.mark.skipif(not HAVE_YAML, reason="pyyaml not in image")
+def test_cronjobs_forbid_concurrency_and_parse_schedules():
+    docs = _docs(DEPLOY / "k8s" / "maintenance-cronjobs.yaml")
+    crons = [d for d in docs if d["kind"] == "CronJob"]
+    assert len(crons) == 3
+    for c in crons:
+        assert c["spec"]["concurrencyPolicy"] == "Forbid"
+        fields = c["spec"]["schedule"].split()
+        assert len(fields) == 5, c["metadata"]["name"]
+        # avoid the :00 stampede minute
+        assert fields[0] not in ("0", "30")
+
+
+def test_grafana_dashboard_valid_and_covers_exported_metrics():
+    dash = json.loads(
+        (DEPLOY / "grafana" / "vlog-dashboard.json").read_text())
+    assert dash["title"] and dash["panels"]
+    exprs = " ".join(t["expr"] for p in dash["panels"]
+                     for t in p.get("targets", []))
+    # every metric family the worker API exports appears in a panel
+    for family in ("vlog_jobs", "vlog_workers_online",
+                   "vlog_jobs_claimed_total", "vlog_jobs_completed_total",
+                   "vlog_jobs_failed_total", "vlog_upload_bytes_total",
+                   "vlog_http_requests_total"):
+        assert family in exprs, family
+
+
+def test_systemd_units_reference_real_modules():
+    units = sorted((DEPLOY / "systemd").glob("*.service"))
+    assert len(units) == 4
+    import importlib.util
+
+    for u in units:
+        text = u.read_text()
+        assert "Restart=" in text
+        for token in text.split():
+            if token.startswith("vlog_tpu."):
+                mod = token.split()[0]
+                assert importlib.util.find_spec(mod) is not None, (
+                    f"{u.name} references missing module {mod}")
+    worker = (DEPLOY / "systemd" / "vlog-worker.service").read_text()
+    assert "RestartForceExitStatus=64" in worker   # mgmt restart verb

@@ -1100,3 +1100,125 @@ def test_abr_js_constants_match_python_mirror():
     assert "const UP_MIN_BUFFER_S = 10" in js
     assert "const DOWN_BUFFER_S = 5" in js
     assert "const SWITCH_COOLDOWN_S = 3" in js
+
+
+# --------------------------------------------------------------------------
+# alert rate limiting
+# --------------------------------------------------------------------------
+
+def test_alert_rate_limit_per_key(run):
+    from aiohttp import web as aioweb
+    from aiohttp.test_utils import TestServer
+    from vlog_tpu.jobs.alerts import AlertSink
+
+    hits = []
+
+    async def go():
+        async def receive(request):
+            hits.append(await request.json())
+            return aioweb.json_response({"ok": True})
+
+        app = aioweb.Application()
+        app.router.add_post("/a", receive)
+        srv = TestServer(app)
+        await srv.start_server()
+        sink = AlertSink(url=str(srv.make_url("/a")),
+                         min_interval_s=30.0)
+        assert await sink.send("disk.full", "a") is True
+        assert await sink.send("disk.full", "b") is False   # suppressed
+        assert await sink.send("other.alert", "c") is True  # distinct key
+        assert sink.metrics.sent == 2
+        assert sink.metrics.suppressed == 1
+        # custom key groups unrelated alert names into one budget
+        assert await sink.send("x", "d", key="shared") is True
+        assert await sink.send("y", "e", key="shared") is False
+        await srv.close()
+
+    run(go())
+    assert [h["alert"] for h in hits] == ["disk.full", "other.alert", "x"]
+
+
+def test_alert_disabled_without_url(run):
+    from vlog_tpu.jobs.alerts import AlertSink
+
+    sink = AlertSink(url=None)
+
+    async def go():
+        assert await sink.send("a", "b") is False
+        sink.send_fire_and_forget("a", "b")   # no loop needed, no crash
+
+    run(go())
+    assert sink.metrics.sent == 0
+
+
+# --------------------------------------------------------------------------
+# finalize edges
+# --------------------------------------------------------------------------
+
+def test_finalize_transcode_flips_video_and_enqueues_downstream(
+        run, db, tmp_path):
+    from vlog_tpu.enums import JobKind
+    from vlog_tpu.jobs import claims, videos as vids
+    from vlog_tpu.jobs.finalize import finalize_transcode
+    from tests.fixtures.media import make_y4m
+
+    async def go():
+        src = make_y4m(tmp_path / "s.y4m", n_frames=4, width=64,
+                       height=48)
+        v = await vids.create_video(db, "Fin", source_path=str(src))
+        await claims.enqueue_job(db, v["id"])
+        job = await claims.claim_job(db, "w1")
+        await finalize_transcode(
+            db, job, dict(v),
+            probe={"duration_s": 2.0, "width": 64, "height": 48,
+                   "fps": 24.0, "audio_codec": "aac"},
+            qualities=[{"quality": "360p", "width": 64, "height": 48,
+                        "playlist_path": str(tmp_path / "p.m3u8")}],
+            thumbnail_path=None, streaming_format="cmaf")
+        row = await vids.get_video(db, v["id"])
+        assert row["status"] == "ready"
+        assert row["duration_s"] == 2.0
+        quals = await db.fetch_all(
+            "SELECT * FROM video_qualities WHERE video_id=:v",
+            {"v": v["id"]})
+        assert [q["name"] for q in quals] == ["360p"]
+        downstream = await db.fetch_all(
+            "SELECT kind FROM jobs WHERE video_id=:v AND kind != "
+            "'transcode'", {"v": v["id"]})
+        kinds = {d["kind"] for d in downstream}
+        assert "sprite" in kinds and "transcription" in kinds
+
+    run(go())
+
+
+def test_finalize_replaces_stale_qualities(run, db, tmp_path):
+    from vlog_tpu.jobs import claims, videos as vids
+    from vlog_tpu.jobs.finalize import finalize_transcode
+    from tests.fixtures.media import make_y4m
+
+    async def go():
+        src = make_y4m(tmp_path / "s.y4m", n_frames=4, width=64,
+                       height=48)
+        v = await vids.create_video(db, "Re", source_path=str(src))
+        await claims.enqueue_job(db, v["id"])
+        job = await claims.claim_job(db, "w1")
+        for qual in ("360p", "480p"):
+            await db.execute(
+                "INSERT INTO video_qualities (video_id, name, width, "
+                "height, playlist_path, created_at) VALUES (:v, :q, 1, "
+                "1, 'stale', 0)", {"v": v["id"], "q": qual})
+        await finalize_transcode(
+            db, job, dict(v),
+            probe={"duration_s": 1.0, "width": 64, "height": 48,
+                   "fps": 24.0},
+            qualities=[{"quality": "360p", "width": 64, "height": 48,
+                        "playlist_path": "fresh"}],
+            thumbnail_path=None, streaming_format="cmaf",
+            enqueue_downstream=False)
+        quals = await db.fetch_all(
+            "SELECT * FROM video_qualities WHERE video_id=:v",
+            {"v": v["id"]})
+        assert len(quals) == 1
+        assert quals[0]["playlist_path"] == "fresh"
+
+    run(go())

@@ -57,6 +57,11 @@ class RateController:
     # averages 25-60% hot even though each quiet batch sits in-band —
     # x264's VBR pays its debt back the same way.
     payback_horizon_frames: float = 96.0
+    # Converged-phase downward probe size. 1 for integer-QP video codecs
+    # (cliffs sit between adjacent QPs; one step either converges or
+    # forms an adjacent bracket for the analytic dither). Controllers on
+    # finer, smoother scales (AAC scalefactors span ~170 steps) raise it.
+    converged_down_step: float = 1.0
 
     _q: float = field(init=False)
     _obs: dict = field(default_factory=dict, init=False)   # int qp -> bpf
@@ -182,13 +187,26 @@ class RateController:
             q_real = self._q
         self._attribute(bpf, lo, f)
         nominal = max(self.target_bytes_per_frame, 1e-9)
-        # Anti-windup: the debt integral is clamped to the largest value
-        # the (clamped) setpoint offset can actually pay back, so a long
-        # stretch of un-payable credit/debt (content pinned at a QP rail)
-        # cannot bank thousands of frames of rail-riding for later.
-        debt_cap = 0.5 * nominal * self.payback_horizon_frames
-        self._debt_bytes += float(bytes_out) - nominal * int(n_frames)
-        self._debt_bytes = min(max(self._debt_bytes, -debt_cap), debt_cap)
+        # Anti-windup, two layers: a single batch can book at most 3x
+        # its nominal budget of debt/credit (one cliff batch must not
+        # dominate the integral), and the integral itself is clamped to
+        # what the (clamped) setpoint offset can actually pay back —
+        # a long stretch of un-payable credit/debt (content pinned at a
+        # QP rail) cannot bank thousands of frames of rail-riding.
+        batch_budget = nominal * int(n_frames)
+        # debt per batch caps at 3x budget (one cliff batch must not
+        # dominate the integral); credit is inherently <= 1x budget
+        # (bytes_out >= 0), no clamp needed there
+        per_batch = min(float(bytes_out) - batch_budget,
+                        3.0 * batch_budget)
+        # integral caps mirror the setpoint clamp below: debt pays back
+        # at up to 0.5x nominal/frame, credit spends at only 0.15x —
+        # each side bounded by what one horizon can actually recover
+        self._debt_bytes += per_batch
+        self._debt_bytes = min(
+            max(self._debt_bytes,
+                -0.15 * nominal * self.payback_horizon_frames),
+            0.5 * nominal * self.payback_horizon_frames)
         calibrating, self._calibrating = self._calibrating, False
         self._hunting = (abs(math.log2(max(bpf, 1.0) / nominal))
                          > math.log2(1.5))
@@ -203,9 +221,15 @@ class RateController:
         if calibrating:
             target = nominal
         else:
+            # Asymmetric setpoint clamp (the integral sibling of the
+            # asymmetric step rule): paying back overshoot pushes the
+            # setpoint down to 0.5x freely — raising QP is always safe —
+            # but banked credit raises it at most 15%, because SPENDING
+            # credit means stepping down toward rate cliffs, and a
+            # cliff batch costs more than the credit was worth.
             target = min(max(
                 nominal - self._debt_bytes / self.payback_horizon_frames,
-                0.5 * nominal), 1.5 * nominal)
+                0.5 * nominal), 1.15 * nominal)
 
         # converged: the just-measured rate sits inside the band
         if abs(math.log2(max(bpf, 1.0) / target)) <= math.log2(
@@ -258,9 +282,13 @@ class RateController:
             # while far from target: any target is reached in O(log)
             # batches of cheap UNDER-target encodes, and a cliff at the
             # far end is approached, never leapt onto (the 5x-burn batch
-            # a full jump used to cost)
+            # a full jump used to cost). CONVERGED operation probes one
+            # QP at a time: near the working point the rate curve's
+            # cliffs are exactly where a -3 model step lands 5x hot, and
+            # a single step either converges or forms an adjacent
+            # bracket for the analytic dither to solve.
             step = step / 2.0 if self._hunting or calibrating \
-                else max(step, -float(self.max_step))
+                else max(step, -self.converged_down_step)
         elif not calibrating:
             step = min(step, 2.0 * self.max_step)
         base = q_real if frame_qps is not None else self._q

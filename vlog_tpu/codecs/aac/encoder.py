@@ -81,7 +81,11 @@ class AacEncoder:
         # tracks the audio bitrate. Wide QP range maps to base scalefactor.
         self._rc = RateController(
             target_bps=self.bitrate, fps=frame_rate, init_qp=148,
-            min_qp=80, max_qp=250, max_step=6)
+            min_qp=80, max_qp=250, max_step=6,
+            # the scalefactor rate curve is smooth across ~170 steps;
+            # single-step probing (a video-cliff defense) would drag
+            # undershoot recovery out 6x
+            converged_down_step=6.0)
         self._window = sine_window(2048)
         self._basis = mdct_matrix(2048)
 
