@@ -373,18 +373,33 @@ def _drive(rc, plant, n=14):
 ])
 def test_rate_controller_handles_cliff_plants(edge, hi, lo):
     """Targets INSIDE a rate cliff are reachable only by dithering across
-    it; the integer-bracket controller must land within the band."""
+    it; the integer-bracket controller must land within the band.
+
+    The debt integral steers the setpoint below nominal while the
+    hunting transient's overspend amortizes (payback_horizon_frames),
+    so the instantaneous rate is checked AFTER the horizon has passed;
+    the transient itself is covered by the cumulative-bytes assert —
+    payback exists precisely so the whole-encode average hits target."""
     from vlog_tpu.backends.rate_control import RateController
 
     target_bpf = (hi + lo) / 2
     rc = RateController(target_bps=int(target_bpf * 8 * 30), fps=30.0,
                         init_qp=40)
     plant = lambda q: hi if q < edge else lo
-    _drive(rc, plant)
+    seen = []
+    for _ in range(34):                     # 272 frames > hunt + horizon
+        qs = rc.frame_qps(8)
+        bpf = float(np.mean([plant(int(q)) for q in qs]))
+        seen.append(bpf)
+        rc.observe(int(bpf * 8), 8, frame_qps=qs)
     qs = rc.frame_qps(64)
     achieved = float(np.mean([plant(int(q)) for q in qs]))
     assert abs(achieved - target_bpf) / target_bpf < 0.2, (
         rc._q, rc._obs, achieved)
+    # whole-run average (what debt payback buys): tighter than the
+    # instantaneous band even though it includes the hunting transient
+    cum = float(np.mean(seen))
+    assert abs(cum - target_bpf) / target_bpf < 0.1, (cum, target_bpf)
 
 
 def test_rate_controller_never_runs_away_upward():

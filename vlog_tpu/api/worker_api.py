@@ -71,12 +71,17 @@ async def auth_middleware(request: web.Request, handler):
 @web.middleware
 async def metrics_middleware(request: web.Request, handler):
     m = request.app[METRICS]
+    status = 500                      # unhandled exception -> counted 500
     try:
         resp = await handler(request)
+        status = resp.status
         return resp
+    except web.HTTPException as exc:
+        status = exc.status
+        raise
     finally:
-        m.http_requests.labels(request.method,
-                               _route_label(request)).inc()
+        m.http_requests.labels(request.method, _route_label(request),
+                               str(status)).inc()
 
 
 def _route_label(request: web.Request) -> str:
@@ -93,7 +98,7 @@ class Metrics:
         self.registry = CollectorRegistry()
         self.http_requests = Counter(
             "vlog_http_requests_total", "HTTP requests",
-            ["method", "route"], registry=self.registry)
+            ["method", "route", "status"], registry=self.registry)
         self.jobs_claimed = Counter(
             "vlog_jobs_claimed_total", "Jobs claimed over HTTP",
             ["kind"], registry=self.registry)
@@ -122,6 +127,13 @@ class Metrics:
                  "# TYPE vlog_jobs gauge"]
         for st, n in sorted(counts.items()):
             lines.append(f'vlog_jobs{{state="{st}"}} {n}')
+        # flat queue-depth gauge: what the worker HPA scales on
+        # (deploy/k8s/worker-autoscaling.yaml) — claimable work only
+        queued = (counts.get("unclaimed", 0) + counts.get("retrying", 0)
+                  + counts.get("expired", 0))
+        lines.append("# HELP vlog_jobs_queued Jobs waiting for a worker")
+        lines.append("# TYPE vlog_jobs_queued gauge")
+        lines.append(f"vlog_jobs_queued {queued}")
         online = await db.fetch_val(
             "SELECT COUNT(*) FROM workers WHERE last_heartbeat_at > :cut",
             {"cut": t - config.WORKER_OFFLINE_THRESHOLD_S})

@@ -194,9 +194,8 @@ class RateController:
         # a long stretch of un-payable credit/debt (content pinned at a
         # QP rail) cannot bank thousands of frames of rail-riding.
         batch_budget = nominal * int(n_frames)
-        # debt per batch caps at 3x budget (one cliff batch must not
-        # dominate the integral); credit is inherently <= 1x budget
-        # (bytes_out >= 0), no clamp needed there
+        # credit is inherently <= 1x budget (bytes_out >= 0); no
+        # per-batch clamp needed on that side
         per_batch = min(float(bytes_out) - batch_budget,
                         3.0 * batch_budget)
         # integral caps mirror the setpoint clamp below: debt pays back
