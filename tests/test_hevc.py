@@ -524,3 +524,102 @@ def test_quality_monotonic_in_qp(hevcdec, tmp_path):
             assert psnr < prev_psnr
         prev_bytes, prev_psnr = len(stream), psnr
     assert prev_psnr > 25.0          # qp42 still recognizable
+
+
+def test_deblock_pps_signalling():
+    """write_pps(deblock=...) flips the loop-filter signalling: the two
+    PPS payloads must differ, and the deblock-on PPS must be the one the
+    in-loop filter tests decode against (control_present=0 -> 8.7.2 runs
+    with zero offsets)."""
+    on = syntax.write_pps(deblock=True).to_bytes()
+    off = syntax.write_pps(deblock=False).to_bytes()
+    assert on != off
+    from vlog_tpu.codecs.hevc.api import HevcEncoder
+
+    enc_on = HevcEncoder(width=64, height=64, deblock=True)
+    enc_off = HevcEncoder(width=64, height=64, deblock=False)
+    assert enc_on.pps.to_bytes() == on
+    assert enc_off.pps.to_bytes() == off
+
+
+def test_deblock_off_chain_oracle(hevcdec, tmp_path):
+    """Legacy deblock-off mode must stay oracle-exact (the round-4
+    stream shape: PPS disables 8.7.2, recon is pred+residual)."""
+    from vlog_tpu.codecs.hevc.api import HevcEncoder
+    from tests.test_h264_p import moving_frames
+
+    h, w = 64, 96
+    frames = moving_frames(4, h, w)
+    y = np.stack([f[0] for f in frames])
+    u = np.stack([f[1] for f in frames])
+    v = np.stack([f[2] for f in frames])
+    enc = HevcEncoder(width=w, height=h, qp=30, deblock=False)
+    chain = enc.encode_chain(y, u, v, search=8)
+    decoded = oracle_decode(hevcdec, b"".join(f.annexb for f in chain),
+                            h, w, tmp_path)
+    assert len(decoded) == 4
+    for i, (dy, _, _) in enumerate(decoded):
+        mse = np.mean((dy.astype(np.float64)
+                       - y[i].astype(np.float64)) ** 2)
+        psnr = 10 * np.log10(255.0 ** 2 / max(mse, 1e-12))
+        assert abs(psnr - chain[i].psnr_y) < 1e-6, f"frame {i} drifted"
+
+
+def test_deblock_changes_recon_inside_loop():
+    """The filter must be IN-loop: with deblock on, P frames predict
+    from filtered references, so the bitstreams themselves diverge from
+    the off mode (not just the output planes)."""
+    from vlog_tpu.codecs.hevc.api import HevcEncoder
+    from tests.test_h264_p import moving_frames
+
+    h, w = 64, 96
+    frames = moving_frames(4, h, w)
+    y = np.stack([f[0] for f in frames])
+    u = np.stack([f[1] for f in frames])
+    v = np.stack([f[2] for f in frames])
+    on = HevcEncoder(width=w, height=h, qp=34, deblock=True)
+    off = HevcEncoder(width=w, height=h, qp=34, deblock=False)
+    c_on = on.encode_chain(y, u, v, search=8)
+    c_off = off.encode_chain(y, u, v, search=8)
+    assert any(a.sample != b.sample for a, b in zip(c_on[1:], c_off[1:]))
+
+
+def test_deblock_chroma_oracle_exact(hevcdec, tmp_path):
+    """Chroma deblocking (8.7.2.5.5, intra pictures only) must match the
+    oracle decoder plane-for-plane — and must actually engage, or the
+    assert proves nothing.  Blocky chroma (random per-CTB color fill at
+    high QP) guarantees bS-2 edges where the filter fires."""
+    from vlog_tpu.codecs.hevc.api import HevcEncoder
+
+    h, w = 96, 128
+    rng = np.random.default_rng(7)
+    yb = rng.integers(40, 215, (1, h // 32, w // 32), np.uint8)
+    y = np.kron(yb, np.ones((1, 32, 32), np.uint8))
+    ub = rng.integers(40, 215, (1, h // 32, w // 32), np.uint8)
+    u = np.kron(ub, np.ones((1, 16, 16), np.uint8))
+    vb = rng.integers(40, 215, (1, h // 32, w // 32), np.uint8)
+    v = np.kron(vb, np.ones((1, 16, 16), np.uint8))
+
+    on = HevcEncoder(width=w, height=h, qp=37, deblock=True)
+    off = HevcEncoder(width=w, height=h, qp=37, deblock=False)
+    f_on = on.encode_batch(y, u, v)
+    f_off = off.encode_batch(y, u, v)
+    d_on = oracle_decode(hevcdec, f_on[0].annexb, h, w, tmp_path)[0]
+    (tmp_path / "s.hevc").unlink()
+    d_off = oracle_decode(hevcdec, f_off[0].annexb, h, w, tmp_path)[0]
+    # the chroma filter engaged: decoded chroma differs between modes
+    assert (d_on[1] != d_off[1]).any() or (d_on[2] != d_off[2]).any()
+    # and our in-loop recon equals the decoder on EVERY plane: re-encode
+    # through the chain path (frame 0 = same intra DSP) to read recons
+    from vlog_tpu.codecs.hevc.jax_core import encode_frame_dsp
+
+    def pad(p, n):
+        ph, pw = (-p.shape[0]) % n, (-p.shape[1]) % n
+        return np.pad(p, ((0, ph), (0, pw)), mode="edge")
+
+    _, (ry, ru, rv) = encode_frame_dsp(
+        pad(y[0], 32), pad(u[0], 16), pad(v[0], 16),
+        np.int32(37), deblock=True)
+    assert np.array_equal(np.asarray(ry)[:h, :w], d_on[0])
+    assert np.array_equal(np.asarray(ru)[:h // 2, :w // 2], d_on[1])
+    assert np.array_equal(np.asarray(rv)[:h // 2, :w // 2], d_on[2])

@@ -132,7 +132,8 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         batch_n = clen * chains_per
         fn, mats = hevc_chain_ladder_program(
             rungs_spec, src_h, src_w,
-            search=config.MOTION_SEARCH_RADIUS, mesh=mesh)
+            search=config.MOTION_SEARCH_RADIUS, mesh=mesh,
+            deblock=config.HEVC_DEBLOCK)
         npix = {r.name: r.height * r.width for r in plan.rungs}
         rows_cols = {r.name: ((r.height + 31) // 32, (r.width + 31) // 32)
                      for r in plan.rungs}

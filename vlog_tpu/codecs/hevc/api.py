@@ -77,12 +77,19 @@ class HevcEncoder:
     fps_den: int = 1
     qp: int = 30
     entropy_threads: int = 8
+    deblock: bool | None = None     # None -> config.HEVC_DEBLOCK
 
     def __post_init__(self):
+        if self.deblock is None:
+            from vlog_tpu import config
+
+            self.deblock = config.HEVC_DEBLOCK
         self.vps = syntax.write_vps(
             syntax.level_idc_for(self.width, self.height))
         self.sps = syntax.write_sps(self.width, self.height)
-        self.pps = syntax.write_pps()
+        # the PPS must signal what the DSP reconstructs: a decoder runs
+        # 8.7.2 iff this flag set says so, and P prediction chains on it
+        self.pps = syntax.write_pps(deblock=self.deblock)
 
     # ---- stream metadata -----------------------------------------------
     @property
@@ -190,7 +197,7 @@ class HevcEncoder:
             partitions = config.HEVC_PARTITIONS
         (intra, recon0), (p32, p16, parts, mvs, precons) = \
             encode_chain_dsp(y, u, v, search, np.int32(qp_i),
-                             qp_p_vec, partitions)
+                             qp_p_vec, partitions, bool(self.deblock))
         recons = [recon0] + ([tuple(np.asarray(p[i]) for p in precons)
                               for i in range(t - 1)] if t > 1 else [])
         intra_np = tuple(np.asarray(a) for a in intra)
@@ -351,7 +358,8 @@ class HevcEncoder:
                 qps = np.concatenate(
                     [qps, np.full((b - qps.shape[0],), qps[-1] if qps.size
                                   else self.qp, np.int32)])
-        (ly, lu, lv), (ry, _, _) = encode_batch_dsp(y, u, v, qps)
+        (ly, lu, lv), (ry, _, _) = encode_batch_dsp(
+            y, u, v, qps, deblock=bool(self.deblock))
         ly = np.asarray(ly)
         lu = np.asarray(lu)
         lv = np.asarray(lv)

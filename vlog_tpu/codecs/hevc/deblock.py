@@ -1,0 +1,263 @@
+"""HEVC in-loop deblocking filter (spec 8.7.2) — exact, TPU-shaped.
+
+The reference gets deblocking for free inside x265/NVENC/VAAPI
+(worker/hwaccel.py:555-646); our first-party encoder must run it in the
+JAX DSP because the filter is IN-LOOP: the deblocked picture is what a
+decoder stores in the DPB, so P-frame prediction drifts unless the
+encoder reconstructs through the same filter bit-exactly.
+
+**Why two flat passes (no wavefront).** Unlike H.264's raster-order
+macroblock filter (codecs/h264/deblock.py), HEVC was *designed* for
+parallel deblocking: all vertical edges of the picture are filtered
+first, then all horizontal edges (8.7.2.1).  Edges live on an 8x8 grid
+and the filter reads 4 / writes 3 samples on each side, so no two
+same-direction edge filters ever touch the same sample — each pass is
+one dense batched gather/filter/scatter, exactly what the VPU wants.
+Our streams are simpler still: every coded TU is >= 16x16 (jax_core
+TU32 luma / TU16 chroma, TU16 luma inside partitioned CTBs), so edges
+only exist on the 16-luma grid and bS is constant over each 16x16 cell.
+
+Boundary strengths for the streams this encoder emits:
+
+- I pictures: every TU-boundary edge has an intra CU on both sides ->
+  bS = 2 (8.7.2.4).  TU boundaries sit on the 32-luma CTB grid.
+- P pictures (single ref, list0): bS = 1 where either adjacent TU has
+  nonzero coefficients or the MV delta is >= 4 quarter-pel on either
+  component, else 0.  Edges exist at CTB boundaries, plus the interior
+  16-grid of partitioned CTBs (their TU tree splits to TU16).
+- Chroma is filtered only where bS = 2 -> intra pictures only, on the
+  16-chroma (= CTB) grid.
+
+beta/tc are spec Tables 8-12 (values cross-checked against
+libavcodec's hevc_filter betatable/tctable).  QP is uniform per picture
+(per-frame rate control), so threshold lookups are traced scalars.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Spec Table 8-12: beta' indexed by Q = Clip3(0, 51, qp).
+BETA_TBL = np.array([
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 6, 7, 8, 9, 10,
+    11, 12, 13, 14, 15, 16, 17, 18, 20, 22, 24, 26, 28, 30, 32, 34,
+    36, 38, 40, 42, 44, 46, 48, 50, 52, 54, 56, 58, 60, 62, 64,
+], np.int32)
+# Spec Table 8-12: tc' indexed by Q = Clip3(0, 53, qp + 2*(bS-1)).
+TC_TBL = np.array([
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 1, 1, 1,
+    1, 1, 1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3, 4, 4, 4, 5, 5, 6, 6,
+    7, 8, 9, 10, 11, 13, 14, 16, 18, 20, 22, 24,
+], np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Boundary strengths (cell granularity: bS is constant per 16x16 cell)
+# ---------------------------------------------------------------------------
+
+def intra_bs(ctbh: int, ctbw: int):
+    """(bs_v, bs_h) for an all-intra picture.
+
+    bs_v: (Ev, H16) int32 — vertical edge k at x = 16*(k+1), per
+    16-line cell row.  Only CTB boundaries carry a TU edge (TU32), so
+    odd k (x a multiple of 32) gets bS 2, interior 16-columns 0.
+    bs_h mirrors for horizontal edges.
+    """
+    h16, w16 = 2 * ctbh, 2 * ctbw
+    kv = np.arange(w16 - 1)
+    bs_v = np.where((kv % 2 == 1)[:, None], 2, 0).astype(np.int32)
+    bs_v = np.broadcast_to(bs_v, (w16 - 1, h16))
+    kh = np.arange(h16 - 1)
+    bs_h = np.where((kh % 2 == 1)[:, None], 2, 0).astype(np.int32)
+    bs_h = np.broadcast_to(bs_h, (h16 - 1, w16))
+    return jnp.asarray(bs_v), jnp.asarray(bs_h)
+
+
+def p_bs(part, cbf_cells, mv):
+    """Boundary strengths for a P picture.
+
+    part: (R, C) int32 per-CTB partition code (0 = 2Nx2N).
+    cbf_cells: (2R, 2C) bool — the TU containing the cell has nonzero
+    coefficients (TU32's cbf replicated over its 4 cells, or per-TU16).
+    mv: (2R, 2C, 2) int32 quarter-pel MVs per 16-cell.
+    Returns (bs_v, bs_h): (Ev, H16) / (Eh, W16) int32.
+    """
+    cbf_cells = cbf_cells.astype(jnp.int32)
+    h16, w16 = cbf_cells.shape
+    part_cells = jnp.repeat(jnp.repeat(part, 2, 0), 2, 1)      # (2R, 2C)
+
+    cond_v = (((cbf_cells[:, :-1] | cbf_cells[:, 1:]) > 0)
+              | jnp.any(jnp.abs(mv[:, 1:] - mv[:, :-1]) >= 4, axis=-1))
+    kv = jnp.arange(w16 - 1)
+    ctb_v = (kv % 2) == 1                                      # (Ev,)
+    # interior edge k (even) lies inside CTB column k//2: a TU16 edge
+    # exists there only when that CTB is partitioned
+    inner_v = part_cells[:, (kv // 2) * 2] != 0                # (H16, Ev)
+    exists_v = ctb_v[None, :] | ((~ctb_v)[None, :] & inner_v)
+    bs_v = jnp.where(exists_v & cond_v, 1, 0).T                # (Ev, H16)
+
+    cond_h = (((cbf_cells[:-1, :] | cbf_cells[1:, :]) > 0)
+              | jnp.any(jnp.abs(mv[1:] - mv[:-1]) >= 4, axis=-1))
+    kh = jnp.arange(h16 - 1)
+    ctb_h = (kh % 2) == 1
+    inner_h = part_cells[(kh // 2) * 2, :] != 0                # (Eh, W16)
+    exists_h = ctb_h[:, None] | ((~ctb_h)[:, None] & inner_h)
+    return bs_v.astype(jnp.int32), jnp.where(
+        exists_h & cond_h, 1, 0).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Edge filters: win (..., L, 8) = [p3 p2 p1 p0 q0 q1 q2 q3] per line
+# ---------------------------------------------------------------------------
+
+def _filter_luma(win, bs_seg, qp):
+    """Spec 8.7.2.5.3 (decisions) + 8.7.2.5.6/8.7.2.5.7 (filters).
+
+    win: (E, L, 8) int32, L a multiple of 4; bs_seg: (E, L//4) int32
+    per 4-line segment; qp traced scalar.  Returns filtered windows.
+    """
+    e, l, _ = win.shape
+    s = l // 4
+    w4 = win.reshape(e, s, 4, 8)
+    p3, p2, p1, p0 = w4[..., 0], w4[..., 1], w4[..., 2], w4[..., 3]
+    q0, q1, q2, q3 = w4[..., 4], w4[..., 5], w4[..., 6], w4[..., 7]
+
+    beta = jnp.asarray(BETA_TBL)[jnp.clip(qp, 0, 51)]
+    tc = jnp.asarray(TC_TBL)[jnp.clip(qp + 2 * (bs_seg - 1), 0, 53)]
+
+    dp = jnp.abs(p2 - 2 * p1 + p0)                   # (E, S, 4) per line
+    dq = jnp.abs(q2 - 2 * q1 + q0)
+    dp03 = dp[..., 0] + dp[..., 3]                   # (E, S) lines 0+3
+    dq03 = dq[..., 0] + dq[..., 3]
+    d = dp03 + dq03
+    filt = (bs_seg > 0) & (d < beta)                 # (E, S)
+
+    def strong_line(i):
+        return ((2 * (dp[..., i] + dq[..., i]) < (beta >> 2))
+                & ((jnp.abs(p3[..., i] - p0[..., i])
+                    + jnp.abs(q0[..., i] - q3[..., i])) < (beta >> 3))
+                & (jnp.abs(p0[..., i] - q0[..., i])
+                   < ((5 * tc + 1) >> 1)))
+
+    strong = filt & strong_line(0) & strong_line(3)  # (E, S)
+
+    tcl = tc[..., None]                              # broadcast to lines
+    c2 = 2 * tcl
+    p0s = jnp.clip((p2 + 2 * p1 + 2 * p0 + 2 * q0 + q1 + 4) >> 3,
+                   p0 - c2, p0 + c2)
+    p1s = jnp.clip((p2 + p1 + p0 + q0 + 2) >> 2, p1 - c2, p1 + c2)
+    p2s = jnp.clip((2 * p3 + 3 * p2 + p1 + p0 + q0 + 4) >> 3,
+                   p2 - c2, p2 + c2)
+    q0s = jnp.clip((q2 + 2 * q1 + 2 * q0 + 2 * p0 + p1 + 4) >> 3,
+                   q0 - c2, q0 + c2)
+    q1s = jnp.clip((q2 + q1 + q0 + p0 + 2) >> 2, q1 - c2, q1 + c2)
+    q2s = jnp.clip((2 * q3 + 3 * q2 + q1 + q0 + p0 + 4) >> 3,
+                   q2 - c2, q2 + c2)
+
+    # normal filter: per-line gate |delta| < 10*tc (8.7.2.5.7)
+    d0 = (9 * (q0 - p0) - 3 * (q1 - p1) + 8) >> 4
+    nf = jnp.abs(d0) < 10 * tcl
+    delta = jnp.clip(d0, -tcl, tcl)
+    p0n = jnp.clip(p0 + delta, 0, 255)
+    q0n = jnp.clip(q0 - delta, 0, 255)
+    thr_side = (beta + (beta >> 1)) >> 3
+    side_p = (dp03 < thr_side)[..., None]            # per segment
+    side_q = (dq03 < thr_side)[..., None]
+    tch = tcl >> 1
+    # sign asymmetry is spec: p0 moves by +delta, q0 by -delta, and each
+    # side's p1/q1 regression term carries its own side's sign
+    dp1 = jnp.clip((((p2 + p0 + 1) >> 1) - p1 + delta) >> 1, -tch, tch)
+    dq1 = jnp.clip((((q2 + q0 + 1) >> 1) - q1 - delta) >> 1, -tch, tch)
+    p1n = jnp.clip(p1 + dp1, 0, 255)
+    q1n = jnp.clip(q1 + dq1, 0, 255)
+
+    f = filt[..., None]
+    st = strong[..., None]
+    p0o = jnp.where(f & st, p0s, jnp.where(f & nf, p0n, p0))
+    q0o = jnp.where(f & st, q0s, jnp.where(f & nf, q0n, q0))
+    p1o = jnp.where(f & st, p1s,
+                    jnp.where(f & nf & side_p, p1n, p1))
+    q1o = jnp.where(f & st, q1s,
+                    jnp.where(f & nf & side_q, q1n, q1))
+    p2o = jnp.where(f & st, p2s, p2)
+    q2o = jnp.where(f & st, q2s, q2)
+    out = jnp.stack([p3, p2o, p1o, p0o, q0o, q1o, q2o, q3], axis=-1)
+    return out.reshape(e, l, 8)
+
+
+def _filter_chroma(win, qp):
+    """Spec 8.7.2.5.5: bS-2 chroma filter, win (E, L, 4) = [p1 p0 q0 q1].
+
+    No on/off decision beyond bS == 2 (which the caller guarantees);
+    tc indexed at qp + 2 because bS is always 2 here.
+    """
+    p1, p0, q0, q1 = win[..., 0], win[..., 1], win[..., 2], win[..., 3]
+    tc = jnp.asarray(TC_TBL)[jnp.clip(qp + 2, 0, 53)]
+    delta = jnp.clip((((q0 - p0) << 2) + p1 - q1 + 4) >> 3, -tc, tc)
+    p0o = jnp.clip(p0 + delta, 0, 255)
+    q0o = jnp.clip(q0 - delta, 0, 255)
+    return jnp.stack([p1, p0o, q0o, q1], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Passes: gather non-overlapping windows, filter, scatter back
+# ---------------------------------------------------------------------------
+
+def _luma_pass_v(plane, bs_v, qp):
+    """All vertical luma edges in one shot.  plane (H, W) int32;
+    bs_v (Ev, H16) per-cell -> repeated to 4-line segments."""
+    h, w = plane.shape
+    ev = w // 16 - 1
+    if ev <= 0:
+        return plane
+    xs = (jnp.arange(ev) + 1) * 16
+    cols = xs[:, None] + jnp.arange(-4, 4)[None, :]          # (Ev, 8)
+    win = jnp.swapaxes(plane[:, cols], 0, 1)                 # (Ev, H, 8)
+    bs_seg = jnp.repeat(bs_v, 4, axis=1)                     # (Ev, H//4)
+    out = _filter_luma(win, bs_seg, qp)
+    return plane.at[:, cols].set(jnp.swapaxes(out, 0, 1))
+
+
+def _luma_pass_h(plane, bs_h, qp):
+    """Horizontal edges = vertical pass on the transpose (the p side is
+    above the edge, which transposition maps to the left)."""
+    return _luma_pass_v(plane.T, bs_h, qp).T
+
+
+def _chroma_pass_v(plane, qp):
+    """Intra-picture chroma: every 16-chroma column is a bS-2 CTB/TU
+    boundary.  plane (Hc, Wc) int32."""
+    hc, wc = plane.shape
+    ev = wc // 16 - 1
+    if ev <= 0:
+        return plane
+    xs = (jnp.arange(ev) + 1) * 16
+    cols = xs[:, None] + jnp.arange(-2, 2)[None, :]          # (Ev, 4)
+    win = jnp.swapaxes(plane[:, cols], 0, 1)                 # (Ev, Hc, 4)
+    out = _filter_chroma(win, qp)
+    return plane.at[:, cols].set(jnp.swapaxes(out, 0, 1))
+
+
+def deblock_picture(y, u, v, *, qp, qpc, bs_v, bs_h, chroma: bool):
+    """Deblock one reconstructed picture per spec 8.7.2.
+
+    y (H, W), u/v (H/2, W/2) integer planes; ``qp``/``qpc`` traced
+    scalars; bS arrays from :func:`intra_bs` / :func:`p_bs`; ``chroma``
+    static (True only for intra pictures — chroma filters at bS 2).
+    Returns (y, u, v) int32 in [0, 255].
+    """
+    y = y.astype(jnp.int32)
+    y = _luma_pass_v(y, bs_v, qp)
+    y = _luma_pass_h(y, bs_h, qp)
+    u = u.astype(jnp.int32)
+    v = v.astype(jnp.int32)
+    if chroma:
+        u = _chroma_pass_v(u, qpc)
+        v = _chroma_pass_v(v, qpc)
+        u = _chroma_pass_v(u.T, qpc).T
+        v = _chroma_pass_v(v.T, qpc).T
+    return y, u, v

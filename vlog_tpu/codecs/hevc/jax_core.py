@@ -360,7 +360,8 @@ def from_blocks(blk, n):
 
 
 def encode_p_frame_dsp(y, u, v, ref_y, ref_u, ref_v, qp, *,
-                       search: int = 16, partitions: bool = True):
+                       search: int = 16, partitions: bool = True,
+                       deblock: bool = False):
     """One P frame against the previous reconstruction. Every CTB is
     inter; per CTB the motion field is 2Nx2N (one MV), 2NxN or Nx2N
     (two MVs) — chosen where the independently-refined 16-cell MVs
@@ -368,7 +369,8 @@ def encode_p_frame_dsp(y, u, v, ref_y, ref_u, ref_v, qp, *,
     evaluations. Returns per-CTB partition codes, the 16-cell MV map,
     BOTH residual codings (TU32+chroma16 for 2Nx2N; four TU16 + 8x8
     chroma sub-TUs for two-part CTBs — entropy picks by partition), and
-    the recon consistent with the decision."""
+    the recon consistent with the decision (in-loop deblocked per
+    spec 8.7.2 when ``deblock`` — the reference a decoder would hold)."""
     qp = jnp.asarray(qp, jnp.int32)
     qpc = chroma_qp_traced(qp)
     # luma pad: integer reach + 1 refinement pel + 4-tap reach + the
@@ -388,9 +390,10 @@ def encode_p_frame_dsp(y, u, v, ref_y, ref_u, ref_v, qp, *,
         # covers two-part CUs)
         part = jnp.zeros((rr, cc), jnp.int32)
         mv_map = jnp.repeat(jnp.repeat(mv32, 2, 0), 2, 1)
-        return _p_residuals_and_recon(
+        out = _p_residuals_and_recon(
             y, u, v, cur, hplanes, mv_map, part, qp, qpc, pad, search,
             ref_u, ref_v, partitions=False)
+        return _deblock_p(out, qp, qpc) if deblock else out
     mv16, _ = _p_ctb_search(cur, refp, hplanes, search=search,
                             pad=pad, n=16)
 
@@ -449,14 +452,39 @@ def encode_p_frame_dsp(y, u, v, ref_y, ref_u, ref_v, qp, *,
                        jnp.where(part_cells == PART_2NxN, mvh_cells,
                                  mvv_cells))
 
-    return _p_residuals_and_recon(
+    out = _p_residuals_and_recon(
         y, u, v, cur, hplanes, mv_map, part, qp, qpc, pad, search,
         ref_u, ref_v)
+    return _deblock_p(out, qp, qpc) if deblock else out
+
+
+def _deblock_p(out, qp, qpc):
+    """Apply spec 8.7.2 to a P recon.  Luma-TB cbf drives the bS-1
+    condition (what libavcodec's boundary-strength pass reads); the TU
+    grid is TU32 for 2Nx2N CTBs and TU16 inside partitioned ones, so
+    per-16-cell cbf selects by partition.  Chroma needs bS 2 (intra) —
+    never on P pictures — so only luma is filtered."""
+    from vlog_tpu.codecs.hevc import deblock as dbk
+
+    (lv32, lv16, part, mv_map, (ry, ru, rv)) = out
+    cbf32 = jnp.any(lv32[0] != 0, axis=(-1, -2))          # (R, C)
+    cell_cbf = jnp.repeat(jnp.repeat(cbf32, 2, 0), 2, 1)  # (2R, 2C)
+    if lv16 is not None:
+        cbf16 = jnp.any(lv16[0] != 0, axis=(-1, -2))      # (2R, 2C)
+        part_cells = jnp.repeat(jnp.repeat(part, 2, 0), 2, 1)
+        cell_cbf = jnp.where(part_cells == PART_2Nx2N, cell_cbf, cbf16)
+    bs_v, bs_h = dbk.p_bs(part, cell_cbf, mv_map)
+    dy, du, dv = dbk.deblock_picture(
+        ry, ru, rv, qp=qp, qpc=qpc, bs_v=bs_v, bs_h=bs_h, chroma=False)
+    return (lv32, lv16, part, mv_map,
+            (dy.astype(jnp.uint8), du.astype(jnp.uint8),
+             dv.astype(jnp.uint8)))
 
 
 
-@partial(jax.jit, static_argnums=(3, 6))
-def encode_chain_dsp(y, u, v, search, qp_i, qp_p, partitions=False):
+@partial(jax.jit, static_argnums=(3, 6, 7))
+def encode_chain_dsp(y, u, v, search, qp_i, qp_p, partitions=False,
+                     deblock=False):
     """I + P chain: frame 0 intra (row-scan), frames 1.. inter against
     the running reconstruction (lax.scan carry). Inputs (T, H, W) padded
     planes; returns intra levels, per-P levels/MVs, and recons.
@@ -471,13 +499,14 @@ def encode_chain_dsp(y, u, v, search, qp_i, qp_p, partitions=False):
     t = y.shape[0]
     qp_p = jnp.broadcast_to(jnp.asarray(qp_p, jnp.int32).reshape(-1),
                             (max(t - 1, 1),))
-    (li, lui, lvi), (ry, ru, rv) = encode_frame_dsp(y[0], u[0], v[0], qp_i)
+    (li, lui, lvi), (ry, ru, rv) = encode_frame_dsp(
+        y[0], u[0], v[0], qp_i, deblock=deblock)
 
     def step(carry, frame):
         fy, fu, fv, qpf = frame
         lv32, lv16, part, mv_map, recon = encode_p_frame_dsp(
             fy, fu, fv, *carry, qpf, search=search,
-            partitions=partitions)
+            partitions=partitions, deblock=deblock)
         return recon, (lv32, lv16, part, mv_map, recon)
 
     if t > 1:
@@ -488,16 +517,32 @@ def encode_chain_dsp(y, u, v, search, qp_i, qp_p, partitions=False):
     return ((li, lui, lvi), (ry, ru, rv)), (p32, p16, parts, mvs, precons)
 
 
-@partial(jax.jit, static_argnums=())
-def encode_frame_dsp(y, u, v, qp):
+@partial(jax.jit, static_argnames=("deblock",))
+def encode_frame_dsp(y, u, v, qp, *, deblock=False):
     """Device pass for one padded frame: returns per-CTB quantized levels
-    and the bit-exact reconstruction for all three planes."""
+    and the bit-exact reconstruction for all three planes (spec-8.7.2
+    deblocked when ``deblock`` — intra pictures filter luma AND chroma,
+    every TU edge at bS 2)."""
+    from vlog_tpu.codecs.hevc import deblock as dbk
+
     qp = jnp.asarray(qp, jnp.int32)
     qpc = chroma_qp_traced(qp)
     ly, ry = _encode_plane(y, qp, jnp.asarray(T32), 32)
     lu, ru = _encode_plane(u, qpc, jnp.asarray(T16), 16)
     lv, rv = _encode_plane(v, qpc, jnp.asarray(T16), 16)
+    if deblock:
+        h, w = y.shape
+        bs_v, bs_h = dbk.intra_bs(h // 32, w // 32)
+        dy, du, dv = dbk.deblock_picture(
+            ry, ru, rv, qp=qp, qpc=qpc, bs_v=bs_v, bs_h=bs_h,
+            chroma=True)
+        ry, ru, rv = (dy.astype(jnp.uint8), du.astype(jnp.uint8),
+                      dv.astype(jnp.uint8))
     return (ly, lu, lv), (ry, ru, rv)
 
 
-encode_batch_dsp = jax.jit(jax.vmap(encode_frame_dsp, in_axes=(0, 0, 0, 0)))
+@partial(jax.jit, static_argnames=("deblock",))
+def encode_batch_dsp(y, u, v, qps, deblock=False):
+    return jax.vmap(
+        lambda a, b, c, q: encode_frame_dsp(a, b, c, q, deblock=deblock)
+    )(y, u, v, qps)

@@ -170,7 +170,7 @@ def write_sps(width: int, height: int) -> NalUnit:
     return NalUnit(NAL_SPS, w.getvalue())
 
 
-def write_pps() -> NalUnit:
+def write_pps(deblock: bool = False) -> NalUnit:
     w = BitWriter()
     w.write_ue(0)            # pps_pic_parameter_set_id
     w.write_ue(0)            # pps_seq_parameter_set_id
@@ -193,10 +193,18 @@ def write_pps() -> NalUnit:
     w.write_bit(0)           # transquant_bypass_enabled_flag
     w.write_bit(0)           # tiles_enabled_flag
     w.write_bit(0)           # entropy_coding_sync_enabled_flag
-    w.write_bit(1)           # pps_loop_filter_across_slices_enabled_flag
-    w.write_bit(1)           # deblocking_filter_control_present_flag
-    w.write_bit(0)           # deblocking_filter_override_enabled_flag
-    w.write_bit(1)           # pps_deblocking_filter_disabled_flag
+    # across-slices off keeps slice headers free of the across-slices
+    # flag when deblocking is on (7.3.6.1 gates it on this && !disabled)
+    # — pictures are single-slice, so the flag is moot either way
+    w.write_bit(0)           # pps_loop_filter_across_slices_enabled_flag
+    if deblock:
+        # control_present 0 -> 8.7.2 runs with zero beta/tc offsets and
+        # no override; nothing more appears here or in slice headers
+        w.write_bit(0)       # deblocking_filter_control_present_flag
+    else:
+        w.write_bit(1)       # deblocking_filter_control_present_flag
+        w.write_bit(0)       # deblocking_filter_override_enabled_flag
+        w.write_bit(1)       # pps_deblocking_filter_disabled_flag
     w.write_bit(0)           # pps_scaling_list_data_present_flag
     w.write_bit(0)           # lists_modification_present_flag
     w.write_ue(0)            # log2_parallel_merge_level_minus2

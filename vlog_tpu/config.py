@@ -251,6 +251,8 @@ AV1_SPEED: int = _env_int("VLOG_AV1_SPEED", 8, lo=0, hi=8)
 # for mixed content and partitioned slices entropy-code in Python —
 # opt-in until both are resolved).
 HEVC_PARTITIONS: bool = _env_bool("VLOG_HEVC_PARTITIONS", False)
+# Spec-8.7.2 in-loop deblocking in the HEVC DSP (codecs/hevc/deblock.py)
+HEVC_DEBLOCK: bool = _env_bool("VLOG_HEVC_DEBLOCK", True)
 # Frames per device-batch staged to HBM per encode dispatch. GOP size for the
 # all-intra encoder is a packaging concept (segment boundary), so this is a
 # pure throughput/memory knob.

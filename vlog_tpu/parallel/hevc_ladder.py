@@ -43,10 +43,27 @@ def _pad_ctb(y, u, v):
     return y, u, v
 
 
-@functools.lru_cache(maxsize=8)
 def hevc_chain_ladder_program(rungs: tuple[RungSpec, ...], src_h: int,
                               src_w: int, search: int = 16,
-                              mesh: Mesh | None = None
+                              mesh: Mesh | None = None,
+                              deblock: bool | None = None
+                              ) -> tuple[Callable, dict]:
+    """Resolve ``deblock`` (None -> config.HEVC_DEBLOCK) OUTSIDE the
+    cache: resolving inside would let two different config states share
+    one cache entry (tests monkeypatch the flag)."""
+    if deblock is None:
+        from vlog_tpu import config
+
+        deblock = config.HEVC_DEBLOCK
+    return _hevc_chain_ladder_cached(rungs, src_h, src_w, search, mesh,
+                                     bool(deblock))
+
+
+@functools.lru_cache(maxsize=8)
+def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
+                              src_w: int, search: int,
+                              mesh: Mesh | None,
+                              deblock: bool
                               ) -> tuple[Callable, dict]:
     """``fn(y, u, v, mats, qps)`` with y/u/v (n_chains, clen, ...) uint8
     and ``qps`` mapping rung -> (n_chains, clen) int32 (frame 0's value
@@ -72,7 +89,7 @@ def hevc_chain_ladder_program(rungs: tuple[RungSpec, ...], src_h: int,
             qp_i = jnp.maximum(10, q[0] - 2)
             qp_p = q[1:] if clen > 1 else q
             (intra, recon0), (p32, _, _, mvs, precons) = encode_chain_dsp(
-                cy, cu, cv, search, qp_i, qp_p, False)
+                cy, cu, cv, search, qp_i, qp_p, False, deblock)
             # display-region SSE per frame (recons stay on device)
             r0 = recon0[0][:h, :w].astype(jnp.float32)
             sse0 = jnp.sum((r0 - cy[0][:h, :w].astype(jnp.float32)) ** 2)
