@@ -208,3 +208,53 @@ def test_controller_recovers_undershoot_debt_too():
         n += 8
     # after the banked credit drains, normal content re-converges
     assert abs(total / n - target_bpf) / target_bpf < 0.35
+
+
+def test_device_inchain_adaptation_reacts_within_chain():
+    """ladder_chain_program's rc arg: a mid-chain noise burst must raise
+    QP on the NEXT frame (the host controller can only react a whole
+    chain later — the failure mode that shipped 3-4x-hot chains)."""
+    import numpy as np
+
+    from vlog_tpu.parallel.ladder import ladder_chain_program
+
+    rungs = (("64p", 64, 96, 30),)
+    fn, mats = ladder_chain_program(rungs, 64, 96, search=4, deblock=True)
+    rng = np.random.default_rng(0)
+    clen = 8
+    y = np.full((1, clen, 64, 96), 120, np.uint8)
+    u = np.full((1, clen, 32, 48), 128, np.uint8)
+    v = u.copy()
+    y[0, 4:] = rng.integers(0, 256, (clen - 4, 64, 96), np.uint8)
+    qps = {"64p": np.full((1, clen), 30, np.int32)}
+    qps["64p"][:, 0] = 28
+    rc = {"64p": {"budget": np.float32(200.0), "alpha": np.float32(0.3)}}
+    out = fn(y, u, v, mats, qps, rc)["64p"]
+    qe = np.asarray(out["qp_eff"])[0]
+    cost = np.asarray(out["cost"])[0]
+    assert qe[0] == 28                         # intra anchor untouched
+    assert (qe[1:4] <= 30).all()               # flat frames: no debt
+    assert (qe[5:] > 30).any(), qe             # burst -> QP up next frame
+    assert cost[4] > 50 * max(cost[1], 1.0)    # proxy saw the burst
+    # without rc the program is the legacy one (no qp_eff/cost keys)
+    legacy = fn(y, u, v, mats, qps)["64p"]
+    assert "qp_eff" not in legacy and "cost" not in legacy
+
+
+def test_device_inchain_adaptation_uncalibrated_is_openloop():
+    """alpha == 0 (first dispatch) must leave every QP at plan."""
+    import numpy as np
+
+    from vlog_tpu.parallel.ladder import ladder_chain_program
+
+    rungs = (("64p", 64, 96, 30),)
+    fn, mats = ladder_chain_program(rungs, 64, 96, search=4, deblock=True)
+    rng = np.random.default_rng(1)
+    clen = 4
+    y = rng.integers(0, 256, (1, clen, 64, 96)).astype(np.uint8)
+    u = rng.integers(0, 256, (1, clen, 32, 48)).astype(np.uint8)
+    v = rng.integers(0, 256, (1, clen, 32, 48)).astype(np.uint8)
+    qps = {"64p": np.full((1, clen), 30, np.int32)}
+    rc = {"64p": {"budget": np.float32(50.0), "alpha": np.float32(0.0)}}
+    out = fn(y, u, v, mats, qps, rc)["64p"]
+    assert (np.asarray(out["qp_eff"]) == qps["64p"]).all()

@@ -366,6 +366,12 @@ class JaxBackend:
             for r in plan.rungs
         }
         npix = {r.name: r.height * r.width for r in plan.rungs}
+        # Device-side in-chain adaptation (ladder_chain_program rc arg):
+        # bytes-per-proxy-unit calibration per rung, EMA-updated from
+        # each chain batch's realized bytes.  0 = uncalibrated (first
+        # dispatch runs open-loop; the host controller still converges
+        # across chains as before).
+        alpha_cal = {r.name: 0.0 for r in plan.rungs}
 
         # Stage accounting: decode_wait = blocked on the prefetch fifo;
         # device_pull = blocked on np.asarray of dispatch outputs (device
@@ -395,12 +401,21 @@ class JaxBackend:
                         chains_per * clen).reshape(chains_per, clen)
                     q[:, 0] = np.maximum(q[:, 0] - 2, 0)
                     qps[r.name] = q
+                # per-rung device RC params; budget 0-target rungs get
+                # alpha 0 (never calibrated below), disabling adjustment
+                rc = {r.name: {
+                    "budget": np.float32(max(
+                        controllers[r.name].target_bytes_per_frame, 1.0)),
+                    "alpha": np.float32(alpha_cal[r.name])}
+                    for r in plan.rungs}
             else:
                 qps = {r.name: controllers[r.name].frame_qps(batch_n)
                        for r in plan.rungs}
             if mesh is not None:
                 by, bu, bv = shard_frames(mesh, by, bu, bv)
                 qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
+            if chain_mode:
+                return fn(by, bu, bv, mats, qps, rc), n_real, qps
             return fn(by, bu, bv, mats, qps), n_real, qps
 
         # One long-lived entropy pool for chain mode (frames across a
@@ -429,18 +444,30 @@ class JaxBackend:
                          "p_chroma_ac", "mv")}
                 prof["device_pull_s"] += time.perf_counter() - tp
                 te = time.perf_counter()
-                qarr = np.asarray(qps[name])              # (nc, clen)
+                # the QPs the device ACTUALLY encoded at (plan + in-chain
+                # adjustment) — slice headers must signal these
+                qarr = np.asarray(ro["qp_eff"])           # (nc, clen)
+                cost = np.asarray(ro["cost"])             # (nc, clen)
                 batch_bytes = 0
                 n_frames = 0
+                cost_sum = 0.0
                 rc_qs = []   # P-frame dither values: the working-point
                 #              mix the controller must attribute to (the
                 #              I frames carry the -2 anchor, excluded)
+                plan_q = np.asarray(qps[name])            # (nc, clen)
                 for ci in range(chains_per):
                     base = ci * clen
                     if base >= n_real:
                         break
                     keep = min(clen, n_real - base)
-                    rc_qs.append(qarr[ci, 1:keep])
+                    # attribute to the PLAN (outer-loop) working point,
+                    # not qp_eff: the device's in-chain bumps are the
+                    # inner loop of a cascade — if the host attributed
+                    # to the realized QPs, its own corrective step would
+                    # cancel against the attribution shift and the plan
+                    # would never converge (measured: stuck 28% under)
+                    rc_qs.append(plan_q[ci, 1:keep])
+                    cost_sum += float(cost[ci, :keep].sum())
                     lv0 = FrameLevels(
                         luma_dc=i32(host["i_luma_dc"][ci]),
                         luma_ac=i32(host["i_luma_ac"][ci]),
@@ -472,6 +499,13 @@ class JaxBackend:
                     rc_mix = None
                 controllers[name].observe(batch_bytes, max(n_frames, 1),
                                           frame_qps=rc_mix)
+                # calibrate the device RC's bytes-per-proxy scalar from
+                # what this batch actually packed (EMA after first fix)
+                if controllers[name].target_bps > 0 and cost_sum > 0:
+                    a_obs = batch_bytes / cost_sum
+                    alpha_cal[name] = (a_obs if alpha_cal[name] == 0
+                                       else 0.5 * alpha_cal[name]
+                                       + 0.5 * a_obs)
                 prof["entropy_s"] += time.perf_counter() - te
                 tw = time.perf_counter()
                 while len(pending[name]) >= frames_per_seg:

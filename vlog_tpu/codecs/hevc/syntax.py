@@ -9,10 +9,13 @@ for the H.264 path, adapted to H.265):
 - Picture dimensions padded up to multiples of 32; the true size is
   restored by the SPS conformance window (same crop mechanism H.264's
   frame_cropping serves).
-- SAO off, deblocking off (PPS), no tiles/WPP: recon is pred+residual
-  exactly, so the encoder's device reconstruction matches any spec
-  decoder bit-for-bit — tests/test_hevc.py asserts this against
-  libavcodec.
+- SAO off, no tiles/WPP.  Deblocking is CONFIGURABLE (write_pps's
+  ``deblock`` arg, config.HEVC_DEBLOCK, default on): when signalled on,
+  the DSP runs spec 8.7.2 in-loop (codecs/hevc/deblock.py) so recon is
+  pred+residual+filter; when off, recon is pred+residual exactly.  The
+  PPS flag and the DSP flag must always agree — either way the
+  encoder's device reconstruction matches any spec decoder
+  bit-for-bit, which tests/test_hevc.py asserts against libavcodec.
 - One slice per picture, entropy: CABAC (codecs/hevc/cabac.py).
 
 Reference parity: the reference's HEVC rungs come from hevc_nvenc /

@@ -74,9 +74,14 @@ def run(n_devices: int) -> None:
     cv = rng.integers(0, 256, (n_devices, clen, h // 2, w // 2)).astype(np.uint8)
     qps = {name: np.full((n_devices, clen), qp, np.int32)
            for name, _, _, qp in rungs}
+    # exercise the device-side in-chain rate adaptation exactly as the
+    # production backend dispatches it (alpha > 0 -> adjustment live)
+    rc = {name: {"budget": np.float32(2000.0),
+                 "alpha": np.float32(0.5)}
+          for name, _, _, _ in rungs}
     cy, cu, cv = shard_frames(mesh, cy, cu, cv)
     qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
-    couts = cfn(cy, cu, cv, cmats, qps)
+    couts = cfn(cy, cu, cv, cmats, qps, rc)
     jax.block_until_ready(couts)
     for name, _, _, _ in rungs:
         ro = couts[name]

@@ -152,6 +152,23 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
     (H264Encoder(deblock=True)), and SSE measures the filtered picture
     (what a decoder displays).
 
+    **Device-side in-chain rate adaptation.**  ``fn`` takes an optional
+    6th arg ``rc`` mapping rung -> {"budget": f32 bytes/frame, "alpha":
+    f32 bytes/proxy-unit}.  The host controller observes once per chain
+    dispatch, so a scene cut or noise burst used to ship a whole hot
+    chain before any correction (measured 3-4x over budget for 24
+    frames).  With ``rc``, the frame scan carries a byte balance: each
+    frame's quantized levels yield a bits proxy (nnz + sum log2(1+|l|),
+    the shape of CAVLC/CABAC coeff cost), ``alpha`` converts it to
+    bytes, and the NEXT frame's QP gets ``trunc(balance/(3*budget))``
+    clamped to [-1, +8] — pay debt aggressively (a burst raises QP one
+    frame later, not one chain later), spend credit one QP at a time
+    (the same asymmetry as backends/rate_control.py).  ``alpha`` is
+    EMA-calibrated by the host from realized chain bytes; alpha==0
+    (first dispatch) disables adjustment.  With ``rc`` the outputs gain
+    "qp_eff" (n, clen) int16 — the QPs the entropy stage must signal —
+    and "cost" (n, clen) f32 for the host's alpha update.
+
     Per rung output (int16 levels, device-only recon):
       i_luma_dc/(n,4,4) i_luma_ac i_chroma_dc i_chroma_ac   — frame 0
       p_luma (n, clen-1, mbh, mbw, 4,4,4,4), p_chroma_dc, p_chroma_ac
@@ -161,7 +178,18 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
     from vlog_tpu.codecs.h264.encoder import encode_frame
     from vlog_tpu.codecs.h264.inter import encode_p_frame
 
-    def one_rung(y, u, v, rung_mats, qps, h, w):
+    def _proxy(*level_arrays):
+        """Per-chain bits proxy over one frame's level tensors: nnz +
+        sum log2(1+|l|) — the shape of entropy-coded coefficient cost.
+        Each array is (n, ...); reduces all but the chain axis."""
+        tot = 0.0
+        for a in level_arrays:
+            af = jnp.abs(a.astype(jnp.float32))
+            axes = tuple(range(1, a.ndim))
+            tot = tot + jnp.sum((af > 0) + jnp.log2(1.0 + af), axis=axes)
+        return tot                                           # (n,)
+
+    def one_rung(y, u, v, rung_mats, qps, h, w, rcr=None):
         # y: (n, clen, H, W) local chains; resize whole block at once
         n, clen = y.shape[0], y.shape[1]
         flat = lambda p: p.reshape((n * clen,) + p.shape[2:])
@@ -186,10 +214,29 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
         sse0 = jnp.sum(
             (i_rec[0][:, :h, :w].astype(jnp.float32)
              - ry[:, 0].astype(jnp.float32)) ** 2, axis=(1, 2))
+        if rcr is not None:
+            budget = jnp.maximum(
+                jnp.asarray(rcr["budget"], jnp.float32), 1.0)
+            alpha = jnp.asarray(rcr["alpha"], jnp.float32)
+            cost0 = _proxy(i_out["luma_dc"], i_out["luma_ac"],
+                           i_out["chroma_dc"], i_out["chroma_ac"])
+            # balance starts at ZERO: the I frame's overspend vs the
+            # per-frame budget is PLANNED (the -2 anchor pays off down
+            # the chain) and the host's outer loop already accounts for
+            # it across chains — charging it here would tax the first P
+            # frames of every chain with +1..2 QP right after each IDR
+            bal0 = jnp.zeros_like(cost0)
 
         def step(carry, xs):
-            ref_y, ref_u, ref_v = carry
-            cy, cu, cv, q, src_y = xs
+            if rcr is None:
+                ref_y, ref_u, ref_v = carry
+                cy, cu, cv, q, src_y = xs
+            else:
+                (ref_y, ref_u, ref_v), bal = carry
+                cy, cu, cv, q_plan, src_y = xs
+                adj = jnp.clip(jnp.trunc(bal / (3.0 * budget)),
+                               -1.0, 8.0).astype(jnp.int32)
+                q = jnp.clip(q_plan + adj, 10, 51)
             pout = jax.vmap(
                 lambda a, b, c, r1, r2, r3, qq: encode_p_frame(
                     a, b, c, r1, r2, r3, qp=qq, search=search)
@@ -217,17 +264,29 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
                 "mv": pout["mv"].astype(jnp.int16),
                 "sse": sse,
             }
-            return (rec, out)
+            if rcr is None:
+                return (rec, out)
+            cost = _proxy(pout["luma"], pout["chroma_dc"],
+                          pout["chroma_ac"])
+            # anti-windup: credit bottoms at 3 frames of budget (a long
+            # easy stretch must not delay the response to a burst by
+            # more than a frame), debt tops at what +8 QP can repay
+            bal = jnp.clip(
+                bal + jnp.where(alpha > 0, cost * alpha - budget, 0.0),
+                -3.0 * budget, 30.0 * budget)
+            out["qp_eff"] = q.astype(jnp.int16)
+            out["cost"] = cost
+            return ((rec, bal), out)
 
         t_axis = lambda p: jnp.moveaxis(p[:, 1:], 1, 0)  # (clen-1, n, ...)
         _, scanned = jax.lax.scan(
             step,
-            i_rec,
+            i_rec if rcr is None else (i_rec, bal0),
             (t_axis(py), t_axis(pu), t_axis(pv),
              jnp.moveaxis(qps[:, 1:], 1, 0), t_axis(ry)),
         )
         chain_first = lambda p: jnp.moveaxis(p, 0, 1)    # (n, clen-1, ...)
-        return {
+        out = {
             "i_luma_dc": i_out["luma_dc"].astype(jnp.int16),
             "i_luma_ac": i_out["luma_ac"].astype(jnp.int16),
             "i_chroma_dc": i_out["chroma_dc"].astype(jnp.int16),
@@ -239,9 +298,17 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
             "sse_y": jnp.concatenate(
                 [sse0[:, None], chain_first(scanned["sse"])], axis=1),
         }
+        if rcr is not None:
+            out["qp_eff"] = jnp.concatenate(
+                [qps[:, :1].astype(jnp.int16),
+                 chain_first(scanned["qp_eff"])], axis=1)
+            out["cost"] = jnp.concatenate(
+                [cost0[:, None], chain_first(scanned["cost"])], axis=1)
+        return out
 
-    def local(y, u, v, mats, qps):
-        return {name: one_rung(y, u, v, mats[name], qps[name], h, w)
+    def local(y, u, v, mats, qps, rc=None):
+        return {name: one_rung(y, u, v, mats[name], qps[name], h, w,
+                               None if rc is None else rc[name])
                 for name, h, w, qp in rungs}
 
     mats = ladder_matrices(rungs, src_h, src_w)
@@ -249,7 +316,7 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
         return jax.jit(local), jax.device_put(mats)
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P("data"), P("data"), P("data"), P(), P("data")),
+        in_specs=(P("data"), P("data"), P("data"), P(), P("data"), P()),
         out_specs=P("data"),
         check_vma=False,
     )
