@@ -258,3 +258,28 @@ def test_device_inchain_adaptation_uncalibrated_is_openloop():
     rc = {"64p": {"budget": np.float32(50.0), "alpha": np.float32(0.0)}}
     out = fn(y, u, v, mats, qps, rc)["64p"]
     assert (np.asarray(out["qp_eff"]) == qps["64p"]).all()
+
+
+def test_hevc_device_inchain_adaptation():
+    """Same cascade on the HEVC fused ladder: burst -> QP up next frame;
+    no rc -> legacy outputs."""
+    import numpy as np
+
+    from vlog_tpu.parallel.hevc_ladder import hevc_chain_ladder_program
+
+    rungs = (("64p", 64, 96, 30),)
+    fn, mats = hevc_chain_ladder_program(rungs, 64, 96, search=4)
+    rng = np.random.default_rng(0)
+    clen = 8
+    y = np.full((1, clen, 64, 96), 120, np.uint8)
+    u = np.full((1, clen, 32, 48), 128, np.uint8)
+    v = u.copy()
+    y[0, 4:] = rng.integers(0, 256, (clen - 4, 64, 96), np.uint8)
+    qps = {"64p": np.full((1, clen), 30, np.int32)}
+    rc = {"64p": {"budget": np.float32(200.0), "alpha": np.float32(0.3)}}
+    out = fn(y, u, v, mats, qps, rc)["64p"]
+    qe = np.asarray(out["qp_eff"])[0]
+    assert qe[0] == 30                     # plan slot; anchor derived later
+    assert (qe[5:] > 30).any(), qe
+    legacy = fn(y, u, v, mats, qps)["64p"]
+    assert "qp_eff" not in legacy

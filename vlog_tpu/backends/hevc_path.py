@@ -170,10 +170,12 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                 q = controllers[r.name].frame_qps(
                     chains_per * clen).reshape(chains_per, clen)
                 qps[r.name] = q       # the program applies the I -2 anchor
+            rc = {r.name: controllers[r.name].device_rc_params()
+                  for r in plan.rungs}
             if mesh is not None:
                 by, bu, bv = shard_frames(mesh, by, bu, bv)
                 qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
-            return fn(by, bu, bv, mats, qps), n_real, qps
+            return fn(by, bu, bv, mats, qps, rc), n_real, qps
 
         def consume(outs, n_real, qps):
             nonlocal frames_done
@@ -185,18 +187,24 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                         ("i_luma", "i_cb", "i_cr", "p_luma", "p_cb",
                          "p_cr", "mv")}
                 sse = np.asarray(ro["sse_y"])            # (nc, clen)
-                qarr = np.asarray(qps[name])
+                plan_q = np.asarray(qps[name])
+                # the QPs the device ACTUALLY encoded at (plan + in-chain
+                # adjustment) — slice headers must signal these; the
+                # controller still attributes to PLAN (cascade outer loop)
+                qarr = np.asarray(ro["qp_eff"])
+                cost = np.asarray(ro["cost"])
                 batch_bytes = 0
                 n_frames = 0
-                rc_qs = []   # realized working-point dither (the HEVC
-                #              program applies its I -2 anchor internally,
-                #              so qarr IS the controller's mix)
+                cost_sum = 0.0
+                rc_qs = []   # plan working-point dither (the HEVC
+                #              program applies its I -2 anchor internally)
                 for ci in range(chains_per):
                     base = ci * clen
                     if base >= n_real:
                         break
                     keep = min(clen, n_real - base)
-                    rc_qs.append(qarr[ci, :keep])
+                    rc_qs.append(plan_q[ci, :keep])
+                    cost_sum += float(cost[ci, :keep].sum())
                     mse = np.maximum(sse[ci, :keep] / npix[name], 1e-12)
                     psnrs = np.where(mse < 1e-9, 99.0,
                                      10 * np.log10(255.0 ** 2 / mse))
@@ -219,6 +227,7 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                 controllers[name].observe(
                     batch_bytes, max(n_frames, 1),
                     frame_qps=(np.concatenate(rc_qs) if rc_qs else None))
+                controllers[name].calibrate_proxy(batch_bytes, cost_sum)
                 while len(pending[name]) >= frames_per_seg:
                     chunk = pending[name][:frames_per_seg]
                     pending[name] = pending[name][frames_per_seg:]

@@ -366,12 +366,6 @@ class JaxBackend:
             for r in plan.rungs
         }
         npix = {r.name: r.height * r.width for r in plan.rungs}
-        # Device-side in-chain adaptation (ladder_chain_program rc arg):
-        # bytes-per-proxy-unit calibration per rung, EMA-updated from
-        # each chain batch's realized bytes.  0 = uncalibrated (first
-        # dispatch runs open-loop; the host controller still converges
-        # across chains as before).
-        alpha_cal = {r.name: 0.0 for r in plan.rungs}
 
         # Stage accounting: decode_wait = blocked on the prefetch fifo;
         # device_pull = blocked on np.asarray of dispatch outputs (device
@@ -401,13 +395,10 @@ class JaxBackend:
                         chains_per * clen).reshape(chains_per, clen)
                     q[:, 0] = np.maximum(q[:, 0] - 2, 0)
                     qps[r.name] = q
-                # per-rung device RC params; budget 0-target rungs get
-                # alpha 0 (never calibrated below), disabling adjustment
-                rc = {r.name: {
-                    "budget": np.float32(max(
-                        controllers[r.name].target_bytes_per_frame, 1.0)),
-                    "alpha": np.float32(alpha_cal[r.name])}
-                    for r in plan.rungs}
+                # per-rung device RC params; zero-target rungs keep
+                # alpha 0 (calibrate_proxy no-ops), disabling adjustment
+                rc = {r.name: controllers[r.name].device_rc_params()
+                      for r in plan.rungs}
             else:
                 qps = {r.name: controllers[r.name].frame_qps(batch_n)
                        for r in plan.rungs}
@@ -500,12 +491,8 @@ class JaxBackend:
                 controllers[name].observe(batch_bytes, max(n_frames, 1),
                                           frame_qps=rc_mix)
                 # calibrate the device RC's bytes-per-proxy scalar from
-                # what this batch actually packed (EMA after first fix)
-                if controllers[name].target_bps > 0 and cost_sum > 0:
-                    a_obs = batch_bytes / cost_sum
-                    alpha_cal[name] = (a_obs if alpha_cal[name] == 0
-                                       else 0.5 * alpha_cal[name]
-                                       + 0.5 * a_obs)
+                # what this batch actually packed
+                controllers[name].calibrate_proxy(batch_bytes, cost_sum)
                 prof["entropy_s"] += time.perf_counter() - te
                 tw = time.perf_counter()
                 while len(pending[name]) >= frames_per_seg:

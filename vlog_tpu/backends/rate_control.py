@@ -69,6 +69,7 @@ class RateController:
     _calibrating: bool = field(default=True, init=False)
     _hunting: bool = field(default=True, init=False)
     _debt_bytes: float = field(default=0.0, init=False)
+    _proxy_alpha: float = field(default=0.0, init=False)
 
     def __post_init__(self) -> None:
         self._q = float(self.init_qp)
@@ -93,6 +94,27 @@ class RateController:
     @property
     def target_bytes_per_frame(self) -> float:
         return self.target_bps / 8.0 / self.fps if self.fps else 0.0
+
+    # ---- device-side in-chain cascade (ops/bitproxy.py) --------------
+    # The chain programs adapt QP per FRAME on device from a bits proxy;
+    # this controller is the outer loop and owns the bytes-per-proxy
+    # calibration both backends share.
+
+    def device_rc_params(self) -> dict:
+        """The rc pytree a chain-ladder dispatch takes (alpha 0 until
+        the first batch calibrates -> device runs open-loop)."""
+        return {"budget": np.float32(
+                    max(self.target_bytes_per_frame, 1.0)),
+                "alpha": np.float32(self._proxy_alpha)}
+
+    def calibrate_proxy(self, batch_bytes: float, cost_sum: float) -> None:
+        """EMA the realized bytes-per-proxy-unit from one chain batch.
+        No-op for constant-QP rungs (no target) or empty batches."""
+        if self.target_bps <= 0 or cost_sum <= 0:
+            return
+        a = batch_bytes / cost_sum
+        self._proxy_alpha = (a if self._proxy_alpha == 0
+                             else 0.5 * self._proxy_alpha + 0.5 * a)
 
     def frame_qps(self, n: int) -> np.ndarray:
         """Per-frame integer QPs whose mix realizes the fractional

@@ -482,9 +482,12 @@ def _deblock_p(out, qp, qpc):
 
 
 
+from vlog_tpu.ops.bitproxy import cost_proxy as _cost_proxy  # noqa: E402
+
+
 @partial(jax.jit, static_argnums=(3, 6, 7))
 def encode_chain_dsp(y, u, v, search, qp_i, qp_p, partitions=False,
-                     deblock=False):
+                     deblock=False, rc=None):
     """I + P chain: frame 0 intra (row-scan), frames 1.. inter against
     the running reconstruction (lax.scan carry). Inputs (T, H, W) padded
     planes; returns intra levels, per-P levels/MVs, and recons.
@@ -494,27 +497,70 @@ def encode_chain_dsp(y, u, v, search, qp_i, qp_p, partitions=False,
     ``qp_p`` may be a scalar or a (T-1,) per-frame vector — the rate
     controller's fractional working point is realized by dithering
     integer QPs across the chain (rate_control.frame_qps), so it rides
-    the scan as a per-step input."""
+    the scan as a per-step input.
+
+    ``rc`` (optional {"budget": f32 bytes/frame, "alpha": f32 bytes per
+    proxy unit}) enables device-side in-chain rate adaptation — the same
+    cascade the H.264 ladder runs (parallel/ladder.py): the scan carries
+    a byte balance fed by a per-frame bits proxy, and each P frame's QP
+    moves trunc(balance/(3*budget)) in [-1, +8] relative to plan.
+    alpha==0 disables adjustment.  With ``rc`` the return gains a third
+    element {"qp_eff": (T-1,) int32, "cost": (T,) f32} — the entropy
+    stage must signal qp_eff."""
     qp_i = jnp.asarray(qp_i, jnp.int32)
     t = y.shape[0]
     qp_p = jnp.broadcast_to(jnp.asarray(qp_p, jnp.int32).reshape(-1),
                             (max(t - 1, 1),))
     (li, lui, lvi), (ry, ru, rv) = encode_frame_dsp(
         y[0], u[0], v[0], qp_i, deblock=deblock)
+    if rc is not None:
+        budget = jnp.maximum(jnp.asarray(rc["budget"], jnp.float32), 1.0)
+        alpha = jnp.asarray(rc["alpha"], jnp.float32)
+        cost0 = _cost_proxy(li, lui, lvi)
 
     def step(carry, frame):
+        if rc is None:
+            refs = carry
+        else:
+            refs, bal = carry
         fy, fu, fv, qpf = frame
+        if rc is not None:
+            adj = jnp.clip(jnp.trunc(bal / (3.0 * budget)),
+                           -1.0, 8.0).astype(jnp.int32)
+            qpf = jnp.clip(qpf + adj, 10, 51)
         lv32, lv16, part, mv_map, recon = encode_p_frame_dsp(
-            fy, fu, fv, *carry, qpf, search=search,
+            fy, fu, fv, *refs, qpf, search=search,
             partitions=partitions, deblock=deblock)
-        return recon, (lv32, lv16, part, mv_map, recon)
+        if rc is None:
+            return recon, (lv32, lv16, part, mv_map, recon)
+        cost = _cost_proxy(*lv32)
+        # anti-windup mirrors parallel/ladder.py: credit bottoms at 3
+        # frames of budget, debt tops at what +8 QP can repay; the
+        # intra frame's planned overspend is NOT charged (bal starts 0)
+        bal = jnp.clip(
+            bal + jnp.where(alpha > 0, cost * alpha - budget, 0.0),
+            -3.0 * budget, 30.0 * budget)
+        return ((recon, bal),
+                (lv32, lv16, part, mv_map, recon, qpf, cost))
 
     if t > 1:
-        _, (p32, p16, parts, mvs, precons) = jax.lax.scan(
-            step, (ry, ru, rv), (y[1:], u[1:], v[1:], qp_p))
+        init = ((ry, ru, rv) if rc is None
+                else ((ry, ru, rv), jnp.float32(0.0)))
+        _, ys = jax.lax.scan(step, init, (y[1:], u[1:], v[1:], qp_p))
+        if rc is None:
+            p32, p16, parts, mvs, precons = ys
+        else:
+            p32, p16, parts, mvs, precons, qp_eff, costs = ys
     else:
         p32 = p16 = parts = mvs = precons = None
-    return ((li, lui, lvi), (ry, ru, rv)), (p32, p16, parts, mvs, precons)
+        qp_eff = jnp.zeros((0,), jnp.int32)
+        costs = jnp.zeros((0,), jnp.float32)
+    base = (((li, lui, lvi), (ry, ru, rv)),
+            (p32, p16, parts, mvs, precons))
+    if rc is None:
+        return base
+    return base + ({"qp_eff": qp_eff,
+                    "cost": jnp.concatenate([cost0[None], costs])},)
 
 
 @partial(jax.jit, static_argnames=("deblock",))

@@ -94,12 +94,13 @@ def run(n_devices: int) -> None:
     from vlog_tpu.parallel.hevc_ladder import hevc_chain_ladder_program
 
     hfn, hmats = hevc_chain_ladder_program(rungs, h, w, search=4, mesh=mesh)
-    houts = hfn(cy, cu, cv, hmats, qps)
+    houts = hfn(cy, cu, cv, hmats, qps, rc)
     jax.block_until_ready(houts)
     for name, _, _, _ in rungs:
         ro = houts[name]
         assert ro["p_luma"].shape[:2] == (n_devices, clen - 1)
         assert ro["sse_y"].shape == (n_devices, clen)
+        assert ro["qp_eff"].shape == (n_devices, clen)
 
     print(f"dryrun ok: {n_devices} devices, rungs "
           f"{[(r[0], round(float(stats[r[0]]), 2)) for r in rungs]}, "

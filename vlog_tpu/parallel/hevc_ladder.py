@@ -77,7 +77,7 @@ def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
       sse_y (n, clen) float32 over the display region
     """
 
-    def one_rung(y, u, v, rung_mats, qps, h, w):
+    def one_rung(y, u, v, rung_mats, qps, h, w, rcr=None):
         n, clen = y.shape[0], y.shape[1]
         flat = lambda p: p.reshape((n * clen,) + p.shape[2:])
         ry, ru, rv = resize_yuv420_with(flat(y), flat(u), flat(v), rung_mats)
@@ -88,8 +88,10 @@ def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
         def one_chain(cy, cu, cv, q):
             qp_i = jnp.maximum(10, q[0] - 2)
             qp_p = q[1:] if clen > 1 else q
-            (intra, recon0), (p32, _, _, mvs, precons) = encode_chain_dsp(
-                cy, cu, cv, search, qp_i, qp_p, False, deblock)
+            res = encode_chain_dsp(cy, cu, cv, search, qp_i, qp_p,
+                                   False, deblock, rcr)
+            (intra, recon0), (p32, _, _, mvs, precons) = res[0], res[1]
+            rcout = res[2] if rcr is not None else None
             # display-region SSE per frame (recons stay on device)
             r0 = recon0[0][:h, :w].astype(jnp.float32)
             sse0 = jnp.sum((r0 - cy[0][:h, :w].astype(jnp.float32)) ** 2)
@@ -104,7 +106,7 @@ def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
                             for a in intra)
                 mvs = jnp.zeros((0, 1, 1, 2), jnp.int32)
                 sse = sse0[None]
-            return {
+            out = {
                 "i_luma": intra[0].astype(jnp.int16),
                 "i_cb": intra[1].astype(jnp.int16),
                 "i_cr": intra[2].astype(jnp.int16),
@@ -114,11 +116,19 @@ def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
                 "mv": mvs.astype(jnp.int16),
                 "sse_y": sse,
             }
+            if rcr is not None:
+                # entropy_chain re-derives the I anchor from slot 0, so
+                # qp_eff[0] carries the PLAN value q[0]
+                out["qp_eff"] = jnp.concatenate(
+                    [q[:1], rcout["qp_eff"]]).astype(jnp.int16)
+                out["cost"] = rcout["cost"]
+            return out
 
         return jax.vmap(one_chain)(py, pu, pv, qps)
 
-    def local(y, u, v, mats, qps):
-        return {name: one_rung(y, u, v, mats[name], qps[name], h, w)
+    def local(y, u, v, mats, qps, rc=None):
+        return {name: one_rung(y, u, v, mats[name], qps[name], h, w,
+                               None if rc is None else rc[name])
                 for name, h, w, qp in rungs}
 
     mats = ladder_matrices(rungs, src_h, src_w)
@@ -126,7 +136,7 @@ def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
         return jax.jit(local), jax.device_put(mats)
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P("data"), P("data"), P("data"), P(), P("data")),
+        in_specs=(P("data"), P("data"), P("data"), P(), P("data"), P()),
         out_specs=P("data"),
         check_vma=False,
     )

@@ -152,9 +152,11 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
     (H264Encoder(deblock=True)), and SSE measures the filtered picture
     (what a decoder displays).
 
-    **Device-side in-chain rate adaptation.**  ``fn`` takes an optional
-    6th arg ``rc`` mapping rung -> {"budget": f32 bytes/frame, "alpha":
-    f32 bytes/proxy-unit}.  The host controller observes once per chain
+    **Device-side in-chain rate adaptation.**  ``fn`` takes a 6th arg
+    ``rc`` mapping rung -> {"budget": f32 bytes/frame, "alpha": f32
+    bytes/proxy-unit} — optional (default None) on the single-device
+    jit path, REQUIRED (pass None explicitly for legacy behavior) when
+    built over a mesh: shard_map's in_specs is a fixed 6-tuple.  The host controller observes once per chain
     dispatch, so a scene cut or noise burst used to ship a whole hot
     chain before any correction (measured 3-4x over budget for 24
     frames).  With ``rc``, the frame scan carries a byte balance: each
@@ -178,16 +180,10 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
     from vlog_tpu.codecs.h264.encoder import encode_frame
     from vlog_tpu.codecs.h264.inter import encode_p_frame
 
-    def _proxy(*level_arrays):
-        """Per-chain bits proxy over one frame's level tensors: nnz +
-        sum log2(1+|l|) — the shape of entropy-coded coefficient cost.
-        Each array is (n, ...); reduces all but the chain axis."""
-        tot = 0.0
-        for a in level_arrays:
-            af = jnp.abs(a.astype(jnp.float32))
-            axes = tuple(range(1, a.ndim))
-            tot = tot + jnp.sum((af > 0) + jnp.log2(1.0 + af), axis=axes)
-        return tot                                           # (n,)
+    from vlog_tpu.ops.bitproxy import cost_proxy
+
+    # per-chain reduction: each array is (n, ...) -> (n,)
+    _proxy = functools.partial(cost_proxy, batch_ndim=1)
 
     def one_rung(y, u, v, rung_mats, qps, h, w, rcr=None):
         # y: (n, clen, H, W) local chains; resize whole block at once
