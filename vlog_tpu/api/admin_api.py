@@ -347,6 +347,14 @@ async def list_videos(request: web.Request) -> web.Response:
     if q.get("status"):
         where.append("status=:status")
         params["status"] = q["status"]
+    if q.get("q"):
+        # title/slug substring search (reference admin search box);
+        # escape LIKE wildcards so a literal % can't scan everything
+        esc = (q["q"].replace("\\", "\\\\")
+               .replace("%", "\\%").replace("_", "\\_"))
+        where.append(r"(title LIKE :q ESCAPE '\' "
+                     r"OR slug LIKE :q ESCAPE '\')")
+        params["q"] = f"%{esc}%"
     base_where, base_params = list(where), {
         k: v for k, v in params.items() if k not in ("limit", "offset")}
     if q.get("cursor"):
@@ -451,6 +459,126 @@ async def failed_jobs(request: web.Request) -> web.Response:
         ORDER BY j.failed_at DESC LIMIT 200
         """)
     return web.json_response({"jobs": rows})
+
+
+# The derived-state rules of jobs/state.py as one SQL CASE: counts and
+# per-state pages come from the database, so the queue browser scales to
+# the full history instead of the newest N rows (states are not stored —
+# db/schema.py jobs contract).
+_STATE_CASE = """
+    CASE
+      WHEN j.completed_at IS NOT NULL THEN 'completed'
+      WHEN j.failed_at IS NOT NULL THEN 'failed'
+      WHEN j.claimed_by IS NOT NULL AND (j.claim_expires_at IS NULL
+           OR j.claim_expires_at > :now) THEN 'claimed'
+      WHEN j.claimed_by IS NOT NULL THEN 'expired'
+      WHEN j.attempt > 0 THEN 'retrying'
+      ELSE 'unclaimed'
+    END
+"""
+
+
+async def list_jobs(request: web.Request) -> web.Response:
+    """Queue browser: every job with its DERIVED state (the reference's
+    jobs admin, admin.py job listing routes).  ?state= filters, counts
+    aggregate, and pages are keyset over the WHOLE table in SQL."""
+    db = request.app[DB]
+    q = request.query
+    want = q.get("state", "").strip()
+    limit = _qnum(q, "limit", 100, lo=1, hi=500)
+    offset = _qnum(q, "offset", 0, lo=0)
+    t = db_now()
+    count_rows = await db.fetch_all(
+        f"SELECT {_STATE_CASE} AS state, COUNT(*) AS n FROM jobs j "
+        "GROUP BY state", {"now": t})
+    counts = {r["state"]: r["n"] for r in count_rows}
+    where = f"WHERE {_STATE_CASE} = :want" if want else ""
+    params: dict = {"now": t, "limit": limit, "offset": offset}
+    if want:
+        params["want"] = want
+    rows = await db.fetch_all(
+        f"""
+        SELECT j.*, v.slug, v.title, {_STATE_CASE} AS state FROM jobs j
+        JOIN videos v ON v.id = j.video_id
+        {where}
+        ORDER BY j.id DESC LIMIT :limit OFFSET :offset
+        """, params)
+    out = [{"id": r["id"], "kind": r["kind"], "state": r["state"],
+            "slug": r["slug"], "title": r["title"],
+            "attempt": r["attempt"], "progress": r["progress"],
+            "current_step": r["current_step"],
+            "claimed_by": r["claimed_by"],
+            "created_at": r["created_at"],
+            "updated_at": r["updated_at"],
+            "error": r["error"]} for r in rows]
+    total = (counts.get(want, 0) if want
+             else sum(counts.values()))
+    return web.json_response({
+        "jobs": out, "counts": counts, "total": total})
+
+
+async def audit_tail(request: web.Request) -> web.Response:
+    """Tail the audit JSONL (api/audit.py rotations included) newest
+    first; ?action= prefix filter, ?q= substring filter (reference:
+    the admin audit browser)."""
+    audit = request.app.get(AUDIT)
+    if audit is None:
+        return web.json_response({"entries": []})
+    limit = _qnum(request.query, "limit", 200, lo=1, hi=1000)
+    action = request.query.get("action", "").strip()
+    needle = request.query.get("q", "").strip().lower()
+    # Bounded work: read at most the trailing 4 MB of each file (the
+    # current log caps at 10 MB before rotating), iterate newest-first,
+    # stop as soon as ``limit`` matches are collected.  Keeps a filter
+    # click O(tail), not O(full log + rotation).
+    cap_bytes = 4 * 1024 * 1024
+    entries: list[dict] = []
+    for p in (audit.path, audit.path.with_suffix(".1.log")):
+        if len(entries) >= limit:
+            break
+        try:
+            with open(p, "rb") as fp:
+                fp.seek(0, 2)
+                size = fp.tell()
+                fp.seek(max(0, size - cap_bytes))
+                data = fp.read().decode(errors="replace")
+        except OSError:
+            continue
+        lines = data.splitlines()
+        if size > cap_bytes and lines:
+            lines = lines[1:]               # drop the torn first line
+        for line in reversed(lines):
+            if len(entries) >= limit:
+                break
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if action and not str(e.get("action", "")).startswith(action):
+                continue
+            if needle and needle not in line.lower():
+                continue
+            entries.append(e)
+    return web.json_response({"entries": entries})
+
+
+async def analytics_daily(request: web.Request) -> web.Response:
+    """Per-day session counts + watch time for the dashboard charts
+    (reference analytics timeseries, condensed)."""
+    db = request.app[DB]
+    days = _qnum(request.query, "days", 30, lo=1, hi=120)
+    cut = db_now() - days * 86400.0
+    rows = await db.fetch_all(
+        """
+        SELECT CAST((started_at / 86400) AS INTEGER) AS day,
+               COUNT(*) AS sessions,
+               COALESCE(SUM(watch_time_s), 0) AS watch_time_s
+        FROM playback_sessions WHERE started_at >= :cut
+        GROUP BY day ORDER BY day
+        """, {"cut": cut})
+    return web.json_response({"days": [
+        {"epoch_day": r["day"], "sessions": r["sessions"],
+         "watch_time_s": r["watch_time_s"]} for r in rows]})
 
 
 async def requeue_job(request: web.Request) -> web.Response:
@@ -836,8 +964,11 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_get("/api/videos/{video_id:\\d+}", video_detail)
     r.add_post("/api/videos/{video_id:\\d+}/retranscode", retranscode)
     r.add_post("/api/videos/{video_id:\\d+}/reencode", reencode)
+    r.add_get("/api/jobs", list_jobs)
     r.add_get("/api/jobs/failed", failed_jobs)
     r.add_post("/api/jobs/{job_id:\\d+}/requeue", requeue_job)
+    r.add_get("/api/audit", audit_tail)
+    r.add_get("/api/analytics/daily", analytics_daily)
     r.add_delete("/api/videos/{video_id:\\d+}", delete_video)
     r.add_post("/api/videos/{video_id:\\d+}/restore", restore_video)
     r.add_get("/api/events/progress", sse_progress)

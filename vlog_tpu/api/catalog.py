@@ -26,6 +26,8 @@ from vlog_tpu.db.core import Database, now as db_now  # noqa: F401
 # AppKeys are identity-keyed: reuse admin_api's instances (admin_api only
 # imports this module inside build_admin_app, so there is no cycle)
 from vlog_tpu.api.admin_api import DB, VIDEO_DIR
+from vlog_tpu.enums import JobKind, VideoStatus
+from vlog_tpu.jobs import claims, state as js, videos as vids
 
 
 def _json_error(status: int, message: str) -> web.Response:
@@ -547,9 +549,9 @@ async def bulk_videos(request: web.Request) -> web.Response:
     if (not isinstance(ids, list) or not ids
             or not all(isinstance(i, int) for i in ids) or len(ids) > 500):
         return _json_error(400, "video_ids (1..500 ints) required")
-    if action not in ("delete", "restore", "set_category"):
+    if action not in ("delete", "restore", "set_category", "retranscode"):
         return _json_error(400, "action must be delete | restore | "
-                                "set_category")
+                                "set_category | retranscode")
     t = db_now()
     done, missing = [], []
     for vid in ids:
@@ -570,8 +572,78 @@ async def bulk_videos(request: web.Request) -> web.Response:
             await db.execute(
                 "UPDATE videos SET category=:c, updated_at=:t WHERE id=:v",
                 {"c": body.get("category"), "t": t, "v": vid})
+        elif action == "retranscode":
+            try:
+                await claims.enqueue_job(db, vid, JobKind.TRANSCODE,
+                                         force=bool(body.get("force")))
+            except js.JobStateError:
+                missing.append(vid)   # already queued/claimed: report it
+                continue
+            await vids.set_status(db, vid, VideoStatus.PENDING)
         done.append(vid)
     return web.json_response({"ok": True, "done": done, "missing": missing})
+
+
+async def get_sprites(request: web.Request) -> web.Response:
+    """Sprite index for the admin preview strip: parse the WebVTT the
+    sprite worker wrote (worker/sprites.py; reference sprite admin
+    routes) into cue dicts the UI can lay out without a VTT parser."""
+    db = request.app[DB]
+    vid = int(request.match_info["video_id"])
+    row = await db.fetch_one("SELECT * FROM videos WHERE id=:v", {"v": vid})
+    if row is None:
+        return _json_error(404, "no such video")
+    from vlog_tpu import config
+
+    vtt = Path(config.VIDEO_DIR) / row["slug"] / "sprites" / "sprites.vtt"
+    if not vtt.is_file():
+        return _json_error(404, "no sprites generated")
+    cues = []
+    block: list[str] = []
+    for line in vtt.read_text().splitlines() + [""]:
+        if line.strip():
+            block.append(line.strip())
+            continue
+        if len(block) >= 2 and "-->" in block[0]:
+            times, target = block[0], block[1]
+            a, b = [t.strip() for t in times.split("-->")]
+
+            def secs(t):
+                parts = t.split(":")
+                s = float(parts[-1])
+                if len(parts) > 1:
+                    s += 60 * int(parts[-2])
+                if len(parts) > 2:
+                    s += 3600 * int(parts[-3])
+                return s
+
+            sheet, _, frag = target.partition("#xywh=")
+            x, y, w, h = (int(v) for v in frag.split(",")) \
+                if frag else (0, 0, 0, 0)
+            cues.append({"start_s": secs(a), "end_s": secs(b),
+                         "sheet": sheet, "x": x, "y": y, "w": w, "h": h})
+        block = []
+    return web.json_response({"cues": cues})
+
+
+async def get_sprite_sheet(request: web.Request) -> web.Response:
+    """Serve one sprite sheet JPEG to the admin UI (different origin
+    from the public media tree, same reason as get_thumbnail)."""
+    db = request.app[DB]
+    vid = int(request.match_info["video_id"])
+    row = await db.fetch_one("SELECT * FROM videos WHERE id=:v", {"v": vid})
+    if row is None:
+        return _json_error(404, "no such video")
+    name = request.match_info["name"]
+    from vlog_tpu import config
+
+    sdir = (Path(config.VIDEO_DIR) / row["slug"] / "sprites").resolve()
+    p = (sdir / name).resolve()
+    if not str(p).startswith(str(sdir)) or p.suffix != ".jpg" \
+            or not p.is_file():
+        return _json_error(404, "no such sheet")
+    return web.FileResponse(p, headers={
+        "Content-Type": "image/jpeg", "Cache-Control": "no-cache"})
 
 
 def mount(r: web.UrlDispatcher) -> None:
@@ -603,3 +675,6 @@ def mount(r: web.UrlDispatcher) -> None:
     r.add_delete("/api/videos/{video_id:\\d+}/transcript",
                  delete_transcript)
     r.add_post("/api/videos/bulk", bulk_videos)
+    r.add_get("/api/videos/{video_id:\\d+}/sprites", get_sprites)
+    r.add_get("/api/videos/{video_id:\\d+}/sprites/{name}",
+              get_sprite_sheet)

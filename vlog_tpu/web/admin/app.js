@@ -105,6 +105,7 @@ const loaders = {
   dashboard: loadDashboard, videos: loadVideos, jobs: loadJobs,
   workers: loadWorkers, settings: loadSettings, webhooks: loadWebhooks,
   playlists: loadPlaylists, fields: loadFields, analytics: loadAnalytics,
+  queue: loadQueue, audit: loadAudit,
 };
 
 function switchTab(name) {
@@ -225,8 +226,19 @@ function stopSse() {
 const VID_PAGE = 100;
 let vidOffset = 0;
 
+const bulkSel = new Set();        // selected video ids for bulk ops
+
+function syncBulkBar() {
+  $("bulk-bar").hidden = bulkSel.size === 0;
+  $("bulk-count").textContent = `${bulkSel.size} selected`;
+}
+
 async function loadVideos() {
-  const extra = $("show-deleted").checked ? "&include_deleted=1" : "";
+  let extra = $("show-deleted").checked ? "&include_deleted=1" : "";
+  const q = $("vids-search").value.trim();
+  if (q) extra += `&q=${encodeURIComponent(q)}`;
+  const st = $("vids-status").value;
+  if (st) extra += `&status=${encodeURIComponent(st)}`;
   const d = await api(
     `/api/videos?limit=${VID_PAGE}&offset=${vidOffset}${extra}`);
   $("vids-page").textContent =
@@ -280,7 +292,14 @@ async function loadVideos() {
         ? actionBtn("restore", async () => { await api(`/api/videos/${v.id}/restore`, { method: "POST" }); loadVideos(); })
         : actionBtn("delete", async () => { await api(`/api/videos/${v.id}`, { method: "DELETE" }); loadVideos(); }),
     );
-    cells(tr, [v.id, v.title, badge(v.status), fmtBytes(v.size_bytes), fmtDur(v.duration_s), acts]);
+    const sel = document.createElement("input");
+    sel.type = "checkbox";
+    sel.checked = bulkSel.has(v.id);
+    sel.onchange = () => {
+      if (sel.checked) bulkSel.add(v.id); else bulkSel.delete(v.id);
+      syncBulkBar();
+    };
+    cells(tr, [sel, v.id, v.title, badge(v.status), fmtBytes(v.size_bytes), fmtDur(v.duration_s), acts]);
     tb.appendChild(tr);
   }
 }
@@ -288,6 +307,45 @@ async function loadVideos() {
 $("show-deleted").addEventListener("change", () => { vidOffset = 0; loadVideos(); });
 $("vids-prev").onclick = () => { vidOffset = Math.max(0, vidOffset - VID_PAGE); loadVideos(); };
 $("vids-next").onclick = () => { vidOffset += VID_PAGE; loadVideos(); };
+let vidsSearchT = null;
+$("vids-search").addEventListener("input", () => {
+  clearTimeout(vidsSearchT);
+  vidsSearchT = setTimeout(() => { vidOffset = 0; loadVideos(); }, 300);
+});
+$("vids-status").addEventListener("change", () => { vidOffset = 0; loadVideos(); });
+$("vids-all").addEventListener("change", (ev) => {
+  const boxes = $("videos-table").tBodies[0].querySelectorAll("input[type=checkbox]");
+  const ids = [...$("videos-table").tBodies[0].rows].map((r) => parseInt(r.cells[1].textContent, 10));
+  boxes.forEach((b, i) => {
+    b.checked = ev.target.checked;
+    if (ev.target.checked) bulkSel.add(ids[i]); else bulkSel.delete(ids[i]);
+  });
+  syncBulkBar();
+});
+
+async function runBulk(action, body) {
+  const d = await api("/api/videos/bulk", {
+    method: "POST", headers: { "Content-Type": "application/json" },
+    body: JSON.stringify({ action, video_ids: [...bulkSel], ...body }),
+  });
+  toast(`bulk ${action}: ${d.done.length} done` +
+    (d.missing.length ? `, ${d.missing.length} skipped` : ""));
+  bulkSel.clear();
+  syncBulkBar();
+  loadVideos();
+}
+$("bulk-retranscode").onclick = () => {
+  // no force: jobs a worker actively holds are SKIPPED server-side
+  // (resetting them would let two workers write one output tree) and
+  // reported back in the toast's "skipped" count
+  if (confirm(`Retranscode ${bulkSel.size} videos? Actively-running jobs are skipped.`)) {
+    runBulk("retranscode", {});
+  }
+};
+$("bulk-delete").onclick = () => {
+  if (confirm(`Delete ${bulkSel.size} videos?`)) runBulk("delete", {});
+};
+$("bulk-clear").onclick = () => { bulkSel.clear(); syncBulkBar(); loadVideos(); };
 
 $("upload-form").addEventListener("submit", (ev) => {
   ev.preventDefault();
@@ -457,7 +515,49 @@ $("cf-create").onclick = async () => {
 
 /* ------------------------------------------------- analytics ---------- */
 
+function renderBars(el, rows, valueOf, labelOf, titleOf) {
+  el.textContent = "";
+  const peak = Math.max(1, ...rows.map(valueOf));
+  for (const row of rows) {
+    const col = document.createElement("div");
+    col.className = "bar";
+    const fill = document.createElement("div");
+    fill.className = "bar-fill";
+    fill.style.height = `${Math.round((valueOf(row) / peak) * 100)}%`;
+    fill.title = titleOf(row);
+    const lbl = document.createElement("div");
+    lbl.className = "bar-label";
+    lbl.textContent = labelOf(row);
+    col.append(fill, lbl);
+    el.appendChild(col);
+  }
+}
+
+async function loadDailyCharts() {
+  const days = parseInt($("an-days").value, 10);
+  const d = await api(`/api/analytics/daily?days=${days}`);
+  // fill gaps so quiet days render as empty slots, not missing bars
+  const byDay = new Map(d.days.map((r) => [r.epoch_day, r]));
+  const today = Math.floor(Date.now() / 86400000);
+  const series = [];
+  for (let k = today - days + 1; k <= today; k++) {
+    series.push(byDay.get(k) ||
+      { epoch_day: k, sessions: 0, watch_time_s: 0 });
+  }
+  const dayLbl = (r) => {
+    const dt = new Date(r.epoch_day * 86400000);
+    return `${dt.getUTCMonth() + 1}/${dt.getUTCDate()}`;
+  };
+  renderBars($("an-daily-sessions"), series, (r) => r.sessions, dayLbl,
+    (r) => `${dayLbl(r)}: ${r.sessions} sessions`);
+  renderBars($("an-daily-watch"), series, (r) => r.watch_time_s, dayLbl,
+    (r) => `${dayLbl(r)}: ${(r.watch_time_s / 3600).toFixed(1)}h watched`);
+}
+
+$("an-days").addEventListener("change", loadDailyCharts);
+
 async function loadAnalytics() {
+  loadDailyCharts();
   const m = await api("/api/analytics/sessions/months");
   const wrap = $("an-months");
   wrap.textContent = "";
@@ -521,6 +621,11 @@ async function openDrawer(v) {
   $("drawer").hidden = false;
   $("dr-title").textContent = `#${v.id} ${v.title}`;
   refreshThumb(v.id);
+  loadDrawerChapters(v.id);
+  $("dr-sprites").textContent = "";
+  revokeSpriteBlobs();
+  $("dr-sp-msg").textContent = "";
+  $("dr-ch-msg").textContent = "";
   $("dr-tr-msg").textContent = "";
   try {
     const tr = await api(`/api/videos/${v.id}/transcript`);
@@ -571,7 +676,11 @@ async function openDrawer(v) {
   }
 }
 
-$("dr-close").onclick = () => { $("drawer").hidden = true; drawerVideoId = null; };
+$("dr-close").onclick = () => {
+  $("drawer").hidden = true;
+  drawerVideoId = null;
+  revokeSpriteBlobs();
+};
 
 $("dr-thumb-grab").onclick = async () => {
   const t = parseFloat($("dr-thumb-time").value || "0");
@@ -753,6 +862,158 @@ $("wh-create").onclick = async () => {
     $("wh-url").value = $("wh-events").value = $("wh-secret").value = "";
     loadWebhooks();
   } catch (e) { toast(e.message, true); }
+};
+
+/* ------------------------------------------------- queue -------------- */
+
+async function loadQueue() {
+  const st = $("q-state").value;
+  const d = await api(`/api/jobs${st ? `?state=${st}` : ""}`);
+  const pills = $("q-counts");
+  pills.textContent = "";
+  for (const [state, n] of Object.entries(d.counts).sort()) {
+    const b = badge(`${state}: ${n}`);
+    b.style.cursor = "pointer";
+    b.onclick = () => { $("q-state").value = state; loadQueue(); };
+    pills.appendChild(b);
+  }
+  const tb = $("queue-table").tBodies[0];
+  tb.textContent = "";
+  $("queue-empty").hidden = d.jobs.length > 0;
+  for (const jb of d.jobs) {
+    const tr = document.createElement("tr");
+    const prog = jb.progress != null
+      ? `${Math.round(jb.progress * 100)}%` : "—";
+    cells(tr, [`#${jb.id}`, jb.title, jb.kind, badge(jb.state),
+      jb.attempt, prog, jb.current_step || "—", jb.claimed_by || "—",
+      fmtAgo(jb.updated_at)]);
+    tb.appendChild(tr);
+  }
+}
+$("q-refresh").onclick = loadQueue;
+$("q-state").addEventListener("change", loadQueue);
+
+/* ------------------------------------------------- audit -------------- */
+
+async function loadAudit() {
+  const action = $("au-action").value.trim();
+  const q = $("au-q").value.trim();
+  const params = new URLSearchParams();
+  if (action) params.set("action", action);
+  if (q) params.set("q", q);
+  const d = await api(`/api/audit?${params}`);
+  const tb = $("audit-table").tBodies[0];
+  tb.textContent = "";
+  $("audit-empty").hidden = d.entries.length > 0;
+  for (const e of d.entries.slice(0, 300)) {
+    const tr = document.createElement("tr");
+    const { ts, action: act, ...rest } = e;
+    const det = document.createElement("code");
+    det.textContent = JSON.stringify(rest);
+    det.style.fontSize = "11px";
+    cells(tr, [new Date(ts * 1000).toLocaleString(), badge(act), det]);
+    tb.appendChild(tr);
+  }
+}
+$("au-refresh").onclick = loadAudit;
+
+/* ------------------------------------------------- drawer: chapters --- */
+
+let drawerChapters = [];
+
+function renderChapters() {
+  const tb = $("dr-chapters").tBodies[0];
+  tb.textContent = "";
+  drawerChapters.sort((a, b) => a.start_s - b.start_s);
+  drawerChapters.forEach((ch, i) => {
+    const tr = document.createElement("tr");
+    cells(tr, [fmtDur(ch.start_s), ch.title,
+      actionBtn("remove", async () => {
+        drawerChapters.splice(i, 1);
+        renderChapters();
+      })]);
+    tb.appendChild(tr);
+  });
+}
+
+async function loadDrawerChapters(id) {
+  try {
+    const d = await api(`/api/videos/${id}/chapters`);
+    drawerChapters = d.chapters || [];
+  } catch (e) { drawerChapters = []; }
+  renderChapters();
+}
+
+$("dr-ch-add").onclick = () => {
+  const start = parseFloat($("dr-ch-start").value);
+  const title = $("dr-ch-title").value.trim();
+  if (isNaN(start) || !title) { toast("need seconds + title", true); return; }
+  drawerChapters.push({ start_s: start, title });
+  $("dr-ch-start").value = $("dr-ch-title").value = "";
+  renderChapters();
+};
+$("dr-ch-save").onclick = async () => {
+  try {
+    await api(`/api/videos/${drawerVideoId}/chapters`, {
+      method: "PUT", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify({ chapters: drawerChapters }),
+    });
+    $("dr-ch-msg").textContent = `${drawerChapters.length} chapters saved`;
+  } catch (e) { toast(e.message, true); }
+};
+$("dr-ch-detect").onclick = async () => {
+  try {
+    const d = await api(`/api/videos/${drawerVideoId}/chapters/detect`,
+      { method: "POST" });
+    drawerChapters = d.chapters || [];
+    renderChapters();
+    $("dr-ch-msg").textContent =
+      `${drawerChapters.length} detected (unsaved)`;
+  } catch (e) { toast(e.message, true); }
+};
+
+/* ------------------------------------------------- drawer: sprites ---- */
+
+let spriteBlobUrls = [];        // revoked on re-load / drawer close
+
+function revokeSpriteBlobs() {
+  for (const u of spriteBlobUrls.splice(0)) URL.revokeObjectURL(u);
+}
+
+$("dr-sp-load").onclick = async () => {
+  const wrap = $("dr-sprites");
+  wrap.textContent = "";
+  revokeSpriteBlobs();
+  $("dr-sp-msg").textContent = "";
+  let d;
+  try {
+    d = await api(`/api/videos/${drawerVideoId}/sprites`);
+  } catch (e) {
+    $("dr-sp-msg").textContent = e.message;
+    return;
+  }
+  // one blob URL per sheet (admin plane needs the auth header)
+  const sheets = new Map();
+  for (const cue of d.cues.slice(0, 60)) {
+    if (!sheets.has(cue.sheet)) {
+      const r = await fetch(
+        `/api/videos/${drawerVideoId}/sprites/${cue.sheet}`,
+        { headers: { "X-Admin-Secret": secret } });
+      if (!r.ok) continue;
+      const u = URL.createObjectURL(await r.blob());
+      spriteBlobUrls.push(u);
+      sheets.set(cue.sheet, u);
+    }
+    const tile = document.createElement("div");
+    tile.className = "sprite-tile";
+    tile.style.width = `${cue.w}px`;
+    tile.style.height = `${cue.h}px`;
+    tile.style.background =
+      `url(${sheets.get(cue.sheet)}) -${cue.x}px -${cue.y}px`;
+    tile.title = fmtDur(cue.start_s);
+    wrap.appendChild(tile);
+  }
+  $("dr-sp-msg").textContent = `${d.cues.length} tiles`;
 };
 
 /* ------------------------------------------------- boot --------------- */

@@ -206,6 +206,18 @@ async function loadTranscript(slug, video) {
       el.appendChild(div);
       return div;
     });
+    // transcript search: filter cues by substring
+    const search = $("tr-search");
+    search.hidden = false;
+    search.value = "";
+    search.oninput = () => {
+      const needle = search.value.trim().toLowerCase();
+      nodes.forEach((n, i) => {
+        n.hidden = needle !== "" &&
+          !cues[i].text.toLowerCase().includes(needle);
+      });
+    };
+    watchCleanup.push(() => { search.hidden = true; search.oninput = null; });
     // native captions overlay
     const track = document.createElement("track");
     track.kind = "captions"; track.label = d.language || "captions";
@@ -223,6 +235,63 @@ async function loadTranscript(slug, video) {
 }
 
 let watchSeq = 0;           // drops stale openWatch responses
+
+/* Sprite-preview seek strip under the player: hover shows the tile
+   from the sprite sheets (worker/sprites.py), click seeks. */
+async function loadSeekStrip(v, video, seq) {
+  const strip = $("seek-strip");
+  const preview = $("seek-preview");
+  strip.hidden = true;
+  if (!v.sprites_url) return;
+  let cues = [];
+  try {
+    const vtt = await (await fetch(v.sprites_url)).text();
+    if (seq !== watchSeq) return;   // user navigated away mid-fetch
+    const re = /([\d:.]+)\s+-->\s+([\d:.]+)\s*\n(\S+)#xywh=(\d+),(\d+),(\d+),(\d+)/g;
+    const secs = (t) => t.split(":").reduce((a, x) => a * 60 + (+x), 0);
+    const base = v.sprites_url.slice(0, v.sprites_url.lastIndexOf("/") + 1);
+    let m;
+    while ((m = re.exec(vtt)) !== null) {
+      cues.push({ start: secs(m[1]), end: secs(m[2]), url: base + m[3],
+        x: +m[4], y: +m[5], w: +m[6], h: +m[7] });
+    }
+  } catch (e) { return; }
+  if (!cues.length) return;
+  strip.hidden = false;
+  const played = $("seek-played");
+  const onTime = () => {
+    const d = video.duration || v.duration_s || 1;
+    played.style.width = `${(video.currentTime / d) * 100}%`;
+  };
+  video.addEventListener("timeupdate", onTime);
+  const frac = (ev) => {
+    const r = strip.getBoundingClientRect();
+    return Math.min(Math.max((ev.clientX - r.left) / r.width, 0), 1);
+  };
+  strip.onmousemove = (ev) => {
+    const d = video.duration || v.duration_s || 1;
+    const t = frac(ev) * d;
+    const cue = cues.find((c) => t >= c.start && t < c.end)
+      || cues[cues.length - 1];
+    preview.style.display = "block";
+    preview.style.width = `${cue.w}px`;
+    preview.style.height = `${cue.h}px`;
+    preview.style.left = `${frac(ev) * 100}%`;
+    preview.style.background = `url(${cue.url}) -${cue.x}px -${cue.y}px`;
+    preview.querySelector(".t").textContent = fmtDur(t);
+  };
+  strip.onmouseleave = () => { preview.style.display = "none"; };
+  strip.onclick = (ev) => {
+    const d = video.duration || v.duration_s || 1;
+    video.currentTime = frac(ev) * d;
+    video.play();
+  };
+  watchCleanup.push(() => {
+    video.removeEventListener("timeupdate", onTime);
+    strip.hidden = true;
+    strip.onmousemove = strip.onclick = strip.onmouseleave = null;
+  });
+}
 
 async function openWatch(slug) {
   const seq = ++watchSeq;
@@ -286,6 +355,7 @@ async function openWatch(slug) {
     player.onerror(e);
   }
   loadTranscript(slug, video);
+  loadSeekStrip(v, video, seq);
   loadRelated(slug);
   startAnalytics(slug, video);
 }
