@@ -1,0 +1,163 @@
+"""Unit depth for the round-5 internals: the shared bits proxy, the
+controller's device-RC calibration, and the HEVC deblock boundary-
+strength builders (spec 8.7.2.4 restricted to our stream shapes)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+# --------------------------------------------------------------------------
+# ops/bitproxy.py
+# --------------------------------------------------------------------------
+
+def test_cost_proxy_values_and_batching():
+    from vlog_tpu.ops.bitproxy import cost_proxy
+
+    a = np.zeros((2, 4, 4), np.int32)
+    a[0, 0, 0] = 1          # nnz 1, log2(2) = 1        -> 2.0
+    a[1, 1, 1] = -3         # nnz 1, log2(4) = 2        -> 3.0
+    per_chain = np.asarray(cost_proxy(a, batch_ndim=1))
+    assert per_chain.shape == (2,)
+    assert per_chain[0] == pytest.approx(2.0)
+    assert per_chain[1] == pytest.approx(3.0)
+    total = float(np.asarray(cost_proxy(a)))
+    assert total == pytest.approx(5.0)
+    # multiple arrays sum; empty tensors contribute zero
+    both = float(np.asarray(cost_proxy(a, np.zeros((1, 2), np.int32))))
+    assert both == pytest.approx(5.0)
+
+
+def test_cost_proxy_monotone_in_levels():
+    """More/larger coefficients must never cost less — the property the
+    device controller's direction logic relies on."""
+    from vlog_tpu.ops.bitproxy import cost_proxy
+
+    rng = np.random.default_rng(0)
+    base = rng.integers(-10, 11, (8, 8)).astype(np.int32)
+    bigger = base * 2
+    denser = base.copy()
+    denser[base == 0] = 1
+    c0 = float(np.asarray(cost_proxy(base)))
+    assert float(np.asarray(cost_proxy(bigger))) >= c0
+    assert float(np.asarray(cost_proxy(denser))) >= c0
+
+
+# --------------------------------------------------------------------------
+# RateController.device_rc_params / calibrate_proxy
+# --------------------------------------------------------------------------
+
+def _rc(target=240_000):
+    from vlog_tpu.backends.rate_control import RateController
+
+    return RateController(target_bps=target, fps=30.0, init_qp=30)
+
+
+def test_device_rc_params_uncalibrated_alpha_zero():
+    rc = _rc()
+    p = rc.device_rc_params()
+    assert p["alpha"] == 0.0
+    assert p["budget"] == pytest.approx(1000.0)  # 240k/8/30
+
+
+def test_calibrate_proxy_first_fix_then_ema():
+    rc = _rc()
+    rc.calibrate_proxy(10_000, 50_000.0)          # 0.2 bytes/unit
+    assert rc.device_rc_params()["alpha"] == pytest.approx(0.2)
+    rc.calibrate_proxy(30_000, 50_000.0)          # obs 0.6 -> EMA 0.4
+    assert rc.device_rc_params()["alpha"] == pytest.approx(0.4)
+
+
+def test_calibrate_proxy_noops():
+    rc = _rc(target=0)                            # constant-QP rung
+    rc.calibrate_proxy(10_000, 50_000.0)
+    assert rc.device_rc_params()["alpha"] == 0.0
+    rc2 = _rc()
+    rc2.calibrate_proxy(10_000, 0.0)              # empty batch
+    assert rc2.device_rc_params()["alpha"] == 0.0
+    # zero-target budget floors at 1.0 (device divides by it)
+    assert rc.device_rc_params()["budget"] >= 1.0
+
+
+# --------------------------------------------------------------------------
+# codecs/hevc/deblock.py: tables + bS builders
+# --------------------------------------------------------------------------
+
+def test_hevc_deblock_table_endpoints():
+    from vlog_tpu.codecs.hevc.deblock import BETA_TBL, TC_TBL
+
+    assert BETA_TBL.shape == (52,) and TC_TBL.shape == (54,)
+    # spec Table 8-12 endpoints
+    assert BETA_TBL[15] == 0 and BETA_TBL[16] == 6 and BETA_TBL[51] == 64
+    assert TC_TBL[17] == 0 and TC_TBL[18] == 1 and TC_TBL[53] == 24
+
+
+def test_intra_bs_only_ctb_boundaries():
+    from vlog_tpu.codecs.hevc.deblock import intra_bs
+
+    bs_v, bs_h = intra_bs(2, 3)                   # 64x96 picture
+    bs_v, bs_h = np.asarray(bs_v), np.asarray(bs_h)
+    assert bs_v.shape == (5, 4) and bs_h.shape == (3, 6)
+    # edge k at x=16(k+1): odd k = CTB boundary (bS 2), even k interior
+    assert (bs_v[1::2] == 2).all() and (bs_v[0::2] == 0).all()
+    assert (bs_h[1::2] == 2).all() and (bs_h[0::2] == 0).all()
+
+
+def test_p_bs_cbf_mv_and_partition_rules():
+    import jax
+
+    from vlog_tpu.codecs.hevc.deblock import p_bs
+
+    r, c = 2, 2                                   # 64x64: cells 4x4
+    part = np.zeros((r, c), np.int32)
+    cbf = np.zeros((2 * r, 2 * c), bool)
+    mv = np.zeros((2 * r, 2 * c, 2), np.int32)
+    z_v, z_h = (np.asarray(a) for a in p_bs(part, cbf, mv))
+    assert z_v.shape == (3, 4) and (z_v == 0).all() and (z_h == 0).all()
+
+    # cbf on one cell lights only its CTB-boundary edges
+    cbf2 = cbf.copy()
+    cbf2[0, 2] = True                             # cell col 2 = CTB col 1
+    bs_v, _ = (np.asarray(a) for a in p_bs(part, cbf2, mv))
+    # vertical edge k=1 (x=32, CTB boundary between cell cols 1|2)
+    assert bs_v[1, 0] == 1
+    # interior edge k=2 (x=48, inside unpartitioned CTB col 1): no edge
+    assert bs_v[2, 0] == 0
+    # rows that don't touch the cell stay 0
+    assert bs_v[1, 2] == 0
+
+    # MV delta >= 4 qpel across a CTB boundary -> bS 1 even with cbf 0
+    mv2 = mv.copy()
+    mv2[:, :2] = (0, 0)
+    mv2[:, 2:] = (4, 0)
+    bs_v2, bs_h2 = (np.asarray(a) for a in p_bs(part, cbf, mv2))
+    assert (bs_v2[1] == 1).all()                  # the x=32 CTB edge
+    assert (bs_h2 == 0).all()                     # no vertical-dir delta
+
+    # partitioned CTB exposes its interior TU16 edges
+    part2 = part.copy()
+    part2[0, 1] = 1                               # CTB (0,1) partitioned
+    cbf3 = cbf.copy()
+    cbf3[0, 2] = True
+    bs_v3, _ = (np.asarray(a) for a in p_bs(part2, cbf3, mv))
+    assert bs_v3[2, 0] == 1                       # x=48 now a TU16 edge
+    assert bs_v3[2, 2] == 0                       # other CTB row: 2Nx2N
+
+
+def test_deblock_picture_identity_when_bs_zero():
+    """bS 0 everywhere must leave every sample untouched."""
+    import jax
+
+    from vlog_tpu.codecs.hevc.deblock import deblock_picture
+
+    rng = np.random.default_rng(3)
+    y = rng.integers(0, 256, (64, 64), np.uint8)
+    u = rng.integers(0, 256, (32, 32), np.uint8)
+    v = rng.integers(0, 256, (32, 32), np.uint8)
+    bs_v = np.zeros((3, 4), np.int32)
+    bs_h = np.zeros((3, 4), np.int32)
+    dy, du, dv = deblock_picture(y, u, v, qp=30, qpc=30,
+                                 bs_v=bs_v, bs_h=bs_h, chroma=False)
+    assert (np.asarray(dy) == y).all()
+    assert (np.asarray(du) == u).all() and (np.asarray(dv) == v).all()

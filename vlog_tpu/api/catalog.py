@@ -593,9 +593,8 @@ async def get_sprites(request: web.Request) -> web.Response:
     row = await db.fetch_one("SELECT * FROM videos WHERE id=:v", {"v": vid})
     if row is None:
         return _json_error(404, "no such video")
-    from vlog_tpu import config
-
-    vtt = Path(config.VIDEO_DIR) / row["slug"] / "sprites" / "sprites.vtt"
+    vtt = (request.app[VIDEO_DIR] / row["slug"] / "sprites"
+           / "sprites.vtt")
     if not vtt.is_file():
         return _json_error(404, "no sprites generated")
     cues = []
@@ -635,9 +634,7 @@ async def get_sprite_sheet(request: web.Request) -> web.Response:
     if row is None:
         return _json_error(404, "no such video")
     name = request.match_info["name"]
-    from vlog_tpu import config
-
-    sdir = (Path(config.VIDEO_DIR) / row["slug"] / "sprites").resolve()
+    sdir = (request.app[VIDEO_DIR] / row["slug"] / "sprites").resolve()
     p = (sdir / name).resolve()
     if not str(p).startswith(str(sdir)) or p.suffix != ".jpg" \
             or not p.is_file():

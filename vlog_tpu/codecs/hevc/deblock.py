@@ -250,11 +250,11 @@ def deblock_picture(y, u, v, *, qp, qpc, bs_v, bs_h, chroma: bool):
     static (True only for intra pictures — chroma filters at bS 2).
     Returns (y, u, v) int32 in [0, 255].
     """
-    y = y.astype(jnp.int32)
+    y = jnp.asarray(y, jnp.int32)
     y = _luma_pass_v(y, bs_v, qp)
     y = _luma_pass_h(y, bs_h, qp)
-    u = u.astype(jnp.int32)
-    v = v.astype(jnp.int32)
+    u = jnp.asarray(u, jnp.int32)
+    v = jnp.asarray(v, jnp.int32)
     if chroma:
         u = _chroma_pass_v(u, qpc)
         v = _chroma_pass_v(v, qpc)
