@@ -89,3 +89,142 @@ def test_cli_unknown_command_fails():
 
     with pytest.raises(SystemExit):
         climod.main(["frobnicate"])
+
+
+def test_cli_manifests_regenerate(run, tmp_path, stack, cli, capsys):
+    """Build a real rung tree, delete the master, regenerate through the
+    CLI + admin route, and validate the result references every rung."""
+    import numpy as np
+
+    from vlog_tpu.db.core import now as db_now
+    from vlog_tpu.media.hls import validate_master_playlist
+
+    vid = _upload(cli, capsys, tmp_path, "Regen")
+    row = run(stack["db"].fetch_one(
+        "SELECT slug FROM videos WHERE id=:i", {"i": vid}))
+    slug = row["slug"]
+
+    # real single-rung encode into the stack's video dir
+    import quality_bench  # noqa: F401  (repo root on sys.path)
+    from vlog_tpu import config as cfg
+    from vlog_tpu.worker.pipeline import process_video
+
+    out_dir = stack["video_dir"] / slug
+    src = make_y4m(tmp_path / "regen_src.y4m", n_frames=6, width=64,
+                   height=48)
+    r = process_video(src, out_dir, audio=False, thumbnail=False,
+                      segment_duration_s=1.0,
+                      rungs=(cfg.QualityRung("48p", 48, 50_000, 0,
+                                             base_qp=30),))
+    t = db_now()
+    run(stack["db"].execute(
+        """
+        INSERT INTO video_qualities (video_id, name, width, height,
+            video_bitrate, codec, created_at)
+        VALUES (:v, '48p', 64, 48, 50000, 'h264', :t)
+        """, {"v": vid, "t": t}))
+    master = out_dir / "master.m3u8"
+    mpd = out_dir / "manifest.mpd"
+    master.unlink()
+    mpd.unlink()
+
+    cli.main(["manifests-regenerate", str(vid)])
+    out = capsys.readouterr().out
+    assert "variants=48p" in out
+    validate_master_playlist(master)
+    text = master.read_text()
+    assert "48p/playlist.m3u8" in text and "avc1." in text
+    assert "Representation" in mpd.read_text()
+
+
+def test_cli_manifests_regenerate_no_rungs_409(run, tmp_path, stack, cli,
+                                               capsys):
+    vid = _upload(cli, capsys, tmp_path, "NoRungs")
+    with pytest.raises(SystemExit):
+        cli.main(["manifests-regenerate", str(vid)])
+    assert "no intact rungs" in capsys.readouterr().err
+
+
+def test_cli_download_direct_url(run, tmp_path, stack, cli, capsys):
+    """Direct-URL ingest: serve a y4m over local HTTP, download, and
+    confirm the upload + queued job."""
+    import http.server
+    import threading
+
+    src = make_y4m(tmp_path / "dlsrc.y4m", n_frames=4, width=64,
+                   height=48)
+
+    class H(http.server.SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=str(tmp_path), **kw)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        port = srv.server_address[1]
+        cli.main(["download", f"http://127.0.0.1:{port}/dlsrc.y4m",
+                  "--title", "Downloaded"])
+        out = capsys.readouterr().out
+        assert "'Downloaded' uploaded" in out and "queued" in out
+    finally:
+        srv.shutdown()
+
+
+def test_cli_download_404_fails_cleanly(run, tmp_path, stack, cli, capsys):
+    import http.server
+    import threading
+
+    class H(http.server.SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=str(tmp_path), **kw)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        port = srv.server_address[1]
+        with pytest.raises(SystemExit):
+            cli.main(["download",
+                      f"http://127.0.0.1:{port}/missing.mp4"])
+    finally:
+        srv.shutdown()
+
+
+def test_cli_manifests_regenerate_ts_mode(run, tmp_path, stack, cli,
+                                          capsys):
+    """Legacy hls_ts trees regenerate too: the avc1 string is recovered
+    from SPS bytes inside the TS segments and no MPD is written."""
+    from vlog_tpu.db.core import now as db_now
+    from vlog_tpu import config as cfg
+    from vlog_tpu.media.hls import validate_master_playlist
+    from vlog_tpu.worker.pipeline import process_video
+
+    vid = _upload(cli, capsys, tmp_path, "TSRegen")
+    row = run(stack["db"].fetch_one(
+        "SELECT slug FROM videos WHERE id=:i", {"i": vid}))
+    out_dir = stack["video_dir"] / row["slug"]
+    src = make_y4m(tmp_path / "ts_src.y4m", n_frames=6, width=64,
+                   height=48)
+    process_video(src, out_dir, audio=False, thumbnail=False,
+                  segment_duration_s=1.0, streaming_format="hls_ts",
+                  rungs=(cfg.QualityRung("48p", 48, 50_000, 0,
+                                         base_qp=30),))
+    run(stack["db"].execute(
+        """
+        INSERT INTO video_qualities (video_id, name, width, height,
+            video_bitrate, codec, created_at)
+        VALUES (:v, '48p', 64, 48, 50000, 'h264', :t)
+        """, {"v": vid, "t": db_now()}))
+    (out_dir / "master.m3u8").unlink()
+    assert not (out_dir / "manifest.mpd").exists()   # TS mode: no MPD
+
+    cli.main(["manifests-regenerate", str(vid)])
+    assert "variants=48p" in capsys.readouterr().out
+    validate_master_playlist(out_dir / "master.m3u8")
+    assert "avc1." in (out_dir / "master.m3u8").read_text()
+    assert not (out_dir / "manifest.mpd").exists()

@@ -581,6 +581,111 @@ async def analytics_daily(request: web.Request) -> web.Response:
          "watch_time_s": r["watch_time_s"]} for r in rows]})
 
 
+async def regenerate_manifests(request: web.Request) -> web.Response:
+    """Rebuild master.m3u8 + manifest.mpd from the database qualities
+    and on-disk rung trees (reference CLI ``manifests-regenerate``):
+    the repair path when a master is lost/corrupted or rungs were moved.
+    Codec strings come from each rung's init.mp4 (media/codecstr.py) —
+    the DB only stores short names."""
+    db = request.app[DB]
+    vid = int(request.match_info["video_id"])
+    video = await vids.get_video(db, vid)
+    if video is None:
+        return _json_error(404, "no such video")
+    out_dir = request.app[VIDEO_DIR] / video["slug"]
+    quals = await db.fetch_all(
+        "SELECT * FROM video_qualities WHERE video_id=:v ORDER BY height",
+        {"v": vid})
+    # the whole rebuild reads every segment of every rung (deep
+    # validation BEFORE anything is overwritten) — off the event loop
+    result = await asyncio.to_thread(
+        _regenerate_manifests_sync, out_dir, video, quals)
+    if "error" in result:
+        return _json_error(result.pop("status", 409), result["error"])
+    audit = request.app.get(AUDIT)
+    if audit is not None:
+        audit.record("video.manifests_regenerated", video_id=vid,
+                     variants=result["variants"],
+                     skipped=result["skipped"])
+    return web.json_response({"ok": True, **result})
+
+
+def _regenerate_manifests_sync(out_dir: Path, video, quals) -> dict:
+    from vlog_tpu.media import hls
+    from vlog_tpu.media.codecstr import (codec_string_from_init,
+                                         codec_string_from_ts)
+    from vlog_tpu.utils.fsio import atomic_write_text
+
+    variants: list[hls.VariantRef] = []
+    skipped: list[str] = []
+    cmaf = True
+    for q in quals:
+        rdir = out_dir / q["name"]
+        playlist = rdir / "playlist.m3u8"
+        init = rdir / "init.mp4"
+        if not playlist.is_file():
+            skipped.append(q["name"])
+            continue
+        # deep-validate the rung (segments read + moof checks) BEFORE a
+        # new master could reference a half-broken tree
+        try:
+            hls.validate_media_playlist(playlist)
+        except hls.PlaylistValidationError:
+            skipped.append(q["name"])
+            continue
+        if init.is_file():
+            codecs = codec_string_from_init(init.read_bytes())
+        else:
+            # legacy hls_ts rung: SPS bytes live in the TS segments
+            cmaf = False
+            seg = next(iter(sorted(rdir.glob("segment_*.ts"))), None)
+            codecs = (codec_string_from_ts(seg.read_bytes())
+                      if seg is not None else None)
+        if codecs is None:
+            skipped.append(q["name"])
+            continue
+        abps = q["audio_bitrate"]
+        variants.append(hls.VariantRef(
+            name=q["name"], uri=f"{q['name']}/playlist.m3u8",
+            bandwidth=int(q["video_bitrate"] or 100_000),
+            width=q["width"], height=q["height"], codecs=codecs,
+            frame_rate=float(video["fps"] or 0.0),
+            audio_group=f"aud{abps // 1000}" if abps else ""))
+    if not variants:
+        return {"error": "no intact rungs to reference", "status": 409}
+    audio_refs: list[hls.AudioRendition] = []
+    for adir in sorted(out_dir.glob("audio_*k")):
+        if not (adir / "playlist.m3u8").is_file():
+            continue
+        try:
+            kbps = int(adir.name[len("audio_"):-1])
+        except ValueError:
+            continue
+        audio_refs.append(hls.AudioRendition(
+            name=adir.name, uri=f"{adir.name}/playlist.m3u8",
+            group_id=f"aud{kbps}", bitrate=kbps * 1000))
+    seg_s = config.SEGMENT_DURATION_S
+    try:
+        meta = hls.validate_media_playlist(
+            out_dir / variants[0].name / "playlist.m3u8")
+        if meta.get("segments"):
+            seg_s = meta["duration_s"] / meta["segments"]
+    except Exception:  # noqa: BLE001 — fall back to config default
+        pass
+    atomic_write_text(out_dir / "master.m3u8",
+                      hls.master_playlist(variants, audio=audio_refs))
+    if cmaf:
+        # TS mode has no DASH representation (same rule as the encode
+        # path: jax_backend writes the MPD only for CMAF trees)
+        atomic_write_text(out_dir / "manifest.mpd", hls.dash_manifest(
+            variants, duration_s=float(video["duration_s"] or 0.0),
+            segment_duration_s=seg_s, audio=audio_refs))
+    hls.validate_master_playlist(out_dir / "master.m3u8")
+    return {"variants": [v.name for v in variants],
+            "audio": [a.name for a in audio_refs],
+            "skipped": skipped}
+
+
 async def requeue_job(request: web.Request) -> web.Response:
     """Return a dead-lettered job to the claimable pool with a fresh
     retry budget."""
@@ -964,6 +1069,8 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_get("/api/videos/{video_id:\\d+}", video_detail)
     r.add_post("/api/videos/{video_id:\\d+}/retranscode", retranscode)
     r.add_post("/api/videos/{video_id:\\d+}/reencode", reencode)
+    r.add_post("/api/videos/{video_id:\\d+}/manifests/regenerate",
+               regenerate_manifests)
     r.add_get("/api/jobs", list_jobs)
     r.add_get("/api/jobs/failed", failed_jobs)
     r.add_post("/api/jobs/{job_id:\\d+}/requeue", requeue_job)

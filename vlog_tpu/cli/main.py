@@ -203,6 +203,70 @@ def cmd_webhooks(args) -> None:
             print("ok")
 
 
+def cmd_manifests_regenerate(args) -> None:
+    """Rebuild master.m3u8/manifest.mpd for a video from the DB +
+    on-disk rung trees (reference CLI manifests-regenerate)."""
+    with _client(ADMIN_URL) as c:
+        d = _ok(c.post(f"/api/videos/{args.video_id}/manifests/regenerate"))
+    print(f"regenerated: variants={','.join(d['variants'])}"
+          + (f" audio={','.join(d['audio'])}" if d.get("audio") else "")
+          + (f" skipped={','.join(d['skipped'])}" if d["skipped"] else ""))
+
+
+def cmd_download(args) -> None:
+    """Ingest a video FROM A URL: fetch to a temp file, then upload it
+    through the admin API (reference CLI download, which shells to
+    yt-dlp).  Direct media URLs stream over plain HTTP(S); for
+    portal/page URLs a system ``yt-dlp`` is used when installed."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    url = args.url
+    tmpdir = Path(tempfile.mkdtemp(prefix="vlog-dl-"))
+    try:
+        name = (url.rsplit("/", 1)[-1].split("?")[0] or "download") \
+            if "/" in url else "download"
+        target = tmpdir / (name if "." in name else name + ".mp4")
+        ytdlp = shutil.which("yt-dlp")
+        direct = any(name.lower().endswith(ext) for ext in
+                     (".mp4", ".mkv", ".webm", ".mov", ".y4m", ".ts",
+                      ".avi", ".m4v"))
+        if direct or ytdlp is None:
+            if not direct and ytdlp is None:
+                print("note: yt-dlp not installed; attempting a direct "
+                      "HTTP fetch", file=sys.stderr)
+            with httpx.stream("GET", url, follow_redirects=True,
+                              timeout=600.0) as r:
+                if r.status_code >= 400:
+                    print(f"error {r.status_code} fetching {url}",
+                          file=sys.stderr)
+                    sys.exit(1)
+                with open(target, "wb") as fp:
+                    for chunk in r.iter_bytes(1 << 20):
+                        fp.write(chunk)
+        else:
+            out_tpl = str(tmpdir / "%(title)s.%(ext)s")
+            proc = subprocess.run([ytdlp, "-o", out_tpl, "--no-playlist",
+                                   url])
+            if proc.returncode != 0:
+                sys.exit(proc.returncode)
+            files = [p for p in tmpdir.iterdir() if p.is_file()]
+            if not files:
+                print("yt-dlp produced no file", file=sys.stderr)
+                sys.exit(1)
+            target = max(files, key=lambda p: p.stat().st_size)
+        title = args.title or target.stem.replace("_", " ")
+        with _client(ADMIN_URL) as c, open(target, "rb") as fp:
+            d = _ok(c.post("/api/videos", data={"title": title},
+                           files={"file": (target.name, fp)}))
+        v = d["video"]
+        print(f"video #{v['id']} '{v['title']}' uploaded; "
+              f"job #{d['job_id']} queued")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def cmd_serve(args) -> None:
     if args.service == "worker-api":
         from vlog_tpu.api.worker_api import main as m
@@ -260,6 +324,18 @@ def build_parser() -> argparse.ArgumentParser:
     rt.add_argument("video_id", type=int)
     rt.add_argument("--force", action="store_true")
     rt.set_defaults(fn=cmd_retranscode)
+
+    mr = sub.add_parser("manifests-regenerate",
+                        help="rebuild master/DASH manifests for a video")
+    mr.add_argument("video_id", type=int)
+    mr.set_defaults(fn=cmd_manifests_regenerate)
+
+    dl = sub.add_parser("download",
+                        help="ingest a video from a URL (yt-dlp when "
+                             "installed, direct HTTP otherwise)")
+    dl.add_argument("url")
+    dl.add_argument("--title", default="")
+    dl.set_defaults(fn=cmd_download)
 
     w = sub.add_parser("workers", help="list the worker fleet")
     w.set_defaults(fn=cmd_workers)
