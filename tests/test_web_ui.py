@@ -263,3 +263,11 @@ def test_public_seek_strip_and_transcript_search(stack):  # noqa: F811
     assert "seek-strip" in html and "tr-search" in html
     assert "sprites_url" in js and "#xywh=" in js
     assert "loadSeekStrip" in js
+
+
+def test_public_playlist_queue(stack):  # noqa: F811
+    html = (WEB_ROOT / "public" / "index.html").read_text()
+    js = (WEB_ROOT / "public" / "app.js").read_text()
+    assert "pl-queue-list" in html
+    assert "loadPlaylistQueue" in js
+    assert '"ended"' in js          # auto-advance wired to the element

@@ -116,8 +116,11 @@ class RunResult:
     fps: float = 0.0
     segment_duration_s: float = 0.0
     # wall-clock accounting per pipeline stage (decode_wait_s /
-    # device_pull_s / entropy_s / package_s): where the e2e time went,
-    # so benches can report which stage bounds throughput
+    # compute_wait_s / device_pull_s / entropy_s / package_s): where the
+    # e2e time went, so benches can report which stage bounds
+    # throughput. compute_wait = block_until_ready on the async
+    # dispatch (pure device compute); device_pull = np.asarray AFTER
+    # readiness (pure device->host transfer)
     stage_s: dict = field(default_factory=dict)
     # chain length the run actually used (plan_for's segment-divisor
     # logic may pick a different value than config.GOP_LEN; 1 = intra)

@@ -356,8 +356,48 @@ async function openWatch(slug) {
   }
   loadTranscript(slug, video);
   loadSeekStrip(v, video, seq);
+  loadPlaylistQueue(slug, video, seq);
   loadRelated(slug);
   startAnalytics(slug, video);
+}
+
+/* Playlist watch queue: when a video was opened from a playlist, the
+   side column lists the playlist order, highlights the current entry,
+   and the player auto-advances on ended (reference public player's
+   playlist continuation). */
+async function loadPlaylistQueue(slug, video, seq) {
+  const box = $("pl-queue");
+  box.hidden = true;
+  if (!state.playlist) return;
+  let pd;
+  try {
+    pd = await j(`/api/playlists/${encodeURIComponent(state.playlist)}`);
+  } catch (e) { return; }
+  if (seq !== watchSeq) return;
+  const vids = pd.videos || [];
+  const idx = vids.findIndex((x) => x.slug === slug);
+  if (idx < 0) return;
+  box.hidden = false;
+  $("pl-queue-title").textContent =
+    `${pd.playlist.title} (${idx + 1}/${vids.length})`;
+  const list = $("pl-queue-list");
+  list.textContent = "";
+  vids.forEach((x, i) => {
+    const b = document.createElement("button");
+    b.textContent = `${i + 1}. ${x.title}`;
+    if (i === idx) b.className = "active";
+    b.onclick = () => { location.hash = `#/v/${x.slug}`; };
+    list.appendChild(b);
+  });
+  const onEnded = () => {
+    const next = vids[idx + 1];
+    if (next) location.hash = `#/v/${next.slug}`;
+  };
+  video.addEventListener("ended", onEnded);
+  watchCleanup.push(() => {
+    video.removeEventListener("ended", onEnded);
+    box.hidden = true;
+  });
 }
 
 async function loadRelated(slug) {
