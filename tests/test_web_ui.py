@@ -271,3 +271,72 @@ def test_public_playlist_queue(stack):  # noqa: F811
     assert "pl-queue-list" in html
     assert "loadPlaylistQueue" in js
     assert '"ended"' in js          # auto-advance wired to the element
+
+
+def test_admin_webhook_delivery_history():
+    html, js = _admin_html(), _admin_js()
+    assert "wh-hist-table" in html
+    assert "/deliveries" in js
+    import asyncio as _a
+    import tempfile as _tf
+
+    from aiohttp.test_utils import TestClient, TestServer as _TS
+
+    from vlog_tpu.api.admin_api import build_admin_app
+    from vlog_tpu.db import Database, create_all
+    from vlog_tpu.db.core import now as db_now
+
+    async def drive(tmp):
+        db2 = Database(f"sqlite:///{tmp}/wh.db")
+        await db2.connect()
+        await create_all(db2)
+        t = db_now()
+        wid = await db2.execute(
+            "INSERT INTO webhooks (url, events, secret, active, "
+            "created_at) VALUES ('https://example.com/h', '[]', '', 1, "
+            ":t)", {"t": t})
+        await db2.execute(
+            "INSERT INTO webhook_deliveries (webhook_id, event, payload, "
+            "status, attempts, response_code, created_at, delivered_at) "
+            "VALUES (:w, 'video.ready', '{}', 'delivered', 1, 200, :t, "
+            ":t)", {"w": wid, "t": t})
+        app = build_admin_app(db2)
+        H = {"X-Admin-Secret": config.ADMIN_SECRET}
+        async with TestClient(_TS(app)) as c2:
+            r = await c2.get(f"/api/webhooks/{wid}/deliveries", headers=H)
+            body = await r.json()
+            assert body["deliveries"][0]["event"] == "video.ready"
+            assert body["deliveries"][0]["response_code"] == 200
+            r404 = await c2.get("/api/webhooks/999/deliveries", headers=H)
+            assert r404.status == 404
+        await db2.disconnect()
+
+    with _tf.TemporaryDirectory() as tmp:
+        _a.run(drive(tmp))
+
+
+def test_webhook_deliveries_huge_id_is_404():
+    """\\d+ admits ints sqlite cannot bind; the route must 404, not
+    crash with OverflowError."""
+    import asyncio as _a
+    import tempfile as _tf
+
+    from aiohttp.test_utils import TestClient, TestServer as _TS
+
+    from vlog_tpu.api.admin_api import build_admin_app
+    from vlog_tpu.db import Database, create_all
+
+    async def drive(tmp):
+        db2 = Database(f"sqlite:///{tmp}/o.db")
+        await db2.connect()
+        await create_all(db2)
+        app = build_admin_app(db2)
+        H = {"X-Admin-Secret": config.ADMIN_SECRET}
+        async with TestClient(_TS(app)) as c2:
+            r = await c2.get("/api/webhooks/9" * 1 + "9" * 25
+                             + "/deliveries", headers=H)
+            assert r.status == 404
+        await db2.disconnect()
+
+    with _tf.TemporaryDirectory() as tmp:
+        _a.run(drive(tmp))

@@ -828,6 +828,27 @@ async def list_webhooks(request: web.Request) -> web.Response:
     return web.json_response({"webhooks": rows})
 
 
+async def webhook_deliveries(request: web.Request) -> web.Response:
+    """Recent delivery attempts for one webhook (reference webhook
+    admin's delivery log): status, attempts, response code, timing."""
+    db = request.app[DB]
+    wid = int(request.match_info["webhook_id"])
+    if wid > (1 << 62):      # \d+ admits ints sqlite cannot bind
+        return _json_error(404, "no such webhook")
+    if await db.fetch_one("SELECT id FROM webhooks WHERE id=:i",
+                          {"i": wid}) is None:
+        return _json_error(404, "no such webhook")
+    limit = _qnum(request.query, "limit", 50, lo=1, hi=500)
+    rows = await db.fetch_all(
+        """
+        SELECT id, event, status, attempts, response_code, created_at,
+               next_attempt_at, delivered_at
+        FROM webhook_deliveries WHERE webhook_id=:i
+        ORDER BY id DESC LIMIT :n
+        """, {"i": wid, "n": limit})
+    return web.json_response({"deliveries": rows})
+
+
 async def create_webhook(request: web.Request) -> web.Response:
     from vlog_tpu.jobs.webhooks import url_allowed
 
@@ -1084,6 +1105,8 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_delete("/api/settings/{key}", delete_setting)
     r.add_get("/api/webhooks", list_webhooks)
     r.add_post("/api/webhooks", create_webhook)
+    r.add_get("/api/webhooks/{webhook_id:\\d+}/deliveries",
+              webhook_deliveries)
     r.add_delete("/api/webhooks/{webhook_id:\\d+}", delete_webhook)
     r.add_get("/api/workers", list_workers)
     r.add_post("/api/workers/{name}/revoke", revoke_worker)

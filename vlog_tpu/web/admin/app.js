@@ -841,8 +841,31 @@ async function loadWebhooks() {
   tb.textContent = "";
   for (const w of d.webhooks) {
     const tr = document.createElement("tr");
-    cells(tr, [w.id, w.url, w.events.join(", ") || "all", w.active ? "yes" : "no",
-      actionBtn("delete", async () => { await api(`/api/webhooks/${w.id}`, { method: "DELETE" }); loadWebhooks(); })]);
+    const acts = document.createElement("div");
+    acts.className = "row-actions";
+    acts.append(
+      actionBtn("history", async () => {
+        const h = await api(`/api/webhooks/${w.id}/deliveries`);
+        const tb2 = $("wh-hist-table").tBodies[0];
+        tb2.textContent = "";
+        $("wh-hist").hidden = false;
+        $("wh-hist-title").textContent = `Deliveries for #${w.id} ${w.url}`;
+        for (const dl of h.deliveries) {
+          const tr2 = document.createElement("tr");
+          cells(tr2, [dl.event, badge(dl.status), dl.attempts,
+            dl.response_code ?? "—", fmtAgo(dl.created_at),
+            dl.delivered_at ? fmtAgo(dl.delivered_at) : "—"]);
+          tb2.appendChild(tr2);
+        }
+        $("wh-hist-empty").hidden = h.deliveries.length > 0;
+      }),
+      actionBtn("delete", async () => {
+        await api(`/api/webhooks/${w.id}`, { method: "DELETE" });
+        $("wh-hist").hidden = true;   // the panel may show this webhook
+        loadWebhooks();
+      }));
+    cells(tr, [w.id, w.url, w.events.join(", ") || "all",
+      w.active ? "yes" : "no", acts]);
     tb.appendChild(tr);
   }
 }
