@@ -65,6 +65,19 @@ def _qnum(query, name: str, default, *, lo=None, hi=None, cast=int):
 
 AUDIT = web.AppKey("audit", object)
 
+
+def _path_id(request: web.Request, key: str) -> int:
+    """Parse a ``{name:\\d+}`` path id.  The regex admits digit strings
+    larger than sqlite's INTEGER (2^63) — binding those raises
+    OverflowError deep in the driver and surfaces as a 500; any id that
+    big simply doesn't exist, so it is a 404."""
+    val = int(request.match_info[key])
+    if val > (1 << 62):
+        raise web.HTTPNotFound(text=json.dumps(
+            {"error": f"no such {key.removesuffix('_id')}"}),
+            content_type="application/json")
+    return val
+
 # --------------------------------------------------------------------------
 # Cookie sessions + CSRF (reference admin.py:1088-1234): the admin SPA
 # logs in once with the secret and holds an HttpOnly session cookie;
@@ -385,7 +398,7 @@ async def list_videos(request: web.Request) -> web.Response:
 
 async def video_detail(request: web.Request) -> web.Response:
     db = request.app[DB]
-    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    video = await vids.get_video(db, _path_id(request, "video_id"))
     if video is None:
         return _json_error(404, "no such video")
     quals = await db.fetch_all(
@@ -408,7 +421,7 @@ async def video_detail(request: web.Request) -> web.Response:
 async def retranscode(request: web.Request) -> web.Response:
     """Force re-enqueue (reference admin.py retranscode, 2883)."""
     db = request.app[DB]
-    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    video = await vids.get_video(db, _path_id(request, "video_id"))
     if video is None:
         return _json_error(404, "no such video")
     force = bool((await request.json() if request.can_read_body else {}
@@ -426,7 +439,7 @@ async def reencode(request: web.Request) -> web.Response:
     """Queue a format/codec conversion (reference reencode queue,
     admin.py:6297-6687)."""
     db = request.app[DB]
-    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    video = await vids.get_video(db, _path_id(request, "video_id"))
     if video is None:
         return _json_error(404, "no such video")
     body = await request.json() if request.can_read_body else {}
@@ -588,7 +601,7 @@ async def regenerate_manifests(request: web.Request) -> web.Response:
     Codec strings come from each rung's init.mp4 (media/codecstr.py) —
     the DB only stores short names."""
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     video = await vids.get_video(db, vid)
     if video is None:
         return _json_error(404, "no such video")
@@ -690,7 +703,7 @@ async def requeue_job(request: web.Request) -> web.Response:
     """Return a dead-lettered job to the claimable pool with a fresh
     retry budget."""
     db = request.app[DB]
-    job_id = int(request.match_info["job_id"])
+    job_id = _path_id(request, "job_id")
     job = await db.fetch_one("SELECT * FROM jobs WHERE id=:id",
                              {"id": job_id})
     if job is None:
@@ -711,7 +724,7 @@ async def requeue_job(request: web.Request) -> web.Response:
 async def delete_video(request: web.Request) -> web.Response:
     """Soft delete (reference admin.py:2500: restorable)."""
     db = request.app[DB]
-    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    video = await vids.get_video(db, _path_id(request, "video_id"))
     if video is None:
         return _json_error(404, "no such video")
     await db.execute(
@@ -722,7 +735,7 @@ async def delete_video(request: web.Request) -> web.Response:
 
 async def restore_video(request: web.Request) -> web.Response:
     db = request.app[DB]
-    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    video = await vids.get_video(db, _path_id(request, "video_id"))
     if video is None or video["deleted_at"] is None:
         return _json_error(404, "not deleted")
     has_master = (request.app[VIDEO_DIR] / video["slug"] / "master.m3u8").exists()
@@ -832,9 +845,7 @@ async def webhook_deliveries(request: web.Request) -> web.Response:
     """Recent delivery attempts for one webhook (reference webhook
     admin's delivery log): status, attempts, response code, timing."""
     db = request.app[DB]
-    wid = int(request.match_info["webhook_id"])
-    if wid > (1 << 62):      # \d+ admits ints sqlite cannot bind
-        return _json_error(404, "no such webhook")
+    wid = _path_id(request, "webhook_id")
     if await db.fetch_one("SELECT id FROM webhooks WHERE id=:i",
                           {"i": wid}) is None:
         return _json_error(404, "no such webhook")
@@ -871,7 +882,7 @@ async def create_webhook(request: web.Request) -> web.Response:
 async def delete_webhook(request: web.Request) -> web.Response:
     n = await request.app[DB].execute(
         "DELETE FROM webhooks WHERE id=:id",
-        {"id": int(request.match_info["webhook_id"])})
+        {"id": _path_id(request, "webhook_id")})
     return web.json_response({"ok": True, "deleted": bool(n)})
 
 
@@ -922,14 +933,14 @@ async def get_chapters(request: web.Request) -> web.Response:
     db = request.app[DB]
     rows = await db.fetch_all(
         "SELECT start_s, title, source FROM chapters WHERE video_id=:v "
-        "ORDER BY start_s", {"v": int(request.match_info["video_id"])})
+        "ORDER BY start_s", {"v": _path_id(request, "video_id")})
     return web.json_response({"chapters": rows})
 
 
 async def put_chapters(request: web.Request) -> web.Response:
     """Replace a video's chapter list (reference admin.py chapters CRUD)."""
     db = request.app[DB]
-    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    video = await vids.get_video(db, _path_id(request, "video_id"))
     if video is None:
         return _json_error(404, "no such video")
     body = await request.json()
@@ -963,7 +974,7 @@ async def detect_chapters(request: web.Request) -> web.Response:
                                          suggest_from_transcript)
 
     db = request.app[DB]
-    video = await vids.get_video(db, int(request.match_info["video_id"]))
+    video = await vids.get_video(db, _path_id(request, "video_id"))
     if video is None:
         return _json_error(404, "no such video")
     found = []

@@ -25,7 +25,7 @@ from aiohttp import web
 from vlog_tpu.db.core import Database, now as db_now  # noqa: F401
 # AppKeys are identity-keyed: reuse admin_api's instances (admin_api only
 # imports this module inside build_admin_app, so there is no cycle)
-from vlog_tpu.api.admin_api import DB, VIDEO_DIR
+from vlog_tpu.api.admin_api import DB, VIDEO_DIR, _path_id
 from vlog_tpu.enums import JobKind, VideoStatus
 from vlog_tpu.jobs import claims, state as js, videos as vids
 
@@ -89,7 +89,7 @@ async def create_playlist(request: web.Request) -> web.Response:
 
 async def playlist_detail(request: web.Request) -> web.Response:
     db = request.app[DB]
-    pid = int(request.match_info["playlist_id"])
+    pid = _path_id(request, "playlist_id")
     row = await db.fetch_one("SELECT * FROM playlists WHERE id=:i",
                              {"i": pid})
     if row is None:
@@ -106,7 +106,7 @@ async def playlist_detail(request: web.Request) -> web.Response:
 
 async def update_playlist(request: web.Request) -> web.Response:
     db = request.app[DB]
-    pid = int(request.match_info["playlist_id"])
+    pid = _path_id(request, "playlist_id")
     body = await request.json()
     sets, params = ["updated_at=:t"], {"t": db_now(), "i": pid}
     if "title" in body:
@@ -135,7 +135,7 @@ async def update_playlist(request: web.Request) -> web.Response:
 async def delete_playlist(request: web.Request) -> web.Response:
     n = await request.app[DB].execute(
         "DELETE FROM playlists WHERE id=:i",
-        {"i": int(request.match_info["playlist_id"])})
+        {"i": _path_id(request, "playlist_id")})
     if not n:
         return _json_error(404, "no such playlist")
     return web.json_response({"ok": True})
@@ -143,7 +143,7 @@ async def delete_playlist(request: web.Request) -> web.Response:
 
 async def playlist_add_video(request: web.Request) -> web.Response:
     db = request.app[DB]
-    pid = int(request.match_info["playlist_id"])
+    pid = _path_id(request, "playlist_id")
     body = await request.json()
     vid = body.get("video_id")
     if not isinstance(vid, int):
@@ -177,8 +177,8 @@ async def playlist_add_video(request: web.Request) -> web.Response:
 
 async def playlist_remove_video(request: web.Request) -> web.Response:
     db = request.app[DB]
-    pid = int(request.match_info["playlist_id"])
-    vid = int(request.match_info["video_id"])
+    pid = _path_id(request, "playlist_id")
+    vid = _path_id(request, "video_id")
     n = await db.execute(
         "DELETE FROM playlist_items WHERE playlist_id=:p AND video_id=:v",
         {"p": pid, "v": vid})
@@ -193,7 +193,7 @@ async def playlist_reorder(request: web.Request) -> web.Response:
     """PUT an explicit video-id order; positions are rewritten 0..n-1
     (reference admin.py reorder semantics)."""
     db = request.app[DB]
-    pid = int(request.match_info["playlist_id"])
+    pid = _path_id(request, "playlist_id")
     body = await request.json()
     order = body.get("video_ids")
     if (not isinstance(order, list)
@@ -273,7 +273,7 @@ async def create_custom_field(request: web.Request) -> web.Response:
 async def delete_custom_field(request: web.Request) -> web.Response:
     n = await request.app[DB].execute(
         "DELETE FROM custom_fields WHERE id=:i",
-        {"i": int(request.match_info["field_id"])})
+        {"i": _path_id(request, "field_id")})
     if not n:
         return _json_error(404, "no such field")
     return web.json_response({"ok": True})
@@ -306,7 +306,7 @@ def _validate_value(ftype: str, options: list, value) -> str | None:
 
 async def get_video_custom_values(request: web.Request) -> web.Response:
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     rows = await db.fetch_all(
         """
         SELECT f.name, f.label, f.field_type, cv.value
@@ -321,7 +321,7 @@ async def get_video_custom_values(request: web.Request) -> web.Response:
 async def put_video_custom_values(request: web.Request) -> web.Response:
     """Upsert a {field_name: value} map for one video."""
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     if await db.fetch_one("SELECT id FROM videos WHERE id=:v", {"v": vid}) \
             is None:
         return _json_error(404, "no such video")
@@ -371,7 +371,7 @@ async def put_video_custom_values(request: web.Request) -> web.Response:
 async def set_thumbnail_from_time(request: web.Request) -> web.Response:
     """Re-grab the thumbnail from a timestamp of the stored source."""
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     row = await db.fetch_one("SELECT * FROM videos WHERE id=:v", {"v": vid})
     if row is None or not row["source_path"]:
         return _json_error(404, "no such video (or source dropped)")
@@ -425,7 +425,7 @@ async def get_thumbnail(request: web.Request) -> web.Response:
     serves it from the media tree; the admin plane is a different
     origin/port, so it needs its own authenticated route)."""
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     row = await db.fetch_one("SELECT * FROM videos WHERE id=:v", {"v": vid})
     if row is None or not row["thumbnail_path"]:
         return _json_error(404, "no thumbnail")
@@ -439,7 +439,7 @@ async def get_thumbnail(request: web.Request) -> web.Response:
 async def upload_thumbnail(request: web.Request) -> web.Response:
     """Accept a custom JPEG thumbnail body (content-type image/jpeg)."""
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     row = await db.fetch_one("SELECT * FROM videos WHERE id=:v", {"v": vid})
     if row is None:
         return _json_error(404, "no such video")
@@ -471,7 +471,7 @@ async def upload_thumbnail(request: web.Request) -> web.Response:
 
 async def get_transcript_admin(request: web.Request) -> web.Response:
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     tr = await db.fetch_one(
         "SELECT * FROM transcriptions WHERE video_id=:v", {"v": vid})
     if tr is None:
@@ -485,7 +485,7 @@ async def get_transcript_admin(request: web.Request) -> web.Response:
 async def put_transcript(request: web.Request) -> web.Response:
     """Replace the transcript text/VTT (manual correction flow)."""
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     row = await db.fetch_one("SELECT * FROM videos WHERE id=:v", {"v": vid})
     if row is None:
         return _json_error(404, "no such video")
@@ -525,7 +525,7 @@ async def put_transcript(request: web.Request) -> web.Response:
 
 async def delete_transcript(request: web.Request) -> web.Response:
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     n = await db.execute("DELETE FROM transcriptions WHERE video_id=:v",
                          {"v": vid})
     if not n:
@@ -589,7 +589,7 @@ async def get_sprites(request: web.Request) -> web.Response:
     sprite worker wrote (worker/sprites.py; reference sprite admin
     routes) into cue dicts the UI can lay out without a VTT parser."""
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     row = await db.fetch_one("SELECT * FROM videos WHERE id=:v", {"v": vid})
     if row is None:
         return _json_error(404, "no such video")
@@ -629,7 +629,7 @@ async def get_sprite_sheet(request: web.Request) -> web.Response:
     """Serve one sprite sheet JPEG to the admin UI (different origin
     from the public media tree, same reason as get_thumbnail)."""
     db = request.app[DB]
-    vid = int(request.match_info["video_id"])
+    vid = _path_id(request, "video_id")
     row = await db.fetch_one("SELECT * FROM videos WHERE id=:v", {"v": vid})
     if row is None:
         return _json_error(404, "no such video")

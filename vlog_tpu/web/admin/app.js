@@ -849,6 +849,7 @@ async function loadWebhooks() {
         const tb2 = $("wh-hist-table").tBodies[0];
         tb2.textContent = "";
         $("wh-hist").hidden = false;
+        $("wh-hist").dataset.webhookId = String(w.id);
         $("wh-hist-title").textContent = `Deliveries for #${w.id} ${w.url}`;
         for (const dl of h.deliveries) {
           const tr2 = document.createElement("tr");
@@ -861,7 +862,9 @@ async function loadWebhooks() {
       }),
       actionBtn("delete", async () => {
         await api(`/api/webhooks/${w.id}`, { method: "DELETE" });
-        $("wh-hist").hidden = true;   // the panel may show this webhook
+        if ($("wh-hist").dataset.webhookId === String(w.id)) {
+          $("wh-hist").hidden = true;   // panel showed THIS webhook
+        }
         loadWebhooks();
       }));
     cells(tr, [w.id, w.url, w.events.join(", ") || "all",
