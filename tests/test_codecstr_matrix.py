@@ -1,0 +1,79 @@
+"""RFC 6381 recovery matrix (media/codecstr.py): every codec family the
+manifest-regeneration path can meet, including garbage and truncation.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from vlog_tpu.media.codecstr import (codec_string_from_init,
+                                     codec_string_from_ts)
+
+
+def _avcc(profile, compat, level) -> bytes:
+    return b"\x00\x00\x00\x30avcC" + bytes([1, profile, compat, level,
+                                            0xFF, 0xE1])
+
+
+@pytest.mark.parametrize("profile,compat,level,want", [
+    (0x42, 0xC0, 0x1E, "avc1.42C01E"),     # baseline 3.0 (our streams)
+    (0x4D, 0x40, 0x28, "avc1.4D4028"),     # main 4.0
+    (0x64, 0x00, 0x33, "avc1.640033"),     # high 5.1
+    (0x42, 0x00, 0x0A, "avc1.42000A"),     # baseline 1.0
+])
+def test_avc_strings(profile, compat, level, want):
+    assert codec_string_from_init(_avcc(profile, compat, level)) == want
+
+
+@pytest.mark.parametrize("level", [63, 93, 123, 153])
+def test_hvc_levels_roundtrip(level):
+    """hvcC built by our own encoder parses back to the declared
+    string at every ladder level."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from vlog_tpu.codecs.hevc.api import HevcEncoder
+
+    sizes = {63: (640, 360), 93: (1280, 720), 123: (1920, 1080),
+             153: (3840, 2160)}
+    w, h = sizes[level]
+    e = HevcEncoder(width=w, height=h, qp=30)
+    blob = b"xxxx" + b"hvcC" + e.hvcc_config
+    assert codec_string_from_init(blob) == e.codec_string
+
+
+@pytest.mark.parametrize("b1,b2,want", [
+    (0b000_01000, 0b0_0_0_0_0000, "av01.0.08M.08"),   # main, L4.0, 8bit
+    (0b001_01101, 0b1_0_0_0_0000, "av01.1.13H.08"),   # high, L5.1, tier H
+    (0b000_00101, 0b0_1_0_0_0000, "av01.0.05M.10"),   # 10-bit
+])
+def test_av1_strings(b1, b2, want):
+    blob = b"\x00\x00\x00\x10av1C" + bytes([0x81, b1, b2, 0])
+    assert codec_string_from_init(blob) == want
+
+
+@pytest.mark.parametrize("blob", [
+    b"",                              # empty
+    b"no boxes at all here",          # no 4CC
+    b"xxxxavcC" + b"\x01",            # truncated avcC -> IndexError risk
+    b"xxxxhvcC" + b"\x01" * 12,       # truncated hvcC (needs 13)
+    b"xxxxav1C" + b"\x81",            # truncated av1C (needs 3)
+])
+def test_garbage_inits(blob):
+    try:
+        out = codec_string_from_init(blob)
+    except IndexError:
+        pytest.fail("parser must not raise on truncated boxes")
+    assert out is None or isinstance(out, str)
+
+
+def test_ts_sps_scan_skips_non_sps_nals():
+    # a non-SPS NAL first (type 1), then the SPS
+    seg = (b"\x00\x00\x01\x41junk" + b"pad" * 10
+           + b"\x00\x00\x01\x67\x64\x00\x33after")
+    assert codec_string_from_ts(seg) == "avc1.640033"
+
+
+def test_ts_sps_absent_is_none():
+    assert codec_string_from_ts(b"\x00" * 400) is None
+    assert codec_string_from_ts(b"") is None

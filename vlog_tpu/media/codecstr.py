@@ -20,15 +20,17 @@ def _find_box(data: bytes, name: bytes) -> int:
 
 
 def codec_string_from_init(init: bytes) -> str | None:
-    """Best-effort RFC 6381 string for the (single) video track."""
+    """Best-effort RFC 6381 string for the (single) video track.
+    Damaged/truncated boxes yield None, never an exception — the
+    manifest-repair path runs this on possibly-corrupt trees."""
     i = _find_box(init, b"avcC")
-    if i >= 0:
+    if i >= 0 and len(init) >= i + 4:
         # configurationVersion, AVCProfileIndication,
         # profile_compatibility, AVCLevelIndication
         p, c, l = init[i + 1], init[i + 2], init[i + 3]
         return f"avc1.{p:02X}{c:02X}{l:02X}"
     i = _find_box(init, b"hvcC")
-    if i >= 0:
+    if i >= 0 and len(init) >= i + 13:
         b = init[i + 1]
         profile_idc = b & 0x1F
         tier = "H" if b & 0x20 else "L"
@@ -42,7 +44,7 @@ def codec_string_from_init(init: bytes) -> str | None:
                          cons[:max(1, len(cons.rstrip(b'\x00')))])
         return f"hvc1.{profile_idc}.{rev:X}.{tier}{level}{cons_s}"
     i = _find_box(init, b"av1C")
-    if i >= 0:
+    if i >= 0 and len(init) >= i + 3:
         return _av1_string(init, i)
     return None
 
