@@ -167,3 +167,28 @@ def test_sprites_route_parses_real_tree(stack):  # noqa: F811
         # non-jpg names and traversal stay out
         assert c.get(
             f"/api/videos/{vid}/sprites/sprites.vtt").status_code == 404
+
+
+def test_request_id_on_all_planes(stack):  # noqa: F811
+    """Every plane echoes a sane caller id, mints one otherwise, and
+    carries the header on error responses too (reference common.py
+    X-Request-ID middleware)."""
+    with httpx.Client(base_url=stack["public"]) as cp:
+        r = cp.get("/api/videos", headers={"X-Request-ID": "trace-123"})
+        assert r.headers["X-Request-ID"] == "trace-123"
+        r2 = cp.get("/api/videos")
+        assert len(r2.headers["X-Request-ID"]) == 16
+        # garbage ids (header injection shapes) are replaced
+        r3 = cp.get("/api/videos", headers={"X-Request-ID": "a b\tc" * 40})
+        assert r3.headers["X-Request-ID"] != "a b\tc" * 40
+    with httpx.Client(base_url=stack["admin"]) as ca:
+        r = ca.get("/api/settings", headers={"X-Request-ID": "op.7"})
+        assert r.headers["X-Request-ID"] == "op.7"
+        # present on auth-failure responses
+        r4 = ca.get("/api/videos/999999", headers={"X-Request-ID": "x-1"})
+        assert r4.headers.get("X-Request-ID") == "x-1"
+        # present on FRAMEWORK HTTPException responses (unrouted 404
+        # raises web.HTTPNotFound inside aiohttp itself)
+        r5 = ca.get("/api/no-such-route", headers={"X-Request-ID": "x-2"})
+        assert r5.status_code in (403, 404)
+        assert r5.headers.get("X-Request-ID") == "x-2"

@@ -575,6 +575,10 @@ def test_admin_500_sanitized(run):
         method = "GET"
         path = "/api/x"
 
+        @staticmethod
+        def get(key, default=None):
+            return default        # request-scoped storage (request_id)
+
     async def boom(request):
         raise RuntimeError("stat('/srv/secret/path') failed: "
                            "Permission denied")

@@ -1080,7 +1080,8 @@ async def admin_error_middleware(request: web.Request, handler):
     except web.HTTPException:
         raise
     except Exception as exc:   # noqa: BLE001 — boundary sanitizer
-        log.exception("unhandled admin error on %s %s", request.method,
+        log.exception("unhandled admin error rid=%s on %s %s",
+                      request.get("request_id", "-"), request.method,
                       request.path)
         return _json_error(500, sanitize_error(exc))
 
@@ -1088,7 +1089,10 @@ async def admin_error_middleware(request: web.Request, handler):
 def build_admin_app(db: Database, *, upload_dir: Path | None = None,
                     video_dir: Path | None = None,
                     audit_path: Path | str | None = None) -> web.Application:
-    app = web.Application(middlewares=[admin_error_middleware,
+    from vlog_tpu.api.errors import request_id_middleware
+
+    app = web.Application(middlewares=[request_id_middleware,
+                                       admin_error_middleware,
                                        admin_auth_middleware],
                           client_max_size=config.MAX_UPLOAD_SIZE_BYTES)
     app[DB] = db

@@ -69,3 +69,46 @@ def public_job_error(error: str | None) -> str | None:
     if not error:
         return None
     return sanitize_error(error)
+
+
+# --------------------------------------------------------------------------
+# Request-ID tracing (reference common.py X-Request-ID middleware):
+# every response carries an id — caller-supplied when sane, minted
+# otherwise — and unhandled errors log it, so a user-reported failure
+# can be joined to its log line across all three API planes.
+# --------------------------------------------------------------------------
+
+_REQ_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+
+# imported lazily at module top keeps errors.py usable without aiohttp?
+# no — every consumer is an aiohttp app; import plainly.
+import uuid as _uuid  # noqa: E402
+
+from aiohttp import web as _web  # noqa: E402
+
+
+@_web.middleware
+async def request_id_middleware(request, handler):
+    """Outermost middleware on every plane: every response (including
+    framework HTTPExceptions and unhandled 500s) carries X-Request-ID,
+    and an unhandled exception that reaches this tier is converted to a
+    sanitized 500 WITH the id — so the one response class where log
+    correlation matters most never ships without it.  Planes with their
+    own error middleware log rid themselves (they sit inside this one
+    and catch first)."""
+    rid = request.headers.get("X-Request-ID", "")
+    if not _REQ_ID_RE.match(rid):
+        rid = _uuid.uuid4().hex[:16]
+    request["request_id"] = rid
+    try:
+        resp = await handler(request)
+    except _web.HTTPException as exc:
+        exc.headers["X-Request-ID"] = rid
+        raise
+    except Exception as exc:  # noqa: BLE001 — boundary conversion
+        log.exception("unhandled error rid=%s %s %s", rid,
+                      request.method, request.path)
+        resp = _web.json_response(
+            {"error": sanitize_error(exc)}, status=500)
+    resp.headers["X-Request-ID"] = rid
+    return resp

@@ -416,7 +416,8 @@ async def error_middleware(request: web.Request, handler):
     except web.HTTPException:
         raise
     except Exception as exc:   # noqa: BLE001 — boundary sanitizer
-        log.exception("unhandled error on %s %s", request.method,
+        log.exception("unhandled error rid=%s on %s %s",
+                      request.get("request_id", "-"), request.method,
                       request.path)
         return _json_error(500, sanitize_error(exc))
 
@@ -425,7 +426,10 @@ def build_public_app(db: Database, *, video_dir: Path | None = None
                      ) -> web.Application:
     from vlog_tpu.api.settings import SettingsService
 
-    app = web.Application(middlewares=[error_middleware])
+    from vlog_tpu.api.errors import request_id_middleware
+
+    app = web.Application(middlewares=[request_id_middleware,
+                                       error_middleware])
     app[DB] = db
     app[VIDEO_DIR] = Path(video_dir or config.VIDEO_DIR)
     app[SETTINGS_SVC] = SettingsService(db)

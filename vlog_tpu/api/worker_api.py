@@ -519,7 +519,10 @@ async def list_workers(request: web.Request) -> web.Response:
 # --------------------------------------------------------------------------
 
 def build_worker_app(db: Database, video_dir: Path | None = None) -> web.Application:
-    app = web.Application(middlewares=[metrics_middleware, auth_middleware],
+    from vlog_tpu.api.errors import request_id_middleware
+
+    app = web.Application(middlewares=[request_id_middleware,
+                                       metrics_middleware, auth_middleware],
                           client_max_size=MAX_UPLOAD_PART)
     app[DB] = db
     app[VIDEO_DIR] = Path(video_dir or config.VIDEO_DIR)
