@@ -428,12 +428,16 @@ def run_entropy() -> None:
     # factor). Calibrate with the textbook bits-halve-per-6-QP slope.
     target_bpf = sum(r.video_bitrate for r in ladder) / 8.0 / 30.0
     qps = _chain_qps(np, rungs, clen)
+    # one worker-count for the probe pool, the measurement pool, and
+    # the per-vCPU normalization (C coders release the GIL: scaling is
+    # by core, and the divisor must match what the pool can use)
+    n_workers = max(1, min(16, os.cpu_count() or 1))
     import math as _math
 
     best = None          # (log-distance, per_rung, total_mbs, bpf)
     for _ in range(4):
         per_rung, total_mbs = stage(qps)
-        with ThreadPoolExecutor(max(1, min(16, os.cpu_count() or 1))) as p0:
+        with ThreadPoolExecutor(n_workers) as p0:
             probe = [enc.encode_chain(lv0, p_list, qarr, None, pool=p0)
                      for enc, lv0, p_list, qarr, _ in per_rung]
         bpf = sum(len(ef.avcc) for rung in probe
@@ -456,10 +460,6 @@ def run_entropy() -> None:
 
     # Exactly the production shape: rungs serial, frames within a chain
     # parallel on the shared 16-thread pool (consume_chain's loop).
-    # Pool width = min(16, vcpus): the C coders release the GIL, so
-    # throughput scales by core; on a 1-vCPU VM wider pools only add
-    # overhead. Production TPU hosts carry 100+ vCPUs.
-    n_workers = max(1, min(16, os.cpu_count() or 1))
     pool = ThreadPoolExecutor(max_workers=n_workers)
 
     def code_all():
@@ -488,8 +488,7 @@ def run_entropy() -> None:
         # per-vCPU normalization: the C coders release the GIL and
         # frames are independent, so entropy scales ~linearly with host
         # cores — a production TPU host (100+ vCPUs) multiplies this
-        "entropy_mb_per_s_per_vcpu": round(
-            mb_per_s / max(os.cpu_count() or 1, 1), 0),
+        "entropy_mb_per_s_per_vcpu": round(mb_per_s / n_workers, 0),
         "entropy_ladder_fps_1080p": round(clen / dt, 2),
         "entropy_ladder_fps_4k_equiv": round(mb_per_s / mb_4k, 2),
         "entropy_bytes_per_frame": round(coded_bytes / clen, 0),

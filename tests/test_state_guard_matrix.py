@@ -1,0 +1,161 @@
+"""Job state-machine guard matrix: every (row shape, guard) pair the
+claim protocol can reach (reference job_state.py's transition tests).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from vlog_tpu.enums import JobState
+from vlog_tpu.jobs import state as js
+
+NOW = 1_000_000.0
+
+
+def _row(**kw) -> dict:
+    base = {"completed_at": None, "failed_at": None, "claimed_by": None,
+            "claim_expires_at": None, "attempt": 0, "max_attempts": 3}
+    base.update(kw)
+    return base
+
+
+UNCLAIMED = _row()
+RETRYING = _row(attempt=1)
+CLAIMED = _row(claimed_by="w1", claim_expires_at=NOW + 60, attempt=1)
+EXPIRED = _row(claimed_by="w1", claim_expires_at=NOW - 1, attempt=1)
+COMPLETED = _row(completed_at=NOW - 5)
+FAILED = _row(failed_at=NOW - 5)
+EXHAUSTED = _row(attempt=3)
+
+
+@pytest.mark.parametrize("row,want", [
+    (UNCLAIMED, JobState.UNCLAIMED),
+    (RETRYING, JobState.RETRYING),
+    (CLAIMED, JobState.CLAIMED),
+    (EXPIRED, JobState.EXPIRED),
+    (COMPLETED, JobState.COMPLETED),
+    (FAILED, JobState.FAILED),
+])
+def test_derive_state_matrix(row, want):
+    assert js.derive_state(row, now=NOW) is want
+
+
+@pytest.mark.parametrize("row,ok", [
+    (UNCLAIMED, True),
+    (RETRYING, True),
+    (EXPIRED, True),          # lapsed lease is reclaimable
+    (CLAIMED, False),
+    (COMPLETED, False),
+    (FAILED, False),
+    (EXHAUSTED, False),       # claimable state but no budget left
+])
+def test_guard_claim_matrix(row, ok):
+    if ok:
+        js.guard_claim(row, now=NOW)
+    else:
+        with pytest.raises(js.JobStateError):
+            js.guard_claim(row, now=NOW)
+
+
+@pytest.mark.parametrize("row,worker,ok", [
+    (CLAIMED, "w1", True),
+    (CLAIMED, "w2", False),   # not the lease holder
+    (EXPIRED, "w1", False),   # lease lapsed mid-work
+    (UNCLAIMED, "w1", False),
+    (COMPLETED, "w1", False),
+])
+def test_guard_progress_matrix(row, worker, ok):
+    if ok:
+        js.guard_progress(row, worker, now=NOW)
+    else:
+        with pytest.raises(js.JobStateError):
+            js.guard_progress(row, worker, now=NOW)
+
+
+@pytest.mark.parametrize("row,worker,ok", [
+    (CLAIMED, "w1", True),
+    (CLAIMED, "w2", False),
+    # lease lapsed but NOBODY reclaimed: the original holder may still
+    # land its finished work (grace completion — reclaim flips
+    # claimed_by, which is the actual double-complete guard)
+    (EXPIRED, "w1", True),
+    (_row(claimed_by="w2", claim_expires_at=NOW + 60, attempt=2),
+     "w1", False),            # reclaimed by w2: w1's completion rejected
+    (FAILED, "w1", False),
+])
+def test_guard_complete_matrix(row, worker, ok):
+    if ok:
+        js.guard_complete(row, worker, now=NOW)
+    else:
+        with pytest.raises(js.JobStateError):
+            js.guard_complete(row, worker, now=NOW)
+
+
+def test_sql_fragments_agree_with_derivation():
+    """The composable SQL conditions select exactly the rows whose
+    derived state matches — checked against real sqlite."""
+    import sqlite3
+
+    rows = {
+        "unclaimed": UNCLAIMED, "retrying": RETRYING,
+        "claimed": CLAIMED, "expired": EXPIRED,
+        "completed": COMPLETED, "failed": FAILED,
+    }
+    con = sqlite3.connect(":memory:")
+    con.execute(
+        "CREATE TABLE jobs (name TEXT, completed_at REAL, failed_at REAL,"
+        " claimed_by TEXT, claim_expires_at REAL, attempt INT,"
+        " max_attempts INT)")
+    for name, r in rows.items():
+        con.execute(
+            "INSERT INTO jobs VALUES (?,?,?,?,?,?,?)",
+            (name, r["completed_at"], r["failed_at"], r["claimed_by"],
+             r["claim_expires_at"], r["attempt"], r["max_attempts"]))
+
+    def names(cond):
+        cur = con.execute(
+            f"SELECT name FROM jobs WHERE {cond}".replace(":now", "?"),
+            (NOW,) if ":now" in cond else ())
+        return sorted(x[0] for x in cur)
+
+    assert names(js.SQL_NOT_TERMINAL) == ["claimed", "expired",
+                                          "retrying", "unclaimed"]
+    assert names(js.SQL_CLAIMABLE) == ["expired", "retrying", "unclaimed"]
+    assert names(js.SQL_ACTIVELY_CLAIMED) == ["claimed"]
+    assert names(js.SQL_EXPIRED_CLAIM) == ["expired"]
+
+
+@pytest.mark.parametrize("src_w,src_h,rung_h,want_w,want_h", [
+    (3840, 2160, 720, 1280, 720),     # exact 16:9
+    (1920, 1080, 720, 1280, 720),
+    (1280, 720, 1080, 1280, 720),     # never upscale: clamps to source
+    (720, 576, 360, 450, 360),        # 5:4-ish PAL source
+    (640, 481, 360, 480, 360),        # odd source height: mod-2
+    (100, 50, 360, 100, 50),          # tiny source
+])
+def test_rung_geometry_matrix(src_w, src_h, rung_h, want_w, want_h):
+    from vlog_tpu import config
+    from vlog_tpu.backends.base import plan_rung_geometry
+
+    rung = config.QualityRung("t", rung_h, 1000, 0, base_qp=30)
+    p = plan_rung_geometry(src_w, src_h, rung)
+    assert (p.width, p.height) == (want_w, want_h)
+    assert p.width % 2 == 0 and p.height % 2 == 0
+
+
+@pytest.mark.parametrize("ts,rid", [
+    (0.0, 0), (1234.5, 42), (1.7e9, 2**31), (1e-9, 1),
+])
+def test_cursor_roundtrip_matrix(ts, rid):
+    from vlog_tpu.api.pagination import decode_cursor, encode_cursor
+
+    assert decode_cursor(encode_cursor(ts, rid)) == (ts, rid)
+
+
+@pytest.mark.parametrize("bad", ["", "!!!", "eyJ4IjoxfQ", "a.b.c",
+                                 "AAAA" * 100])
+def test_cursor_garbage_matrix(bad):
+    from vlog_tpu.api.pagination import CursorError, decode_cursor
+
+    with pytest.raises(CursorError):
+        decode_cursor(bad)
