@@ -124,6 +124,10 @@ def test_public_500_is_sanitized(run, stack, monkeypatch):
         method = "GET"
         path = "/api/test"
 
+        @staticmethod
+        def get(key, default=None):
+            return default        # request-scoped storage (request_id)
+
     run(go())
 
 
