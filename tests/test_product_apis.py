@@ -35,6 +35,9 @@ def test_settings_roundtrip_types(run, db):
         await s.set("features.downloads", True)
         await s.set("ui.title", "My VLog")
         await s.set("ladder.custom", {"rungs": [360, 720]})
+        # invalidate FIRST: set() pre-populates the cache, so without
+        # this the gets would never exercise the DB read/decode branch
+        s.invalidate()
         assert await s.get("transcoding.segment_duration") == 6.5
         assert await s.get("features.downloads") is True
         assert await s.get("ui.title") == "My VLog"
@@ -43,6 +46,12 @@ def test_settings_roundtrip_types(run, db):
         assert await s.delete("ui.title") is True
         s.invalidate()
         assert await s.get("ui.title") is None
+        # bool survives the int-ish encode through a REAL db read, and
+        # types come back exact (bool-before-int in _type_of)
+        await s.set("features.flag2", False)
+        s.invalidate("features.flag2")
+        got = await s.get("features.flag2")
+        assert got is False
 
     run(go())
 

@@ -159,3 +159,4 @@ def test_cursor_garbage_matrix(bad):
 
     with pytest.raises(CursorError):
         decode_cursor(bad)
+
