@@ -123,29 +123,41 @@ $("tabs").addEventListener("click", (ev) => {
 const progressRows = new Map();   // job_id -> tr
 
 async function loadDashboard() {
-  const d = await api("/api/analytics/summary");
+  const [d, w, jq] = await Promise.all([
+    api("/api/analytics/summary"), api("/api/workers"),
+    api("/api/jobs?limit=1"),
+  ]);
   const vids = d.videos || [];
   const totals = vids.reduce((a, v) => {
     a.sessions += v.sessions; a.watch += v.watch_time_s; a.live += v.live_now;
     return a;
   }, { sessions: 0, watch: 0, live: 0 });
-  const w = await api("/api/workers");
   const online = w.workers.filter((x) => x.online).length;
+  // same claimable-state set as the vlog_jobs_queued gauge the worker
+  // HPA scales on (api/worker_api.py render)
+  const queued = (jq.counts.unclaimed || 0) + (jq.counts.retrying || 0)
+    + (jq.counts.expired || 0);
   const stats = [
     [vids.length, "videos with plays"],
     [totals.sessions, "playback sessions"],
     [`${(totals.watch / 3600).toFixed(1)}h`, "watch time"],
     [totals.live, "watching now"],
     [`${online}/${w.workers.length}`, "workers online"],
+    [queued, "jobs queued", "queue"],
+    [jq.counts.failed || 0, "dead-lettered", "jobs"],
   ];
   const sg = $("stats");
   sg.textContent = "";
-  for (const [n, l] of stats) {
+  for (const [n, l, tab] of stats) {
     const div = document.createElement("div");
     div.className = "stat";
     div.innerHTML = `<div class="n"></div><div class="l"></div>`;
     div.firstChild.textContent = n;
     div.lastChild.textContent = l;
+    if (tab) {
+      div.style.cursor = "pointer";
+      div.onclick = () => switchTab(tab);
+    }
     sg.appendChild(div);
   }
   const tb = $("top-table").tBodies[0];
