@@ -95,3 +95,22 @@ def test_av1_ladder_pipeline_roundtrip(tmp_path, run):
         assert frames[0].shape == (96, 128)
     finally:
         s.close()
+
+
+@pytest.mark.parametrize("prof,level,tier", [
+    (0, 8, 0), (0, 13, 0), (1, 13, 1), (2, 19, 1), (0, 5, 0),
+])
+def test_av1_codec_string_parsers_agree(prof, level, tier):
+    """codec_string_from_tu (sequence-header fields) and the av1C
+    init-box parser (media/codecstr.py) must render identical RFC 6381
+    strings for the same stream parameters — the manifest-regeneration
+    path reads the box, the live encode path reads the TU."""
+    from vlog_tpu.codecs.av1 import codec_string_from_tu
+    from vlog_tpu.media.codecstr import codec_string_from_init
+    from vlog_tpu.media.fmp4 import av1c_record
+
+    s1 = codec_string_from_tu(
+        {"profile": prof, "level": level, "tier": tier})
+    blob = b"xxxx" + b"av1C" + av1c_record(prof, level, tier)
+    s2 = codec_string_from_init(blob)
+    assert s1 == s2, (s1, s2)
