@@ -48,17 +48,22 @@ def test_queue_browser_tracks_claim_lifecycle(stack):  # noqa: F811
 
 
 def test_queue_browser_pagination_consistency(stack):  # noqa: F811
+    """Keyset paging: following next_cursor re-walks the exact id-DESC
+    order of the unpaged listing; only the first page carries counts."""
     with httpx.Client(base_url=stack["admin"]) as c:
         all_jobs = c.get("/api/jobs?limit=500").json()
+        assert "counts" in all_jobs
         paged = []
-        off = 0
-        while True:
-            page = c.get(f"/api/jobs?limit=2&offset={off}").json()["jobs"]
-            if not page:
-                break
-            paged.extend(page)
-            off += 2
-            if off > 50:
+        cursor = None
+        for _ in range(30):
+            url = f"/api/jobs?limit=2{f'&cursor={cursor}' if cursor else ''}"
+            page = c.get(url).json()
+            if cursor is not None:
+                # deeper pages never re-aggregate the whole table
+                assert "counts" not in page
+            paged.extend(page["jobs"])
+            cursor = page.get("next_cursor")
+            if not cursor:
                 break
         ids = [j["id"] for j in all_jobs["jobs"]]
         assert [j["id"] for j in paged][:len(ids)] == ids

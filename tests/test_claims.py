@@ -109,8 +109,17 @@ class TestClaim:
                 failed = await claims.fail_job(db, job_id, "w1", f"boom {attempt}")
                 if attempt < 2:
                     assert failed["failed_at"] is None, "retry budget remains"
+                    # the failed attempt is paced: BACKOFF until due, and
+                    # not claimable while waiting
+                    assert failed["next_retry_at"] > db_now()
+                    assert await claims.claim_job(db, "w1") is None
+                    # fast-forward past the backoff for the next iteration
+                    await db.execute(
+                        "UPDATE jobs SET next_retry_at=NULL WHERE id=:id",
+                        {"id": job_id})
                 else:
                     assert failed["failed_at"] is not None, "terminal after budget"
+                    assert failed["next_retry_at"] is None
             assert await claims.claim_job(db, "w1") is None
 
         run(body())

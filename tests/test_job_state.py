@@ -17,6 +17,7 @@ def row(**kw):
         "claim_expires_at": None,
         "attempt": 0,
         "max_attempts": 3,
+        "next_retry_at": None,
     }
     base.update(kw)
     return base
@@ -40,6 +41,13 @@ class TestDeriveState:
 
     def test_retrying(self):
         assert js.derive_state(row(attempt=1), now=NOW) is JobState.RETRYING
+
+    def test_backoff_until_due(self):
+        r = row(attempt=1, next_retry_at=NOW + 30)
+        assert js.derive_state(r, now=NOW) is JobState.BACKOFF
+        assert js.derive_state(r, now=NOW + 30) is JobState.RETRYING
+        assert not js.is_claimable(r, now=NOW)
+        assert js.is_claimable(r, now=NOW + 30)
 
     def test_completed_wins_over_claim(self):
         r = row(completed_at=NOW - 5, claimed_by="w1", claim_expires_at=NOW + 60)
@@ -110,6 +118,8 @@ class TestSqlFragments:
         cases = [
             row(),
             row(attempt=1),
+            row(attempt=1, next_retry_at=NOW + 60),     # in backoff
+            row(attempt=1, next_retry_at=NOW - 60),     # backoff lapsed
             row(claimed_by="w", claim_expires_at=NOW + 60, attempt=1),
             row(claimed_by="w", claim_expires_at=NOW - 60, attempt=1),
             row(completed_at=NOW - 1),
@@ -118,12 +128,14 @@ class TestSqlFragments:
         conn = sqlite3.connect(":memory:")
         conn.execute(
             "CREATE TABLE jobs (completed_at REAL, failed_at REAL, claimed_by TEXT,"
-            " claimed_at REAL, claim_expires_at REAL, attempt INT, max_attempts INT)"
+            " claimed_at REAL, claim_expires_at REAL, attempt INT, max_attempts INT,"
+            " next_retry_at REAL)"
         )
         for c in cases:
             conn.execute(
                 "INSERT INTO jobs VALUES (:completed_at,:failed_at,:claimed_by,"
-                ":claimed_at,:claim_expires_at,:attempt,:max_attempts)",
+                ":claimed_at,:claim_expires_at,:attempt,:max_attempts,"
+                ":next_retry_at)",
                 c,
             )
         got = conn.execute(

@@ -14,13 +14,16 @@ NOW = 1_000_000.0
 
 def _row(**kw) -> dict:
     base = {"completed_at": None, "failed_at": None, "claimed_by": None,
-            "claim_expires_at": None, "attempt": 0, "max_attempts": 3}
+            "claim_expires_at": None, "attempt": 0, "max_attempts": 3,
+            "next_retry_at": None}
     base.update(kw)
     return base
 
 
 UNCLAIMED = _row()
 RETRYING = _row(attempt=1)
+BACKOFF = _row(attempt=1, next_retry_at=NOW + 60)
+BACKOFF_DUE = _row(attempt=1, next_retry_at=NOW - 1)
 CLAIMED = _row(claimed_by="w1", claim_expires_at=NOW + 60, attempt=1)
 EXPIRED = _row(claimed_by="w1", claim_expires_at=NOW - 1, attempt=1)
 COMPLETED = _row(completed_at=NOW - 5)
@@ -31,6 +34,8 @@ EXHAUSTED = _row(attempt=3)
 @pytest.mark.parametrize("row,want", [
     (UNCLAIMED, JobState.UNCLAIMED),
     (RETRYING, JobState.RETRYING),
+    (BACKOFF, JobState.BACKOFF),
+    (BACKOFF_DUE, JobState.RETRYING),   # due backoff degrades to RETRYING
     (CLAIMED, JobState.CLAIMED),
     (EXPIRED, JobState.EXPIRED),
     (COMPLETED, JobState.COMPLETED),
@@ -43,7 +48,9 @@ def test_derive_state_matrix(row, want):
 @pytest.mark.parametrize("row,ok", [
     (UNCLAIMED, True),
     (RETRYING, True),
+    (BACKOFF_DUE, True),      # backoff elapsed: claimable again
     (EXPIRED, True),          # lapsed lease is reclaimable
+    (BACKOFF, False),         # not yet due
     (CLAIMED, False),
     (COMPLETED, False),
     (FAILED, False),
@@ -98,6 +105,7 @@ def test_sql_fragments_agree_with_derivation():
 
     rows = {
         "unclaimed": UNCLAIMED, "retrying": RETRYING,
+        "backoff": BACKOFF, "backoff_due": BACKOFF_DUE,
         "claimed": CLAIMED, "expired": EXPIRED,
         "completed": COMPLETED, "failed": FAILED,
     }
@@ -105,24 +113,28 @@ def test_sql_fragments_agree_with_derivation():
     con.execute(
         "CREATE TABLE jobs (name TEXT, completed_at REAL, failed_at REAL,"
         " claimed_by TEXT, claim_expires_at REAL, attempt INT,"
-        " max_attempts INT)")
+        " max_attempts INT, next_retry_at REAL)")
     for name, r in rows.items():
         con.execute(
-            "INSERT INTO jobs VALUES (?,?,?,?,?,?,?)",
+            "INSERT INTO jobs VALUES (?,?,?,?,?,?,?,?)",
             (name, r["completed_at"], r["failed_at"], r["claimed_by"],
-             r["claim_expires_at"], r["attempt"], r["max_attempts"]))
+             r["claim_expires_at"], r["attempt"], r["max_attempts"],
+             r["next_retry_at"]))
 
     def names(cond):
         cur = con.execute(
             f"SELECT name FROM jobs WHERE {cond}".replace(":now", "?"),
-            (NOW,) if ":now" in cond else ())
+            (NOW,) * cond.count(":now"))
         return sorted(x[0] for x in cur)
 
-    assert names(js.SQL_NOT_TERMINAL) == ["claimed", "expired",
+    assert names(js.SQL_NOT_TERMINAL) == ["backoff", "backoff_due",
+                                          "claimed", "expired",
                                           "retrying", "unclaimed"]
-    assert names(js.SQL_CLAIMABLE) == ["expired", "retrying", "unclaimed"]
+    assert names(js.SQL_CLAIMABLE) == ["backoff_due", "expired",
+                                       "retrying", "unclaimed"]
     assert names(js.SQL_ACTIVELY_CLAIMED) == ["claimed"]
     assert names(js.SQL_EXPIRED_CLAIM) == ["expired"]
+    assert names(js.SQL_IN_BACKOFF) == ["backoff"]
 
 
 @pytest.mark.parametrize("src_w,src_h,rung_h,want_w,want_h", [

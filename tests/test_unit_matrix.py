@@ -85,6 +85,10 @@ def _rows():
     cases = [
         dict(claimed_by=None, claim_expires_at=None, completed_at=None,
              failed_at=None, attempt=0),
+        dict(claimed_by=None, claim_expires_at=None, completed_at=None,
+             failed_at=None, attempt=1, next_retry_at=now + 30),
+        dict(claimed_by=None, claim_expires_at=None, completed_at=None,
+             failed_at=None, attempt=1, next_retry_at=now - 30),
         dict(claimed_by="w", claim_expires_at=now + 5, completed_at=None,
              failed_at=None, attempt=1),
         dict(claimed_by="w", claim_expires_at=now - 5, completed_at=None,
@@ -96,6 +100,8 @@ def _rows():
         dict(claimed_by="w", claim_expires_at=None, completed_at=None,
              failed_at=None, attempt=1),
     ]
+    for c in cases:
+        c.setdefault("next_retry_at", None)
     return now, cases
 
 
@@ -105,11 +111,12 @@ def test_sql_claimable_matches_python():
     now, cases = _rows()
     conn = sqlite3.connect(":memory:")
     conn.execute("CREATE TABLE jobs (claimed_by, claim_expires_at, "
-                 "completed_at, failed_at, attempt)")
+                 "completed_at, failed_at, attempt, next_retry_at)")
     for c in cases:
-        conn.execute("INSERT INTO jobs VALUES (?,?,?,?,?)",
+        conn.execute("INSERT INTO jobs VALUES (?,?,?,?,?,?)",
                      (c["claimed_by"], c["claim_expires_at"],
-                      c["completed_at"], c["failed_at"], c["attempt"]))
+                      c["completed_at"], c["failed_at"], c["attempt"],
+                      c["next_retry_at"]))
     got = [bool(r[0]) for r in conn.execute(
         f"SELECT ({js.SQL_CLAIMABLE}) FROM jobs", {"now": now})]
     want = [js.is_claimable(c, now=now) for c in cases]
@@ -123,11 +130,12 @@ def test_sql_expired_matches_python():
     now, cases = _rows()
     conn = sqlite3.connect(":memory:")
     conn.execute("CREATE TABLE jobs (claimed_by, claim_expires_at, "
-                 "completed_at, failed_at, attempt)")
+                 "completed_at, failed_at, attempt, next_retry_at)")
     for c in cases:
-        conn.execute("INSERT INTO jobs VALUES (?,?,?,?,?)",
+        conn.execute("INSERT INTO jobs VALUES (?,?,?,?,?,?)",
                      (c["claimed_by"], c["claim_expires_at"],
-                      c["completed_at"], c["failed_at"], c["attempt"]))
+                      c["completed_at"], c["failed_at"], c["attempt"],
+                      c["next_retry_at"]))
     got = [bool(r[0]) for r in conn.execute(
         f"SELECT ({js.SQL_EXPIRED_CLAIM}) FROM jobs", {"now": now})]
     want = [js.derive_state(c, now=now) is JobState.EXPIRED for c in cases]

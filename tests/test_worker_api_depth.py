@@ -45,6 +45,11 @@ def test_fail_retries_then_dead_letters(run, db, tmp_path, api):  # noqa: F811
             assert row["failed_at"] is None       # retrying
             assert row["claimed_by"] is None
             assert row["attempt"] == k + 1
+            # failed attempts are paced: clear the retry backoff so the
+            # next loop iteration can claim immediately
+            assert row["next_retry_at"] is not None
+            run(db.execute("UPDATE jobs SET next_retry_at=NULL "
+                           "WHERE id=:i", {"i": jid}))
         else:
             assert row["failed_at"] is not None   # dead-lettered
     # terminal failure marks the video failed

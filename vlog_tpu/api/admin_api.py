@@ -460,9 +460,26 @@ async def reencode(request: web.Request) -> web.Response:
     return web.json_response({"job_id": job_id})
 
 
+async def _attach_failure_history(db: Database, rows: list[dict]) -> None:
+    """Bulk-load job_failures for ``rows`` (adds a ``failures`` key)."""
+    by_job: dict[int, list[dict]] = {r["id"]: [] for r in rows}
+    if by_job:
+        marks = ",".join(f":f{i}" for i in range(len(by_job)))
+        hist = await db.fetch_all(
+            f"SELECT * FROM job_failures WHERE job_id IN ({marks}) "
+            "ORDER BY id",
+            {f"f{i}": jid for i, jid in enumerate(by_job)})
+        for h in hist:
+            by_job[h["job_id"]].append(h)
+    for r in rows:
+        r["failures"] = by_job.get(r["id"], [])
+
+
 async def failed_jobs(request: web.Request) -> web.Response:
-    """The dead-letter view: terminally failed jobs with their errors
-    (reference dead-letter admin, admin.py:8934-9228)."""
+    """The dead-letter view: terminally failed jobs with their errors and
+    the full classified per-attempt failure history (job_failures rows —
+    which worker, which class, which error, per attempt). Reference
+    dead-letter admin, admin.py:8934-9228."""
     db = request.app[DB]
     rows = await db.fetch_all(
         """
@@ -471,7 +488,20 @@ async def failed_jobs(request: web.Request) -> web.Response:
         WHERE j.failed_at IS NOT NULL
         ORDER BY j.failed_at DESC LIMIT 200
         """)
+    await _attach_failure_history(db, rows)
     return web.json_response({"jobs": rows})
+
+
+async def job_failure_history(request: web.Request) -> web.Response:
+    """Per-attempt failure records for one job (oldest first)."""
+    db = request.app[DB]
+    job_id = _path_id(request, "job_id")
+    job = await db.fetch_one("SELECT id FROM jobs WHERE id=:id",
+                             {"id": job_id})
+    if job is None:
+        return _json_error(404, "no such job")
+    return web.json_response(
+        {"failures": await claims.get_failure_history(db, job_id)})
 
 
 # The derived-state rules of jobs/state.py as one SQL CASE: counts and
@@ -485,6 +515,8 @@ _STATE_CASE = """
       WHEN j.claimed_by IS NOT NULL AND (j.claim_expires_at IS NULL
            OR j.claim_expires_at > :now) THEN 'claimed'
       WHEN j.claimed_by IS NOT NULL THEN 'expired'
+      WHEN j.attempt > 0 AND j.next_retry_at IS NOT NULL
+           AND j.next_retry_at > :now THEN 'backoff'
       WHEN j.attempt > 0 THEN 'retrying'
       ELSE 'unclaimed'
     END
@@ -493,28 +525,33 @@ _STATE_CASE = """
 
 async def list_jobs(request: web.Request) -> web.Response:
     """Queue browser: every job with its DERIVED state (the reference's
-    jobs admin, admin.py job listing routes).  ?state= filters, counts
-    aggregate, and pages are keyset over the WHOLE table in SQL."""
+    jobs admin, admin.py job listing routes). ?state= filters; pages are
+    true id-cursor keyset (?cursor= is the last id of the previous page;
+    the response's ``next_cursor`` feeds the next request), so deep pages
+    stay O(limit). The per-state counts aggregate over the whole table
+    and are therefore computed only on the FIRST page (no cursor) —
+    paging deeper never rescans the table for them."""
     db = request.app[DB]
     q = request.query
     want = q.get("state", "").strip()
     limit = _qnum(q, "limit", 100, lo=1, hi=500)
-    offset = _qnum(q, "offset", 0, lo=0)
+    cursor = _qnum(q, "cursor", None, lo=1)
     t = db_now()
-    count_rows = await db.fetch_all(
-        f"SELECT {_STATE_CASE} AS state, COUNT(*) AS n FROM jobs j "
-        "GROUP BY state", {"now": t})
-    counts = {r["state"]: r["n"] for r in count_rows}
-    where = f"WHERE {_STATE_CASE} = :want" if want else ""
-    params: dict = {"now": t, "limit": limit, "offset": offset}
+    where = []
+    params: dict = {"now": t, "limit": limit}
     if want:
+        where.append(f"{_STATE_CASE} = :want")
         params["want"] = want
+    if cursor is not None:
+        where.append("j.id < :cursor")
+        params["cursor"] = cursor
+    where_sql = f"WHERE {' AND '.join(where)}" if where else ""
     rows = await db.fetch_all(
         f"""
         SELECT j.*, v.slug, v.title, {_STATE_CASE} AS state FROM jobs j
         JOIN videos v ON v.id = j.video_id
-        {where}
-        ORDER BY j.id DESC LIMIT :limit OFFSET :offset
+        {where_sql}
+        ORDER BY j.id DESC LIMIT :limit
         """, params)
     out = [{"id": r["id"], "kind": r["kind"], "state": r["state"],
             "slug": r["slug"], "title": r["title"],
@@ -523,11 +560,19 @@ async def list_jobs(request: web.Request) -> web.Response:
             "claimed_by": r["claimed_by"],
             "created_at": r["created_at"],
             "updated_at": r["updated_at"],
+            "next_retry_at": r["next_retry_at"],
             "error": r["error"]} for r in rows]
-    total = (counts.get(want, 0) if want
-             else sum(counts.values()))
-    return web.json_response({
-        "jobs": out, "counts": counts, "total": total})
+    next_cursor = rows[-1]["id"] if len(rows) == limit else None
+    resp = {"jobs": out, "next_cursor": next_cursor}
+    if cursor is None:
+        count_rows = await db.fetch_all(
+            f"SELECT {_STATE_CASE} AS state, COUNT(*) AS n FROM jobs j "
+            "GROUP BY state", {"now": t})
+        counts = {r["state"]: r["n"] for r in count_rows}
+        resp["counts"] = counts
+        resp["total"] = (counts.get(want, 0) if want
+                         else sum(counts.values()))
+    return web.json_response(resp)
 
 
 async def audit_tail(request: web.Request) -> web.Response:
@@ -546,7 +591,11 @@ async def audit_tail(request: web.Request) -> web.Response:
     # click O(tail), not O(full log + rotation).
     cap_bytes = 4 * 1024 * 1024
     entries: list[dict] = []
-    for p in (audit.path, audit.path.with_suffix(".1.log")):
+    from vlog_tpu.api.audit import KEEP_ROTATIONS
+
+    files = [audit.path] + [audit.path.with_suffix(f".{i}.log")
+                            for i in range(1, KEEP_ROTATIONS + 1)]
+    for p in files:
         if len(entries) >= limit:
             break
         try:
@@ -710,12 +759,20 @@ async def requeue_job(request: web.Request) -> web.Response:
         return _json_error(404, "no such job")
     if job["failed_at"] is None:
         return _json_error(409, "job is not dead-lettered")
-    await db.execute(
-        """
-        UPDATE jobs SET failed_at=NULL, error=NULL, attempt=0,
-               progress=0.0, current_step=NULL, updated_at=:t
-        WHERE id=:id
-        """, {"t": db_now(), "id": job_id})
+    # one transaction: a half-applied requeue would either resurrect the
+    # previous life's post-mortem or delete a fresh failure row (same
+    # atomicity contract as the enqueue_job reset path)
+    async with db.transaction() as tx:
+        await tx.execute(
+            """
+            UPDATE jobs SET failed_at=NULL, error=NULL, attempt=0,
+                   progress=0.0, current_step=NULL, next_retry_at=NULL,
+                   updated_at=:t
+            WHERE id=:id
+            """, {"t": db_now(), "id": job_id})
+        # fresh retry budget -> fresh post-mortem
+        await tx.execute("DELETE FROM job_failures WHERE job_id=:id",
+                         {"id": job_id})
     if JobKind(job["kind"]) is JobKind.TRANSCODE:
         await vids.set_status(db, job["video_id"], VideoStatus.PENDING)
     return web.json_response({"ok": True})
@@ -1109,6 +1166,7 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
                regenerate_manifests)
     r.add_get("/api/jobs", list_jobs)
     r.add_get("/api/jobs/failed", failed_jobs)
+    r.add_get("/api/jobs/{job_id:\\d+}/failures", job_failure_history)
     r.add_post("/api/jobs/{job_id:\\d+}/requeue", requeue_job)
     r.add_get("/api/audit", audit_tail)
     r.add_get("/api/analytics/daily", analytics_daily)

@@ -636,8 +636,10 @@ async def get_sprite_sheet(request: web.Request) -> web.Response:
     name = request.match_info["name"]
     sdir = (request.app[VIDEO_DIR] / row["slug"] / "sprites").resolve()
     p = (sdir / name).resolve()
-    if not str(p).startswith(str(sdir)) or p.suffix != ".jpg" \
-            or not p.is_file():
+    # Path-boundary containment: a plain startswith() admits sibling
+    # directories sharing the prefix (".../sprites-evil/x.jpg"); sheets
+    # live directly in sdir, so the parent must BE sdir.
+    if p.parent != sdir or p.suffix != ".jpg" or not p.is_file():
         return _json_error(404, "no such sheet")
     return web.FileResponse(p, headers={
         "Content-Type": "image/jpeg", "Cache-Control": "no-cache"})

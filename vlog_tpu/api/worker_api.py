@@ -24,7 +24,7 @@ from vlog_tpu import config
 from vlog_tpu.api import auth as authmod
 from vlog_tpu.db.core import Database, Row, now as db_now, open_database
 from vlog_tpu.db.retry import with_retries
-from vlog_tpu.enums import AcceleratorKind, JobKind
+from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
 from vlog_tpu.jobs import claims, state as js, videos as vids
 from vlog_tpu.jobs.finalize import finalize_transcode, finalize_transcription
 
@@ -128,7 +128,9 @@ class Metrics:
         for st, n in sorted(counts.items()):
             lines.append(f'vlog_jobs{{state="{st}"}} {n}')
         # flat queue-depth gauge: what the worker HPA scales on
-        # (deploy/k8s/worker-autoscaling.yaml) — claimable work only
+        # (deploy/k8s/worker-autoscaling.yaml) — claimable work only;
+        # jobs waiting out retry backoff are deliberately excluded (they
+        # cannot be claimed yet, so they must not trigger scale-up)
         queued = (counts.get("unclaimed", 0) + counts.get("retrying", 0)
                   + counts.get("expired", 0))
         lines.append("# HELP vlog_jobs_queued Jobs waiting for a worker")
@@ -332,12 +334,20 @@ async def fail(request: web.Request) -> web.Response:
     body = await request.json()
     db = request.app[DB]
     job_id = int(request.match_info["job_id"])
+    fc_raw = body.get("failure_class")
+    try:
+        # only absent/null means "use the default" — an empty string is
+        # a caller bug and gets the same 400 as any other unknown class
+        fc = FailureClass(fc_raw) if fc_raw is not None else None
+    except ValueError:
+        return _json_error(400, f"unknown failure_class {fc_raw!r}")
     try:
         row = await with_retries(
             lambda: claims.fail_job(
                 db, job_id, request[IDENTITY].worker_name,
                 str(body.get("error") or "unspecified"),
-                permanent=bool(body.get("permanent"))),
+                permanent=bool(body.get("permanent")),
+                failure_class=fc),
             label="fail")
     except js.JobStateError as exc:
         return _json_error(409, str(exc))

@@ -180,6 +180,9 @@ class JaxBackend:
     # ------------------------------------------------------------------
     def run(self, plan: ExecutionPlan, progress_cb: ProgressFn | None = None,
             *, resume: bool = True) -> RunResult:
+        from vlog_tpu.utils import failpoints
+
+        failpoints.hit("backend.encode")    # chaos: simulated device fault
         _enable_persistent_compile_cache()
         t0 = time.monotonic()
         if any(r.codec == "h265" for r in plan.rungs):

@@ -174,6 +174,25 @@ MAX_JOB_ATTEMPTS: int = _env_int("VLOG_MAX_JOB_ATTEMPTS", 3, lo=1, hi=20)
 WORKER_POLL_INTERVAL_S: float = _env_float("VLOG_WORKER_POLL_INTERVAL", 5.0, lo=0.1)
 
 # --------------------------------------------------------------------------
+# Failure plane: retry backoff, circuit breaker, stall watchdog
+# --------------------------------------------------------------------------
+
+# Jittered exponential backoff between retry attempts: attempt N becomes
+# claimable no earlier than base * 2^(N-1), capped, with +/-50% jitter
+# (jobs/claims.py retry_backoff_s). Base 0 disables backoff entirely.
+RETRY_BACKOFF_BASE_S: float = _env_float("VLOG_RETRY_BACKOFF_BASE", 30.0, lo=0.0)
+RETRY_BACKOFF_CAP_S: float = _env_float("VLOG_RETRY_BACKOFF_CAP", 1800.0, lo=0.0)
+# Worker-side circuit breaker (worker/breaker.py): this many CONSECUTIVE
+# compute failures stops the daemon claiming; after the cooldown one
+# half-open probe job decides whether to close or re-open.
+BREAKER_FAILURE_THRESHOLD: int = _env_int("VLOG_BREAKER_THRESHOLD", 5, lo=1)
+BREAKER_COOLDOWN_S: float = _env_float("VLOG_BREAKER_COOLDOWN", 60.0, lo=0.0)
+# Stall watchdog: cancel in-flight compute whose progress has not advanced
+# within this window, even while lease renewals keep it nominally alive.
+# 0 disables the watchdog.
+STALL_WINDOW_S: float = _env_float("VLOG_STALL_WINDOW", 900.0, lo=0.0)
+
+# --------------------------------------------------------------------------
 # Transcription (reference: config.py:263-267)
 # --------------------------------------------------------------------------
 

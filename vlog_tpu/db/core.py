@@ -27,6 +27,8 @@ from contextlib import asynccontextmanager
 from pathlib import Path
 from typing import Any
 
+from vlog_tpu.utils import failpoints
+
 Row = dict[str, Any]
 Params = Mapping[str, Any] | None
 
@@ -178,6 +180,7 @@ class Database:
             await asyncio.to_thread(conn.execute, begin)
             try:
                 yield Transaction(self)
+                failpoints.hit("db.commit")
             except BaseException:
                 await asyncio.to_thread(conn.execute, "ROLLBACK")
                 raise

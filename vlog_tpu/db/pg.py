@@ -43,6 +43,8 @@ from collections.abc import AsyncIterator, Iterable, Mapping
 from contextlib import asynccontextmanager
 from typing import Any
 
+from vlog_tpu.utils import failpoints
+
 Row = dict[str, Any]
 Params = Mapping[str, Any] | None
 
@@ -446,6 +448,7 @@ class PgDatabase:
             tx = PgTransaction(self, conn)
             try:
                 yield tx
+                failpoints.hit("db.commit")
             except BaseException:
                 await asyncio.to_thread(conn.query, "ROLLBACK", None)
                 raise

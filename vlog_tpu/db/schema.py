@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from vlog_tpu.db.core import Database, now
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 5
 
 # Each entry: (version, [statements]). Append-only.
 MIGRATIONS: list[tuple[int, list[str]]] = [
@@ -314,6 +314,39 @@ MIGRATIONS: list[tuple[int, list[str]]] = [
                 last_used_at REAL
             )
             """,
+        ],
+    ),
+    (
+        5,
+        [
+            # -- failure plane (jobs/claims.py) ------------------------------
+            # next_retry_at: jittered-exponential-backoff gate written by
+            # fail_job; a job whose timestamp is in the future derives the
+            # BACKOFF state and is skipped by SQL_CLAIMABLE, so a crashing
+            # job can no longer burn its whole retry budget in seconds.
+            "ALTER TABLE jobs ADD COLUMN next_retry_at REAL",
+            "CREATE INDEX IF NOT EXISTS idx_jobs_next_retry"
+            " ON jobs(next_retry_at)",
+            # Per-attempt failure history with classification, written by
+            # fail_job (transient/permanent/stalled), the expired-claim
+            # sweep and daemon startup recovery (worker_crash). Surfaced in
+            # the dead-letter admin view; rows outlive the retry loop so a
+            # dead-lettered job carries its full post-mortem.
+            """
+            CREATE TABLE IF NOT EXISTS job_failures (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                job_id INTEGER NOT NULL REFERENCES jobs(id) ON DELETE CASCADE,
+                attempt INTEGER NOT NULL,
+                worker TEXT,
+                error TEXT,
+                failure_class TEXT NOT NULL DEFAULT 'transient',
+                created_at REAL NOT NULL,
+                CHECK (failure_class IN
+                       ('transient','permanent','worker_crash','stalled'))
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS idx_job_failures_job"
+            " ON job_failures(job_id, id)",
         ],
     ),
 ]

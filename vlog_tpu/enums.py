@@ -28,8 +28,8 @@ class JobState(str, enum.Enum):
     """Derived job states (reference: api/job_state.py:48-96).
 
     These are *derived* from nullable columns (claimed_by, claim_expires_at,
-    completed_at, failed_at, attempt) rather than stored, so the database can
-    never hold a contradictory state.
+    completed_at, failed_at, attempt, next_retry_at) rather than stored, so
+    the database can never hold a contradictory state.
     """
 
     UNCLAIMED = "unclaimed"
@@ -37,7 +37,27 @@ class JobState(str, enum.Enum):
     EXPIRED = "expired"      # claimed but lease lapsed
     COMPLETED = "completed"
     FAILED = "failed"        # terminally failed
-    RETRYING = "retrying"    # failed attempt, retry budget remains
+    RETRYING = "retrying"    # failed attempt, retry budget remains, due now
+    BACKOFF = "backoff"      # failed attempt, waiting out next_retry_at
+
+
+class FailureClass(str, enum.Enum):
+    """Per-attempt failure classification (``job_failures`` rows).
+
+    - TRANSIENT: the attempt failed but a retry may succeed (I/O, timeout,
+      flaky backend) — the default for non-permanent ``fail_job`` calls.
+    - PERMANENT: retrying cannot help (bad input, validation failure).
+    - WORKER_CRASH: the claim lease lapsed without a completion or failure
+      report — the worker process is presumed dead (attributed by the
+      expired-claim sweep and by a restarted daemon's startup recovery).
+    - STALLED: compute was cancelled by the stall watchdog — lease renewals
+      kept the claim alive but ``progress`` stopped advancing.
+    """
+
+    TRANSIENT = "transient"
+    PERMANENT = "permanent"
+    WORKER_CRASH = "worker_crash"
+    STALLED = "stalled"
 
 
 class VideoCodec(str, enum.Enum):

@@ -6,6 +6,16 @@ lease extension on progress, and completion/failure with retry accounting.
 In sqlite the ``BEGIN IMMEDIATE`` transaction is the serialization point
 (single writer), so two workers can never claim the same row.
 
+Failure plane: every failed attempt is stamped with jittered exponential
+backoff (``next_retry_at``; the job derives BACKOFF until due — see
+jobs/state.py) and recorded in ``job_failures`` with a classification
+(:class:`vlog_tpu.enums.FailureClass`). The expired-claim sweep
+attributes lapsed leases to ``worker_crash`` so a dead worker's jobs
+carry a post-mortem even though nobody reported the failure. Chaos
+hooks: failpoints ``claims.claim`` / ``claims.complete`` /
+``claims.fail`` fire inside the respective transactions
+(utils/failpoints.py).
+
 All functions are pure DB logic — no HTTP, no media. The Worker API service
 wraps these; local in-process workers call them directly, mirroring how the
 reference's local transcoder bypassed the HTTP plane.
@@ -14,13 +24,85 @@ reference's local transcoder bypassed the HTTP plane.
 from __future__ import annotations
 
 import json
+import random
 from typing import Any
 
 from vlog_tpu import config
 from vlog_tpu.db.core import Database, Row, now as db_now
-from vlog_tpu.enums import AcceleratorKind, JobKind
+from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
 from vlog_tpu.jobs import state as js
 from vlog_tpu.jobs.events import CH_JOBS, CH_PROGRESS, wake as _wake
+from vlog_tpu.utils import failpoints
+
+
+def retry_backoff_s(attempt: int, *, base: float | None = None,
+                    cap: float | None = None) -> float:
+    """Delay before attempt ``attempt``'s failure becomes claimable again.
+
+    Jittered exponential, the db/retry.py idiom at job scale:
+    ``min(base * 2^(attempt-1), cap)`` scaled by ``0.5 + random()`` so a
+    herd of same-attempt failures desynchronizes instead of thundering
+    back together. ``base == 0`` disables backoff.
+    """
+    base = config.RETRY_BACKOFF_BASE_S if base is None else base
+    cap = config.RETRY_BACKOFF_CAP_S if cap is None else cap
+    if base <= 0:
+        return 0.0
+    delay = min(base * (2 ** max(attempt - 1, 0)), cap)
+    return delay * (0.5 + random.random())
+
+
+async def _record_failure(x: Any, job_id: int, attempt: int,
+                          worker: str | None, error: str,
+                          failure_class: FailureClass, t: float) -> None:
+    """Append one job_failures row (``x`` is a Database or Transaction)."""
+    await x.execute(
+        """
+        INSERT INTO job_failures (job_id, attempt, worker, error,
+                                  failure_class, created_at)
+        VALUES (:j, :a, :w, :e, :c, :t)
+        """,
+        {"j": job_id, "a": attempt, "w": worker, "e": error[:2000],
+         "c": failure_class.value, "t": t},
+    )
+
+
+async def _dead_letter_crashed(x: Any, job_id: int, video_id: int,
+                               kind: str, t: float) -> None:
+    """Terminally fail a job whose final attempt's worker crashed, and
+    flip its video to failed for transcodes — shared by the expired-claim
+    sweep and crash-recovery release so the two paths cannot diverge.
+    (``x`` is a Database or Transaction.)"""
+    await x.execute(
+        """
+        UPDATE jobs SET failed_at=:t, next_retry_at=NULL,
+               error=COALESCE(error, 'worker crashed on final attempt'),
+               updated_at=:t
+        WHERE id=:id AND completed_at IS NULL AND failed_at IS NULL
+        """,
+        {"t": t, "id": job_id},
+    )
+    if kind == JobKind.TRANSCODE.value:
+        # same terminal transition every other dead-letter path takes
+        # (daemon._fail / worker_api.fail): the catalog must not show the
+        # video processing forever with no job left to advance it
+        await x.execute(
+            """
+            UPDATE videos SET status='failed',
+                   error='worker crashed on final transcode attempt',
+                   updated_at=:t
+            WHERE id=:v AND status NOT IN ('deleted','ready')
+            """,
+            {"t": t, "v": video_id},
+        )
+
+
+async def get_failure_history(db: Database, job_id: int) -> list[Row]:
+    """Per-attempt failure records, oldest first (dead-letter view)."""
+    return await db.fetch_all(
+        "SELECT * FROM job_failures WHERE job_id=:j ORDER BY id",
+        {"j": job_id},
+    )
 
 
 async def enqueue_job(
@@ -78,7 +160,8 @@ async def enqueue_job(
                     required_accelerator=:ra, claimed_by=NULL, claimed_at=NULL,
                     claim_expires_at=NULL, started_at=NULL, completed_at=NULL,
                     failed_at=NULL, error=NULL, attempt=0, current_step=NULL,
-                    last_checkpoint='{}', progress=0.0, updated_at=:t
+                    last_checkpoint='{}', progress=0.0, next_retry_at=NULL,
+                    updated_at=:t
                 WHERE id=:id
                 """,
                 {**params, "id": existing["id"]},
@@ -87,19 +170,68 @@ async def enqueue_job(
                 "DELETE FROM quality_progress WHERE job_id=:id",
                 {"id": existing["id"]},
             )
+            # A reset starts a fresh life for the row; the previous life's
+            # failure post-mortem would misattribute in the dead-letter view.
+            await tx.execute(
+                "DELETE FROM job_failures WHERE job_id=:id",
+                {"id": existing["id"]},
+            )
             jid = int(existing["id"])
     # after commit, so a woken claimant always sees the row
     _wake(db, CH_JOBS, {"job_id": jid, "kind": kind.value})
     return jid
 
 
-# Shared by sweep_expired_claims and the sweep phase inside claim_job, so
-# lease-release semantics can never drift between the two paths.
-SWEEP_EXPIRED_SQL = f"""
-    UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
-           updated_at=:now
-    WHERE {js.SQL_EXPIRED_CLAIM}
-"""
+async def _sweep_expired(x: Any, t: float,
+                         lock_suffix: str = "") -> tuple[int, list[int]]:
+    """Release lapsed leases, attributing each to ``worker_crash``.
+
+    ``x`` is a Database or Transaction; ``lock_suffix`` is the owning
+    database's ``row_lock_suffix`` — on Postgres the expired-row select
+    takes ``FOR UPDATE SKIP LOCKED`` so two concurrent sweeps cannot
+    both attribute the same lapsed lease (sqlite is serialized by
+    BEGIN IMMEDIATE). A lapsed lease means the holder neither completed,
+    failed, nor renewed — the worker is presumed dead, and the
+    job_failures row is the only record the attempt ever existed
+    (nothing else writes on this path).
+
+    A swept job whose retry budget is already spent is dead-lettered here
+    (its video marked failed for transcodes): releasing it would strand
+    it forever — unclaimable (``attempt >= max_attempts`` fails the claim
+    filter) yet never terminal, invisible to both the queue and the
+    dead-letter view. Returns ``(released, dead_lettered_job_ids)``; the
+    caller emits the terminal progress events after its commit.
+    """
+    expired = await x.fetch_all(
+        "SELECT id, video_id, kind, attempt, max_attempts, claimed_by "
+        f"FROM jobs WHERE {js.SQL_EXPIRED_CLAIM}{lock_suffix}",
+        {"now": t},
+    )
+    if not expired:
+        return 0, []
+    for r in expired:
+        await _record_failure(
+            x, r["id"], r["attempt"] or 0, r["claimed_by"],
+            "claim lease expired without completion (worker presumed crashed)",
+            FailureClass.WORKER_CRASH, t)
+    # Release exactly the rows selected (and, on Postgres, locked) above.
+    # Re-running the expired predicate here would block on rows a
+    # concurrent sweep's SKIP LOCKED just told us to stay away from.
+    marks = ",".join(f":s{i}" for i in range(len(expired)))
+    await x.execute(
+        f"""
+        UPDATE jobs SET claimed_by=NULL, claimed_at=NULL,
+               claim_expires_at=NULL, updated_at=:now
+        WHERE id IN ({marks})
+        """,
+        {"now": t, **{f"s{i}": r["id"] for i, r in enumerate(expired)}})
+    dead: list[int] = []
+    for r in expired:
+        if (r["attempt"] or 0) >= (r["max_attempts"] or 1):
+            await _dead_letter_crashed(x, r["id"], r["video_id"],
+                                       r["kind"], t)
+            dead.append(r["id"])
+    return len(expired), dead
 
 
 async def sweep_expired_claims(db: Database) -> int:
@@ -107,9 +239,16 @@ async def sweep_expired_claims(db: Database) -> int:
 
     Reference parity: worker_api.py:1469-1491 (expired-claim sweep inside the
     claim transaction). Each release increments nothing — the attempt counter
-    belongs to claim time.
+    belongs to claim time. No backoff either: the lease interval already
+    paced this attempt. Each swept job gains a ``worker_crash`` failure row;
+    budget-exhausted jobs are dead-lettered (see _sweep_expired).
     """
-    return await db.execute(SWEEP_EXPIRED_SQL, {"now": db_now()})
+    async with db.transaction() as tx:
+        released, dead = await _sweep_expired(tx, db_now(),
+                                              db.row_lock_suffix)
+    for jid in dead:
+        _wake(db, CH_PROGRESS, {"job_id": jid, "event": "failed"})
+    return released
 
 
 async def claim_job(
@@ -133,7 +272,7 @@ async def claim_job(
     kind_list = ",".join(f"'{k.value}'" for k in kinds)
     async with db.transaction() as tx:
         # sweep expired leases first so they are claimable below
-        await tx.execute(SWEEP_EXPIRED_SQL, {"now": t})
+        _, dead = await _sweep_expired(tx, t, db.row_lock_suffix)
         # On Postgres the suffix is FOR UPDATE SKIP LOCKED: concurrent
         # claimants contend on row locks and skip each other's picks —
         # the reference's exact mechanism (worker_api.py:1494-1556). On
@@ -151,21 +290,26 @@ async def claim_job(
             """,
             {"now": t, "accel": accelerator.value, "cv": code_version},
         )
-        if row is None:
-            return None
-        js.guard_claim(row, now=t)
-        await tx.execute(
-            """
-            UPDATE jobs SET claimed_by=:w, claimed_at=:t, claim_expires_at=:exp,
-                   started_at=COALESCE(started_at, :t), attempt=attempt+1,
-                   updated_at=:t
-            WHERE id=:id
-            """,
-            {"w": worker_name, "t": t, "exp": t + lease, "id": row["id"]},
-        )
-        claimed = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": row["id"]})
-        assert claimed is not None
-        return claimed
+        claimed = None
+        if row is not None:
+            js.guard_claim(row, now=t)
+            failpoints.hit("claims.claim")
+            await tx.execute(
+                """
+                UPDATE jobs SET claimed_by=:w, claimed_at=:t, claim_expires_at=:exp,
+                       started_at=COALESCE(started_at, :t), attempt=attempt+1,
+                       next_retry_at=NULL, updated_at=:t
+                WHERE id=:id
+                """,
+                {"w": worker_name, "t": t, "exp": t + lease, "id": row["id"]},
+            )
+            claimed = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id",
+                                         {"id": row["id"]})
+            assert claimed is not None
+    # terminal transitions the sweep performed, announced post-commit
+    for jid in dead:
+        _wake(db, CH_PROGRESS, {"job_id": jid, "event": "failed"})
+    return claimed
 
 
 async def update_progress(
@@ -222,6 +366,7 @@ async def complete_job(db: Database, job_id: int, worker_name: str) -> Row:
         if row is None:
             raise js.JobStateError(f"job {job_id} does not exist")
         js.guard_complete(row, worker_name, now=t)
+        failpoints.hit("claims.complete")
         await tx.execute(
             """
             UPDATE jobs SET completed_at=:t, progress=100.0, claimed_by=NULL,
@@ -243,40 +388,56 @@ async def fail_job(
     error: str,
     *,
     permanent: bool = False,
+    failure_class: FailureClass | str | None = None,
 ) -> Row:
     """Record a failed attempt; terminal only when the retry budget is gone.
 
     Reference parity: worker_api.py:2074-2190 + transcoder.py:2869-2933 —
     a failure releases the claim; the job terminally fails when
-    ``attempt >= max_attempts`` (or ``permanent=True``), otherwise it returns
-    to the claimable pool as RETRYING.
+    ``attempt >= max_attempts`` (or ``permanent=True``), otherwise it is
+    stamped with jittered exponential backoff (``next_retry_at``) and
+    derives BACKOFF until due. Every call appends a classified
+    ``job_failures`` row; ``failure_class`` defaults to PERMANENT when
+    ``permanent`` else TRANSIENT.
     """
+    if failure_class is None:
+        failure_class = (FailureClass.PERMANENT if permanent
+                         else FailureClass.TRANSIENT)
+    else:
+        failure_class = FailureClass(failure_class)
     t = db_now()
     async with db.transaction() as tx:
         row = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         if row is None:
             raise js.JobStateError(f"job {job_id} does not exist")
         js.guard_fail(row, worker_name, now=t)
+        failpoints.hit("claims.fail")
         exhausted = permanent or (row["attempt"] or 0) >= (row["max_attempts"] or 1)
+        retry_at = None if exhausted else t + retry_backoff_s(row["attempt"] or 1)
         await tx.execute(
             """
             UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
-                   failed_at=:failed_at, error=:err, updated_at=:t
+                   failed_at=:failed_at, error=:err, next_retry_at=:nra,
+                   updated_at=:t
             WHERE id=:id
             """,
             {
                 "failed_at": t if exhausted else None,
                 "err": error[:2000],
+                "nra": retry_at,
                 "t": t,
                 "id": job_id,
             },
         )
+        await _record_failure(tx, job_id, row["attempt"] or 0, worker_name,
+                              error, failure_class, t)
         out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         assert out is not None
     _wake(db, CH_PROGRESS, {"job_id": job_id,
                             "event": "failed" if exhausted else "retrying"})
     if not exhausted:
-        # back in the claimable pool — wake sleeping workers
+        # back in the claimable pool (once the backoff lapses) — wake
+        # sleeping workers; their claim query enforces next_retry_at
         _wake(db, CH_JOBS, {"job_id": job_id})
     return out
 
@@ -292,7 +453,13 @@ async def release_job(
     — the work was interrupted, not attempted-and-failed. Crash-recovery
     callers (a restarted worker releasing its dead incarnation's claims)
     must pass ``refund_attempt=False``: a job that kills its worker process
-    would otherwise never exhaust ``max_attempts``.
+    would otherwise never exhaust ``max_attempts``. The no-refund path also
+    records a ``worker_crash`` failure row and applies retry backoff — a
+    poison job under a fast supervisor restart loop must not burn its
+    whole budget at relaunch speed — and, when the budget is already
+    spent, dead-letters the job outright (same strand-avoidance rule as
+    the expired-claim sweep: a released final attempt would be
+    unclaimable yet never terminal).
     """
     t = db_now()
     async with db.transaction() as tx:
@@ -301,19 +468,34 @@ async def release_job(
             raise js.JobStateError(f"job {job_id} does not exist")
         # Same ownership rule as progress: only the claim holder may release.
         js.guard_progress(row, worker_name, now=t)
+        exhausted = (not refund_attempt
+                     and (row["attempt"] or 0) >= (row["max_attempts"] or 1))
         attempt_sql = (f"attempt={db.greatest('attempt - 1', '0')},"
                        if refund_attempt else "")
+        retry_at = None if (refund_attempt or exhausted) \
+            else t + retry_backoff_s(row["attempt"] or 1)
         await tx.execute(
             f"""
             UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
-                   {attempt_sql} updated_at=:t
+                   {attempt_sql} next_retry_at=:nra, updated_at=:t
             WHERE id=:id
             """,
-            {"t": t, "id": job_id},
+            {"t": t, "nra": retry_at, "id": job_id},
         )
+        if not refund_attempt:
+            await _record_failure(
+                tx, job_id, row["attempt"] or 0, worker_name,
+                "claim released without refund (previous worker incarnation "
+                "crashed mid-job)", FailureClass.WORKER_CRASH, t)
+        if exhausted:
+            await _dead_letter_crashed(tx, job_id, row["video_id"],
+                                       row["kind"], t)
         out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         assert out is not None
-    _wake(db, CH_JOBS, {"job_id": job_id})   # claimable again
+    if exhausted:
+        _wake(db, CH_PROGRESS, {"job_id": job_id, "event": "failed"})
+    else:
+        _wake(db, CH_JOBS, {"job_id": job_id})   # claimable again
     return out
 
 

@@ -1,6 +1,6 @@
 """Pure job state machine.
 
-Reference parity: api/job_state.py:48-616 — six states *derived* from
+Reference parity: api/job_state.py:48-616 — states *derived* from
 nullable columns so the database can never hold a contradictory state, plus
 composable SQL fragments and transition guards used by the claim protocol.
 
@@ -10,8 +10,16 @@ Column semantics (see db/schema.py `jobs` table):
 - ``failed_at`` set     -> FAILED (terminal)
 - ``claimed_by`` set and lease valid  -> CLAIMED
 - ``claimed_by`` set and lease lapsed -> EXPIRED (reclaimable)
+- ``claimed_by`` null, attempt > 0, ``next_retry_at`` in the future
+                                      -> BACKOFF (not yet claimable)
 - ``claimed_by`` null, attempt > 0    -> RETRYING
 - ``claimed_by`` null, attempt == 0   -> UNCLAIMED
+
+BACKOFF is the retry-pacing state: ``fail_job`` stamps ``next_retry_at``
+with jittered exponential backoff (config: VLOG_RETRY_BACKOFF_BASE /
+VLOG_RETRY_BACKOFF_CAP), and ``SQL_CLAIMABLE`` skips rows that are not
+yet due, so a crash-looping job cannot burn its whole retry budget in
+seconds. Claiming clears the timestamp.
 """
 
 from __future__ import annotations
@@ -38,6 +46,9 @@ def derive_state(row: Mapping[str, Any], *, now: float) -> JobState:
             return JobState.EXPIRED
         return JobState.CLAIMED
     if (row.get("attempt") or 0) > 0:
+        nra = row.get("next_retry_at")
+        if nra is not None and nra > now:
+            return JobState.BACKOFF
         return JobState.RETRYING
     return JobState.UNCLAIMED
 
@@ -47,7 +58,12 @@ def is_terminal(state: JobState) -> bool:
 
 
 def is_claimable(row: Mapping[str, Any], *, now: float) -> bool:
-    """A job is claimable when unclaimed/retrying or its claim lease lapsed."""
+    """A job is claimable when unclaimed/retrying or its claim lease lapsed.
+
+    BACKOFF is deliberately absent: a failed attempt is not claimable
+    again until its ``next_retry_at`` has passed (it then derives
+    RETRYING).
+    """
     return derive_state(row, now=now) in (
         JobState.UNCLAIMED,
         JobState.RETRYING,
@@ -64,6 +80,15 @@ SQL_NOT_TERMINAL = "(completed_at IS NULL AND failed_at IS NULL)"
 SQL_CLAIMABLE = (
     f"{SQL_NOT_TERMINAL} AND "
     "(claimed_by IS NULL OR (claim_expires_at IS NOT NULL AND claim_expires_at <= :now))"
+    " AND (next_retry_at IS NULL OR next_retry_at <= :now)"
+)
+
+# Completes the composable-fragment family (one per derivable state with
+# a waiting pool); the SQL/Python agreement tests hold it to derive_state,
+# and operators use it for ad-hoc "what is the queue waiting on" queries.
+SQL_IN_BACKOFF = (
+    f"{SQL_NOT_TERMINAL} AND claimed_by IS NULL AND attempt > 0 AND "
+    "next_retry_at IS NOT NULL AND next_retry_at > :now"
 )
 
 SQL_ACTIVELY_CLAIMED = (

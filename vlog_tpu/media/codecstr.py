@@ -32,17 +32,21 @@ def codec_string_from_init(init: bytes) -> str | None:
     i = _find_box(init, b"hvcC")
     if i >= 0 and len(init) >= i + 13:
         b = init[i + 1]
+        # general_profile_space (2 bits): nonzero prefixes the profile
+        # with a letter (A/B/C per RFC 6381 / ISO 14496-15 E.3)
+        space = (b >> 6) & 0x3
+        prefix = "" if space == 0 else chr(ord("A") + space - 1)
         profile_idc = b & 0x1F
         tier = "H" if b & 0x20 else "L"
         compat = int.from_bytes(init[i + 2:i + 6], "big")
         # compatibility flags are stored bit-reversed in the string
         rev = int(f"{compat:032b}"[::-1], 2)
         level = init[i + 12]
-        # general_constraint bytes: trailing zero bytes are dropped
-        cons = init[i + 6:i + 12]
-        cons_s = "".join(f".{x:02X}" for x in
-                         cons[:max(1, len(cons.rstrip(b'\x00')))])
-        return f"hvc1.{profile_idc}.{rev:X}.{tier}{level}{cons_s}"
+        # general_constraint bytes: trailing zero bytes are dropped, and
+        # an all-zero group is omitted entirely (no trailing ".00")
+        cons = init[i + 6:i + 12].rstrip(b"\x00")
+        cons_s = "".join(f".{x:02X}" for x in cons)
+        return f"hvc1.{prefix}{profile_idc}.{rev:X}.{tier}{level}{cons_s}"
     i = _find_box(init, b"av1C")
     if i >= 0 and len(init) >= i + 3:
         return _av1_string(init, i)

@@ -1,0 +1,173 @@
+"""Deterministic fault-injection failpoints.
+
+Named injection sites compiled into the job plane so chaos tests (and
+operators reproducing an incident) can make any hop fail on demand:
+
+==================  =====================================================
+site                where it fires
+==================  =====================================================
+``claims.claim``    inside the claim transaction, after the row pick and
+                    before the claim write (jobs/claims.py)
+``claims.complete`` inside the completion transaction, before the
+                    terminal write
+``claims.fail``     inside the failure transaction, before any retry
+                    accounting (a failure to record a failure)
+``db.commit``       just before a transaction COMMIT (db/core.py) — the
+                    armed transaction rolls back
+``daemon.compute``  in WorkerDaemon._dispatch, before the kind handler
+``backend.encode``  at JaxBackend.run entry (worker compute thread)
+``remote.upload``   in WorkerAPIClient.upload_file, before each attempt
+``remote.claim``    in WorkerAPIClient.claim
+==================  =====================================================
+
+A disarmed site costs one dict lookup; nothing is armed unless
+``VLOG_FAILPOINTS`` is set at import time or :func:`arm` /
+:func:`arm_from_spec` is called. Spec grammar (comma/semicolon
+separated)::
+
+    VLOG_FAILPOINTS="claims.complete=1,backend.encode=p0.25,db.commit=skip2:3"
+
+    site            every hit raises (no budget)
+    site=N          raise on the first N hits, then stay silent
+    site=pX         raise each hit with probability X; the sequence is
+                    deterministic given VLOG_FAILPOINTS_SEED (default 0)
+    site=skipM:...  let the first M hits pass before the trigger applies
+
+Triggered sites raise :class:`FailpointError` (a RuntimeError), so
+injected faults flow through exactly the handling real faults get. The
+registry is process-global and thread-safe — compute threads hit sites
+too.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+ENV_VAR = "VLOG_FAILPOINTS"
+SEED_VAR = "VLOG_FAILPOINTS_SEED"
+
+
+class FailpointError(RuntimeError):
+    """An armed failpoint fired."""
+
+    def __init__(self, site: str):
+        super().__init__(f"failpoint {site!r} triggered")
+        self.site = site
+
+
+class _Failpoint:
+    __slots__ = ("site", "count", "prob", "skip", "hits", "fires")
+
+    def __init__(self, site: str, *, count: int | None = None,
+                 prob: float | None = None, skip: int = 0):
+        self.site = site
+        self.count = count      # max fires; None = unbounded
+        self.prob = prob        # fire probability; None = always
+        self.skip = skip        # hits to let pass before the trigger
+        self.hits = 0
+        self.fires = 0
+
+
+_active: dict[str, _Failpoint] = {}
+_lock = threading.Lock()
+_rng = random.Random(0)
+
+
+def arm(site: str, *, count: int | None = None, prob: float | None = None,
+        skip: int = 0) -> None:
+    """Arm (or re-arm, resetting counters) one site."""
+    with _lock:
+        _active[site] = _Failpoint(site, count=count, prob=prob, skip=skip)
+
+
+def disarm(site: str) -> None:
+    with _lock:
+        _active.pop(site, None)
+
+
+def reset() -> None:
+    """Disarm every site and reseed the probability stream."""
+    with _lock:
+        _active.clear()
+        _rng.seed(int(os.environ.get(SEED_VAR, "0") or 0))
+
+
+def is_armed(site: str) -> bool:
+    return site in _active
+
+
+def arm_from_spec(spec: str) -> list[str]:
+    """Arm sites from a spec string (see module docstring); returns the
+    site names armed. Malformed entries raise ValueError — a typo'd
+    failpoint silently not firing would invalidate the whole chaos run.
+    """
+    armed: list[str] = []
+    for entry in spec.replace(";", ",").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, _, trig = entry.partition("=")
+        site = site.strip()
+        if not site:
+            raise ValueError(f"failpoint spec entry {entry!r} has no site")
+        count: int | None = None
+        prob: float | None = None
+        skip = 0
+        trig = trig.strip()
+        if trig.startswith("skip"):
+            head, _, trig = trig.partition(":")
+            skip = int(head[4:])
+            trig = trig.strip()
+        if trig.startswith("p"):
+            prob = float(trig[1:])
+            if not 0.0 <= prob <= 1.0:
+                raise ValueError(f"failpoint {site}: probability {prob} "
+                                 "outside [0, 1]")
+        elif trig:
+            count = int(trig)
+            if count < 0:
+                raise ValueError(f"failpoint {site}: negative count")
+        arm(site, count=count, prob=prob, skip=skip)
+        armed.append(site)
+    return armed
+
+
+def arm_from_env() -> list[str]:
+    spec = os.environ.get(ENV_VAR, "")
+    return arm_from_spec(spec) if spec else []
+
+
+def hit(site: str) -> None:
+    """Record a hit at ``site``; raises FailpointError when triggered."""
+    if not _active:          # fast path: nothing armed anywhere
+        return
+    fp = _active.get(site)
+    if fp is None:
+        return
+    with _lock:
+        fp.hits += 1
+        if fp.hits <= fp.skip:
+            return
+        if fp.count is not None and fp.fires >= fp.count:
+            return
+        if fp.prob is not None and _rng.random() >= fp.prob:
+            return
+        fp.fires += 1
+    raise FailpointError(site)
+
+
+def counters() -> dict[str, dict[str, int]]:
+    """Hit/fire counters per armed site (test + admin observability)."""
+    with _lock:
+        return {s: {"hits": fp.hits, "fires": fp.fires,
+                    "budget": -1 if fp.count is None else fp.count}
+                for s, fp in _active.items()}
+
+
+# Arming at import keeps the contract simple: export VLOG_FAILPOINTS and
+# every process that imports the job plane participates in the chaos run.
+if os.environ.get(ENV_VAR):
+    _rng.seed(int(os.environ.get(SEED_VAR, "0") or 0))
+    arm_from_env()

@@ -144,6 +144,7 @@ async function loadDashboard() {
     [totals.live, "watching now"],
     [`${online}/${w.workers.length}`, "workers online"],
     [queued, "jobs queued", "queue"],
+    [jq.counts.backoff || 0, "in backoff", "queue"],
     [jq.counts.failed || 0, "dead-lettered", "jobs"],
   ];
   const sg = $("stats");
@@ -188,11 +189,12 @@ function renderProgress(ev) {
   const bar = document.createElement("div");
   bar.className = "progressbar";
   const fill = document.createElement("div");
-  fill.style.width = `${Math.round((ev.progress || 0) * 100)}%`;
+  // SSE streams the raw jobs.progress value, already on a 0-100 scale
+  fill.style.width = `${Math.round(ev.progress || 0)}%`;
   bar.appendChild(fill);
   const pct = document.createElement("span");
   pct.className = "dim";
-  pct.textContent = ` ${Math.round((ev.progress || 0) * 100)}% ${ev.current_step || ""}`;
+  pct.textContent = ` ${Math.round(ev.progress || 0)}% ${ev.current_step || ""}`;
   const cell = document.createElement("div");
   cell.append(bar, pct);
   cells(tr, [`#${ev.job_id}`, `video ${ev.video_id}`, ev.kind, badge(ev.state), cell, ev.worker || "—"]);
@@ -761,6 +763,38 @@ $("dr-cf-save").onclick = async () => {
 
 /* ------------------------------------------------- jobs --------------- */
 
+function failureHistory(failures) {
+  // Compact per-attempt post-mortem: "N× class" badges up front, the
+  // full attempt/worker/error list behind a <details> fold.
+  if (!failures || failures.length === 0) {
+    const s = document.createElement("span");
+    s.className = "dim";
+    s.textContent = "—";
+    return s;
+  }
+  const byClass = {};
+  for (const f of failures) byClass[f.failure_class] = (byClass[f.failure_class] || 0) + 1;
+  const det = document.createElement("details");
+  const sum = document.createElement("summary");
+  for (const [cls, n] of Object.entries(byClass).sort()) {
+    sum.appendChild(badge(`${cls}: ${n}`));
+  }
+  det.appendChild(sum);
+  const ul = document.createElement("ul");
+  ul.style.margin = "4px 0 0 0";
+  for (const f of failures) {
+    const li = document.createElement("li");
+    li.className = "dim";
+    li.style.fontSize = "11px";
+    li.textContent = `attempt ${f.attempt} · ${f.failure_class}`
+      + ` · ${f.worker || "?"} · ${(f.error || "").slice(0, 160)}`;
+    li.title = f.error || "";
+    ul.appendChild(li);
+  }
+  det.appendChild(ul);
+  return det;
+}
+
 async function loadJobs() {
   const d = await api("/api/jobs/failed");
   const tb = $("failed-table").tBodies[0];
@@ -773,6 +807,7 @@ async function loadJobs() {
     err.textContent = (jb.error || "").slice(0, 120);
     err.title = jb.error || "";
     cells(tr, [`#${jb.id}`, jb.title, jb.kind, jb.attempt, err,
+      failureHistory(jb.failures),
       actionBtn("requeue", async () => { await api(`/api/jobs/${jb.id}/requeue`, { method: "POST" }); loadJobs(); })]);
     tb.appendChild(tr);
   }
@@ -904,32 +939,61 @@ $("wh-create").onclick = async () => {
 
 /* ------------------------------------------------- queue -------------- */
 
-async function loadQueue() {
+let qCursor = null;     // keyset position of the next page (null = first)
+let qLoading = false;   // double-click guard: one in-flight page fetch
+
+async function loadQueue(more) {
+  if (qLoading) return;
+  qLoading = true;
+  try {
+    await loadQueuePage(more);
+  } finally {
+    qLoading = false;
+  }
+}
+
+async function loadQueuePage(more) {
   const st = $("q-state").value;
-  const d = await api(`/api/jobs${st ? `?state=${st}` : ""}`);
-  const pills = $("q-counts");
-  pills.textContent = "";
-  for (const [state, n] of Object.entries(d.counts).sort()) {
-    const b = badge(`${state}: ${n}`);
-    b.style.cursor = "pointer";
-    b.onclick = () => { $("q-state").value = state; loadQueue(); };
-    pills.appendChild(b);
+  if (!more) qCursor = null;
+  const params = new URLSearchParams();
+  if (st) params.set("state", st);
+  if (qCursor) params.set("cursor", qCursor);
+  const qs = params.toString();
+  const d = await api(`/api/jobs${qs ? `?${qs}` : ""}`);
+  if (d.counts) {   // only the first (cursorless) page carries counts
+    const pills = $("q-counts");
+    pills.textContent = "";
+    for (const [state, n] of Object.entries(d.counts).sort()) {
+      const b = badge(`${state}: ${n}`);
+      b.style.cursor = "pointer";
+      b.onclick = () => { $("q-state").value = state; loadQueue(); };
+      pills.appendChild(b);
+    }
   }
   const tb = $("queue-table").tBodies[0];
-  tb.textContent = "";
-  $("queue-empty").hidden = d.jobs.length > 0;
+  if (!more) tb.textContent = "";
   for (const jb of d.jobs) {
     const tr = document.createElement("tr");
+    // jobs.progress is stored 0-100 (claims.update_progress clamp)
     const prog = jb.progress != null
-      ? `${Math.round(jb.progress * 100)}%` : "—";
-    cells(tr, [`#${jb.id}`, jb.title, jb.kind, badge(jb.state),
+      ? `${Math.round(jb.progress)}%` : "—";
+    const state = badge(jb.state);
+    if (jb.state === "backoff" && jb.next_retry_at) {
+      state.title = `retry due in ${Math.max(0,
+        Math.round(jb.next_retry_at - Date.now() / 1000))}s`;
+    }
+    cells(tr, [`#${jb.id}`, jb.title, jb.kind, state,
       jb.attempt, prog, jb.current_step || "—", jb.claimed_by || "—",
       fmtAgo(jb.updated_at)]);
     tb.appendChild(tr);
   }
+  $("queue-empty").hidden = tb.rows.length > 0;
+  qCursor = d.next_cursor;
+  $("q-more").hidden = !qCursor;
 }
-$("q-refresh").onclick = loadQueue;
-$("q-state").addEventListener("change", loadQueue);
+$("q-refresh").onclick = () => loadQueue();
+$("q-more").onclick = () => loadQueue(true);
+$("q-state").addEventListener("change", () => loadQueue());
 
 /* ------------------------------------------------- audit -------------- */
 

@@ -9,6 +9,20 @@ cooperative at GOP-batch granularity through the progress callback — the
 same chunked-execution contract that makes XLA dispatches checkpointable
 (SURVEY.md §7 hard part 3).
 
+Failure domain hardening:
+
+- A circuit breaker (worker/breaker.py) pauses claiming after
+  ``VLOG_BREAKER_THRESHOLD`` consecutive compute failures; after
+  ``VLOG_BREAKER_COOLDOWN`` seconds one half-open probe job decides
+  whether to resume or keep waiting.
+- A stall watchdog cancels in-flight compute whose progress has not
+  advanced within ``VLOG_STALL_WINDOW`` seconds — catching work that
+  renews its lease (progress writes) without ever moving ``done``
+  forward. Stall cancels are classified ``stalled`` in job_failures.
+- Failures are classified (enums.FailureClass) when reported through
+  ``claims.fail_job``; chaos runs arm failpoints (utils/failpoints.py,
+  site ``daemon.compute`` here) via ``VLOG_FAILPOINTS``.
+
 Run it: ``python -m vlog_tpu.worker.daemon --name my-worker``.
 """
 
@@ -28,18 +42,15 @@ from typing import Any, Awaitable, Callable
 from vlog_tpu import config
 from vlog_tpu.codecs import validate_codec_format
 from vlog_tpu.db.core import Database, Row, now as db_now, open_database
-from vlog_tpu.enums import AcceleratorKind, JobKind, VideoStatus
+from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind, VideoStatus
 from vlog_tpu.jobs import claims, state as js, videos as vids
+from vlog_tpu.utils import failpoints
+from vlog_tpu.worker.breaker import CircuitBreaker
+from vlog_tpu.worker.watchdog import ComputeWatchdogMixin, JobCancelled
 
 log = logging.getLogger("vlog_tpu.worker")
 
-
-class JobCancelled(Exception):
-    """Raised inside the compute thread to abort at the next batch boundary."""
-
-    def __init__(self, reason: str):
-        super().__init__(reason)
-        self.reason = reason
+__all__ = ["WorkerDaemon", "DaemonStats", "JobCancelled"]
 
 
 @dataclass
@@ -78,7 +89,7 @@ def _cleanup_other_format(out_dir: Path, new_fmt: str) -> None:
 
 
 @dataclass
-class WorkerDaemon:
+class WorkerDaemon(ComputeWatchdogMixin):
     db: Database
     name: str
     accelerator: AcceleratorKind = AcceleratorKind.TPU
@@ -93,6 +104,13 @@ class WorkerDaemon:
     progress_min_interval_s: float = 2.0   # DB-write rate limit (thread side)
     on_event: EventFn | None = None
     transcription_model_dir: str | None = None
+    # Stall watchdog: cancel compute whose progress (frames done) has not
+    # advanced within this window; 0 disables. Checked every watchdog tick.
+    stall_window_s: float = field(
+        default_factory=lambda: config.STALL_WINDOW_S)
+    watchdog_tick_s: float = 1.0
+    # Circuit breaker over the compute path; None builds one from config.
+    breaker: CircuitBreaker | None = None
 
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
@@ -101,6 +119,9 @@ class WorkerDaemon:
         self._cancel = threading.Event()   # aborts the in-flight compute
         self._cancel_reason = ""
         self._current_job_id: int | None = None
+        if self.breaker is None:
+            self.breaker = CircuitBreaker()
+        self._reset_watchdog()
         # recent-log ring so the get_logs command verb can answer
         # without a log file (utils/logring.py)
         from vlog_tpu.utils.logring import install_ring
@@ -185,6 +206,7 @@ class WorkerDaemon:
 
             return {**asdict(self.stats),
                     "current_job_id": self._current_job_id,
+                    "breaker": self.breaker.snapshot(),
                     "kinds": [k.value for k in self.kinds]}
         if command == "stop":
             log.info("remote stop command received")
@@ -222,14 +244,27 @@ class WorkerDaemon:
         flow and at worst ``poll_interval_s`` when they don't."""
         from vlog_tpu.jobs.events import CH_JOBS, bus_for
 
-        await self.startup()
+        try:
+            await self.startup()
+        except Exception:  # noqa: BLE001 — a failed recovery sweep must
+            # not keep the worker down; lapsed leases are also swept
+            # inside every claim transaction
+            log.exception("startup recovery failed; polling anyway")
         bus = bus_for(self.db)
         await bus.start()
         jobs_sub = bus.subscribe(CH_JOBS)
         hb = asyncio.create_task(self._heartbeat_loop())
         try:
             while not self._stop.is_set():
-                worked = await self.poll_once()
+                try:
+                    worked = await self.poll_once()
+                except Exception:  # noqa: BLE001 — the daemon must outlive
+                    # any single poll cycle (transient DB faults, injected
+                    # failpoints); pause briefly so a persistent fault
+                    # cannot hot-loop
+                    log.exception("poll cycle failed; continuing")
+                    worked = False
+                    await asyncio.sleep(min(self.poll_interval_s, 1.0))
                 if worked or self._stop.is_set():
                     # a poll that found work already consumed the queue
                     # head; stale wakeups would only cause a hot no-op
@@ -250,16 +285,30 @@ class WorkerDaemon:
         """Claim and process at most one job. Returns True if one ran."""
         from vlog_tpu.db.retry import with_retries
 
-        job = await with_retries(
-            lambda: claims.claim_job(
-                self.db, self.name, kinds=self.kinds,
-                accelerator=self.accelerator),
-            label="daemon-claim")
+        if not self.breaker.allow():
+            # breaker open: leave the queue alone until the cooldown
+            # lapses and a half-open probe is due
+            return False
+        # From here on, every exit that does not end in record_success /
+        # record_failure must call release_probe() (a no-op unless this
+        # poll holds the half-open probe) — otherwise the breaker wedges
+        # in HALF_OPEN waiting for an outcome that can never arrive.
+        try:
+            job = await with_retries(
+                lambda: claims.claim_job(
+                    self.db, self.name, kinds=self.kinds,
+                    accelerator=self.accelerator),
+                label="daemon-claim")
+        except BaseException:
+            self.breaker.release_probe()
+            raise
         if job is None:
+            self.breaker.release_probe()
             return False
         if self._stop.is_set():
             # Shutdown arrived while the claim was in flight: hand it
             # straight back instead of starting (and then abandoning) work.
+            self.breaker.release_probe()
             try:
                 await claims.release_job(self.db, job["id"], self.name)
             except js.JobStateError:
@@ -269,9 +318,14 @@ class WorkerDaemon:
         self._cancel.clear()
         self._cancel_reason = ""
         self._current_job_id = job["id"]
+        self._reset_watchdog()
         try:
             await self._dispatch(job)
         finally:
+            # Resolve any half-open probe _dispatch leaked — e.g. an
+            # exception before its try block (video lookup) records no
+            # outcome; a wedged HALF_OPEN would never claim again.
+            self.breaker.release_probe()
             self._current_job_id = None
         return True
 
@@ -291,8 +345,18 @@ class WorkerDaemon:
             JobKind.SPRITE: self._run_sprites,
             JobKind.TRANSCRIPTION: self._run_transcription,
         }[kind]
+        failed_before = self.stats.failed
         try:
+            failpoints.hit("daemon.compute")
             await handler(job, video)
+            # A handler can return normally after dead-lettering a DATA
+            # problem internally (missing source, duration cap, bad
+            # payload) — that says nothing about compute health, so it
+            # must neither close a half-open breaker nor count against
+            # it (poll_once's finally releases any probe). Only a run
+            # with no failure recorded is a success.
+            if self.stats.failed == failed_before:
+                self.breaker.record_success()
         except JobCancelled as exc:
             if self._stop.is_set():
                 # Graceful shutdown: hand the claim back, attempt refunded.
@@ -307,19 +371,28 @@ class WorkerDaemon:
                     log.warning("shutdown release of job %s skipped: %s",
                                 job["id"], rel_exc)
             else:
-                await self._fail(job, video, f"cancelled: {exc.reason}")
+                self.breaker.record_failure()
+                fc = (FailureClass.STALLED
+                      if exc.reason.startswith("stalled")
+                      else FailureClass.TRANSIENT)
+                await self._fail(job, video, f"cancelled: {exc.reason}",
+                                 failure_class=fc)
         except js.JobStateError as exc:
             # Lost the claim (lease lapsed + reclaimed); nothing to write.
+            # Not a breaker event: contention, not compute health.
             log.warning("job %s claim lost: %s", job["id"], exc)
             self.stats.last_error = str(exc)
         except Exception as exc:  # noqa: BLE001 — worker must survive any job
             log.exception("job %s failed", job["id"])
+            self.breaker.record_failure()
             await self._fail(job, video, f"{type(exc).__name__}: {exc}")
 
     async def _fail(self, job: Row, video: Row, error: str, *,
-                    permanent: bool = False) -> None:
+                    permanent: bool = False,
+                    failure_class: FailureClass | None = None) -> None:
         row = await claims.fail_job(self.db, job["id"], self.name, error,
-                                    permanent=permanent)
+                                    permanent=permanent,
+                                    failure_class=failure_class)
         self.stats.failed += 1
         self.stats.last_error = error
         terminal = row["failed_at"] is not None
@@ -365,6 +438,7 @@ class WorkerDaemon:
 
         def cb(done: int, total: int, msg: str) -> None:
             nonlocal last_write
+            self._note_progress(done)   # stall-watchdog feed
             if self._cancel.is_set():
                 raise JobCancelled(self._cancel_reason or "cancelled")
             if claim_lost.is_set():
@@ -382,28 +456,9 @@ class WorkerDaemon:
     # progress-callback boundary before the daemon abandons it.
     cancel_grace_s: float = 120.0
 
-    async def _run_with_timeout(self, fn, timeout_s: float, what: str):
-        """Run blocking compute in a thread; cancel cooperatively on timeout.
-
-        If the thread does not honor the cancel within ``cancel_grace_s``
-        (wedged outside any progress callback — e.g. a pathological parse),
-        it is abandoned: the daemon raises and moves on; the zombie thread
-        can no longer write to the job (its claim is released/failed).
-        """
-        task = asyncio.create_task(asyncio.to_thread(fn))
-        try:
-            return await asyncio.wait_for(asyncio.shield(task), timeout_s)
-        except asyncio.TimeoutError:
-            self._cancel_reason = f"{what} timed out after {timeout_s:.0f}s"
-            self._cancel.set()
-            try:
-                return await asyncio.wait_for(asyncio.shield(task),
-                                              self.cancel_grace_s)
-            except asyncio.TimeoutError:
-                log.error("%s ignored cancellation for %.0fs; abandoning "
-                          "the compute thread", what, self.cancel_grace_s)
-                raise JobCancelled(
-                    f"{self._cancel_reason} (thread unresponsive)") from None
+    # _run_with_timeout / _cancel_and_drain: ComputeWatchdogMixin
+    # (worker/watchdog.py) — shared with RemoteWorker so timeout, stall
+    # and cancel semantics cannot drift between the two workers.
 
     # -- handlers ----------------------------------------------------------
 

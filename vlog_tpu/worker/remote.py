@@ -29,8 +29,11 @@ import httpx
 
 from vlog_tpu import config
 from vlog_tpu.codecs import validate_codec_format
-from vlog_tpu.enums import AcceleratorKind, JobKind
-from vlog_tpu.worker.daemon import DaemonStats, JobCancelled
+from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
+from vlog_tpu.utils import failpoints
+from vlog_tpu.worker.breaker import CircuitBreaker
+from vlog_tpu.worker.daemon import DaemonStats
+from vlog_tpu.worker.watchdog import ComputeWatchdogMixin, JobCancelled
 
 log = logging.getLogger("vlog_tpu.remote")
 
@@ -107,6 +110,7 @@ class WorkerAPIClient:
                             json={"capabilities": capabilities or {}})
 
     async def claim(self, kinds: list[str], accelerator: str) -> dict | None:
+        failpoints.hit("remote.claim")
         r = await self._request("POST", "/api/worker/claim",
                                 json={"kinds": kinds,
                                       "accelerator": accelerator,
@@ -128,9 +132,11 @@ class WorkerAPIClient:
                             json={"result": result})
 
     async def fail(self, job_id: int, error: str, *,
-                   permanent: bool = False) -> None:
+                   permanent: bool = False,
+                   failure_class: str | None = None) -> None:
         await self._request("POST", f"/api/worker/jobs/{job_id}/fail",
-                            json={"error": error, "permanent": permanent})
+                            json={"error": error, "permanent": permanent,
+                                  "failure_class": failure_class})
 
     async def release(self, job_id: int) -> None:
         await self._request("POST", f"/api/worker/jobs/{job_id}/release")
@@ -166,8 +172,11 @@ class WorkerAPIClient:
         url = f"/api/worker/upload/{video_id}/{rel}"
         for attempt in range(self.retries + 1):
             try:
+                failpoints.hit("remote.upload")
                 resp = await self._client.put(url, content=body())
-            except httpx.TransportError as exc:
+            except (httpx.TransportError, failpoints.FailpointError) as exc:
+                # an injected upload fault takes the same bounded-retry
+                # path a real transport fault takes
                 if attempt == self.retries:
                     raise TransientAPIError(str(exc)) from exc
             else:
@@ -292,7 +301,7 @@ class StreamingUploader:
 # --------------------------------------------------------------------------
 
 @dataclass
-class RemoteWorker:
+class RemoteWorker(ComputeWatchdogMixin):
     client: WorkerAPIClient
     name: str
     work_dir: Path
@@ -309,6 +318,14 @@ class RemoteWorker:
     cancel_grace_s: float = 120.0
     keep_work_dirs: bool = False
     transcription_model_dir: str | None = None
+    # Same breaker shape as WorkerDaemon: consecutive compute failures
+    # stop the claim loop until a half-open probe succeeds.
+    breaker: CircuitBreaker | None = None
+    # Stall watchdog (WorkerDaemon parity): cancel compute whose progress
+    # has not advanced within this window; 0 disables.
+    stall_window_s: float = field(
+        default_factory=lambda: config.STALL_WINDOW_S)
+    watchdog_tick_s: float = 1.0
 
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
@@ -316,6 +333,9 @@ class RemoteWorker:
         self._stop = asyncio.Event()
         self._cancel = threading.Event()
         self._cancel_reason = ""
+        if self.breaker is None:
+            self.breaker = CircuitBreaker()
+        self._reset_watchdog()
         from vlog_tpu.utils.logring import install_ring
 
         install_ring()
@@ -334,6 +354,14 @@ class RemoteWorker:
                 except TransientAPIError as exc:
                     log.warning("API unreachable: %s", exc)
                     worked = False
+                except Exception:  # noqa: BLE001 — the worker must outlive
+                    # any single poll cycle (unexpected API faults,
+                    # injected failpoints), same contract as
+                    # WorkerDaemon.run; pause so a persistent fault
+                    # cannot hot-loop
+                    log.exception("poll cycle failed; continuing")
+                    worked = False
+                    await asyncio.sleep(min(self.poll_interval_s, 1.0))
                 if worked or self._stop.is_set():
                     continue
                 try:
@@ -375,6 +403,7 @@ class RemoteWorker:
             from dataclasses import asdict
 
             return {**asdict(self.stats),
+                    "breaker": self.breaker.snapshot(),
                     "kinds": [k.value for k in self.kinds]}
         if command == "stop":
             log.info("remote stop command received")
@@ -403,11 +432,22 @@ class RemoteWorker:
         return {"error": f"unknown command {command!r}"}
 
     async def poll_once(self) -> bool:
-        claimed = await self.client.claim(
-            [k.value for k in self.kinds], self.accelerator.value)
+        if not self.breaker.allow():
+            return False
+        # Exits that run no compute must hand a half-open probe slot back
+        # (release_probe is a no-op unless this poll holds the probe —
+        # same wedge-avoidance contract as WorkerDaemon.poll_once).
+        try:
+            claimed = await self.client.claim(
+                [k.value for k in self.kinds], self.accelerator.value)
+        except BaseException:
+            self.breaker.release_probe()
+            raise
         if claimed is None:
+            self.breaker.release_probe()
             return False
         if self._stop.is_set():
+            self.breaker.release_probe()
             try:
                 await self.client.release(claimed["job"]["id"])
             except (ClaimLost, TransientAPIError):
@@ -416,14 +456,23 @@ class RemoteWorker:
         self.stats.claimed += 1
         self._cancel.clear()
         self._cancel_reason = ""
+        self._reset_watchdog()
         job, video = claimed["job"], claimed["video"]
         if video is None:
-            # The video row vanished under a still-queued job.
+            # The video row vanished under a still-queued job — a data
+            # problem, not compute health: resolve any probe.
+            self.breaker.release_probe()
             await self._safe_fail(job["id"], "video row vanished",
                                   permanent=True)
             return True
+        failed_before = self.stats.failed
         try:
             await self._dispatch(job, video)
+            # data problems dead-lettered inside the handler (missing
+            # source, bad payload) say nothing about compute health —
+            # only a failure-free run closes/armors the breaker
+            if self.stats.failed == failed_before:
+                self.breaker.record_success()
         except JobCancelled as exc:
             if self._stop.is_set():
                 try:
@@ -432,24 +481,37 @@ class RemoteWorker:
                 except (ClaimLost, TransientAPIError):
                     pass
             else:
-                await self._safe_fail(job["id"], f"cancelled: {exc.reason}")
+                self.breaker.record_failure()
+                fc = (FailureClass.STALLED
+                      if exc.reason.startswith("stalled")
+                      else FailureClass.TRANSIENT)
+                await self._safe_fail(job["id"], f"cancelled: {exc.reason}",
+                                      failure_class=fc)
         except ClaimLost as exc:
             log.warning("job %s claim lost: %s", job["id"], exc)
             self.stats.last_error = str(exc)
         except Exception as exc:  # noqa: BLE001
             log.exception("job %s failed", job["id"])
+            self.breaker.record_failure()
             await self._safe_fail(job["id"], f"{type(exc).__name__}: {exc}")
         finally:
+            # Resolve any half-open probe the dispatch left unrecorded
+            # (claim-lost, shutdown release, pre-dispatch faults) — a
+            # wedged HALF_OPEN would never claim again.
+            self.breaker.release_probe()
             if not self.keep_work_dirs:
                 shutil.rmtree(self._job_dir(video), ignore_errors=True)
         return True
 
     async def _safe_fail(self, job_id: int, error: str, *,
-                         permanent: bool = False) -> None:
+                         permanent: bool = False,
+                         failure_class: FailureClass | None = None) -> None:
         self.stats.failed += 1
         self.stats.last_error = error
         try:
-            await self.client.fail(job_id, error, permanent=permanent)
+            await self.client.fail(
+                job_id, error, permanent=permanent,
+                failure_class=failure_class.value if failure_class else None)
         except (ClaimLost, TransientAPIError) as exc:
             log.warning("could not report failure for job %s: %s",
                         job_id, exc)
@@ -477,6 +539,7 @@ class RemoteWorker:
 
         def cb(done: int, total: int, msg: str) -> None:
             nonlocal last
+            self._note_progress(done)   # stall-watchdog feed
             if self._cancel.is_set():
                 raise JobCancelled(self._cancel_reason or "cancelled")
             if lost.is_set():
@@ -490,19 +553,10 @@ class RemoteWorker:
 
         return cb
 
-    async def _run_with_timeout(self, fn, timeout_s: float, what: str):
-        task = asyncio.create_task(asyncio.to_thread(fn))
-        try:
-            return await asyncio.wait_for(asyncio.shield(task), timeout_s)
-        except asyncio.TimeoutError:
-            self._cancel_reason = f"{what} timed out after {timeout_s:.0f}s"
-            self._cancel.set()
-            try:
-                return await asyncio.wait_for(asyncio.shield(task),
-                                              self.cancel_grace_s)
-            except asyncio.TimeoutError:
-                raise JobCancelled(
-                    f"{self._cancel_reason} (thread unresponsive)") from None
+    # _run_with_timeout / _cancel_and_drain: ComputeWatchdogMixin
+    # (worker/watchdog.py) — shared with WorkerDaemon. The stall window
+    # opens when compute starts, so the source download + probe that
+    # precede it never count as a stall.
 
     # -- handlers ----------------------------------------------------------
 
