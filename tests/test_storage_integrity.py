@@ -1,0 +1,819 @@
+"""The artifact integrity plane (ISSUE 2): checksummed uploads,
+manifest-verified publish, disk admission control, and orphan GC.
+
+Acceptance spine: a chaos run with ``upload.corrupt`` armed converges —
+every corrupted transfer is detected server-side (422), retried, and the
+final tree passes full manifest verification before finalize; disk
+pressure yields 507 + paused claiming; a GC sweep after the chaos run
+leaves zero orphaned temps while leaving published artifacts intact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import time
+from pathlib import Path
+
+import httpx
+import pytest
+from aiohttp.test_utils import TestServer
+
+from vlog_tpu import config
+from vlog_tpu.api.admin_api import build_admin_app
+from vlog_tpu.api.worker_api import METRICS, build_worker_app
+from vlog_tpu.enums import GCTarget, JobKind
+from vlog_tpu.jobs import claims, videos as vids
+from vlog_tpu.storage import gc as storage_gc, integrity
+from vlog_tpu.utils import failpoints
+from vlog_tpu.worker.daemon import WorkerDaemon
+from vlog_tpu.worker.remote import (
+    RemoteWorker,
+    StreamingUploader,
+    WorkerAPIClient,
+)
+from tests.fixtures.media import make_y4m
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+@pytest.fixture
+def api(run, db, tmp_path):
+    """Live worker API + registered client (retries=3 so injected faults
+    have room to converge)."""
+    video_dir = tmp_path / "srv-videos"
+    app = build_worker_app(db, video_dir=video_dir)
+    server = TestServer(app)
+    run(server.start_server())
+    base = str(server.make_url(""))
+    key = run(WorkerAPIClient.register(base, "rw1", accelerator="tpu"))
+    client = WorkerAPIClient(base, key, timeout=30.0, retries=3)
+    yield {"base": base, "client": client, "video_dir": video_dir,
+           "db": db, "app": app, "key": key}
+    run(client.aclose())
+    run(server.close())
+
+
+def _seed_claimed(run, db, tmp_path, api, title="V"):
+    src = make_y4m(tmp_path / f"{title}.y4m", n_frames=8, width=64, height=48)
+    video = run(vids.create_video(db, title, source_path=str(src)))
+    run(claims.enqueue_job(db, video["id"]))
+    claimed = run(api["client"].claim(["transcode"], "tpu"))
+    return video, claimed["job"]["id"], src
+
+
+def _counter(metric) -> float:
+    return metric._value.get()
+
+
+# --------------------------------------------------------------------------
+# Checksummed uploads
+# --------------------------------------------------------------------------
+
+class TestChecksummedUpload:
+    def test_corrupt_transfer_detected_and_retried_to_convergence(
+            self, run, db, tmp_path, api):
+        """upload.corrupt bit-flips the wire bytes while the digest
+        header carries the truth: the server answers 422, the client
+        retries with a fresh (clean) body, the file publishes intact."""
+        video, _job, src = _seed_claimed(run, db, tmp_path, api)
+        failpoints.arm("upload.corrupt", count=1)
+        run(api["client"].upload_file(video["id"], "360p/init.mp4", src))
+        dest = api["video_dir"] / video["slug"] / "360p" / "init.mp4"
+        assert dest.read_bytes() == src.read_bytes()
+        m = api["app"][METRICS]
+        assert _counter(m.upload_digest_mismatch) == 1
+        # the rejected attempt left no .part behind
+        assert not list((api["video_dir"] / video["slug"]).rglob("*.part"))
+        assert failpoints.counters()["upload.corrupt"]["fires"] == 1
+
+    def test_mismatch_without_retry_budget_surfaces_422(
+            self, run, db, tmp_path, api):
+        video, _job, src = _seed_claimed(run, db, tmp_path, api)
+
+        async def go():
+            async with httpx.AsyncClient(
+                    base_url=api["base"],
+                    headers={"Authorization": f"Bearer {api['key']}"}) as c:
+                r = await c.put(
+                    f"/api/worker/upload/{video['id']}/360p/seg.m4s",
+                    content=b"real bytes",
+                    headers={"X-Content-SHA256": "0" * 64})
+                assert r.status_code == 422
+                assert "digest mismatch" in r.json()["error"]
+
+        run(go())
+        assert not (api["video_dir"] / video["slug"] / "360p"
+                    / "seg.m4s").exists()
+
+    def test_upload_status_digest_cache_invalidates_on_change(
+            self, run, db, tmp_path, api):
+        """The inventory digest cache (seeded by the upload handler) is
+        (size, mtime)-validated: rewriting a server file in place must
+        surface the NEW digest, not the cached one."""
+        video, _job, _src = _seed_claimed(run, db, tmp_path, api)
+        f = tmp_path / "x.bin"
+        f.write_bytes(b"A" * 64)
+        run(api["client"].upload_file(video["id"], "x.bin", f))
+        have = run(api["client"].upload_status(video["id"]))
+        assert have["x.bin"]["sha256"] == hashlib.sha256(
+            b"A" * 64).hexdigest()
+        srv = api["video_dir"] / video["slug"] / "x.bin"
+        srv.write_bytes(b"B" * 64)                  # same size
+        os.utime(srv, (time.time() + 5, time.time() + 5))
+        have = run(api["client"].upload_status(video["id"]))
+        assert have["x.bin"]["sha256"] == hashlib.sha256(
+            b"B" * 64).hexdigest()
+
+    def test_tail_colliding_with_file_is_400_not_500(
+            self, run, db, tmp_path, api):
+        """Satellite: 'a' uploaded, then 'a/b' — mkdir over the file must
+        map to a 400 bad-path, and leave no .part."""
+        video, _job, src = _seed_claimed(run, db, tmp_path, api)
+
+        async def go():
+            async with httpx.AsyncClient(
+                    base_url=api["base"],
+                    headers={"Authorization": f"Bearer {api['key']}"}) as c:
+                r = await c.put(f"/api/worker/upload/{video['id']}/a",
+                                content=b"i am a file")
+                assert r.status_code == 200
+                r = await c.put(f"/api/worker/upload/{video['id']}/a/b",
+                                content=b"nested under a file")
+                assert r.status_code == 400
+                assert r.json()["error"] == "bad upload path"
+
+        run(go())
+        tree = api["video_dir"] / video["slug"]
+        assert (tree / "a").read_bytes() == b"i am a file"
+        assert not list(tree.rglob("*.part"))
+
+
+# --------------------------------------------------------------------------
+# Digest-aware resume
+# --------------------------------------------------------------------------
+
+class TestDigestResume:
+    def test_corrupt_same_size_partial_is_reuploaded(
+            self, run, db, tmp_path, api):
+        """Size-only resume would skip a same-size-but-corrupt server
+        file forever; the digest comparison re-uploads it."""
+        video, _job, _src = _seed_claimed(run, db, tmp_path, api)
+        root = tmp_path / "out"
+        (root / "360p").mkdir(parents=True)
+        good = b"g" * 100
+        (root / "360p" / "segment_00001.m4s").write_bytes(good)
+        # server already holds a SAME-SIZE corrupt copy (e.g. published
+        # by a pre-integrity deployment)
+        srv = api["video_dir"] / video["slug"] / "360p"
+        srv.mkdir(parents=True)
+        (srv / "segment_00001.m4s").write_bytes(b"x" * 100)
+
+        async def go():
+            up = StreamingUploader(api["client"], video["id"], root)
+            await up.resume_state()
+            assert "360p/segment_00001.m4s" not in up.uploaded
+            await up.drain()
+
+        run(go())
+        assert (srv / "segment_00001.m4s").read_bytes() == good
+
+    def test_intact_same_size_file_is_skipped(self, run, db, tmp_path, api):
+        video, _job, _src = _seed_claimed(run, db, tmp_path, api)
+        root = tmp_path / "out"
+        (root / "360p").mkdir(parents=True)
+        (root / "360p" / "segment_00001.m4s").write_bytes(b"g" * 100)
+        run(api["client"].upload_file(
+            video["id"], "360p/segment_00001.m4s",
+            root / "360p" / "segment_00001.m4s"))
+
+        async def go():
+            up = StreamingUploader(api["client"], video["id"], root)
+            await up.resume_state()
+            assert "360p/segment_00001.m4s" in up.uploaded
+            have = await api["client"].upload_status(video["id"])
+            assert have["360p/segment_00001.m4s"]["sha256"] == \
+                hashlib.sha256(b"g" * 100).hexdigest()
+
+        run(go())
+
+
+# --------------------------------------------------------------------------
+# Manifest-verified publish
+# --------------------------------------------------------------------------
+
+class TestManifestVerifiedComplete:
+    def _complete_status(self, run, api, job_id) -> tuple[int, str]:
+        async def go():
+            async with httpx.AsyncClient(
+                    base_url=api["base"],
+                    headers={"Authorization": f"Bearer {api['key']}"}) as c:
+                r = await c.post(f"/api/worker/jobs/{job_id}/complete",
+                                 json={"result": {"qualities": []}})
+                return r.status_code, r.text
+
+        return run(go())
+
+    def test_truncated_tree_rejected_at_complete(self, run, db, tmp_path,
+                                                 api):
+        video, job_id, _src = _seed_claimed(run, db, tmp_path, api)
+        root = tmp_path / "out"
+        (root / "360p").mkdir(parents=True)
+        seg = root / "360p" / "segment_00001.m4s"
+        seg.write_bytes(b"s" * 64)
+        run(api["client"].upload_file(video["id"],
+                                      "360p/segment_00001.m4s", seg))
+        # manifest also promises a file that never arrived
+        manifest = {
+            "360p/segment_00001.m4s": {
+                "size": 64, "sha256": hashlib.sha256(b"s" * 64).hexdigest()},
+            "360p/segment_00002.m4s": {
+                "size": 64, "sha256": hashlib.sha256(b"t" * 64).hexdigest()},
+        }
+        mpath = integrity.write_manifest(root, manifest)
+        run(api["client"].upload_file(video["id"], integrity.MANIFEST_NAME,
+                                      mpath))
+        status, text = self._complete_status(run, api, job_id)
+        assert status == 422
+        assert "manifest verification" in text
+        assert "segment_00002.m4s: missing" in text
+        # the terminal transition never happened: the job is still claimed
+        job = run(db.fetch_one("SELECT * FROM jobs WHERE id=:id",
+                               {"id": job_id}))
+        assert job["completed_at"] is None
+        assert _counter(api["app"][METRICS].manifest_rejects) == 1
+
+    def test_tampered_bytes_rejected_at_complete(self, run, db, tmp_path,
+                                                 api):
+        video, job_id, _src = _seed_claimed(run, db, tmp_path, api)
+        root = tmp_path / "out"
+        (root / "360p").mkdir(parents=True)
+        seg = root / "360p" / "segment_00001.m4s"
+        seg.write_bytes(b"s" * 64)
+        run(api["client"].upload_file(video["id"],
+                                      "360p/segment_00001.m4s", seg))
+        mpath = integrity.write_manifest(root, {
+            "360p/segment_00001.m4s": {
+                "size": 64, "sha256": hashlib.sha256(b"s" * 64).hexdigest()}})
+        run(api["client"].upload_file(video["id"], integrity.MANIFEST_NAME,
+                                      mpath))
+        # rot the published copy AFTER upload (same size, different bytes)
+        (api["video_dir"] / video["slug"] / "360p"
+         / "segment_00001.m4s").write_bytes(b"x" * 64)
+        status, text = self._complete_status(run, api, job_id)
+        assert status == 422 and "sha256" in text
+
+    def test_traversal_keys_in_manifest_rejected_without_fs_touch(
+            self, run, db, tmp_path, api):
+        """Manifest CONTENT is worker-controlled: absolute / dot-dot
+        keys must fail verification, never be joined onto root (a
+        traversal would hash arbitrary server-readable files and leak
+        digest prefixes through the 422 text)."""
+        video, job_id, _src = _seed_claimed(run, db, tmp_path, api)
+        root = tmp_path / "out"
+        root.mkdir()
+        mpath = integrity.write_manifest(root, {
+            "/etc/hostname": {"size": 1, "sha256": "0" * 64},
+            "../escape.bin": {"size": 1, "sha256": "0" * 64}})
+        run(api["client"].upload_file(video["id"], integrity.MANIFEST_NAME,
+                                      mpath))
+        status, text = self._complete_status(run, api, job_id)
+        assert status == 422 and "illegal path" in text
+        # unit level: verify_tree never stats outside root
+        problems = integrity.verify_tree(root, {
+            "/etc/hostname": {"size": 1, "sha256": "0" * 64}})
+        assert problems == ["'/etc/hostname': illegal path in manifest"]
+
+    def test_malformed_manifest_entry_is_422_not_500(
+            self, run, db, tmp_path, api):
+        """A JSON-valid but shape-invalid manifest (e.g. an int entry)
+        must take the 422 ManifestError path, not crash complete."""
+        video, job_id, _src = _seed_claimed(run, db, tmp_path, api)
+        root = tmp_path / "out"
+        root.mkdir()
+        from vlog_tpu.utils.fsio import atomic_write_text
+
+        mpath = root / integrity.MANIFEST_NAME
+        atomic_write_text(
+            mpath, '{"version": 1, "files": {"360p/init.mp4": 40}}')
+        run(api["client"].upload_file(video["id"], integrity.MANIFEST_NAME,
+                                      mpath))
+        status, text = self._complete_status(run, api, job_id)
+        assert status == 422 and "malformed" in text
+        with pytest.raises(integrity.ManifestError):
+            integrity.load_manifest(root)
+
+    def test_storage_verify_failpoint_forces_rejection(
+            self, run, db, tmp_path, api):
+        video, job_id, _src = _seed_claimed(run, db, tmp_path, api)
+        root = tmp_path / "out"
+        root.mkdir()
+        mpath = integrity.write_manifest(root, {})
+        run(api["client"].upload_file(video["id"], integrity.MANIFEST_NAME,
+                                      mpath))
+        failpoints.arm("storage.verify", count=1)
+        status, text = self._complete_status(run, api, job_id)
+        assert status == 422 and "storage.verify" in text
+
+    def test_tree_without_manifest_skips_the_gate(self, run, db, tmp_path,
+                                                  api):
+        """Pre-integrity-plane uploads still complete (playlist
+        validation remains the only gate)."""
+        video, job_id, _src = _seed_claimed(run, db, tmp_path, api)
+        status, text = self._complete_status(run, api, job_id)
+        # no manifest -> falls through to playlist validation (400: the
+        # dummy tree has no master.m3u8), NOT a 422 manifest reject
+        assert status == 400 and "manifest verification" not in text
+
+
+# --------------------------------------------------------------------------
+# Disk admission control
+# --------------------------------------------------------------------------
+
+class TestDiskAdmission:
+    def test_worker_upload_answers_507(self, run, db, tmp_path, api,
+                                       monkeypatch):
+        video, _job, src = _seed_claimed(run, db, tmp_path, api)
+        monkeypatch.setattr(config, "MIN_FREE_DISK_BYTES", 1 << 60)
+
+        async def go():
+            async with httpx.AsyncClient(
+                    base_url=api["base"],
+                    headers={"Authorization": f"Bearer {api['key']}"}) as c:
+                r = await c.put(f"/api/worker/upload/{video['id']}/x.bin",
+                                content=b"data")
+                assert r.status_code == 507
+
+        run(go())
+        assert _counter(api["app"][METRICS].upload_disk_rejected) == 1
+
+    def test_admin_upload_answers_507(self, run, db, tmp_path, monkeypatch):
+        app = build_admin_app(db, upload_dir=tmp_path / "up",
+                              video_dir=tmp_path / "vid")
+        server = TestServer(app)
+        run(server.start_server())
+        monkeypatch.setattr(config, "MIN_FREE_DISK_BYTES", 1 << 60)
+
+        async def go():
+            src = make_y4m(tmp_path / "c.y4m", n_frames=6, width=64,
+                           height=48)
+            async with httpx.AsyncClient(
+                    base_url=str(server.make_url(""))) as c:
+                with open(src, "rb") as fp:
+                    r = await c.post("/api/videos",
+                                     files={"file": ("c.y4m", fp)})
+                assert r.status_code == 507
+
+        run(go())
+        run(server.close())
+
+    def test_daemon_pauses_claiming(self, run, db, tmp_path, monkeypatch):
+        src = make_y4m(tmp_path / "d.y4m", n_frames=6, width=64, height=48)
+        video = run(vids.create_video(db, "DP", source_path=str(src)))
+        run(claims.enqueue_job(db, video["id"]))
+        daemon = WorkerDaemon(db, name="dp-worker", backend=None,
+                              video_dir=tmp_path / "videos")
+        monkeypatch.setattr(config, "MIN_FREE_DISK_BYTES", 1 << 60)
+        assert run(daemon.poll_once()) is False
+        assert daemon.disk_paused is True
+        job = run(db.fetch_one("SELECT * FROM jobs WHERE video_id=:v",
+                               {"v": video["id"]}))
+        assert job["claimed_by"] is None     # never claimed
+        # pressure clears -> claiming resumes on the next poll
+        monkeypatch.setattr(config, "MIN_FREE_DISK_BYTES", 0)
+        # no backend: the claim succeeds and the job fails in compute,
+        # which is fine — the assertion is that claiming RESUMED
+        run(daemon.poll_once())
+        assert daemon.disk_paused is False
+        assert daemon.stats.claimed == 1
+
+    def test_remote_worker_pauses_claiming(self, run, db, tmp_path, api,
+                                           monkeypatch):
+        src = make_y4m(tmp_path / "r.y4m", n_frames=6, width=64, height=48)
+        video = run(vids.create_video(db, "RP", source_path=str(src)))
+        run(claims.enqueue_job(db, video["id"]))
+        worker = RemoteWorker(api["client"], name="rw1",
+                              work_dir=tmp_path / "work")
+        monkeypatch.setattr(config, "MIN_FREE_DISK_BYTES", 1 << 60)
+        assert run(worker.poll_once()) is False
+        assert worker.disk_paused is True
+        job = run(db.fetch_one("SELECT * FROM jobs WHERE video_id=:v",
+                               {"v": video["id"]}))
+        assert job["claimed_by"] is None
+
+    def test_under_pressure_respects_zero_floor(self, tmp_path):
+        assert integrity.under_pressure(tmp_path, min_free=0) is False
+        assert integrity.under_pressure(tmp_path, min_free=1 << 60) is True
+
+
+# --------------------------------------------------------------------------
+# Orphan GC
+# --------------------------------------------------------------------------
+
+def _age(path: Path, seconds: float) -> None:
+    old = time.time() - seconds
+    os.utime(path, (old, old))
+
+
+class TestOrphanGC:
+    def _build_world(self, run, db, tmp_path):
+        """A video_dir + upload_dir + work_dir exhibiting every leak
+        class plus the live tree GC must never touch."""
+        video_dir = tmp_path / "videos"
+        upload_dir = tmp_path / "uploads"
+        work_dir = tmp_path / "work"
+        src = make_y4m(tmp_path / "s.y4m", n_frames=6, width=64, height=48)
+
+        live = run(vids.create_video(db, "Live", source_path=str(src)))
+        run(claims.enqueue_job(db, live["id"]))
+        run(claims.claim_job(db, "holder"))
+        ready = run(vids.create_video(db, "Ready", source_path=str(src)))
+        gone = run(vids.create_video(db, "Gone", source_path=str(src)))
+        run(db.execute(
+            "UPDATE videos SET deleted_at=:t, status='deleted' WHERE id=:i",
+            {"t": time.time() - 8 * 86400, "i": gone["id"]}))
+        fresh_del = run(vids.create_video(db, "FreshDel",
+                                          source_path=str(src)))
+        run(db.execute(
+            "UPDATE videos SET deleted_at=:t, status='deleted' WHERE id=:i",
+            {"t": time.time() - 60, "i": fresh_del["id"]}))
+
+        for v in (live, ready, gone, fresh_del):
+            d = video_dir / v["slug"]
+            d.mkdir(parents=True)
+            (d / "keep.m4s").write_bytes(b"k")
+        # stale + fresh temps under the live and ready trees
+        stale_live = video_dir / live["slug"] / "seg.m4s.part"
+        stale_live.write_bytes(b"p")
+        _age(stale_live, 7200)
+        stale_ready = video_dir / ready["slug"] / "seg.m4s.part"
+        stale_ready.write_bytes(b"p")
+        _age(stale_ready, 7200)
+        fresh_ready = video_dir / ready["slug"] / "new.m4s.part"
+        fresh_ready.write_bytes(b"p")
+        # orphan trees: one old, one fresh
+        orphan_old = video_dir / "no-such-slug"
+        orphan_old.mkdir()
+        (orphan_old / "junk.bin").write_bytes(b"j" * 10)
+        _age(orphan_old / "junk.bin", 7200)
+        _age(orphan_old, 7200)
+        orphan_new = video_dir / "brand-new-orphan"
+        orphan_new.mkdir()
+        # upload temps
+        upload_dir.mkdir()
+        stale_up = upload_dir / ".upload-deadbeef.y4m"
+        stale_up.write_bytes(b"u" * 5)
+        _age(stale_up, 7200)
+        (upload_dir / ".upload-cafe.y4m").write_bytes(b"u")
+        (upload_dir / "7.y4m").write_bytes(b"source")   # real source: kept
+        # a PERMANENT source whose original filename ended in .part
+        # (upload_video preserves the extension) — must never be swept,
+        # however old
+        aged_part_source = upload_dir / "9.part"
+        aged_part_source.write_bytes(b"source")
+        _age(aged_part_source, 7200)
+        # worker workspaces
+        (work_dir / live["slug"]).mkdir(parents=True)
+        dead_ws = work_dir / "dead-job-slug"
+        dead_ws.mkdir()
+        (dead_ws / "src.y4m").write_bytes(b"w" * 8)
+        _age(dead_ws, 7200)
+        return {"video_dir": video_dir, "upload_dir": upload_dir,
+                "work_dir": work_dir, "live": live, "ready": ready,
+                "gone": gone, "fresh_del": fresh_del}
+
+    def test_sweep_honors_age_and_live_claims(self, run, db, tmp_path):
+        w = self._build_world(run, db, tmp_path)
+        report = run(storage_gc.run_gc(
+            db, video_dir=w["video_dir"], upload_dir=w["upload_dir"],
+            work_dirs=(w["work_dir"],), temp_max_age_s=3600,
+            deleted_retention_s=3600))
+        removed = {e["path"]: e["kind"] for e in report.removed}
+        vd = w["video_dir"]
+        # reclaimed: stale ready-tree temp, old orphan tree, deleted tree
+        # past retention, stale upload temp, dead workspace
+        assert removed[str(vd / w["ready"]["slug"] / "seg.m4s.part")] \
+            == GCTarget.PART_FILE.value
+        assert removed[str(vd / "no-such-slug")] \
+            == GCTarget.ORPHAN_TREE.value
+        assert removed[str(vd / w["gone"]["slug"])] \
+            == GCTarget.DELETED_TREE.value
+        assert removed[str(w["upload_dir"] / ".upload-deadbeef.y4m")] \
+            == GCTarget.UPLOAD_TEMP.value
+        assert removed[str(w["work_dir"] / "dead-job-slug")] \
+            == GCTarget.WORKSPACE.value
+        # preserved: everything live, fresh, known, or within retention
+        assert (vd / w["live"]["slug"] / "seg.m4s.part").exists()
+        assert (vd / w["live"]["slug"] / "keep.m4s").exists()
+        assert (vd / w["ready"]["slug"] / "new.m4s.part").exists()
+        assert (vd / w["ready"]["slug"] / "keep.m4s").exists()
+        assert (vd / "brand-new-orphan").exists()
+        assert (vd / w["fresh_del"]["slug"]).exists()
+        assert (w["upload_dir"] / ".upload-cafe.y4m").exists()
+        assert (w["upload_dir"] / "7.y4m").exists()
+        assert (w["upload_dir"] / "9.part").exists()
+        assert (w["work_dir"] / w["live"]["slug"]).exists()
+        assert str(vd / w["live"]["slug"]) in report.kept_live
+        assert report.bytes_reclaimed > 0
+        assert storage_gc.snapshot()["totals"]["runs"] >= 1
+
+    def test_dry_run_removes_nothing(self, run, db, tmp_path):
+        w = self._build_world(run, db, tmp_path)
+        report = run(storage_gc.run_gc(
+            db, video_dir=w["video_dir"], upload_dir=w["upload_dir"],
+            work_dirs=(w["work_dir"],), temp_max_age_s=3600,
+            deleted_retention_s=3600, dry_run=True))
+        assert report.dry_run and len(report.removed) >= 5
+        for e in report.removed:
+            assert Path(e["path"]).exists(), e
+
+    def test_gc_failpoint_aborts_sweep(self, run, db, tmp_path):
+        failpoints.arm("storage.gc", count=1)
+        with pytest.raises(failpoints.FailpointError):
+            run(storage_gc.run_gc(db, video_dir=tmp_path))
+
+    def test_orphan_trees_use_long_retention_not_temp_age(
+            self, run, db, tmp_path):
+        """An unknown top-level dir (lost+found, operator backups, a
+        slug whose DB row was lost to a restore) must survive the 6h
+        temp window — whole-tree reclamation waits out the deleted
+        retention."""
+        vd = tmp_path / "videos"
+        middle_aged = vd / "lost+found"
+        middle_aged.mkdir(parents=True)
+        _age(middle_aged, 7200)     # older than temp age, not retention
+        report = run(storage_gc.run_gc(
+            db, video_dir=vd, temp_max_age_s=3600,
+            deleted_retention_s=7 * 86400))
+        assert report.removed == []
+        assert middle_aged.exists()
+
+    def test_concurrent_sweep_is_refused(self, run, db, tmp_path):
+        """The hourly loop and the admin trigger must not race: the
+        second sweep gets GCBusyError instead of double-counting."""
+        storage_gc._run_lock.acquire()
+        try:
+            with pytest.raises(storage_gc.GCBusyError):
+                run(storage_gc.run_gc(db, video_dir=tmp_path))
+        finally:
+            storage_gc._run_lock.release()
+
+    def test_remote_worker_sweeps_own_stale_workspaces(
+            self, run, db, tmp_path, api):
+        """Remote workers own their scratch: a stale workspace from a
+        SIGKILLed incarnation is reclaimed at startup, a fresh one (a
+        resume asset for a reclaimed job) survives."""
+        work = tmp_path / "work"
+        stale = work / "crashed-job"
+        stale.mkdir(parents=True)
+        (stale / "src.y4m").write_bytes(b"s" * 16)
+        _age(stale, 8 * 3600)
+        fresh = work / "resumable-job"
+        fresh.mkdir()
+        (fresh / "src.y4m").write_bytes(b"s" * 16)
+        worker = RemoteWorker(api["client"], name="rw1", work_dir=work)
+        run(worker._sweep_workspaces("test"))
+        assert not stale.exists()
+        assert fresh.exists()
+        # run() performs the same sweep at startup
+        worker.request_stop()
+        run(worker.run())
+
+
+# --------------------------------------------------------------------------
+# Admin verify endpoint + claim-gate unification
+# --------------------------------------------------------------------------
+
+class TestAdminSurface:
+    @pytest.fixture
+    def admin(self, run, db, tmp_path):
+        app = build_admin_app(db, upload_dir=tmp_path / "up",
+                              video_dir=tmp_path / "vid")
+        server = TestServer(app)
+        run(server.start_server())
+        yield {"base": str(server.make_url("")),
+               "video_dir": tmp_path / "vid"}
+        run(server.close())
+
+    def test_verify_endpoint_reports_rot(self, run, db, tmp_path, admin):
+        src = make_y4m(tmp_path / "s.y4m", n_frames=6, width=64, height=48)
+        video = run(vids.create_video(db, "Rot", source_path=str(src)))
+        tree = admin["video_dir"] / video["slug"]
+        tree.mkdir(parents=True)
+        (tree / "init.mp4").write_bytes(b"i" * 32)
+        integrity.write_manifest(tree, integrity.build_manifest(tree))
+
+        async def go():
+            async with httpx.AsyncClient(base_url=admin["base"]) as c:
+                r = await c.post(f"/api/videos/{video['id']}/verify")
+                assert r.status_code == 200
+                assert r.json()["ok"] is True
+                assert r.json()["files_checked"] == 1
+                # now rot a byte (same size) and re-verify
+                (tree / "init.mp4").write_bytes(b"i" * 31 + b"X")
+                r = await c.post(f"/api/videos/{video['id']}/verify")
+                body = r.json()
+                assert body["ok"] is False
+                assert any("sha256" in p for p in body["problems"])
+
+        run(go())
+
+    def test_verify_without_manifest_is_409(self, run, db, tmp_path, admin):
+        src = make_y4m(tmp_path / "s.y4m", n_frames=6, width=64, height=48)
+        video = run(vids.create_video(db, "Old", source_path=str(src)))
+        (admin["video_dir"] / video["slug"]).mkdir(parents=True)
+
+        async def go():
+            async with httpx.AsyncClient(base_url=admin["base"]) as c:
+                r = await c.post(f"/api/videos/{video['id']}/verify")
+                assert r.status_code == 409
+                assert "no stored manifest" in r.json()["error"]
+
+        run(go())
+
+    def test_storage_status_and_gc_endpoints(self, run, db, admin):
+        async def go():
+            async with httpx.AsyncClient(base_url=admin["base"]) as c:
+                r = await c.get("/api/storage/status")
+                vols = r.json()["volumes"]
+                assert set(vols) == {"upload", "video", "tmp"}
+                for v in vols.values():
+                    assert "free_bytes" in v and "pressure" in v
+                r = await c.post("/api/storage/gc",
+                                 json={"dry_run": True})
+                assert r.status_code == 200
+                assert r.json()["report"]["dry_run"] is True
+                r = await c.get("/api/storage/gc")
+                assert r.json()["last_report"]["dry_run"] is True
+
+        run(go())
+
+    def test_duplicate_file_part_replaces_first(self, run, db, tmp_path,
+                                                admin):
+        """Satellite: a second file part must not leak the first temp or
+        accumulate size across parts."""
+        a = make_y4m(tmp_path / "a.y4m", n_frames=6, width=64, height=48)
+        b = make_y4m(tmp_path / "b.y4m", n_frames=8, width=128, height=96)
+
+        async def go():
+            async with httpx.AsyncClient(base_url=admin["base"],
+                                         timeout=60.0) as c:
+                with open(a, "rb") as fa, open(b, "rb") as fb:
+                    r = await c.post("/api/videos", files=[
+                        ("file", ("a.y4m", fa)),
+                        ("file", ("b.y4m", fb))])
+                assert r.status_code == 201, r.text
+                v = r.json()["video"]
+                # the SECOND part won, with its own size (not a+b)
+                assert v["size_bytes"] == b.stat().st_size
+                assert v["width"] == 128
+                return v
+
+        v = run(go())
+        upload_dir = Path(admin["video_dir"]).parent / "up"
+        leaks = list(upload_dir.glob(".upload-*"))
+        assert leaks == []
+        assert (upload_dir / f"{v['id']}.y4m").exists()
+
+    def test_download_source_gate_matches_actively_claimed(
+            self, run, db, tmp_path):
+        """Satellite: the hand-rolled gate admitted failed-but-claimed
+        jobs and rejected NULL-expiry claims; the unified predicate
+        does neither."""
+        from vlog_tpu.jobs import state as js
+
+        video_dir = tmp_path / "vd"
+        app = build_worker_app(db, video_dir=video_dir)
+        server = TestServer(app)
+        run(server.start_server())
+        base = str(server.make_url(""))
+        key = run(WorkerAPIClient.register(base, "gate-w",
+                                           accelerator="tpu"))
+        src = make_y4m(tmp_path / "s.y4m", n_frames=6, width=64, height=48)
+        video = run(vids.create_video(db, "Gate", source_path=str(src)))
+        run(claims.enqueue_job(db, video["id"]))
+
+        async def fetch() -> int:
+            async with httpx.AsyncClient(
+                    base_url=base,
+                    headers={"Authorization": f"Bearer {key}"}) as c:
+                r = await c.get(f"/api/worker/source/{video['id']}")
+                return r.status_code
+
+        # NULL-expiry claim (legal per SQL_ACTIVELY_CLAIMED) must be
+        # admitted — the old gate's `claim_expires_at > :now` rejected it
+        run(db.execute(
+            "UPDATE jobs SET claimed_by='gate-w', claim_expires_at=NULL "
+            "WHERE video_id=:v", {"v": video["id"]}))
+        assert run(fetch()) == 200
+        # failed-but-claimed must be rejected — the old gate (which only
+        # checked completed_at) admitted it
+        run(db.execute(
+            "UPDATE jobs SET failed_at=:t WHERE video_id=:v",
+            {"t": time.time(), "v": video["id"]}))
+        assert run(fetch()) == 403
+        # sanity: predicate agreement with the state module
+        row = run(db.fetch_one("SELECT * FROM jobs WHERE video_id=:v",
+                               {"v": video["id"]}))
+        assert js.derive_state(row, now=time.time()).value == "failed"
+        run(server.close())
+
+
+# --------------------------------------------------------------------------
+# Failpoint registry / docs agreement
+# --------------------------------------------------------------------------
+
+class TestFailpointRegistry:
+    def test_every_documented_site_is_registered(self):
+        readme = (Path(__file__).parent.parent / "README.md").read_text()
+        doc_sites = set(re.findall(r"`([a-z]+\.[a-z_]+)`", readme))
+        # backticked dotted tokens in README that LOOK like failpoint
+        # sites: keep only ones whose prefix matches a registered family
+        families = {s.split(".")[0] for s in failpoints.SITES}
+        doc_sites = {s for s in doc_sites if s.split(".")[0] in families
+                     and not s.endswith(".py")}
+        missing = doc_sites - set(failpoints.SITES)
+        assert not missing, f"README documents unregistered sites: {missing}"
+
+    def test_every_registered_site_is_documented(self):
+        readme = (Path(__file__).parent.parent / "README.md").read_text()
+        undocumented = {s for s in failpoints.SITES if f"`{s}`" not in readme}
+        assert not undocumented, \
+            f"registered sites missing from README: {undocumented}"
+
+    def test_every_hit_call_site_is_registered(self):
+        """grep the source for failpoints.hit("...") literals — an
+        unregistered site could never be armed from a spec."""
+        pkg = Path(__file__).parent.parent / "vlog_tpu"
+        used = set()
+        for p in pkg.rglob("*.py"):
+            used.update(re.findall(r'failpoints\.hit\("([^"]+)"\)',
+                                   p.read_text()))
+        assert used, "expected hit() call sites in the package"
+        unregistered = used - set(failpoints.SITES)
+        assert not unregistered, \
+            f"hit() sites missing from SITES: {unregistered}"
+
+    def test_spec_rejects_typod_site(self):
+        with pytest.raises(ValueError, match="unknown failpoint site"):
+            failpoints.arm_from_spec("uplaod.corrupt=1")
+        # the registry rejection names the real sites
+        with pytest.raises(ValueError, match="upload.corrupt"):
+            failpoints.arm_from_spec("nope=1")
+
+
+# --------------------------------------------------------------------------
+# Chaos convergence (ISSUE 2 acceptance)
+# --------------------------------------------------------------------------
+
+class TestChaosConvergence:
+    def test_corrupting_network_converges_to_verified_tree(
+            self, run, db, tmp_path, api):
+        """upload.corrupt armed for the first 3 transfer attempts: every
+        corruption is detected (422) and retried; the complete endpoint
+        verifies the full tree against the drained manifest before
+        finalize; a GC sweep afterwards reclaims nothing and leaves the
+        published artifacts intact."""
+        src = make_y4m(tmp_path / "chaos.y4m", n_frames=10, width=128,
+                       height=96, fps=24)
+        video = run(vids.create_video(db, "Chaos", source_path=str(src)))
+        run(claims.enqueue_job(db, video["id"]))
+        failpoints.arm("upload.corrupt", count=3)
+        worker = RemoteWorker(api["client"], name="rw1",
+                              work_dir=tmp_path / "work",
+                              progress_min_interval_s=0.0)
+        assert run(worker.poll_once()) is True
+        row = run(vids.get_video(db, video["id"]))
+        assert row["status"] == "ready", row["error"]
+        # every corruption was caught server-side and retried through
+        fp = failpoints.counters()["upload.corrupt"]
+        assert fp["fires"] == 3
+        m = api["app"][METRICS]
+        assert _counter(m.upload_digest_mismatch) == 3
+        assert _counter(m.manifest_rejects) == 0
+        # the published tree passes full manifest verification
+        tree = api["video_dir"] / video["slug"]
+        manifest = integrity.load_manifest(tree)
+        assert manifest, "drained tree must carry outputs.json"
+        assert integrity.verify_tree(tree, manifest) == []
+        assert "master.m3u8" in manifest
+        assert any(rel.endswith(".m4s") for rel in manifest)
+        # GC after the run: zero temps anywhere, artifacts untouched
+        before = sorted(p.relative_to(tree).as_posix()
+                        for p in tree.rglob("*") if p.is_file())
+        report = run(storage_gc.run_gc(
+            db, video_dir=api["video_dir"], work_dirs=(tmp_path / "work",),
+            temp_max_age_s=0))
+        assert [e for e in report.removed
+                if e["kind"] == GCTarget.PART_FILE.value] == []
+        assert not list(api["video_dir"].rglob("*.part"))
+        after = sorted(p.relative_to(tree).as_posix()
+                       for p in tree.rglob("*") if p.is_file())
+        assert after == before
+        assert integrity.verify_tree(tree, manifest) == []

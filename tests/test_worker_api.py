@@ -176,7 +176,11 @@ def test_upload_requires_claim_and_sane_path(run, db, tmp_path, api):
                 f"/api/worker/upload/{video['id']}/..%2Fevil", content=b"x")
             assert r.status_code == 400
         files = await api["client"].upload_status(video["id"])
-        assert files == {"360p/init.mp4": src.stat().st_size}
+        assert files["360p/init.mp4"]["size"] == src.stat().st_size
+        import hashlib
+
+        assert files["360p/init.mp4"]["sha256"] == \
+            hashlib.sha256(src.read_bytes()).hexdigest()
 
     run(go())
 

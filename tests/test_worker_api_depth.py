@@ -130,7 +130,14 @@ def test_upload_status_inventory_reflects_uploads(run, db, tmp_path,  # noqa: F8
     run(put("360p/segment_00001.m4s", b"x" * 100))
     run(put("360p/init.mp4", b"y" * 40))
     inv = run(api["client"].upload_status(vid))
-    assert inv == {"360p/segment_00001.m4s": 100, "360p/init.mp4": 40}
+    import hashlib
+
+    assert inv == {
+        "360p/segment_00001.m4s": {
+            "size": 100, "sha256": hashlib.sha256(b"x" * 100).hexdigest()},
+        "360p/init.mp4": {
+            "size": 40, "sha256": hashlib.sha256(b"y" * 40).hexdigest()},
+    }
 
 
 def test_command_roundtrip_over_http(run, db, tmp_path, api):  # noqa: F811

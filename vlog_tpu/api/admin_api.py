@@ -279,6 +279,12 @@ async def upload_video(request: web.Request) -> web.Response:
     Reference: admin.py:1706-1890 (save_upload_with_size_limit at 613).
     """
     db = request.app[DB]
+    from vlog_tpu.storage import integrity
+
+    # Disk admission before the first byte lands: a 50 GB upload that
+    # dies at 90% from ENOSPC wastes the transfer AND leaves a temp.
+    if integrity.under_pressure(request.app[UPLOAD_DIR]):
+        return _json_error(507, "insufficient free disk space for upload")
     reader = await request.multipart()
     title = None
     description = ""
@@ -294,6 +300,14 @@ async def upload_video(request: web.Request) -> web.Response:
         elif part.name == "category":
             category = (await part.text()).strip() or None
         elif part.name == "file":
+            if saved is not None:
+                # A second file part supersedes the first: without this,
+                # the earlier temp leaked forever and ``size`` kept
+                # accumulating across parts (a 2-part upload could trip
+                # the size cap while neither file did).
+                saved.unlink(missing_ok=True)
+                saved = None
+                size = 0
             original_name = Path(part.filename or "upload.bin").name
             suffix = Path(original_name).suffix.lower() or ".bin"
             tmp = request.app[UPLOAD_DIR] / \
@@ -743,6 +757,23 @@ def _regenerate_manifests_sync(out_dir: Path, video, quals) -> dict:
             variants, duration_s=float(video["duration_s"] or 0.0),
             segment_duration_s=seg_s, audio=audio_refs))
     hls.validate_master_playlist(out_dir / "master.m3u8")
+    # The stored integrity manifest recorded the OLD master/MPD digests;
+    # refresh those entries so admin verify doesn't flag the repair.
+    from vlog_tpu.storage import integrity
+
+    try:
+        files = integrity.load_manifest(out_dir)
+    except integrity.ManifestError:
+        files = None
+    if files is not None:
+        for name in ("master.m3u8", "manifest.mpd"):
+            p = out_dir / name
+            if p.is_file():
+                files[name] = {"size": p.stat().st_size,
+                               "sha256": integrity.sha256_file(p)}
+            else:
+                files.pop(name, None)
+        integrity.write_manifest(out_dir, files)
     return {"variants": [v.name for v in variants],
             "audio": [a.name for a in audio_refs],
             "skipped": skipped}
@@ -788,6 +819,101 @@ async def delete_video(request: web.Request) -> web.Response:
         "UPDATE videos SET status='deleted', deleted_at=:t, updated_at=:t "
         "WHERE id=:id", {"t": db_now(), "id": video["id"]})
     return web.json_response({"ok": True})
+
+
+# --------------------------------------------------------------------------
+# Storage integrity + GC plane (storage/integrity.py, storage/gc.py)
+# --------------------------------------------------------------------------
+
+async def storage_status(request: web.Request) -> web.Response:
+    """Disk admission view: free space vs the VLOG_MIN_FREE_DISK_GB
+    floor for each storage volume."""
+    from vlog_tpu.storage import integrity
+
+    dirs = {"upload": request.app[UPLOAD_DIR],
+            "video": request.app[VIDEO_DIR],
+            "tmp": config.TMP_DIR}
+    out = {}
+    for name, path in dirs.items():
+        free = await asyncio.to_thread(integrity.free_bytes, path)
+        # under_pressure owns the admission predicate — the status tab
+        # must never disagree with what the upload endpoints enforce
+        pressure = await asyncio.to_thread(integrity.under_pressure, path)
+        out[name] = {"path": str(path), "free_bytes": free,
+                     "min_free_bytes": config.MIN_FREE_DISK_BYTES,
+                     "pressure": pressure}
+    return web.json_response({"volumes": out})
+
+
+async def run_storage_gc(request: web.Request) -> web.Response:
+    """Trigger an orphan-GC sweep now; body {dry_run, temp_max_age_s,
+    deleted_retention_s} all optional. Returns the full report."""
+    from vlog_tpu.storage import gc as storage_gc
+    from vlog_tpu.utils import failpoints
+
+    body = await request.json() if request.can_read_body else {}
+    try:
+        temp_age = (float(body["temp_max_age_s"])
+                    if body.get("temp_max_age_s") is not None else None)
+        retention = (float(body["deleted_retention_s"])
+                     if body.get("deleted_retention_s") is not None else None)
+    except (TypeError, ValueError):
+        return _json_error(400, "bad age threshold")
+    try:
+        report = await storage_gc.run_gc(
+            request.app[DB], video_dir=request.app[VIDEO_DIR],
+            upload_dir=request.app[UPLOAD_DIR],
+            temp_max_age_s=temp_age, deleted_retention_s=retention,
+            dry_run=bool(body.get("dry_run")))
+    except storage_gc.GCBusyError as exc:
+        return _json_error(409, str(exc))
+    except failpoints.FailpointError as exc:
+        return _json_error(503, f"gc sweep aborted: {exc}")
+    audit = request.app.get(AUDIT)
+    if audit is not None:
+        audit.record("storage.gc", dry_run=report.dry_run,
+                     removed=len(report.removed),
+                     bytes_reclaimed=report.bytes_reclaimed)
+    return web.json_response({"report": report.to_dict()})
+
+
+async def storage_gc_report(request: web.Request) -> web.Response:
+    """Last sweep's report + cumulative process totals."""
+    from vlog_tpu.storage import gc as storage_gc
+
+    return web.json_response(storage_gc.snapshot())
+
+
+async def verify_video(request: web.Request) -> web.Response:
+    """Re-verify a published video's output tree against its stored
+    ``outputs.json`` manifest — existence, size, sha256 of every file.
+    The on-demand answer to \"did this tree rot since publish?\"."""
+    from vlog_tpu.storage import integrity
+
+    db = request.app[DB]
+    video = await vids.get_video(db, _path_id(request, "video_id"))
+    if video is None:
+        return _json_error(404, "no such video")
+    root = request.app[VIDEO_DIR] / video["slug"]
+    if not root.is_dir():
+        return _json_error(404, "no output tree on disk")
+    try:
+        manifest = await asyncio.to_thread(integrity.load_manifest, root)
+        if manifest is None:
+            return _json_error(
+                409, "no stored manifest (tree published before the "
+                     "integrity plane; re-transcode to get one)")
+        problems = await asyncio.to_thread(
+            integrity.verify_tree, root, manifest)
+    except integrity.ManifestError as exc:
+        manifest, problems = {}, [str(exc)]
+    audit = request.app.get(AUDIT)
+    if audit is not None:
+        audit.record("video.verified", video_id=video["id"],
+                     ok=not problems, problems=len(problems))
+    return web.json_response({
+        "ok": not problems, "video_id": video["id"],
+        "files_checked": len(manifest), "problems": problems})
 
 
 async def restore_video(request: web.Request) -> web.Response:
@@ -1172,6 +1298,10 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_get("/api/analytics/daily", analytics_daily)
     r.add_delete("/api/videos/{video_id:\\d+}", delete_video)
     r.add_post("/api/videos/{video_id:\\d+}/restore", restore_video)
+    r.add_post("/api/videos/{video_id:\\d+}/verify", verify_video)
+    r.add_get("/api/storage/status", storage_status)
+    r.add_get("/api/storage/gc", storage_gc_report)
+    r.add_post("/api/storage/gc", run_storage_gc)
     r.add_get("/api/events/progress", sse_progress)
     r.add_get("/api/settings", get_settings)
     r.add_put("/api/settings/{key}", put_setting)
@@ -1240,16 +1370,40 @@ async def serve(port: int | None = None, db_url: str | None = None,
     deliverer = WebhookDeliverer(db)
     delivery_task = asyncio.create_task(deliverer.run())
     maintenance_task = asyncio.create_task(_session_maintenance_loop(db))
+    gc_task = asyncio.create_task(_gc_loop(
+        db, video_dir=app[VIDEO_DIR], upload_dir=app[UPLOAD_DIR]))
     try:
         await asyncio.Event().wait()
     finally:
         deliverer.request_stop()
         delivery_task.cancel()
         maintenance_task.cancel()
-        await asyncio.gather(delivery_task, maintenance_task,
+        gc_task.cancel()
+        await asyncio.gather(delivery_task, maintenance_task, gc_task,
                              return_exceptions=True)
         await runner.cleanup()
         await db.disconnect()
+
+
+async def _gc_loop(db: Database, *, video_dir: Path, upload_dir: Path,
+                   interval_s: float | None = None) -> None:
+    """Periodic orphan-GC sweep (storage/gc.py) in the admin process —
+    the one process that always runs and owns the storage tree. The
+    dirs come from the app (serve passes app[VIDEO_DIR]/[UPLOAD_DIR]),
+    not config globals, so an embedder's overrides are honored.
+    VLOG_GC_INTERVAL=0 disables (the admin trigger endpoint remains)."""
+    from vlog_tpu.storage import gc as storage_gc
+
+    interval = config.GC_INTERVAL_S if interval_s is None else interval_s
+    if interval <= 0:
+        return
+    while True:
+        await asyncio.sleep(interval)
+        try:
+            await storage_gc.run_gc(db, video_dir=video_dir,
+                                    upload_dir=upload_dir)
+        except Exception:   # noqa: BLE001 — next pass retries
+            log.exception("gc sweep failed")
 
 
 async def _session_maintenance_loop(db: Database,

@@ -14,6 +14,8 @@ Run it: ``python -m vlog_tpu.api.worker_api``.
 from __future__ import annotations
 
 import asyncio
+import errno
+import hashlib
 import json
 import logging
 from pathlib import Path
@@ -27,6 +29,7 @@ from vlog_tpu.db.retry import with_retries
 from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
 from vlog_tpu.jobs import claims, state as js, videos as vids
 from vlog_tpu.jobs.finalize import finalize_transcode, finalize_transcription
+from vlog_tpu.storage import integrity
 
 log = logging.getLogger("vlog_tpu.worker_api")
 
@@ -110,6 +113,18 @@ class Metrics:
             ["kind"], registry=self.registry)
         self.bytes_uploaded = Counter(
             "vlog_upload_bytes_total", "Output bytes uploaded by workers",
+            registry=self.registry)
+        self.upload_digest_mismatch = Counter(
+            "vlog_upload_digest_mismatch_total",
+            "Uploads rejected for an X-Content-SHA256 mismatch (422)",
+            registry=self.registry)
+        self.upload_disk_rejected = Counter(
+            "vlog_upload_disk_rejected_total",
+            "Uploads rejected under disk pressure (507)",
+            registry=self.registry)
+        self.manifest_rejects = Counter(
+            "vlog_manifest_verify_failures_total",
+            "Completions rejected by outputs.json tree verification (422)",
             registry=self.registry)
 
     async def render(self, db: Database) -> str:
@@ -272,6 +287,34 @@ async def complete(request: web.Request) -> web.Response:
             (vtt and _safe_relpath(vtt) is None):
         return _json_error(400, "bad result path")
     out_dir = request.app[VIDEO_DIR] / video["slug"]
+    if kind in (JobKind.TRANSCODE, JobKind.REENCODE):
+        # Manifest verification FIRST (existence + size + sha256 of every
+        # file the worker claims to have published): structural playlist
+        # validation can only prove the playlists parse, not that the
+        # segments they reference carry the bytes the worker encoded. A
+        # tree uploaded before the integrity plane has no manifest and
+        # skips this gate; a present-but-corrupt manifest fails it.
+        try:
+            manifest = await asyncio.to_thread(
+                integrity.load_manifest, out_dir)
+            # use_cache: every file arrived through upload() above, which
+            # hashed the received bytes and seeded the digest cache — a
+            # full sequential re-read of a multi-GB tree here would run
+            # inside the claim lease with no progress posts extending it.
+            problems = ([] if manifest is None else await asyncio.to_thread(
+                lambda: integrity.verify_tree(out_dir, manifest,
+                                              use_cache=True)))
+        except integrity.ManifestError as exc:
+            problems = [str(exc)]
+        if problems:
+            request.app[METRICS].manifest_rejects.inc()
+            log.warning("job %s rejected by manifest verification: %s",
+                        job_id, "; ".join(problems[:10]))
+            # 422 like the per-file digest gate: the worker's bytes did
+            # not survive the wire — retryable, not a client bug (400).
+            return _json_error(
+                422, "uploaded tree failed manifest verification: "
+                     + "; ".join(problems[:5]))
     if kind is JobKind.TRANSCODE:
         # server-side verification pass (reference transcoder.py:2565)
         from vlog_tpu.media import hls
@@ -381,14 +424,10 @@ async def download_source(request: web.Request) -> web.StreamResponse:
     db = request.app[DB]
     ident = request[IDENTITY]
     video_id = int(request.match_info["video_id"])
-    holder = await db.fetch_one(
-        """
-        SELECT id FROM jobs
-        WHERE video_id=:v AND claimed_by=:w AND completed_at IS NULL
-          AND claim_expires_at > :now
-        """,
-        {"v": video_id, "w": ident.worker_name, "now": db_now()})
-    if holder is None:
+    # Same ownership predicate as upload/complete (SQL_ACTIVELY_CLAIMED):
+    # the previous hand-rolled SQL admitted failed-but-claimed jobs and
+    # rejected NULL-expiry claims, drifting from every other gate.
+    if not await _worker_holds_claim(db, ident.worker_name, video_id):
         return _json_error(403, "no active claim on this video")
     video = await vids.get_video(db, video_id)
     if video is None or not video["source_path"]:
@@ -430,7 +469,11 @@ async def upload(request: web.Request) -> web.Response:
 
     PUT /api/worker/upload/{video_id}/{tail}. The uploader must hold an
     active claim on the video (reference segment upload,
-    worker_api.py:2492-2933).
+    worker_api.py:2492-2933). Integrity: the server hashes the received
+    bytes and compares against the caller's ``X-Content-SHA256`` — a
+    mismatch discards the ``.part`` and answers 422 (the client retries
+    it as transient), so a corrupting hop can never publish. Admission:
+    507 under disk pressure, before a byte is written.
     """
     db = request.app[DB]
     video_id = int(request.match_info["video_id"])
@@ -443,39 +486,86 @@ async def upload(request: web.Request) -> web.Response:
     rel = _safe_relpath(request.match_info["tail"])
     if rel is None:
         return _json_error(400, "bad upload path")
+    if integrity.under_pressure(request.app[VIDEO_DIR]):
+        request.app[METRICS].upload_disk_rejected.inc()
+        return _json_error(507, "insufficient free disk space")
+    claimed_digest = (request.headers.get("X-Content-SHA256") or "") \
+        .strip().lower()
     dest = request.app[VIDEO_DIR] / video["slug"] / rel
-    dest.parent.mkdir(parents=True, exist_ok=True)
+    try:
+        dest.parent.mkdir(parents=True, exist_ok=True)
+    except OSError:
+        # A tail component collides with an existing FILE ("a" uploaded,
+        # then "a/b") — a caller-path problem, not a server fault.
+        return _json_error(400, "bad upload path")
     tmp = dest.with_name(dest.name + ".part")
     size = 0
+    hasher = hashlib.sha256()
     try:
-        with open(tmp, "wb") as fp:
-            async for chunk in request.content.iter_chunked(_COPY_CHUNK):
-                size += len(chunk)
-                if size > MAX_UPLOAD_PART:
-                    raise web.HTTPRequestEntityTooLarge(
-                        max_size=MAX_UPLOAD_PART, actual_size=size)
-                fp.write(chunk)
-        tmp.rename(dest)
+        try:
+            with open(tmp, "wb") as fp:
+                async for chunk in request.content.iter_chunked(_COPY_CHUNK):
+                    size += len(chunk)
+                    if size > MAX_UPLOAD_PART:
+                        raise web.HTTPRequestEntityTooLarge(
+                            max_size=MAX_UPLOAD_PART, actual_size=size)
+                    hasher.update(chunk)
+                    fp.write(chunk)
+        except OSError as exc:
+            tmp.unlink(missing_ok=True)
+            if exc.errno in (errno.ENOSPC, getattr(errno, "EDQUOT", -1)):
+                # The volume filled between the admission check and the
+                # write — same retryable verdict the check would give.
+                request.app[METRICS].upload_disk_rejected.inc()
+                return _json_error(507, "insufficient free disk space")
+            if exc.errno in (errno.ENAMETOOLONG, errno.ENOTDIR,
+                             errno.EISDIR):
+                return _json_error(400, "bad upload path")
+            raise   # EIO and friends: a real server fault, count as 500
+        digest = hasher.hexdigest()
+        if claimed_digest and digest != claimed_digest:
+            request.app[METRICS].upload_digest_mismatch.inc()
+            tmp.unlink(missing_ok=True)
+            log.warning("upload %s/%s digest mismatch: got %s, claimed %s",
+                        video["slug"], rel, digest[:12], claimed_digest[:12])
+            return _json_error(
+                422, f"content digest mismatch: received {digest}, "
+                     f"caller claimed {claimed_digest}")
+        try:
+            tmp.rename(dest)
+        except OSError:
+            # rename onto an existing directory — the bad-path family,
+            # like the mkdir collision above.
+            tmp.unlink(missing_ok=True)
+            return _json_error(400, "bad upload path")
+        # seed the inventory digest cache with the digest this request
+        # just computed — upload_status then stats instead of re-hashing
+        integrity.note_digest(dest, digest)
     except BaseException:
         tmp.unlink(missing_ok=True)
         raise
     request.app[METRICS].bytes_uploaded.inc(size)
-    return web.json_response({"ok": True, "path": str(rel), "bytes": size})
+    return web.json_response({"ok": True, "path": str(rel), "bytes": size,
+                              "sha256": digest})
 
 
 async def upload_status(request: web.Request) -> web.Response:
     """Uploaded-file inventory for resume (reference get_segments_status,
-    http_client.py:1065)."""
+    http_client.py:1065). Entries carry size AND sha256 so resume can
+    re-upload a corrupt same-size partial instead of skipping it — size
+    equality alone cannot distinguish a clean file from a bit-flipped
+    one."""
     db = request.app[DB]
     video = await vids.get_video(db, int(request.match_info["video_id"]))
     if video is None:
         return _json_error(404, "no such video")
     root = request.app[VIDEO_DIR] / video["slug"]
-    files = {}
-    if root.exists():
-        for p in root.rglob("*"):
-            if p.is_file() and not p.name.endswith(".part"):
-                files[str(p.relative_to(root))] = p.stat().st_size
+    # build_manifest IS the inventory semantics (temps excluded, the
+    # manifest itself excluded — resume rewrites it on drain anyway).
+    # use_cache: files uploaded through this API were hashed in the
+    # request path and noted, so steady state is a stat-only walk.
+    files = await asyncio.to_thread(
+        lambda: integrity.build_manifest(root, use_cache=True))
     return web.json_response({"files": files})
 
 

@@ -193,6 +193,25 @@ BREAKER_COOLDOWN_S: float = _env_float("VLOG_BREAKER_COOLDOWN", 60.0, lo=0.0)
 STALL_WINDOW_S: float = _env_float("VLOG_STALL_WINDOW", 900.0, lo=0.0)
 
 # --------------------------------------------------------------------------
+# Storage integrity plane: orphan GC (storage/gc.py). MIN_FREE_DISK_BYTES
+# above is the admission floor enforced by storage/integrity.py:
+# uploads answer 507 and workers pause claiming when free space on the
+# target volume drops below it (0 disables admission control).
+# --------------------------------------------------------------------------
+
+# Periodic sweep cadence in the admin API process; 0 disables the loop
+# (the admin trigger endpoint still works).
+GC_INTERVAL_S: float = _env_float("VLOG_GC_INTERVAL", 3600.0, lo=0.0)
+# A temp (.part/.tmp/.upload-*) younger than this may be an in-flight
+# transfer — only older ones are reclaimed.
+GC_TEMP_MAX_AGE_S: float = _env_float("VLOG_GC_TEMP_MAX_AGE", 6 * 3600.0,
+                                      lo=0.0)
+# Soft-deleted videos are restorable; their output trees survive this
+# long after deleted_at before the sweeper reclaims them.
+GC_DELETED_RETENTION_S: float = _env_float("VLOG_GC_DELETED_RETENTION",
+                                           7 * 86400.0, lo=0.0)
+
+# --------------------------------------------------------------------------
 # Transcription (reference: config.py:263-267)
 # --------------------------------------------------------------------------
 

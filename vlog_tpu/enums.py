@@ -60,6 +60,24 @@ class FailureClass(str, enum.Enum):
     STALLED = "stalled"
 
 
+class GCTarget(str, enum.Enum):
+    """What an orphan-GC sweep reclaimed (storage/gc.py report entries).
+
+    - PART_FILE: a stale ``.part``/``.tmp`` transfer temp in the video tree.
+    - UPLOAD_TEMP: a stale ``.upload-*`` staging file in the upload dir.
+    - ORPHAN_TREE: an output tree under no known video slug.
+    - DELETED_TREE: the output tree of a soft-deleted video past the
+      ``VLOG_GC_DELETED_RETENTION`` grace window.
+    - WORKSPACE: an abandoned worker job workspace (work_dir/{slug}).
+    """
+
+    PART_FILE = "part_file"
+    UPLOAD_TEMP = "upload_temp"
+    ORPHAN_TREE = "orphan_tree"
+    DELETED_TREE = "deleted_tree"
+    WORKSPACE = "workspace"
+
+
 class VideoCodec(str, enum.Enum):
     H264 = "h264"
     HEVC = "hevc"

@@ -30,6 +30,7 @@ from vlog_tpu.codecs.hevc.jax_core import encode_chain_dsp
 from vlog_tpu.codecs.hevc.syntax import CTB
 from vlog_tpu.ops.resize import resize_yuv420_with
 from vlog_tpu.parallel.ladder import RungSpec, ladder_matrices
+from vlog_tpu.parallel.mesh import shard_map
 
 
 def _pad_ctb(y, u, v):
@@ -134,7 +135,7 @@ def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
     mats = ladder_matrices(rungs, src_h, src_w)
     if mesh is None:
         return jax.jit(local), jax.device_put(mats)
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh,
         in_specs=(P("data"), P("data"), P("data"), P(), P("data"), P()),
         out_specs=P("data"),

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from vlog_tpu.parallel.mesh import shard_map
+
 from vlog_tpu.codecs.h264.encoder import encode_frame
 from vlog_tpu.ops.resize import plan_ladder_matrices, resize_yuv420_with
 
@@ -118,7 +120,7 @@ def ladder_encode_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
         # Stage the (up to ~100MB at 4K) matrix pytree to HBM once — jit
         # would otherwise re-upload host numpy args every batch.
         return fn, jax.device_put(ladder_matrices(rungs, src_h, src_w))
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh,
         in_specs=(P("data"), P("data"), P("data"), P(), P("data")),
         out_specs=P("data"),
@@ -310,7 +312,7 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
     mats = ladder_matrices(rungs, src_h, src_w)
     if mesh is None:
         return jax.jit(local), jax.device_put(mats)
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh,
         in_specs=(P("data"), P("data"), P("data"), P(), P("data"), P()),
         out_specs=P("data"),
@@ -337,7 +339,7 @@ def sharded_ladder_levels(mesh: Mesh, rungs: tuple[RungSpec, ...],
     divide by the data-axis size; outputs are sharded on "data"; ``mats``
     is replicated.
     """
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ladder_local, rungs=rungs),
         mesh=mesh,
         in_specs=(P("data"), P("data"), P("data"), P()),
@@ -380,7 +382,7 @@ def sharded_ladder_step(mesh: Mesh, rungs: tuple[RungSpec, ...],
             out[name] = levels
         return out, stats
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(P("data"), P("data"), P("data"), P(), P("data")),

@@ -1,0 +1,29 @@
+"""Storage integrity plane: checksummed transfer, manifest-verified
+publish, disk admission control, and orphan GC.
+
+PR 1 hardened the *job* plane (backoff, classification, failpoints,
+breaker); this package hardens the *storage* plane it feeds. WhisperPipe
+(PAPERS.md) makes the underlying point for any lossy distributed
+pipeline: end-to-end verification at stage boundaries is what lets the
+system degrade instead of corrupt.
+
+- :mod:`vlog_tpu.storage.integrity` — streaming SHA-256 digests, the
+  ``outputs.json`` tree manifest (build / load / verify), and the disk
+  admission check that wires the previously dead
+  ``VLOG_MIN_FREE_DISK_GB`` knob.
+- :mod:`vlog_tpu.storage.gc` — the orphan sweeper: stale ``.part`` /
+  ``.upload-*`` temps, output trees of deleted videos, abandoned worker
+  workspaces; age-thresholded, dry-runnable, never touching live claims.
+"""
+
+from vlog_tpu.storage.integrity import (  # noqa: F401
+    MANIFEST_NAME,
+    ManifestError,
+    build_manifest,
+    free_bytes,
+    load_manifest,
+    sha256_file,
+    under_pressure,
+    verify_tree,
+    write_manifest,
+)

@@ -18,7 +18,21 @@ site                where it fires
 ``backend.encode``  at JaxBackend.run entry (worker compute thread)
 ``remote.upload``   in WorkerAPIClient.upload_file, before each attempt
 ``remote.claim``    in WorkerAPIClient.claim
+``upload.corrupt``  in WorkerAPIClient.upload_file's body stream — does
+                    NOT abort the transfer: the first chunk is bit-
+                    flipped while the X-Content-SHA256 header still
+                    carries the true digest, so the server's integrity
+                    check (422) is what catches it
+``storage.verify``  at storage.integrity.verify_tree entry — forces a
+                    manifest-verification rejection
+``storage.gc``      at storage.gc.run_gc entry — the armed sweep aborts
 ==================  =====================================================
+
+Every legitimate site name is listed in :data:`SITES`;
+:func:`arm_from_spec` (and therefore ``VLOG_FAILPOINTS``) rejects names
+not in the registry — a typo'd site that silently armed nothing would
+invalidate a whole chaos run. :func:`arm` stays permissive for tests
+that exercise the trigger machinery with synthetic names.
 
 A disarmed site costs one dict lookup; nothing is armed unless
 ``VLOG_FAILPOINTS`` is set at import time or :func:`arm` /
@@ -47,6 +61,24 @@ import threading
 
 ENV_VAR = "VLOG_FAILPOINTS"
 SEED_VAR = "VLOG_FAILPOINTS_SEED"
+
+# The registry of every compiled-in injection site. Keep in lockstep with
+# the table above and the README failure-plane / integrity docs — the
+# docs-agreement test (tests/test_storage_integrity.py) parses both.
+SITES: dict[str, str] = {
+    "claims.claim": "claim transaction, after row pick, before write",
+    "claims.complete": "completion transaction, before the terminal write",
+    "claims.fail": "failure transaction, before retry accounting",
+    "db.commit": "just before a transaction COMMIT (rolls back)",
+    "daemon.compute": "WorkerDaemon._dispatch, before the kind handler",
+    "backend.encode": "JaxBackend.run entry (worker compute thread)",
+    "remote.upload": "WorkerAPIClient.upload_file, before each attempt",
+    "remote.claim": "WorkerAPIClient.claim",
+    "upload.corrupt": "upload body stream: first chunk bit-flipped while "
+                      "the digest header stays true",
+    "storage.verify": "storage.integrity.verify_tree entry",
+    "storage.gc": "storage.gc.run_gc entry",
+}
 
 
 class FailpointError(RuntimeError):
@@ -112,6 +144,10 @@ def arm_from_spec(spec: str) -> list[str]:
         site = site.strip()
         if not site:
             raise ValueError(f"failpoint spec entry {entry!r} has no site")
+        if site not in SITES:
+            raise ValueError(
+                f"unknown failpoint site {site!r}; registered sites: "
+                f"{', '.join(sorted(SITES))}")
         count: int | None = None
         prob: float | None = None
         skip = 0

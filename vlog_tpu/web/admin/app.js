@@ -105,7 +105,7 @@ const loaders = {
   dashboard: loadDashboard, videos: loadVideos, jobs: loadJobs,
   workers: loadWorkers, settings: loadSettings, webhooks: loadWebhooks,
   playlists: loadPlaylists, fields: loadFields, analytics: loadAnalytics,
-  queue: loadQueue, audit: loadAudit,
+  queue: loadQueue, audit: loadAudit, storage: loadStorage,
 };
 
 function switchTab(name) {
@@ -291,6 +291,13 @@ async function loadVideos() {
               body: JSON.stringify({ streaming_format: "cmaf", codec: "h265" }),
             });
             toast(`h265 upgrade queued for #${v.id}`);
+          })
+        : document.createTextNode(""),
+      v.status === "ready"
+        ? actionBtn("verify", async () => {
+            const r = await api(`/api/videos/${v.id}/verify`, { method: "POST" });
+            if (r.ok) toast(`#${v.id} verified: ${r.files_checked} files intact`);
+            else toast(`#${v.id} FAILED verification: ${r.problems[0]}`, true);
           })
         : document.createTextNode(""),
       actionBtn("chapters", async () => {
@@ -850,6 +857,59 @@ async function loadWorkers() {
     tb.appendChild(tr);
   }
 }
+
+/* ------------------------------------------------- storage ------------ */
+
+function renderGcReport(report) {
+  const tb = $("st-gc-table").tBodies[0];
+  tb.textContent = "";
+  const entries = (report && report.removed) || [];
+  for (const e of entries) {
+    const tr = document.createElement("tr");
+    cells(tr, [e.path, badge(e.kind), fmtBytes(e.bytes)]);
+    tb.appendChild(tr);
+  }
+  $("st-gc-empty").hidden = entries.length > 0;
+  $("st-gc-empty").textContent = report
+    ? "Sweep removed nothing." : "No sweep yet.";
+  if (report) {
+    $("st-gc-msg").textContent =
+      `${report.dry_run ? "[dry run] " : ""}${report.removed_count} reclaimed ` +
+      `(${fmtBytes(report.bytes_reclaimed)}), ${report.kept_live.length} kept live, ` +
+      `${report.errors.length} errors`;
+  }
+}
+
+async function loadStorage() {
+  const s = await api("/api/storage/status");
+  const tb = $("st-volumes").tBodies[0];
+  tb.textContent = "";
+  for (const [name, v] of Object.entries(s.volumes)) {
+    const tr = document.createElement("tr");
+    cells(tr, [name, v.path, fmtBytes(v.free_bytes), fmtBytes(v.min_free_bytes),
+      badge(v.pressure ? "pressure" : "ok")]);
+    tb.appendChild(tr);
+  }
+  const g = await api("/api/storage/gc");
+  renderGcReport(g.last_report);
+  const t = g.totals;
+  $("st-totals").textContent =
+    `lifetime: ${t.runs} sweeps, ${t.files_removed} removed, ` +
+    `${fmtBytes(t.bytes_reclaimed)} reclaimed, ${t.errors} errors`;
+}
+
+$("st-gc-run").onclick = async () => {
+  const body = { dry_run: $("st-dry").checked };
+  const age = $("st-temp-age").value.trim();
+  if (age) body.temp_max_age_s = parseFloat(age);
+  try {
+    const r = await api("/api/storage/gc", {
+      method: "POST", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify(body),
+    });
+    renderGcReport(r.report);
+  } catch (e) { toast(e.message, true); }
+};
 
 /* ------------------------------------------------- settings ----------- */
 

@@ -115,6 +115,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
         self.restart_requested = False     # restart verb → exit code 64
+        self.disk_paused = False           # claiming paused by admission
         self._stop = asyncio.Event()
         self._cancel = threading.Event()   # aborts the in-flight compute
         self._cancel_reason = ""
@@ -207,6 +208,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
             return {**asdict(self.stats),
                     "current_job_id": self._current_job_id,
                     "breaker": self.breaker.snapshot(),
+                    "disk_paused": self.disk_paused,
                     "kinds": [k.value for k in self.kinds]}
         if command == "stop":
             log.info("remote stop command received")
@@ -284,7 +286,20 @@ class WorkerDaemon(ComputeWatchdogMixin):
     async def poll_once(self) -> bool:
         """Claim and process at most one job. Returns True if one ran."""
         from vlog_tpu.db.retry import with_retries
+        from vlog_tpu.storage import integrity
 
+        # Disk admission BEFORE the breaker: claiming with a full output
+        # volume guarantees ENOSPC mid-write — burning an attempt (and,
+        # in HALF_OPEN, the probe slot) to learn what a statvfs already
+        # knows. The pause is transient by construction: GC or the
+        # operator frees space and the next poll resumes.
+        if integrity.under_pressure(self.video_dir):
+            if not self.disk_paused:
+                log.warning("output volume under disk pressure; pausing "
+                            "claiming (%s)", self.video_dir)
+            self.disk_paused = True
+            return False
+        self.disk_paused = False
         if not self.breaker.allow():
             # breaker open: leave the queue alone until the cooldown
             # lapses and a half-open probe is due
@@ -540,15 +555,26 @@ class WorkerDaemon(ComputeWatchdogMixin):
                                     [r.name for r in rungs])
 
         def work():
-            # resume=False: the output tree changes shape across formats
+            # resume=False: the output tree changes shape across formats.
+            # write_manifest=False: the manifest is rebuilt below after
+            # _cleanup_other_format anyway — hashing the tree twice
+            # inside the timeout envelope would be pure waste.
             return process_video(source, out_dir, backend=self.backend,
                                  progress_cb=cb, rungs=rungs, resume=False,
+                                 write_manifest=False,
                                  streaming_format=fmt, codec=codec)
 
         result = await self._run_with_timeout(work, timeout, "reencode")
         # Drop the previous format's leftovers so clients can never follow
         # stale manifests into a mixed tree.
         _cleanup_other_format(out_dir, fmt)
+        # The integrity manifest process_video wrote described the
+        # pre-cleanup tree — rebuild it so admin verify stays truthful.
+        from vlog_tpu.storage import integrity
+
+        await asyncio.to_thread(
+            lambda: integrity.write_manifest(
+                out_dir, integrity.build_manifest(out_dir)))
         qualities = [
             {**q, "playlist_path": str(out_dir / q["quality"] / "playlist.m3u8")}
             for q in result.qualities
@@ -682,14 +708,17 @@ async def _amain(args: argparse.Namespace) -> None:
         on_event=on_event,
     )
 
-    async def ready() -> tuple[bool, str]:
+    async def db_ready() -> tuple[bool, str]:
         try:
             await db.fetch_val("SELECT 1")
         except Exception as exc:  # noqa: BLE001
             return False, f"db unreachable: {exc}"
         return True, "ok"
 
-    health = WorkerHealthServer(ready)
+    from vlog_tpu.worker.health import combine, disk_check
+
+    health = WorkerHealthServer(
+        combine(db_ready, disk_check(daemon.video_dir, label="output")))
     await health.start()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):

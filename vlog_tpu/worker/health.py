@@ -24,6 +24,38 @@ log = logging.getLogger("vlog_tpu.worker.health")
 ReadyFn = Callable[[], Awaitable[tuple[bool, str]]]
 
 
+def combine(*checks: ReadyFn) -> ReadyFn:
+    """Readiness is the AND of every check; the first failure's detail
+    wins (an orchestrator acts on one reason at a time)."""
+
+    async def ready() -> tuple[bool, str]:
+        for check in checks:
+            ok, detail = await check()
+            if not ok:
+                return False, detail
+        return True, "ok"
+
+    return ready
+
+
+def disk_check(path, *, label: str = "scratch") -> ReadyFn:
+    """Degrade readiness under disk pressure (storage/integrity.py
+    admission floor, VLOG_MIN_FREE_DISK_GB). A full worker is alive but
+    must not receive work — exactly the liveness/readiness split."""
+
+    async def ready() -> tuple[bool, str]:
+        from vlog_tpu import config
+        from vlog_tpu.storage import integrity
+
+        if integrity.under_pressure(path):
+            free = integrity.free_bytes(path)
+            return False, (f"{label} disk pressure: {free} bytes free, "
+                           f"floor {config.MIN_FREE_DISK_BYTES}")
+        return True, "ok"
+
+    return ready
+
+
 class WorkerHealthServer:
     def __init__(self, ready_fn: ReadyFn, *, port: int | None = None,
                  host: str = "0.0.0.0"):

@@ -13,6 +13,10 @@ Steps (checkpointable by inspecting the output directory):
   3b. audio        — AAC rendition group at the ladder's audio bitrates
                      (reference hwaccel.py:700-706 `-c:a aac`)
   4. verify        — validate master/media playlists + segment atoms
+  4b. manifest     — outputs.json integrity manifest (rel -> size+sha256)
+                     over the verified tree, written last so it only
+                     ever describes published files; the admin verify
+                     endpoint re-checks ready trees against it
   5. finalize      — summary dict for the DB/webhook layer
 """
 
@@ -126,6 +130,7 @@ def process_video(
     resume: bool = True,
     rungs=None,
     audio: bool = True,
+    write_manifest: bool = True,
     **plan_opts,
 ) -> ProcessResult:
     """Run the full pipeline for one video. Blocking & compute-heavy —
@@ -201,6 +206,16 @@ def process_video(
     # Step 4: verification (validate_hls_playlist analog)
     master = out_dir / "master.m3u8"
     verify_output(master, run, expect_cmaf=plan.streaming_format == "cmaf")
+
+    # Step 4b: integrity manifest, after verification so outputs.json
+    # never blesses a tree the validators rejected. Remote workers pass
+    # write_manifest=False: their streaming uploader derives the
+    # server-side manifest from the digests it actually transferred, so
+    # hashing the whole scratch tree again here would be pure waste.
+    if write_manifest:
+        from vlog_tpu.storage import integrity
+
+        integrity.write_manifest(out_dir, integrity.build_manifest(out_dir))
 
     result = ProcessResult(
         source=info,

@@ -30,6 +30,7 @@ import httpx
 from vlog_tpu import config
 from vlog_tpu.codecs import validate_codec_format
 from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
+from vlog_tpu.storage import integrity
 from vlog_tpu.utils import failpoints
 from vlog_tpu.worker.breaker import CircuitBreaker
 from vlog_tpu.worker.daemon import DaemonStats
@@ -47,6 +48,12 @@ class TransientAPIError(Exception):
 
 
 RETRY_STATUS = frozenset({502, 503, 504})
+# Upload-specific retryables on top of the 5xx family: 422 is the
+# server's digest-mismatch verdict (the bytes corrupted in flight — a
+# fresh attempt sends a fresh body), 507 is disk-pressure admission
+# (the GC sweep or operator frees space; bounded retries cover the
+# transient case, exhaustion classifies transient and backs off).
+UPLOAD_RETRY_STATUS = RETRY_STATUS | {422, 507}
 _UP_CHUNK = 1 << 20
 
 
@@ -156,24 +163,47 @@ class WorkerAPIClient:
             tmp.rename(out)
             return out
 
-    async def upload_file(self, video_id: int, rel: str, path: Path) -> None:
+    async def upload_file(self, video_id: int, rel: str, path: Path) -> str:
         """Stream a file up without buffering it in memory; retries reopen
-        the file so each attempt sends a fresh body."""
+        the file so each attempt sends a fresh body. The file's SHA-256
+        (computed before send, returned to the caller) rides the
+        ``X-Content-SHA256`` header; the server re-hashes what it
+        received and a mismatch comes back 422 — retried here, since a
+        fresh attempt re-sends the true bytes."""
+        digest = await asyncio.to_thread(integrity.sha256_file, path)
 
         async def body():
+            # The upload.corrupt failpoint simulates a corrupting hop:
+            # the first chunk is bit-flipped while the digest header
+            # still carries the truth — only the server's integrity
+            # check can catch it. Consumed per attempt, so a count
+            # budget corrupts N transfers and then lets retries land.
+            corrupt = False
+            try:
+                failpoints.hit("upload.corrupt")
+            except failpoints.FailpointError:
+                corrupt = True
+            first = True
             with open(path, "rb") as fp:
                 while True:
                     chunk = await asyncio.to_thread(fp.read, _UP_CHUNK)
                     if not chunk:
+                        if first and corrupt:
+                            yield b"\x00"   # corrupt an empty file too
                         return
+                    if first and corrupt:
+                        chunk = bytes([chunk[0] ^ 0xFF]) + chunk[1:]
+                    first = False
                     yield chunk
 
         delay = 0.5
         url = f"/api/worker/upload/{video_id}/{rel}"
+        headers = {"X-Content-SHA256": digest}
         for attempt in range(self.retries + 1):
             try:
                 failpoints.hit("remote.upload")
-                resp = await self._client.put(url, content=body())
+                resp = await self._client.put(url, content=body(),
+                                              headers=headers)
             except (httpx.TransportError, failpoints.FailpointError) as exc:
                 # an injected upload fault takes the same bounded-retry
                 # path a real transport fault takes
@@ -182,15 +212,16 @@ class WorkerAPIClient:
             else:
                 if resp.status_code == 409:
                     raise ClaimLost(resp.text[:300])
-                if not (resp.status_code in RETRY_STATUS
+                if not (resp.status_code in UPLOAD_RETRY_STATUS
                         and attempt < self.retries):
                     resp.raise_for_status()
-                    return
+                    return digest
             await asyncio.sleep(delay)
             delay *= 2
         raise TransientAPIError(f"PUT {url}: retries exhausted")
 
-    async def upload_status(self, video_id: int) -> dict[str, int]:
+    async def upload_status(self, video_id: int) -> dict[str, dict]:
+        """Server-side inventory: ``rel -> {size, sha256}``."""
         r = await self._request("GET",
                                 f"/api/worker/upload/{video_id}/status")
         return r.json()["files"]
@@ -243,11 +274,23 @@ class StreamingUploader:
         self._stop = asyncio.Event()
 
     async def resume_state(self) -> None:
-        """Skip files the server already has at the same size."""
+        """Skip files the server already holds with matching size AND
+        digest. A corrupt same-size partial (a resumed run after a
+        mid-upload crash, a bit-flipped transfer published before the
+        integrity plane) digest-mismatches and gets re-uploaded."""
         have = await self.client.upload_status(self.video_id)
-        for rel, size in have.items():
+        for rel, meta in have.items():
+            if rel == integrity.MANIFEST_NAME:
+                # never resume the manifest: the tree it must describe
+                # is still changing; drain() rewrites and re-uploads it
+                continue
             local = self.root / rel
-            if local.exists() and local.stat().st_size == size:
+            if not local.exists() \
+                    or local.stat().st_size != meta.get("size"):
+                continue
+            local_digest = await asyncio.to_thread(
+                integrity.sha256_file, local)
+            if local_digest == meta.get("sha256"):
                 self.uploaded.add(rel)
 
     def _pending(self, include_deferred: bool) -> list[str]:
@@ -258,7 +301,8 @@ class StreamingUploader:
             if not p.is_file() or p.suffix in (".part", ".tmp"):
                 continue
             rel = str(p.relative_to(self.root))
-            if rel in self.uploaded:
+            if rel in self.uploaded or rel == integrity.MANIFEST_NAME:
+                # the manifest is drain()'s last word, never a poll pickup
                 continue
             if any(rel.startswith(pre) for pre in self.skip_prefixes):
                 continue
@@ -288,12 +332,32 @@ class StreamingUploader:
         self._stop.set()
 
     async def drain(self) -> None:
-        """Final sweep including the deferred manifests."""
+        """Final sweep: remaining files, then the deferred playlists,
+        then — strictly last — the ``outputs.json`` integrity manifest.
+        The ordering is the integrity contract: a manifest can only
+        describe files that are already uploaded, so the server's
+        ``complete`` verification never races a transfer.
+
+        The manifest is built from the server's post-drain inventory,
+        not just this run's digests: a reencode uploads only its new
+        format while the thumbnail (and anything else published by an
+        earlier job) stays on the server — a digests-only manifest
+        would silently shrink verify coverage with every reencode."""
         self.stop()
         for rel in self._pending(include_deferred=False):
             await self._upload_one(rel)
         for rel in self._pending(include_deferred=True):
             await self._upload_one(rel)
+        have = await self.client.upload_status(self.video_id)
+        manifest = {
+            rel: {"size": meta["size"], "sha256": meta["sha256"]}
+            for rel, meta in sorted(have.items())
+            if rel != integrity.MANIFEST_NAME
+        }
+        path = await asyncio.to_thread(
+            integrity.write_manifest, self.root, manifest)
+        await self.client.upload_file(
+            self.video_id, integrity.MANIFEST_NAME, path)
 
 
 # --------------------------------------------------------------------------
@@ -330,6 +394,8 @@ class RemoteWorker(ComputeWatchdogMixin):
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
         self.restart_requested = False
+        self.disk_paused = False
+        self._next_pressure_sweep = 0.0
         self._stop = asyncio.Event()
         self._cancel = threading.Event()
         self._cancel_reason = ""
@@ -346,6 +412,7 @@ class RemoteWorker(ComputeWatchdogMixin):
         self._cancel.set()
 
     async def run(self) -> None:
+        await self._sweep_workspaces("startup")
         hb = asyncio.create_task(self._heartbeat_loop())
         try:
             while not self._stop.is_set():
@@ -404,6 +471,7 @@ class RemoteWorker(ComputeWatchdogMixin):
 
             return {**asdict(self.stats),
                     "breaker": self.breaker.snapshot(),
+                    "disk_paused": self.disk_paused,
                     "kinds": [k.value for k in self.kinds]}
         if command == "stop":
             log.info("remote stop command received")
@@ -432,6 +500,25 @@ class RemoteWorker(ComputeWatchdogMixin):
         return {"error": f"unknown command {command!r}"}
 
     async def poll_once(self) -> bool:
+        # Disk admission BEFORE the breaker: claiming a job we cannot
+        # stage the source or outputs for would only burn an attempt
+        # (and, in HALF_OPEN, the probe slot) on a guaranteed ENOSPC.
+        if integrity.under_pressure(self.work_dir):
+            if not self.disk_paused:
+                log.warning("scratch volume under disk pressure; pausing "
+                            "claiming (%s)", self.work_dir)
+                self.disk_paused = True
+            # self-heal: stale workspaces from crashed incarnations may
+            # be exactly what is filling the volume. Re-sweep on a timer
+            # (not just the pause transition) so workspaces that AGE
+            # into eligibility while paused still get reclaimed —
+            # edge-triggering here would wedge the worker forever.
+            if time.monotonic() >= self._next_pressure_sweep:
+                self._next_pressure_sweep = time.monotonic() + 300.0
+                await self._sweep_workspaces("disk pressure")
+            return False
+        self.disk_paused = False
+        self._next_pressure_sweep = 0.0
         if not self.breaker.allow():
             return False
         # Exits that run no compute must hand a half-open probe slot back
@@ -502,6 +589,24 @@ class RemoteWorker(ComputeWatchdogMixin):
             if not self.keep_work_dirs:
                 shutil.rmtree(self._job_dir(video), ignore_errors=True)
         return True
+
+    async def _sweep_workspaces(self, why: str) -> None:
+        """Reclaim stale job workspaces of previous incarnations
+        (storage/gc.py; remote workers own their scratch — the admin
+        sweeper cannot see it). Age-thresholded so a fresh workspace a
+        reclaimed job could resume onto survives."""
+        from vlog_tpu.storage import gc as storage_gc
+
+        try:
+            report = await asyncio.to_thread(
+                storage_gc.sweep_worker_workspaces, self.work_dir)
+            if report.removed:
+                log.info("workspace gc (%s): reclaimed %d entries, "
+                         "%d bytes", why, len(report.removed),
+                         report.bytes_reclaimed)
+        except Exception:   # noqa: BLE001 — scratch GC must never kill
+            # the claim loop
+            log.exception("workspace gc failed")
 
     async def _safe_fail(self, job_id: int, error: str, *,
                          permanent: bool = False,
@@ -596,9 +701,12 @@ class RemoteWorker(ComputeWatchdogMixin):
         up_task = asyncio.create_task(uploader.run())
 
         def work():
+            # write_manifest=False: the uploader's drain() derives the
+            # published manifest from the transfer digests — hashing the
+            # scratch tree again here would double the digest cost
             return process_video(src, out_dir, backend=self.backend,
                                  progress_cb=cb, rungs=rungs,
-                                 keep_original=False)
+                                 keep_original=False, write_manifest=False)
 
         try:
             result = await self._run_with_timeout(work, timeout, "transcode")
@@ -650,6 +758,7 @@ class RemoteWorker(ComputeWatchdogMixin):
             return process_video(src, out_dir, backend=self.backend,
                                  progress_cb=cb, rungs=rungs,
                                  keep_original=False, resume=False,
+                                 write_manifest=False,
                                  streaming_format=fmt, codec=codec)
 
         try:
@@ -739,14 +848,17 @@ async def _amain(args: argparse.Namespace) -> None:
         kinds=tuple(JobKind(k) for k in args.kinds.split(",")),
         backend=backend, transcription_model_dir=args.whisper_dir)
 
-    from vlog_tpu.worker.health import WorkerHealthServer
+    from vlog_tpu.worker.health import WorkerHealthServer, combine, disk_check
 
-    async def ready() -> tuple[bool, str]:
+    async def api_ready() -> tuple[bool, str]:
         if not await client.healthz():
             return False, "worker API unreachable"
         return True, "ok"
 
-    health = WorkerHealthServer(ready)
+    # Disk pressure degrades readiness (the orchestrator stops routing /
+    # scales) without killing liveness — the worker is healthy, just full.
+    health = WorkerHealthServer(
+        combine(api_ready, disk_check(worker.work_dir, label="scratch")))
     await health.start()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
