@@ -31,7 +31,13 @@ closed-loop VBR — not the intra shortcut earlier rounds measured. A
 per-stage wall-clock breakdown (decode_wait / compute_wait /
 device_pull / entropy / package, from RunResult.stage_s) says where the
 time went — compute_wait is pure device compute (block_until_ready),
-device_pull the device->host transfer after readiness.
+device_pull the device->host transfer after readiness. stage_s also
+carries the pipeline executor's overlap gauges (pipeline_depth /
+max_in_flight / host_busy_s / host_wall_s / host_occupancy,
+parallel/executor.py): the stage fields are per-stage BUSY sums, the
+gauges say how much of that busy time ran concurrently — host_busy_s
+above host_wall_s (occupancy > 1) means the per-rung fan-out and the
+VLOG_PIPELINE_DEPTH-deep in-flight window are overlapping for real.
 
 In THIS driver environment the chip is reached through a network tunnel
 measured at ~30 MB/s down / ~70 MB/s up (``tunnel_*_mbps`` keys) —
@@ -68,12 +74,30 @@ import time
 
 NVENC_FULL_LADDER_REALTIME = 1.0   # see module docstring
 
-SMOKE_ATTEMPTS = 3
+SMOKE_ATTEMPTS = 2
 SMOKE_TIMEOUT_S = 300     # JAX import + tunnel init + one tiny dispatch
-                          # (tunnel init alone has been observed >3 min)
-SMOKE_RETRY_SLEEP_S = 120  # the tunnel has been observed to heal slowly
+                          # (tunnel init alone has been observed >3 min —
+                          # the BUDGET clamp below, not this cap, is what
+                          # protects the CPU fallback's wall clock)
+SMOKE_RETRY_SLEEP_S = 30
 TPU_TIMEOUT_S = 900
 CPU_TIMEOUT_S = 900
+
+# Whole-run wall budget. Every phase's timeout is clamped to what is
+# left of it, and the smoke/TPU phases additionally RESERVE the time a
+# CPU-fallback body needs — so a dead tunnel can never starve the
+# labeled fallback record. (BENCH_r05: 3x300 s smoke attempts plus
+# 2x120 s sleeps burned 1140 s before the fallback even started and the
+# harness killed the run at rc=124 with nothing parseable on stdout.)
+BENCH_BUDGET_S = int(os.environ.get("VLOG_BENCH_BUDGET_S", "1500"))
+CPU_FALLBACK_RESERVE_S = 660       # CPU body worst case + margin
+_BENCH_T0 = time.monotonic()
+
+
+def _budget_left(reserve: float = 0.0) -> int:
+    """Seconds of wall budget remaining after ``reserve`` is held back."""
+    return max(0, int(BENCH_BUDGET_S - (time.monotonic() - _BENCH_T0)
+                      - reserve))
 
 
 # ---------------------------------------------------------------------------
@@ -593,26 +617,45 @@ def main() -> int:
 
     # Phase 0: host entropy throughput (CPU, accelerator-independent).
     # Runs first so a later tunnel stall can't starve it of wall clock.
-    entropy_line, _ = _attempt("--entropy", "cpu", CPU_TIMEOUT_S)
+    entropy_line, _ = _attempt(
+        "--entropy", "cpu",
+        max(120, min(CPU_TIMEOUT_S, _budget_left(CPU_FALLBACK_RESERVE_S))))
 
     # Phase 1: smoke. A ~seconds-scale dispatch distinguishes "tunnel
     # down" (retry, then CPU fallback) from "code broken" (the 900 s
     # body would fail identically on CPU, where it is cheap to see).
+    # Attempts stop early once the budget (minus the CPU-fallback
+    # reserve) runs dry: a labeled fallback record ALWAYS beats one
+    # more smoke retry.
     smoke_ok = False
     for i in range(SMOKE_ATTEMPTS):
-        line, _ = _attempt("--smoke", "tpu", SMOKE_TIMEOUT_S)
+        t = min(SMOKE_TIMEOUT_S, _budget_left(CPU_FALLBACK_RESERVE_S))
+        if t < 30:
+            print("bench: smoke budget exhausted; going to CPU fallback",
+                  file=sys.stderr)
+            break
+        line, _ = _attempt("--smoke", "tpu", t)
         if line and '"ok"' in line:
             smoke_ok = True
             print(f"bench: smoke ok (attempt {i + 1})", file=sys.stderr)
             break
         print(f"bench: smoke attempt {i + 1}/{SMOKE_ATTEMPTS} failed",
               file=sys.stderr)
-        if i + 1 < SMOKE_ATTEMPTS:
+        if (i + 1 < SMOKE_ATTEMPTS
+                and _budget_left(CPU_FALLBACK_RESERVE_S)
+                > SMOKE_RETRY_SLEEP_S):
             time.sleep(SMOKE_RETRY_SLEEP_S)
 
     # Phase 2: the measurement body on the accelerator.
     if smoke_ok:
-        line, _ = _attempt("--body", "tpu", TPU_TIMEOUT_S)
+        t = min(TPU_TIMEOUT_S, _budget_left(CPU_FALLBACK_RESERVE_S))
+        line = None
+        if t >= 120:
+            line, _ = _attempt("--body", "tpu", t)
+        else:
+            print("bench: tpu body skipped (budget exhausted after "
+                  "smoke); falling back to labeled CPU measurement",
+                  file=sys.stderr)
         if line:
             print(json.dumps(_merge_entropy(json.loads(line),
                                             entropy_line)))
@@ -623,7 +666,8 @@ def main() -> int:
         print("bench: accelerator unreachable (smoke failed); "
               "falling back to labeled CPU measurement", file=sys.stderr)
 
-    line, _ = _attempt("--body", "cpu", CPU_TIMEOUT_S)
+    line, _ = _attempt("--body", "cpu",
+                       max(120, min(CPU_TIMEOUT_S, _budget_left())))
     if line:
         print(json.dumps(_merge_entropy(json.loads(line), entropy_line)))
         return 0

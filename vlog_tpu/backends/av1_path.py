@@ -157,11 +157,18 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
         start_frame = 0
 
         from vlog_tpu.ops.resize import resize_yuv420
+        from vlog_tpu.parallel.executor import PipelineExecutor
 
         fifo: queue_mod.Queue = queue_mod.Queue(maxsize=1)
         eof = object()
         stop = threading.Event()
         batch_n = max(1, plan.frame_batch)
+        # same stage fields as the first-party paths: device_pull is the
+        # device resize + d2h, entropy the delegated encoder, package
+        # the fMP4 segment writes (compute_wait stays 0 — the delegated
+        # encoder has no separate async device stage)
+        prof = {"decode_wait_s": 0.0, "compute_wait_s": 0.0,
+                "device_pull_s": 0.0, "entropy_s": 0.0, "package_s": 0.0}
 
         def producer() -> None:
             try:
@@ -228,16 +235,61 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
                 ensure_track(rung, data)
                 pending[rung.name].append(
                     Sample(data=data, duration=frame_dur, is_sync=is_key))
+            tw = time.perf_counter()
             while len(pending[rung.name]) >= frames_per_seg:
                 chunk = pending[rung.name][:frames_per_seg]
                 pending[rung.name] = pending[rung.name][frames_per_seg:]
                 backend._write_segment(out, rung, tracks[rung.name],
                                        seg_counts, seg_durs,
                                        bytes_written, chunk, timescale)
+            pipe.prof_add("package_s", time.perf_counter() - tw)
+
+        # --- consume side on the shared stage-decoupled executor: the
+        # delegated encoders are stateful and order-sensitive per rung
+        # (the pts contract above), which is exactly the executor's
+        # per-rung-ordered guarantee; rungs encode concurrently and up
+        # to VLOG_PIPELINE_DEPTH decoded batches stay in flight.
+        rungs_by_name = {r.name: r for r in plan.rungs}
+
+        def pull(name, batch):
+            rung = rungs_by_name[name]
+            by, bu, bv = batch.extra
+            if (rung.height, rung.width) == (by.shape[1], by.shape[2]):
+                return by, bu, bv
+            ry, ru, rv = resize_yuv420(by, bu, bv, rung.height,
+                                       rung.width)
+            return np.asarray(ry), np.asarray(ru), np.asarray(rv)
+
+        def process(name, batch, host):
+            rung = rungs_by_name[name]
+            ry, ru, rv = host
+            enc = encoders[name]
+            te = time.perf_counter()
+            for i in range(batch.n_real):
+                fi = frame_idx[name]
+                enc.send(ry[i], ru[i], rv[i],
+                         force_key=(fi % frames_per_seg == 0))
+                frame_idx[name] = fi + 1
+                drain(rung, enc.receive())
+            pipe.prof_add("entropy_s", time.perf_counter() - te)
+
+        def on_batch_done(batch):
+            # serialized + batch-ordered by the executor's contract
+            nonlocal frames_done
+            frames_done += batch.n_real
+            if progress_cb is not None:
+                progress_cb(frames_done, max(total, frames_done),
+                            "av1 ladder")
+
+        pipe = PipelineExecutor(
+            [r.name for r in plan.rungs], pull=pull, process=process,
+            on_batch_done=on_batch_done, prof=prof, name="vlog-pipe")
 
         try:
             while True:
+                td = time.perf_counter()
                 item = fifo.get()
+                prof["decode_wait_s"] += time.perf_counter() - td
                 if item is eof:
                     break
                 if isinstance(item, BaseException):
@@ -245,28 +297,11 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
                 by, bu, bv = item
                 if plan.thumbnail and thumb_path is None:
                     thumb_path = str(out / "thumbnail.jpg")
-                    backend._write_thumbnail(by[0], bu[0], bv[0],
-                                             thumb_path)
-                for rung in plan.rungs:
-                    if (rung.height, rung.width) == (by.shape[1],
-                                                     by.shape[2]):
-                        ry, ru, rv = by, bu, bv
-                    else:
-                        ry, ru, rv = resize_yuv420(
-                            by, bu, bv, rung.height, rung.width)
-                        ry, ru, rv = (np.asarray(ry), np.asarray(ru),
-                                      np.asarray(rv))
-                    enc = encoders[rung.name]
-                    for i in range(ry.shape[0]):
-                        fi = frame_idx[rung.name]
-                        enc.send(ry[i], ru[i], rv[i],
-                                 force_key=(fi % frames_per_seg == 0))
-                        frame_idx[rung.name] = fi + 1
-                        drain(rung, enc.receive())
-                frames_done += by.shape[0]
-                if progress_cb is not None:
-                    progress_cb(frames_done, max(total, frames_done),
-                                "av1 ladder")
+                    pipe.submit_aux(backend._write_thumbnail, by[0],
+                                    bu[0], bv[0], thumb_path)
+                pipe.reserve()
+                pipe.submit(None, by.shape[0], extra=(by, bu, bv))
+            pipe.drain()
             for rung in plan.rungs:
                 drain(rung, encoders[rung.name].flush())
                 if pending[rung.name]:
@@ -282,6 +317,7 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
                     fifo.get_nowait()
                 except queue_mod.Empty:
                     break
+            pipe.close()
             for enc in encoders.values():
                 enc.close()
     finally:
@@ -325,4 +361,5 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
         duration_s=duration_s, thumbnail_path=thumb_path,
         wall_s=time.monotonic() - t0, variants=variants, fps=fps,
         segment_duration_s=plan.segment_duration_s,
+        stage_s={k: round(v, 3) for k, v in prof.items()} | pipe.gauges(),
         gop_len=frames_per_seg)

@@ -120,7 +120,12 @@ class RunResult:
     # e2e time went, so benches can report which stage bounds
     # throughput. compute_wait = block_until_ready on the async
     # dispatch (pure device compute); device_pull = np.asarray AFTER
-    # readiness (pure device->host transfer)
+    # readiness (pure device->host transfer). Each field is cumulative
+    # BUSY seconds for its stage; since the stage-decoupled executor
+    # (parallel/executor.py) runs rungs concurrently, busy sums can
+    # exceed wall clock — the overlap gauges it adds (pipeline_depth,
+    # max_in_flight, host_busy_s, host_wall_s, host_occupancy) say how
+    # much actually overlapped.
     stage_s: dict = field(default_factory=dict)
     # chain length the run actually used (plan_for's segment-divisor
     # logic may pick a different value than config.GOP_LEN; 1 = intra)

@@ -91,15 +91,12 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         start_frame = start_segment * frames_per_seg
 
         import jax
-        from concurrent.futures import ThreadPoolExecutor
 
+        from vlog_tpu.parallel.executor import (LaggedRateControl,
+                                                PipelineExecutor)
         from vlog_tpu.parallel.hevc_ladder import hevc_chain_ladder_program
         from vlog_tpu.parallel.mesh import make_mesh, shard_frames
 
-        # one long-lived entropy pool shared by every (rung, batch) call
-        # — per-call pools would churn threads (same reason as the H.264
-        # loop's pool)
-        entropy_pool = ThreadPoolExecutor(max_workers=8)
         # closed-loop VBR toward each rung's ladder bitrate, same
         # controller the H.264 path uses (per-frame QP is traced, so
         # stepping never recompiles)
@@ -111,6 +108,10 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         pending: dict[str, list[Sample]] = {r.name: [] for r in plan.rungs}
         frames_done = start_frame
         thumb_path = None
+        # same five stage fields as the H.264 path (cumulative busy
+        # seconds), plus the executor's overlap gauges at the end
+        prof = {"decode_wait_s": 0.0, "compute_wait_s": 0.0,
+                "device_pull_s": 0.0, "entropy_s": 0.0, "package_s": 0.0}
 
         # one-batch decode prefetch (same shape as the H.264 loop)
         fifo: queue_mod.Queue = queue_mod.Queue(maxsize=1)
@@ -177,72 +178,98 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                 qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
             return fn(by, bu, bv, mats, qps, rc), n_real, qps
 
-        def consume(outs, n_real, qps):
+        # --- stage-decoupled consume side: the same PipelineExecutor
+        # the H.264 path uses (per-rung ordered threads, shared host
+        # pool, VLOG_PIPELINE_DEPTH batches in flight, deterministic
+        # lag-applied rate feedback).
+        rungs_by_name = {r.name: r for r in plan.rungs}
+        rc = LaggedRateControl(controllers)
+
+        def wait_device(batch):
+            jax.block_until_ready(batch.outs)
+
+        def pull(name, batch):
+            ro = batch.outs[name]
+            return {k: np.asarray(ro[k]) for k in
+                    ("i_luma", "i_cb", "i_cr", "p_luma", "p_cb",
+                     "p_cr", "mv", "sse_y", "qp_eff", "cost")}
+
+        def process(name, batch, host):
+            rung = rungs_by_name[name]
+            rows, cols = rows_cols[name]
+            n_real = batch.n_real
+            te = time.perf_counter()
+            sse = host["sse_y"]                      # (nc, clen)
+            plan_q = np.asarray(batch.qps[name])
+            # the QPs the device ACTUALLY encoded at (plan + in-chain
+            # adjustment) — slice headers must signal these; the
+            # controller still attributes to PLAN (cascade outer loop)
+            qarr = host["qp_eff"]
+            cost = host["cost"]
+            batch_bytes = 0
+            n_frames = 0
+            cost_sum = 0.0
+            rc_qs = []   # plan working-point dither (the HEVC
+            #              program applies its I -2 anchor internally)
+            for ci in range(chains_per):
+                base = ci * clen
+                if base >= n_real:
+                    break
+                keep = min(clen, n_real - base)
+                rc_qs.append(plan_q[ci, :keep])
+                cost_sum += float(cost[ci, :keep].sum())
+                mse = np.maximum(sse[ci, :keep] / npix[name], 1e-12)
+                psnrs = np.where(mse < 1e-9, 99.0,
+                                 10 * np.log10(255.0 ** 2 / mse))
+                frames = encoders[name].entropy_chain(
+                    (host["i_luma"][ci], host["i_cb"][ci],
+                     host["i_cr"][ci]),
+                    (host["p_luma"][ci], host["p_cb"][ci],
+                     host["p_cr"][ci]) if clen > 1 else None,
+                    None, None,
+                    host["mv"][ci] if clen > 1 else None,
+                    qarr[ci], rows, cols, psnrs,
+                    t_real=keep, pool=pipe.host_pool)
+                for f in frames:
+                    psnr_acc[name].append(f.psnr_y)
+                    pending[name].append(
+                        Sample(data=f.sample, duration=frame_dur,
+                               is_sync=f.is_idr))
+                    batch_bytes += len(f.sample)
+                n_frames += keep
+            rc.post(name, batch.index, nbytes=batch_bytes,
+                    frames=max(n_frames, 1),
+                    frame_qps=(np.concatenate(rc_qs) if rc_qs else None),
+                    cost=cost_sum)
+            pipe.prof_add("entropy_s", time.perf_counter() - te)
+            tw = time.perf_counter()
+            while len(pending[name]) >= frames_per_seg:
+                chunk = pending[name][:frames_per_seg]
+                pending[name] = pending[name][frames_per_seg:]
+                backend._write_segment(out, rung, tracks[name],
+                                       seg_counts, seg_durs,
+                                       bytes_written, chunk,
+                                       timescale)
+            pipe.prof_add("package_s", time.perf_counter() - tw)
+
+        def on_batch_done(batch):
+            # serialized + batch-ordered by the executor's contract
             nonlocal frames_done
-            for rung in plan.rungs:
-                name = rung.name
-                ro = outs[name]
-                rows, cols = rows_cols[name]
-                host = {k: np.asarray(ro[k]) for k in
-                        ("i_luma", "i_cb", "i_cr", "p_luma", "p_cb",
-                         "p_cr", "mv")}
-                sse = np.asarray(ro["sse_y"])            # (nc, clen)
-                plan_q = np.asarray(qps[name])
-                # the QPs the device ACTUALLY encoded at (plan + in-chain
-                # adjustment) — slice headers must signal these; the
-                # controller still attributes to PLAN (cascade outer loop)
-                qarr = np.asarray(ro["qp_eff"])
-                cost = np.asarray(ro["cost"])
-                batch_bytes = 0
-                n_frames = 0
-                cost_sum = 0.0
-                rc_qs = []   # plan working-point dither (the HEVC
-                #              program applies its I -2 anchor internally)
-                for ci in range(chains_per):
-                    base = ci * clen
-                    if base >= n_real:
-                        break
-                    keep = min(clen, n_real - base)
-                    rc_qs.append(plan_q[ci, :keep])
-                    cost_sum += float(cost[ci, :keep].sum())
-                    mse = np.maximum(sse[ci, :keep] / npix[name], 1e-12)
-                    psnrs = np.where(mse < 1e-9, 99.0,
-                                     10 * np.log10(255.0 ** 2 / mse))
-                    frames = encoders[name].entropy_chain(
-                        (host["i_luma"][ci], host["i_cb"][ci],
-                         host["i_cr"][ci]),
-                        (host["p_luma"][ci], host["p_cb"][ci],
-                         host["p_cr"][ci]) if clen > 1 else None,
-                        None, None,
-                        host["mv"][ci] if clen > 1 else None,
-                        qarr[ci], rows, cols, psnrs,
-                        t_real=keep, pool=entropy_pool)
-                    for f in frames:
-                        psnr_acc[name].append(f.psnr_y)
-                        pending[name].append(
-                            Sample(data=f.sample, duration=frame_dur,
-                                   is_sync=f.is_idr))
-                        batch_bytes += len(f.sample)
-                    n_frames += keep
-                controllers[name].observe(
-                    batch_bytes, max(n_frames, 1),
-                    frame_qps=(np.concatenate(rc_qs) if rc_qs else None))
-                controllers[name].calibrate_proxy(batch_bytes, cost_sum)
-                while len(pending[name]) >= frames_per_seg:
-                    chunk = pending[name][:frames_per_seg]
-                    pending[name] = pending[name][frames_per_seg:]
-                    backend._write_segment(out, rung, tracks[name],
-                                           seg_counts, seg_durs,
-                                           bytes_written, chunk,
-                                           timescale)
-            frames_done += n_real
+            frames_done += batch.n_real
             if progress_cb is not None:
                 progress_cb(frames_done, total, "hevc ladder")
 
-        inflight = None
+        pipe = PipelineExecutor(
+            [r.name for r in plan.rungs], pull=pull, process=process,
+            ready=wait_device, on_batch_done=on_batch_done,
+            prof=prof, name="vlog-pipe")
+
+        batch_idx = 0
         try:
             while True:
+                td = time.perf_counter()
                 item = fifo.get()
+                prof["decode_wait_s"] += time.perf_counter() - td
                 if item is eof:
                     break
                 if isinstance(item, BaseException):
@@ -250,22 +277,22 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                 by, bu, bv = item
                 if plan.thumbnail and thumb_path is None:
                     thumb_path = str(out / "thumbnail.jpg")
-                    backend._write_thumbnail(by[0], bu[0], bv[0], thumb_path)
-                staged = dispatch(by, bu, bv)
-                if any(controllers[r.name].hunting for r in plan.rungs):
-                    # calibration/cliff hunt: consume synchronously so
+                    pipe.submit_aux(backend._write_thumbnail, by[0],
+                                    bu[0], bv[0], thumb_path)
+                # backpressure before planning, then deterministic lagged
+                # feedback — same schedule as jax_backend
+                pipe.reserve()
+                rc.apply_upto(batch_idx - pipe.depth)
+                outs, n_real, qps = dispatch(by, bu, bv)
+                pipe.submit(outs, n_real, qps)
+                batch_idx += 1
+                if rc.hunting():
+                    # calibration/cliff hunt: drain to depth 0 so
                     # corrections land before the next batch stages
                     # (same shape as jax_backend)
-                    if inflight is not None:
-                        consume(*inflight)
-                        inflight = None
-                    consume(*staged)
-                    continue
-                if inflight is not None:
-                    consume(*inflight)
-                inflight = staged
-            if inflight is not None:
-                consume(*inflight)
+                    pipe.drain()
+                    rc.apply_upto(batch_idx - 1)
+            pipe.drain()
             for rung in plan.rungs:
                 if pending[rung.name]:
                     backend._write_segment(out, rung, tracks[rung.name],
@@ -280,7 +307,7 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                     fifo.get_nowait()
                 except queue_mod.Empty:
                     break
-            entropy_pool.shutdown(wait=True)
+            pipe.close()
     finally:
         src.close()
 
@@ -328,4 +355,5 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         thumbnail_path=thumb_path, wall_s=time.monotonic() - t0,
         variants=variants, fps=fps,
         segment_duration_s=plan.segment_duration_s,
+        stage_s={k: round(v, 3) for k, v in prof.items()} | pipe.gauges(),
         gop_len=plan.gop_len)

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from vlog_tpu import config
 from vlog_tpu.codecs.h264 import syntax
 from vlog_tpu.codecs.h264.cavlc import encode_slice
 from vlog_tpu.codecs.h264.encoder import (
@@ -49,7 +50,9 @@ class H264Encoder:
     fps_den: int = 1
     qp: int = 26
     idr_period: int = 1          # every frame IDR by default
-    entropy_threads: int = 8
+    # None -> config.ENTROPY_THREADS (cpu-count-derived; the shared
+    # executor pool is sized by the same knob)
+    entropy_threads: int | None = None
     entropy: str = "cavlc"       # "cavlc" (C fast path) | "cabac"
     # In-loop deblocking (spec 8.7): the chain path enables this — the
     # DSP's reconstruction loop must apply codecs/h264/deblock.py when
@@ -59,6 +62,8 @@ class H264Encoder:
     _idr_pic_id: int = field(default=0, init=False)
 
     def __post_init__(self):
+        if self.entropy_threads is None:
+            self.entropy_threads = config.ENTROPY_THREADS
         if self.entropy not in ("cavlc", "cabac"):
             raise ValueError(f"unknown entropy coder {self.entropy!r}")
         # CABAC is prohibited in Baseline (spec A.2.1); signal Main so
@@ -165,7 +170,9 @@ class H264Encoder:
 
     def encode_levels(self, levels: dict, qps: np.ndarray,
                       psnrs: np.ndarray | None = None,
-                      n: int | None = None) -> list[EncodedFrame]:
+                      n: int | None = None,
+                      pool: ThreadPoolExecutor | None = None
+                      ) -> list[EncodedFrame]:
         """Entropy-code device outputs already on host.
 
         ``levels`` holds numpy ``luma_dc/luma_ac/chroma_dc/chroma_ac``
@@ -173,7 +180,8 @@ class H264Encoder:
         output); ``qps`` is the per-frame QP the DSP actually used. The
         backend calls this while the *next* batch's dispatch is already
         in flight, so host bit-packing overlaps device compute (frames
-        within the call are threaded here).
+        within the call are threaded — on ``pool`` when the caller
+        shares its long-lived executor pool, else a per-call one).
         """
         total = levels["luma_dc"].shape[0]
         n = total if n is None else min(n, total)
@@ -187,10 +195,12 @@ class H264Encoder:
             psnr = float(psnrs[i]) if psnrs is not None else float("nan")
             return self._pack_one(frame_ids[i], lv, int(qps[i]), psnr)
 
+        if pool is not None:
+            return list(pool.map(pack, range(n)))
         if n == 1 or self.entropy_threads <= 1:
             return [pack(i) for i in range(n)]
-        with ThreadPoolExecutor(self.entropy_threads) as pool:
-            return list(pool.map(pack, range(n)))
+        with ThreadPoolExecutor(self.entropy_threads) as own:
+            return list(own.map(pack, range(n)))
 
     def encode(self, y: np.ndarray, u: np.ndarray, v: np.ndarray
                ) -> list[EncodedFrame]:

@@ -76,13 +76,17 @@ class HevcEncoder:
     fps_num: int = 30
     fps_den: int = 1
     qp: int = 30
-    entropy_threads: int = 8
+    # None -> config.ENTROPY_THREADS (cpu-count-derived; the shared
+    # executor pool is sized by the same knob)
+    entropy_threads: int | None = None
     deblock: bool | None = None     # None -> config.HEVC_DEBLOCK
 
     def __post_init__(self):
-        if self.deblock is None:
-            from vlog_tpu import config
+        from vlog_tpu import config
 
+        if self.entropy_threads is None:
+            self.entropy_threads = config.ENTROPY_THREADS
+        if self.deblock is None:
             self.deblock = config.HEVC_DEBLOCK
         self.vps = syntax.write_vps(
             syntax.level_idc_for(self.width, self.height))

@@ -295,6 +295,20 @@ HEVC_DEBLOCK: bool = _env_bool("VLOG_HEVC_DEBLOCK", True)
 # all-intra encoder is a packaging concept (segment boundary), so this is a
 # pure throughput/memory knob.
 TPU_FRAME_BATCH: int = _env_int("VLOG_TPU_FRAME_BATCH", 8, lo=1, hi=256)
+# Batches allowed in flight on the consume side of the transcode
+# pipeline (parallel/executor.py): at depth D, dispatch of batch N,
+# the device->host pull of batch N-1, and entropy/packaging of batch
+# N-2 proceed concurrently (D-1 batches consume while one stages).
+# Depth 1 is the fully-serial loop; the rate controllers' calibration
+# "hunting" phase always drains to depth 0 regardless.
+PIPELINE_DEPTH: int = _env_int("VLOG_PIPELINE_DEPTH", 2, lo=1, hi=16)
+# Host entropy worker threads shared by every rung's frame fan-out (one
+# pool per run, parallel/executor.py). Default derives from the host
+# core count: the C entropy coders release the GIL, so throughput
+# scales ~linearly until cores run out.
+ENTROPY_THREADS: int = _env_int(
+    "VLOG_ENTROPY_THREADS", max(2, min(32, os.cpu_count() or 8)),
+    lo=1, hi=256)
 # Mesh axis layout, e.g. "data:8" or "data:4,chunk:2". Parsed by parallel.mesh.
 TPU_MESH_SPEC: str = _env_str("VLOG_TPU_MESH", "data:-1")
 

@@ -6,6 +6,11 @@ on TPU the intra-pod analog is XLA collectives over ICI driven by
 the hot pipeline steps; the worker runtime stays mesh-agnostic.
 """
 
+from vlog_tpu.parallel.executor import (  # noqa: F401
+    LaggedRateControl,
+    PipelineExecutor,
+    StagedBatch,
+)
 from vlog_tpu.parallel.mesh import (  # noqa: F401
     MeshSpec,
     make_mesh,

@@ -1,0 +1,398 @@
+"""Stage-decoupled async transcode executor (the consume side).
+
+Every codec path used to run the same blocking loop on its dispatch
+thread: ``block_until_ready`` -> per-rung device->host pull -> host
+entropy -> fMP4 packaging, serial across rungs, with exactly one batch
+in flight — and the loop was duplicated nearly verbatim in
+``backends/jax_backend.py``, ``backends/hevc_path.py`` and
+``backends/av1_path.py``. This module owns that loop once, decoupled
+into overlapping stages (the decode ∥ compute ∥ transfer ∥ pack
+pipeline SURVEY §7 calls mandatory at 4K rates):
+
+- The dispatch thread stages device work, then hands the staged batch
+  to :meth:`PipelineExecutor.submit`. ``copy_to_host_async()`` is
+  started on every per-rung output buffer immediately, so the d2h
+  transfer (the bench-dominant stage over slow links) overlaps the
+  NEXT batch's device compute instead of serializing behind
+  ``block_until_ready``.
+- A bounded in-flight window (``VLOG_PIPELINE_DEPTH``, default 2) lets
+  dispatch of batch N, the pull of batch N-1, and entropy/packaging of
+  batch N-2 proceed concurrently; :meth:`PipelineExecutor.reserve` is
+  the backpressure (call it BEFORE planning the next dispatch).
+- One consumer thread per rung pulls and entropy-codes rungs
+  CONCURRENTLY (per-rung fan-out), but each rung consumes its batches
+  strictly in order — the per-rung ordered segment writer that keeps
+  packaging order, encoder state (frame numbering, ``idr_pic_id``) and
+  resume semantics identical at every depth.
+- Frame-level entropy work fans out further onto one shared,
+  cpu-count-sized host pool (``VLOG_ENTROPY_THREADS``) exposed as
+  :attr:`PipelineExecutor.host_pool` and passed to the codec APIs'
+  ``pool=`` parameter (replacing the per-path and per-call pools).
+
+Rate control stays DETERMINISTIC under pipelining via
+:class:`LaggedRateControl`: consumer threads *post* observations; the
+dispatch thread *applies* them (``observe()`` + ``calibrate_proxy()``)
+in batch order with a fixed lag equal to the pipeline depth, so the QP
+plan for batch N depends on exactly the batches <= N-depth no matter
+how threads interleave — the mesh-equivalence byte-identity tests rely
+on this. While a controller is "hunting" (calibration / rate-cliff
+recovery) the backend drains the window to depth 0 and applies feedback
+immediately: the same tight loop the serial code ran.
+
+Chaos: the ``backend.pull`` / ``backend.entropy`` failpoints fire
+inside the consumer stages; a triggered (or otherwise failing) stage
+records the first error, skips the remaining queued work, wakes the
+dispatch thread (which re-raises from :meth:`reserve`/:meth:`drain`),
+and :meth:`close` joins every consumer so nothing leaks.
+
+Profiling: the executor accumulates the classic stage fields
+(``compute_wait_s`` / ``device_pull_s``, with ``entropy_s`` /
+``package_s`` added by the path callbacks through :meth:`prof_add`)
+with unchanged meaning — cumulative busy seconds per stage — and
+:meth:`gauges` adds the overlap/occupancy view: configured depth,
+observed max in-flight depth, and consume-side busy-vs-wall time.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable
+
+from vlog_tpu import config
+from vlog_tpu.utils import failpoints
+
+_STOP = object()
+
+# prof keys that count as consume-side busy time (occupancy numerator);
+# waits are not busy.
+_BUSY_KEYS = frozenset(("device_pull_s", "entropy_s", "package_s"))
+
+
+def start_d2h(tree: Any) -> None:
+    """Kick off async device->host copies for every array in a
+    pytree-ish structure (dicts/lists/tuples of jax Arrays).
+
+    Best effort by design: numpy arrays (no ``copy_to_host_async``) and
+    platforms without a d2h stream are skipped silently — the copy is
+    an overlap optimization, correctness comes from the consumer's own
+    blocking pull."""
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            stack.extend(node.values())
+        elif isinstance(node, (list, tuple)):
+            stack.extend(node)
+        else:
+            start = getattr(node, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:  # noqa: BLE001 — optimization only
+                    pass
+
+
+class StagedBatch:
+    """One dispatched batch traveling through the consume stages.
+
+    ``outs`` is whatever the path's dispatch staged (per-rung device
+    outputs, or ``None`` for delegated paths), ``qps`` the batch-indexed
+    plan QPs rate-control attribution needs, ``extra`` any path-specific
+    payload (e.g. the raw host frames for the AV1 path's resize)."""
+
+    __slots__ = ("index", "outs", "n_real", "qps", "extra",
+                 "_ready_lock", "_ready", "_remaining")
+
+    def __init__(self, index: int, outs: Any, n_real: int, qps: Any,
+                 extra: Any, n_rungs: int):
+        self.index = index
+        self.outs = outs
+        self.n_real = n_real
+        self.qps = qps
+        self.extra = extra
+        self._ready_lock = threading.Lock()
+        self._ready = False
+        self._remaining = n_rungs
+
+
+class PipelineExecutor:
+    """Bounded-depth, per-rung-ordered consumer for staged batches.
+
+    ``pull(rung_name, batch)`` runs in the rung's consumer thread and
+    returns the host-materialized data for that rung (timed as
+    ``device_pull_s``); ``process(rung_name, batch, host)`` entropy-
+    codes and packages it (the callback accounts its own ``entropy_s``
+    / ``package_s`` through :meth:`prof_add`). ``ready(batch)``, when
+    given, is invoked exactly once per batch by the first consumer to
+    reach it (timed as ``compute_wait_s`` — pure device compute, since
+    dispatch is async). ``on_batch_done(batch)`` fires after the LAST
+    rung finishes a batch, before the in-flight slot frees; calls are
+    guaranteed serialized AND in batch order (the thread running batch
+    N's hook still owes its own rung's decrement for batch N+1, so N+1
+    cannot complete concurrently) — hooks may bump plain counters."""
+
+    def __init__(self, rung_names: Iterable[str], *,
+                 pull: Callable[[str, StagedBatch], Any],
+                 process: Callable[[str, StagedBatch, Any], None],
+                 ready: Callable[[StagedBatch], None] | None = None,
+                 on_batch_done: Callable[[StagedBatch], None] | None = None,
+                 depth: int | None = None,
+                 host_pool: ThreadPoolExecutor | None = None,
+                 host_threads: int | None = None,
+                 prof: dict | None = None,
+                 name: str = "vlog-pipe"):
+        self.depth = config.PIPELINE_DEPTH if depth is None else max(1, depth)
+        self._pull = pull
+        self._process = process
+        self._ready = ready
+        self._on_batch_done = on_batch_done
+        self.prof = prof if prof is not None else {}
+        for key in ("compute_wait_s", "device_pull_s", "entropy_s",
+                    "package_s"):
+            self.prof.setdefault(key, 0.0)
+        self._prof_lock = threading.Lock()
+        self._busy_s = 0.0
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._in_flight = 0
+        self._max_in_flight = 0
+        self._submitted = 0
+        self._failure: BaseException | None = None
+        self._t_first: float | None = None
+        self._t_last: float | None = None
+        self._aux: list = []
+        self._own_pool = host_pool is None
+        if host_pool is None:
+            host_pool = ThreadPoolExecutor(
+                max_workers=host_threads or config.ENTROPY_THREADS,
+                thread_name_prefix=f"{name}-host")
+        self.host_pool = host_pool
+        self._queues: dict[str, queue_mod.Queue] = {}
+        self._threads: list[threading.Thread] = []
+        for rname in rung_names:
+            q: queue_mod.Queue = queue_mod.Queue()
+            self._queues[rname] = q
+            t = threading.Thread(target=self._rung_loop, args=(rname, q),
+                                 daemon=True, name=f"{name}-{rname}")
+            self._threads.append(t)
+            t.start()
+
+    # ---- profiling ---------------------------------------------------
+    def prof_add(self, key: str, seconds: float) -> None:
+        """Accumulate stage time (thread-safe; callbacks use this too).
+        Keys in ``entropy_s``/``package_s``/``device_pull_s`` also count
+        toward consume-side busy time (the occupancy numerator)."""
+        with self._prof_lock:
+            self.prof[key] = self.prof.get(key, 0.0) + seconds
+            if key in _BUSY_KEYS:
+                self._busy_s += seconds
+
+    def gauges(self) -> dict:
+        """Overlap/occupancy gauges for ``RunResult.stage_s``: the
+        configured window, the deepest the window actually got, and
+        consume-side busy seconds vs wall seconds (busy > wall means
+        rungs genuinely overlapped; occupancy is their ratio)."""
+        with self._cond:
+            t_first, t_last = self._t_first, self._t_last
+            max_if = self._max_in_flight
+        wall = (t_last - t_first) if t_first is not None \
+            and t_last is not None else 0.0
+        with self._prof_lock:
+            busy = self._busy_s
+        return {
+            "pipeline_depth": self.depth,
+            "max_in_flight": max_if,
+            "host_busy_s": round(busy, 3),
+            "host_wall_s": round(wall, 3),
+            "host_occupancy": round(busy / wall, 3) if wall > 0 else 0.0,
+        }
+
+    # ---- dispatch-thread API -----------------------------------------
+    def _await_slot_locked(self) -> None:
+        """Wait for a free in-flight slot; caller holds ``_cond``.
+        Raises the first consumer failure instead of waiting forever."""
+        while self._failure is None and self._in_flight >= self.depth:
+            self._cond.wait()
+        if self._failure is not None:
+            raise self._failure
+
+    def reserve(self) -> None:
+        """Block until the in-flight window has a free slot. Call
+        BEFORE planning the next dispatch, so QP planning happens at a
+        deterministic point (batches <= N-depth fully consumed)."""
+        with self._cond:
+            self._await_slot_locked()
+
+    def submit(self, outs: Any, n_real: int, qps: Any = None,
+               extra: Any = None) -> StagedBatch:
+        """Hand a staged batch to the consumers (dispatch thread only;
+        :meth:`reserve` first). Starts async d2h copies on ``outs``
+        immediately, then enqueues the batch to every rung."""
+        with self._cond:
+            self._await_slot_locked()
+            batch = StagedBatch(self._submitted, outs, n_real, qps, extra,
+                                len(self._queues))
+            self._submitted += 1
+            self._in_flight += 1
+            self._max_in_flight = max(self._max_in_flight, self._in_flight)
+            if self._t_first is None:
+                self._t_first = time.perf_counter()
+        start_d2h(outs)
+        for q in self._queues.values():
+            q.put(batch)
+        return batch
+
+    def submit_aux(self, fn: Callable, *args: Any) -> None:
+        """Run a side task (e.g. the first-batch thumbnail encode) on
+        the host pool; its failure surfaces at the next drain()."""
+        self._aux.append(self.host_pool.submit(fn, *args))
+
+    def drain(self) -> None:
+        """Wait until every submitted batch is fully consumed (depth 0)
+        and every aux task finished; re-raise the first failure."""
+        with self._cond:
+            while self._failure is None and self._in_flight > 0:
+                self._cond.wait()
+            if self._failure is not None:
+                raise self._failure
+        aux, self._aux = self._aux, []
+        for fut in aux:
+            fut.result()
+        with self._cond:
+            if self._failure is not None:
+                raise self._failure
+
+    def close(self) -> None:
+        """Stop the consumers and release the owned pool. Never raises
+        (failure surfacing is reserve/drain's job) and safe after ANY
+        abort — consumer failure or a dispatch-side exception alike:
+        the stop flag makes consumers skip still-queued batches (a
+        zombie rung thread must not keep writing segments into a tree a
+        retry may already be resuming onto), threads are joined, and a
+        join that times out is logged rather than ignored — the
+        clean-drain guarantee the chaos tests assert."""
+        self._stop.set()
+        for q in self._queues.values():
+            q.put(_STOP)
+        for t in self._threads:
+            t.join(timeout=30)
+        alive = [t.name for t in self._threads if t.is_alive()]
+        if alive:
+            import logging
+
+            logging.getLogger("vlog_tpu.executor").warning(
+                "pipeline consumers failed to join within 30s: %s", alive)
+        if self._own_pool:
+            self.host_pool.shutdown(wait=True)
+
+    # ---- consumer side -----------------------------------------------
+    def _rung_loop(self, rname: str, q: queue_mod.Queue) -> None:
+        while True:
+            batch = q.get()
+            if batch is _STOP:
+                return
+            try:
+                if self._failure is None and not self._stop.is_set():
+                    self._consume(rname, batch)
+            except BaseException as exc:  # noqa: BLE001 — relayed to dispatch
+                self._fail(exc)
+            finally:
+                self._done(batch)
+
+    def _consume(self, rname: str, batch: StagedBatch) -> None:
+        if self._ready is not None and not batch._ready:
+            with batch._ready_lock:
+                if not batch._ready:
+                    t0 = time.perf_counter()
+                    self._ready(batch)
+                    self.prof_add("compute_wait_s",
+                                  time.perf_counter() - t0)
+                    batch._ready = True
+        failpoints.hit("backend.pull")
+        t0 = time.perf_counter()
+        host = self._pull(rname, batch)
+        self.prof_add("device_pull_s", time.perf_counter() - t0)
+        failpoints.hit("backend.entropy")
+        self._process(rname, batch, host)
+
+    def _fail(self, exc: BaseException) -> None:
+        with self._cond:
+            if self._failure is None:
+                self._failure = exc
+            self._cond.notify_all()
+
+    def _done(self, batch: StagedBatch) -> None:
+        with self._cond:
+            batch._remaining -= 1
+            last = batch._remaining == 0
+        if not last:
+            return
+        # on_batch_done runs BEFORE the slot frees, so drain() returning
+        # implies every batch's completion hook (progress, counters) ran.
+        # Skipped batches (stop flag set by close() after a dispatch-side
+        # abort) must NOT report completion — their frames were never
+        # encoded.
+        if (self._failure is None and not self._stop.is_set()
+                and self._on_batch_done is not None):
+            try:
+                self._on_batch_done(batch)
+            except BaseException as exc:  # noqa: BLE001 — relayed
+                self._fail(exc)
+        with self._cond:
+            self._in_flight -= 1
+            self._t_last = time.perf_counter()
+            self._cond.notify_all()
+
+
+class LaggedRateControl:
+    """Deterministic rate-control feedback under pipelining.
+
+    Consumer threads :meth:`post` per-batch observations (achieved
+    bytes, frame count, the batch-indexed PLAN QPs, and — for chain
+    dispatches — the device bit-proxy cost sum); the dispatch thread
+    :meth:`apply_upto` a batch index before planning the next dispatch.
+    Observations apply strictly in batch order per rung, so the QP plan
+    for batch N is a pure function of batches <= N-lag regardless of
+    consumer timing — at depth D the backend applies up to N-D, which
+    is exactly the feedback schedule the old one-batch-in-flight loop
+    realized at D=2, and the synchronous loop at D=1.
+
+    Attribution stays on the PLAN working point (the cascade outer
+    loop): the in-chain device bumps are the inner loop, and
+    attributing to realized QPs would cancel the host's own corrective
+    step against the attribution shift (the convergence invariant
+    documented at the chain consumer)."""
+
+    def __init__(self, controllers: dict):
+        self._controllers = controllers
+        self._pending: dict[str, deque] = {n: deque() for n in controllers}
+        self._lock = threading.Lock()
+
+    def post(self, name: str, batch_index: int, *, nbytes: int,
+             frames: int, frame_qps=None, cost: float | None = None
+             ) -> None:
+        with self._lock:
+            self._pending[name].append(
+                (batch_index, nbytes, frames, frame_qps, cost))
+
+    def apply_upto(self, batch_index: int) -> None:
+        """Apply observations for batches <= ``batch_index`` in order
+        (dispatch thread only). A negative index is a no-op."""
+        for name, dq in self._pending.items():
+            ctl = self._controllers[name]
+            while True:
+                with self._lock:
+                    if not dq or dq[0][0] > batch_index:
+                        break
+                    _, nbytes, frames, mix, cost = dq.popleft()
+                ctl.observe(nbytes, frames, frame_qps=mix)
+                if cost is not None:
+                    ctl.calibrate_proxy(nbytes, cost)
+
+    def hunting(self) -> bool:
+        """True while ANY controller wants the tight (depth-0) loop."""
+        return any(c.hunting for c in self._controllers.values())

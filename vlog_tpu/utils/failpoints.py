@@ -16,6 +16,10 @@ site                where it fires
                     armed transaction rolls back
 ``daemon.compute``  in WorkerDaemon._dispatch, before the kind handler
 ``backend.encode``  at JaxBackend.run entry (worker compute thread)
+``backend.pull``    in the pipeline executor's consumer stage, before a
+                    rung's device->host pull (parallel/executor.py)
+``backend.entropy`` in the pipeline executor's consumer stage, after the
+                    pull and before host entropy coding
 ``remote.upload``   in WorkerAPIClient.upload_file, before each attempt
 ``remote.claim``    in WorkerAPIClient.claim
 ``upload.corrupt``  in WorkerAPIClient.upload_file's body stream — does
@@ -72,6 +76,9 @@ SITES: dict[str, str] = {
     "db.commit": "just before a transaction COMMIT (rolls back)",
     "daemon.compute": "WorkerDaemon._dispatch, before the kind handler",
     "backend.encode": "JaxBackend.run entry (worker compute thread)",
+    "backend.pull": "pipeline executor, before a rung's device->host pull",
+    "backend.entropy": "pipeline executor, before a rung's host entropy "
+                       "stage",
     "remote.upload": "WorkerAPIClient.upload_file, before each attempt",
     "remote.claim": "WorkerAPIClient.claim",
     "upload.corrupt": "upload body stream: first chunk bit-flipped while "
