@@ -1,0 +1,472 @@
+"""Trace plane + unified metrics registry (vlog_tpu/obs/).
+
+Covers the ISSUE-4 acceptance surface: span-tree assembly under
+concurrency, one trace id stitching server and worker spans across a
+full HTTP claim->transcode->upload->complete cycle, stage-duration
+histograms on both /metrics endpoints, a failpoint-induced failure
+producing an error-tagged span, the O(states) scrape aggregate, and
+the lint-style registry/docs agreement tests (metric names, failpoint
+sites, observability knobs).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from vlog_tpu import config
+from vlog_tpu.api.admin_api import build_admin_app
+from vlog_tpu.api.worker_api import build_worker_app
+from vlog_tpu.jobs import claims, videos as vids
+from vlog_tpu.obs import store as obs_store, trace as obs_trace
+from vlog_tpu.obs.metrics import Metrics, runtime
+from vlog_tpu.utils import failpoints
+from vlog_tpu.worker.remote import RemoteWorker, WorkerAPIClient
+from tests.fixtures.media import make_y4m
+
+README = Path(__file__).parent.parent / "README.md"
+
+
+# --------------------------------------------------------------------------
+# Tracer units
+# --------------------------------------------------------------------------
+
+def test_span_nesting_and_error_tagging():
+    buf = obs_trace.TraceBuffer()
+    ctx = obs_trace.TraceContext(obs_trace.new_id(), None, buf)
+    with obs_trace.attach(ctx):
+        with obs_trace.span("outer", k="v") as outer:
+            with obs_trace.span("inner") as inner:
+                pass
+        with pytest.raises(RuntimeError):
+            with obs_trace.span("boom"):
+                raise RuntimeError("bad")
+    spans = {s.name: s for s in buf.drain()}
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["outer"].parent_id is None
+    assert spans["outer"].trace_id == ctx.trace_id
+    assert spans["outer"].duration_s is not None
+    assert spans["outer"].attrs == {"k": "v"}
+    assert spans["boom"].status == "error"
+    assert "bad" in spans["boom"].attrs["error"]
+
+
+def test_span_without_context_is_dropped_but_safe():
+    with obs_trace.span("orphan") as sp:
+        pass
+    assert sp.duration_s is not None   # timed, just not collected
+
+
+def test_span_tree_assembly_under_concurrency():
+    """Spans created from 8 threads (explicit context hand-off, the
+    compute-thread contract) all land in one buffer and assemble into
+    one tree under the root."""
+    buf = obs_trace.TraceBuffer()
+    ctx = obs_trace.TraceContext(obs_trace.new_id(), None, buf)
+    with obs_trace.attach(ctx):
+        with obs_trace.span("root") as root:
+            snapshot = obs_trace.capture()
+
+            def work(i: int) -> None:
+                with obs_trace.attach(snapshot):
+                    with obs_trace.span(f"thread-{i}"):
+                        with obs_trace.span(f"leaf-{i}"):
+                            pass
+
+            threads = [threading.Thread(target=work, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    spans = buf.drain()
+    assert len(spans) == 17                      # root + 8x(thread+leaf)
+    assert {s.trace_id for s in spans} == {ctx.trace_id}
+    tree = obs_store.build_tree(
+        [{**s.to_dict(), "children": []} for s in spans])
+    [root_node] = [n for n in tree if n["name"] == "root"]
+    assert len(root_node["children"]) == 8
+    for child in root_node["children"]:
+        assert len(child["children"]) == 1
+        assert child["children"][0]["name"] == f"leaf-{child['name'][7:]}"
+    assert root.span_id == root_node["span_id"]
+
+
+def test_build_tree_breaks_parent_cycles():
+    """Worker-supplied parent ids are arbitrary: a mutual-parent cycle
+    must surface (earliest node promoted to root), never vanish or
+    recurse forever."""
+    def node(sid, pid):
+        return {"span_id": sid, "parent_id": pid, "name": sid,
+                "children": []}
+
+    a, b, c = node("a", "b"), node("b", "c"), node("c", "a")
+    ok = node("ok", None)
+    roots = obs_store.build_tree([ok, a, b, c])
+    seen = []
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        seen.append(n["span_id"])
+        stack.extend(n["children"])
+    assert sorted(seen) == ["a", "b", "c", "ok"], seen
+    assert {r["span_id"] for r in roots} == {"ok", "a"}
+
+
+def test_build_tree_orphans_surface_as_roots():
+    nodes = [
+        {"span_id": "a", "parent_id": None, "name": "root", "children": []},
+        {"span_id": "b", "parent_id": "missing", "name": "orphan",
+         "children": []},
+    ]
+    roots = obs_store.build_tree(nodes)
+    assert {n["name"] for n in roots} == {"root", "orphan"}
+
+
+def test_record_run_stages_synthesizes_leaves():
+    buf = obs_trace.TraceBuffer()
+    ctx = obs_trace.TraceContext(obs_trace.new_id(), None, buf)
+    with obs_trace.attach(ctx):
+        with obs_trace.span("worker.transcode") as tsp:
+            pass
+        obs_trace.record_run_stages(tsp, {
+            "entropy_s": 1.5, "device_pull_s": 0.25, "rung_360p_s": 0.75,
+            "pipeline_depth": 2, "host_occupancy": 1.4})
+    by_name = {s.name: s for s in buf.drain()}
+    assert by_name["stage.entropy"].duration_s == 1.5
+    assert by_name["stage.entropy"].parent_id == tsp.span_id
+    assert by_name["rung.360p"].duration_s == 0.75
+    assert tsp.attrs["pipeline_depth"] == 2
+    assert tsp.attrs["host_occupancy"] == 1.4
+
+
+# --------------------------------------------------------------------------
+# Full HTTP cycle: one trace id stitches server and worker
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def api(run, db, tmp_path):
+    video_dir = tmp_path / "srv-videos"
+    app = build_worker_app(db, video_dir=video_dir)
+    server = TestServer(app)
+    run(server.start_server())
+    base = str(server.make_url(""))
+    key = run(WorkerAPIClient.register(base, "obs-w1", accelerator="tpu"))
+    client = WorkerAPIClient(base, key, timeout=30.0, retries=1)
+    yield {"base": base, "client": client, "video_dir": video_dir, "db": db}
+    run(client.aclose())
+    run(server.close())
+
+
+def test_trace_stitches_full_remote_cycle(run, db, tmp_path, api):
+    """claim -> transcode -> upload -> complete over HTTP: one trace id
+    across server- and worker-origin spans; stage/rung leaves carry
+    durations; both the trace endpoint and /metrics expose it."""
+    src = make_y4m(tmp_path / "t.y4m", n_frames=8, width=64, height=48)
+    video = run(vids.create_video(db, "Traced", source_path=str(src)))
+    run(claims.enqueue_job(db, video["id"]))
+
+    worker = RemoteWorker(api["client"], name="obs-w1",
+                          work_dir=tmp_path / "work",
+                          progress_min_interval_s=0.0)
+    assert run(worker.poll_once()) is True
+    job = run(db.fetch_one(
+        "SELECT * FROM jobs WHERE video_id=:v AND kind='transcode'",
+        {"v": video["id"]}))
+    assert job["completed_at"] is not None, job["error"]
+
+    rows = run(db.fetch_all("SELECT * FROM job_spans WHERE job_id=:j",
+                            {"j": job["id"]}))
+    assert {r["trace_id"] for r in rows} == {rows[0]["trace_id"]}
+    assert {"server", "worker"} <= {r["origin"] for r in rows}
+    names = {r["name"] for r in rows}
+    assert {"job", "queue.wait", "server.claim", "worker.download",
+            "worker.transcode", "worker.upload", "server.complete",
+            "job.complete"} <= names
+    # the root closed with the job
+    root = next(r for r in rows if r["parent_id"] is None)
+    assert root["duration_s"] is not None and root["duration_s"] > 0
+
+    # trace endpoint returns the ordered tree with stage/rung leaves
+    admin = TestServer(build_admin_app(db, upload_dir=tmp_path / "up",
+                                       video_dir=api["video_dir"]))
+    run(admin.start_server())
+    import httpx
+
+    async def check():
+        async with httpx.AsyncClient(
+                base_url=str(admin.make_url(""))) as c:
+            r = await c.get(f"/api/jobs/{job['id']}/trace")
+            assert r.status_code == 200
+            body = r.json()
+            assert body["trace_id"] == rows[0]["trace_id"]
+
+            def walk(nodes, depth=0):
+                for n in nodes:
+                    yield n, depth
+                    yield from walk(n["children"], depth + 1)
+
+            flat = dict((n["name"], n) for n, _ in walk(body["spans"]))
+            stage_leaves = [n for n in flat.values()
+                            if n["name"].startswith("stage.")]
+            rung_leaves = [n for n in flat.values()
+                           if n["name"].startswith("rung.")]
+            assert stage_leaves and rung_leaves
+            assert all(n["duration_s"] is not None for n in stage_leaves)
+            assert all(n["duration_s"] is not None for n in rung_leaves)
+            assert not flat["worker.transcode"]["children"] == []
+            r404 = await c.get("/api/jobs/999999/trace")
+            assert r404.status_code == 404
+        # server /metrics: stage histograms (observed from the posted
+        # spans) + runtime counters + O(states) job gauges
+        async with httpx.AsyncClient(base_url=api["base"]) as c:
+            m = (await c.get("/metrics")).text
+            assert "vlog_stage_duration_seconds_bucket" in m
+            assert "vlog_rung_duration_seconds_bucket" in m
+            # the ingested (fleet) twins: proves the spans endpoint fed
+            # the server-side histograms — these are a separate family
+            # from the worker's own observations so scraping both
+            # endpoints never double-counts a run
+            assert "vlog_fleet_stage_duration_seconds_bucket" in m
+            assert "vlog_fleet_rung_duration_seconds_bucket" in m
+            assert 'vlog_jobs{state="completed"} 1' in m
+            assert "vlog_job_backoff_total" in m
+            assert "vlog_breaker_transitions_total" in m
+            assert "vlog_gc_runs_total" in m
+            assert "vlog_spans_recorded_total" in m
+
+    run(check())
+    run(admin.close())
+
+
+def test_spans_endpoint_requires_claim(run, db, tmp_path, api):
+    src = make_y4m(tmp_path / "s.y4m", n_frames=6, width=64, height=48)
+    video = run(vids.create_video(db, "Gated", source_path=str(src)))
+    run(claims.enqueue_job(db, video["id"]))
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE video_id=:v",
+                           {"v": video["id"]}))
+    from vlog_tpu.worker.remote import ClaimLost
+
+    with pytest.raises(ClaimLost):
+        run(api["client"].post_spans(job["id"], [{
+            "name": "worker.rogue", "span_id": "ab12", "started_at": 1.0,
+            "duration_s": 1.0, "attrs": {}}]))
+    assert run(db.fetch_all(
+        "SELECT * FROM job_spans WHERE job_id=:j AND origin='worker'",
+        {"j": job["id"]})) == []
+
+
+def test_worker_health_port_exposes_metrics(run):
+    """The new /metrics on WorkerHealthServer serves the runtime
+    registry — workers exported nothing before this route."""
+    import socket
+
+    from vlog_tpu.worker.health import WorkerHealthServer
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    async def go():
+        import httpx
+
+        async def ready():
+            return True, "ok"
+
+        health = WorkerHealthServer(ready, port=port, host="127.0.0.1")
+        assert await health.start()
+        try:
+            async with httpx.AsyncClient(
+                    base_url=f"http://127.0.0.1:{port}") as c:
+                text = (await c.get("/metrics")).text
+                assert "vlog_stage_duration_seconds" in text
+                assert "vlog_worker_jobs_total" in text
+                assert "vlog_breaker_state" in text
+                assert (await c.get("/health")).status_code == 200
+        finally:
+            await health.stop()
+
+    run(go())
+
+
+# --------------------------------------------------------------------------
+# Failpoint-induced failure -> error-tagged span (daemon path)
+# --------------------------------------------------------------------------
+
+def test_failpoint_failure_produces_error_span(run, db, tmp_path):
+    from vlog_tpu.worker.daemon import WorkerDaemon
+
+    video = run(vids.create_video(db, "Chaos",
+                                  source_path=str(tmp_path / "none.y4m")))
+    run(claims.enqueue_job(db, video["id"]))
+    daemon = WorkerDaemon(db, name="chaos-w", backend=None,
+                          video_dir=tmp_path / "out")
+    failpoints.arm("daemon.compute", count=1)
+    try:
+        assert run(daemon.poll_once()) is True
+    finally:
+        failpoints.reset()
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE video_id=:v",
+                           {"v": video["id"]}))
+    errs = run(db.fetch_all(
+        "SELECT * FROM job_spans WHERE job_id=:j AND status='error'",
+        {"j": job["id"]}))
+    assert errs, "failpoint failure left no error-tagged span"
+    names = {r["name"] for r in errs}
+    assert "worker.attempt" in names      # daemon-side, origin worker
+    assert "job.fail" in names            # claims-side marker
+    attempt = next(r for r in errs if r["name"] == "worker.attempt")
+    assert attempt["origin"] == "worker"
+    assert "failpoint" in attempt["attributes"]
+    # the armed fire was counted per site in the runtime registry
+    assert 'vlog_failpoint_fires_total{site="daemon.compute"}' \
+        in runtime().render_text()
+
+
+# --------------------------------------------------------------------------
+# Scrape cost + route-label cardinality
+# --------------------------------------------------------------------------
+
+def test_metrics_render_aggregates_in_sql(run, db):
+    async def seed():
+        for i, title in enumerate(["a", "b", "c"]):
+            v = await vids.create_video(db, title)
+            await claims.enqueue_job(db, v["id"])
+        await claims.claim_job(db, "w1")
+
+    run(seed())
+    text = run(Metrics().render(db))
+    assert 'vlog_jobs{state="claimed"} 1' in text
+    assert 'vlog_jobs{state="unclaimed"} 2' in text
+    assert "vlog_jobs_queued 2" in text
+    # the scrape must stay O(states): no full-table read into Python
+    src = Path(Metrics.render.__code__.co_filename).read_text()
+    assert "SELECT * FROM jobs" not in src
+
+
+def test_unmatched_routes_collapse_to_one_label(run, db, tmp_path):
+    app = build_worker_app(db, video_dir=tmp_path / "v")
+    server = TestServer(app)
+    run(server.start_server())
+    import httpx
+
+    async def go():
+        async with httpx.AsyncClient(base_url=str(server.make_url(""))) as c:
+            await c.get("/totally/bogus/path-1")
+            await c.get("/totally/bogus/path-2")
+            text = (await c.get("/metrics")).text
+            assert 'route="unmatched"' in text
+            assert "bogus" not in text
+
+    run(go())
+    run(server.close())
+
+
+# --------------------------------------------------------------------------
+# Previously write-only surfaces now feed the registry
+# --------------------------------------------------------------------------
+
+def test_breaker_transitions_counted():
+    from vlog_tpu.worker.breaker import CircuitBreaker
+
+    clock = [0.0]
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=10.0,
+                        clock=lambda: clock[0])
+    br.record_failure()                      # -> open
+    clock[0] = 20.0
+    assert br.allow()                        # -> half_open
+    br.record_success()                      # -> closed
+    text = runtime().render_text()
+    for state in ("open", "half_open", "closed"):
+        assert f'vlog_breaker_transitions_total{{state="{state}"}}' in text
+    assert "vlog_breaker_state 0.0" in text
+
+
+def test_alert_metrics_wired():
+    from vlog_tpu.jobs.alerts import AlertSink
+
+    sink = AlertSink(url="http://example.invalid/hook", min_interval_s=600)
+    assert sink._allowed("k") is True
+    assert sink._allowed("k") is False       # suppressed
+    assert sink.metrics.suppressed == 1
+    assert 'vlog_alerts_total{outcome="suppressed"}' \
+        in runtime().render_text()
+
+
+def test_daemon_stats_wired():
+    from vlog_tpu.worker.daemon import DaemonStats
+
+    stats = DaemonStats()
+    stats.bump("claimed")
+    stats.bump("completed")
+    assert (stats.claimed, stats.completed) == (1, 1)
+    text = runtime().render_text()
+    assert 'vlog_worker_jobs_total{event="claimed"}' in text
+    assert 'vlog_worker_jobs_total{event="completed"}' in text
+
+
+# --------------------------------------------------------------------------
+# Registry / docs agreement (the "new planes can't ship blind" lint)
+# --------------------------------------------------------------------------
+
+def _family_names(registry) -> set[str]:
+    names = set()
+    for fam in registry.collect():
+        names.add(fam.name + ("_total" if fam.type == "counter" else ""))
+    return names
+
+
+class TestObservabilityAgreement:
+    OBS_KNOBS = ("VLOG_TRACE_ENABLED", "VLOG_WORKER_HEALTH_PORT")
+    # span names every docs/dashboard consumer may rely on
+    SPAN_NAMES = ("queue.wait", "server.claim", "server.complete",
+                  "worker.download", "worker.attempt", "worker.transcode",
+                  "worker.upload", "job.complete", "job.fail")
+
+    def test_every_metric_family_documented(self):
+        readme = README.read_text()
+        names = _family_names(Metrics().registry) \
+            | _family_names(runtime().registry)
+        assert names, "registries produced no families"
+        for name in sorted(names):
+            assert name in readme, f"{name} missing from README"
+
+    def test_every_failpoint_site_has_metric_and_docs(self):
+        """Each SITES entry must be countable (the labeled fires
+        counter observes every site by construction — assert the hook
+        actually fires) and documented."""
+        readme = README.read_text()
+        m = runtime()
+        for site in failpoints.SITES:
+            assert site in readme, f"failpoint {site} missing from README"
+        failpoints.arm("claims.claim", count=1)
+        try:
+            with pytest.raises(failpoints.FailpointError):
+                failpoints.hit("claims.claim")
+        finally:
+            failpoints.reset()
+        assert 'vlog_failpoint_fires_total{site="claims.claim"}' \
+            in m.render_text()
+
+    def test_obs_knobs_parsed_and_documented(self):
+        cfg_src = Path(config.__file__).read_text()
+        health_src = Path(__file__).parent.parent.joinpath(
+            "vlog_tpu/worker/health.py").read_text()
+        readme = README.read_text()
+        parsed = set(re.findall(r'"(VLOG_[A-Z_]+)"', cfg_src + health_src))
+        for knob in self.OBS_KNOBS:
+            assert knob in parsed, f"{knob} not parsed anywhere"
+            assert knob in readme, f"{knob} missing from README"
+        assert isinstance(config.TRACE_ENABLED, bool)
+
+    def test_stage_and_span_names_documented(self):
+        readme = README.read_text()
+        for key in obs_trace.STAGE_KEYS:
+            assert f"stage.{key[:-2]}" in readme, \
+                f"stage span for {key} missing from README"
+        for name in self.SPAN_NAMES:
+            assert name in readme, f"span name {name} missing from README"

@@ -518,23 +518,29 @@ async def job_failure_history(request: web.Request) -> web.Response:
         {"failures": await claims.get_failure_history(db, job_id)})
 
 
+async def job_trace(request: web.Request) -> web.Response:
+    """The job's span tree (obs/store.py): enqueue -> queue wait ->
+    claim -> worker attempt (download / transcode / per-stage and
+    per-rung leaves / upload) -> completion, one trace id across
+    server and worker origins. Feeds the admin waterfall."""
+    from vlog_tpu.obs import store as obs_store
+
+    db = request.app[DB]
+    job_id = _path_id(request, "job_id")
+    job = await db.fetch_one("SELECT id FROM jobs WHERE id=:id",
+                             {"id": job_id})
+    if job is None:
+        return _json_error(404, "no such job")
+    out = await obs_store.fetch_trace(db, job_id)
+    return web.json_response({"job_id": job_id, **out})
+
+
 # The derived-state rules of jobs/state.py as one SQL CASE: counts and
 # per-state pages come from the database, so the queue browser scales to
 # the full history instead of the newest N rows (states are not stored —
-# db/schema.py jobs contract).
-_STATE_CASE = """
-    CASE
-      WHEN j.completed_at IS NOT NULL THEN 'completed'
-      WHEN j.failed_at IS NOT NULL THEN 'failed'
-      WHEN j.claimed_by IS NOT NULL AND (j.claim_expires_at IS NULL
-           OR j.claim_expires_at > :now) THEN 'claimed'
-      WHEN j.claimed_by IS NOT NULL THEN 'expired'
-      WHEN j.attempt > 0 AND j.next_retry_at IS NOT NULL
-           AND j.next_retry_at > :now THEN 'backoff'
-      WHEN j.attempt > 0 THEN 'retrying'
-      ELSE 'unclaimed'
-    END
-"""
+# db/schema.py jobs contract). One definition (jobs/state.py) also
+# serves the /metrics job-state gauges.
+_STATE_CASE = js.sql_state_case("j.")
 
 
 async def list_jobs(request: web.Request) -> web.Response:
@@ -801,8 +807,11 @@ async def requeue_job(request: web.Request) -> web.Response:
                    updated_at=:t
             WHERE id=:id
             """, {"t": db_now(), "id": job_id})
-        # fresh retry budget -> fresh post-mortem
+        # fresh retry budget -> fresh post-mortem (and a fresh trace:
+        # the old life's spans would graft onto the new waterfall)
         await tx.execute("DELETE FROM job_failures WHERE job_id=:id",
+                         {"id": job_id})
+        await tx.execute("DELETE FROM job_spans WHERE job_id=:id",
                          {"id": job_id})
     if JobKind(job["kind"]) is JobKind.TRANSCODE:
         await vids.set_status(db, job["video_id"], VideoStatus.PENDING)
@@ -1293,6 +1302,7 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_get("/api/jobs", list_jobs)
     r.add_get("/api/jobs/failed", failed_jobs)
     r.add_get("/api/jobs/{job_id:\\d+}/failures", job_failure_history)
+    r.add_get("/api/jobs/{job_id:\\d+}/trace", job_trace)
     r.add_post("/api/jobs/{job_id:\\d+}/requeue", requeue_job)
     r.add_get("/api/audit", audit_tail)
     r.add_get("/api/analytics/daily", analytics_daily)

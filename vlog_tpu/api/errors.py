@@ -79,6 +79,8 @@ def public_job_error(error: str | None) -> str | None:
 # --------------------------------------------------------------------------
 
 _REQ_ID_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+# trace/span ids are hex (obs/trace.py new_id); anything else is junk
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{8,64}$")
 
 # imported lazily at module top keeps errors.py usable without aiohttp?
 # no — every consumer is an aiohttp app; import plainly.
@@ -100,10 +102,21 @@ async def request_id_middleware(request, handler):
     if not _REQ_ID_RE.match(rid):
         rid = _uuid.uuid4().hex[:16]
     request["request_id"] = rid
+    # Trace propagation (obs/trace.py): honor caller-supplied trace
+    # context so a worker's HTTP hop joins the job's trace — handlers
+    # read request["trace_id"] / request["parent_span_id"] when they
+    # record server-side spans, and every response echoes the trace id
+    # so either end of the hop can be joined to the waterfall.
+    tid = (request.headers.get("X-Trace-Id") or "").strip().lower()
+    pid = (request.headers.get("X-Parent-Span") or "").strip().lower()
+    request["trace_id"] = tid if _TRACE_ID_RE.match(tid) else None
+    request["parent_span_id"] = pid if _TRACE_ID_RE.match(pid) else None
     try:
         resp = await handler(request)
     except _web.HTTPException as exc:
         exc.headers["X-Request-ID"] = rid
+        if request["trace_id"]:
+            exc.headers["X-Trace-Id"] = request["trace_id"]
         raise
     except Exception as exc:  # noqa: BLE001 — boundary conversion
         log.exception("unhandled error rid=%s %s %s", rid,
@@ -111,4 +124,6 @@ async def request_id_middleware(request, handler):
         resp = _web.json_response(
             {"error": sanitize_error(exc)}, status=500)
     resp.headers["X-Request-ID"] = rid
+    if request["trace_id"]:
+        resp.headers["X-Trace-Id"] = request["trace_id"]
     return resp

@@ -29,6 +29,11 @@ from vlog_tpu.db.retry import with_retries
 from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
 from vlog_tpu.jobs import claims, state as js, videos as vids
 from vlog_tpu.jobs.finalize import finalize_transcode, finalize_transcription
+from vlog_tpu.obs import store as obs_store
+# Metrics moved to the shared obs plane (obs/metrics.py) so every
+# process can use the same registry class; re-exported here because
+# this module is where existing embedders import it from.
+from vlog_tpu.obs.metrics import Metrics, runtime as obs_runtime
 from vlog_tpu.storage import integrity
 
 log = logging.getLogger("vlog_tpu.worker_api")
@@ -88,76 +93,12 @@ async def metrics_middleware(request: web.Request, handler):
 
 
 def _route_label(request: web.Request) -> str:
+    # Unmatched requests collapse to ONE label: labeling http_requests
+    # with the raw path would let any client mint unbounded metric
+    # series (classic cardinality bomb) — and the raw path is useless
+    # for dashboards anyway.
     info = request.match_info.route.resource
-    return info.canonical if info is not None else request.path
-
-
-class Metrics:
-    """Process-local Prometheus registry (one per app, test-safe)."""
-
-    def __init__(self) -> None:
-        from prometheus_client import CollectorRegistry, Counter
-
-        self.registry = CollectorRegistry()
-        self.http_requests = Counter(
-            "vlog_http_requests_total", "HTTP requests",
-            ["method", "route", "status"], registry=self.registry)
-        self.jobs_claimed = Counter(
-            "vlog_jobs_claimed_total", "Jobs claimed over HTTP",
-            ["kind"], registry=self.registry)
-        self.jobs_completed = Counter(
-            "vlog_jobs_completed_total", "Jobs completed over HTTP",
-            ["kind"], registry=self.registry)
-        self.jobs_failed = Counter(
-            "vlog_jobs_failed_total", "Job failures reported over HTTP",
-            ["kind"], registry=self.registry)
-        self.bytes_uploaded = Counter(
-            "vlog_upload_bytes_total", "Output bytes uploaded by workers",
-            registry=self.registry)
-        self.upload_digest_mismatch = Counter(
-            "vlog_upload_digest_mismatch_total",
-            "Uploads rejected for an X-Content-SHA256 mismatch (422)",
-            registry=self.registry)
-        self.upload_disk_rejected = Counter(
-            "vlog_upload_disk_rejected_total",
-            "Uploads rejected under disk pressure (507)",
-            registry=self.registry)
-        self.manifest_rejects = Counter(
-            "vlog_manifest_verify_failures_total",
-            "Completions rejected by outputs.json tree verification (422)",
-            registry=self.registry)
-
-    async def render(self, db: Database) -> str:
-        from prometheus_client import generate_latest
-
-        text = generate_latest(self.registry).decode()
-        # live job/worker gauges straight from the DB (scrape-time truth)
-        t = db_now()
-        rows = await db.fetch_all("SELECT * FROM jobs")
-        counts: dict[str, int] = {}
-        for r in rows:
-            st = js.derive_state(r, now=t).value
-            counts[st] = counts.get(st, 0) + 1
-        lines = ["# HELP vlog_jobs Jobs by derived state",
-                 "# TYPE vlog_jobs gauge"]
-        for st, n in sorted(counts.items()):
-            lines.append(f'vlog_jobs{{state="{st}"}} {n}')
-        # flat queue-depth gauge: what the worker HPA scales on
-        # (deploy/k8s/worker-autoscaling.yaml) — claimable work only;
-        # jobs waiting out retry backoff are deliberately excluded (they
-        # cannot be claimed yet, so they must not trigger scale-up)
-        queued = (counts.get("unclaimed", 0) + counts.get("retrying", 0)
-                  + counts.get("expired", 0))
-        lines.append("# HELP vlog_jobs_queued Jobs waiting for a worker")
-        lines.append("# TYPE vlog_jobs_queued gauge")
-        lines.append(f"vlog_jobs_queued {queued}")
-        online = await db.fetch_val(
-            "SELECT COUNT(*) FROM workers WHERE last_heartbeat_at > :cut",
-            {"cut": t - config.WORKER_OFFLINE_THRESHOLD_S})
-        lines.append("# HELP vlog_workers_online Workers with a fresh heartbeat")
-        lines.append("# TYPE vlog_workers_online gauge")
-        lines.append(f"vlog_workers_online {online or 0}")
-        return text + "\n".join(lines) + "\n"
+    return info.canonical if info is not None else "unmatched"
 
 
 # --------------------------------------------------------------------------
@@ -229,11 +170,28 @@ async def claim(request: web.Request) -> web.Response:
         return web.Response(status=204)
     video = await vids.get_video(db, row["video_id"])
     request.app[METRICS].jobs_claimed.labels(row["kind"]).inc()
+    # hand the worker the trace to join: its spans (shipped back via
+    # POST .../spans) parent under the job's root span. claim_job
+    # stashed the context on the row when it wrote the claim markers;
+    # re-derive only if that write failed. Best effort: the claim is
+    # already committed — a failing trace read must not turn this
+    # response into a 500 (the worker would re-claim a second job
+    # while this one idles to lease expiry).
+    trace_ctx = row.pop("_trace", None)
+    if trace_ctx is None and config.TRACE_ENABLED:
+        try:
+            trace_id, root, _ = await obs_store.ensure_root(
+                db, row["id"], created_at=row["created_at"])
+            trace_ctx = {"trace_id": trace_id, "parent_span_id": root}
+        except Exception:  # noqa: BLE001 — telemetry must not fail claims
+            log.warning("trace context for job %s unavailable", row["id"],
+                        exc_info=True)
     return web.json_response({
         "job": _job_payload(row),
         "video": {k: video[k] for k in
                   ("id", "slug", "title", "duration_s", "width", "height")}
         if video else None,
+        "trace": trace_ctx,
     })
 
 
@@ -260,6 +218,9 @@ async def progress(request: web.Request) -> web.Response:
 
 
 async def complete(request: web.Request) -> web.Response:
+    import time as _time
+
+    t_req, t0 = db_now(), _time.monotonic()
     body = await request.json()
     db = request.app[DB]
     job_id = int(request.match_info["job_id"])
@@ -370,6 +331,28 @@ async def complete(request: web.Request) -> web.Response:
                 await emit(name, payload)
             except Exception:
                 log.exception("event hook failed for %s", name)
+    if config.TRACE_ENABLED:
+        # the HTTP-plane view of completion: manifest verification +
+        # playlist validation + finalize, measured end to end. Parents
+        # under the worker's span when the request carried trace
+        # headers, else directly under the job root. Best effort: the
+        # completion is committed — a failing span write must not 500
+        # this response (the worker's retry would land 409 and report
+        # a successful job as lost).
+        try:
+            trace_id, root, _ = await obs_store.ensure_root(
+                db, job_id, created_at=job["created_at"])
+            await obs_store.record(
+                db, job_id, trace_id=trace_id,
+                parent_id=request.get("parent_span_id") or root,
+                name="server.complete", started_at=t_req,
+                duration_s=_time.monotonic() - t0,
+                attrs={"worker": worker, "kind": job["kind"],
+                       "request_id": request.get("request_id")})
+        except Exception:  # noqa: BLE001 — telemetry must not fail
+            # completions
+            log.warning("server.complete span for job %s dropped", job_id,
+                        exc_info=True)
     return web.json_response({"ok": True})
 
 
@@ -569,6 +552,110 @@ async def upload_status(request: web.Request) -> web.Response:
     return web.json_response({"files": files})
 
 
+_SPAN_ID_MAX = 64
+
+
+def _clean_span(raw: dict) -> dict | None:
+    """Validate one worker-reported span; None rejects it silently
+    (a malformed span must not fail the whole report — the rest of the
+    trace is still valuable)."""
+    import math
+
+    if not isinstance(raw, dict):
+        return None
+    name = str(raw.get("name") or "")[:obs_store.MAX_NAME_LEN]
+    try:
+        started = float(raw.get("started_at"))
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(started):
+        # json.loads admits bare Infinity/NaN — one such value would
+        # poison histogram sums and break the waterfall's time axis
+        return None
+    dur = raw.get("duration_s")
+    try:
+        dur = None if dur is None else max(0.0, float(dur))
+    except (TypeError, ValueError):
+        dur = None
+    if dur is not None and not math.isfinite(dur):
+        dur = None
+    span_id = str(raw.get("span_id") or "")[:_SPAN_ID_MAX]
+    parent_id = raw.get("parent_id")
+    parent_id = (str(parent_id)[:_SPAN_ID_MAX] if parent_id else None)
+    attrs = raw.get("attrs")
+    if not name or not span_id or not isinstance(attrs, (dict, type(None))):
+        return None
+    return {"name": name, "started_at": started, "duration_s": dur,
+            "span_id": span_id, "parent_id": parent_id,
+            "status": "error" if raw.get("status") == "error" else "ok",
+            "attrs": attrs or {}}
+
+
+async def post_spans(request: web.Request) -> web.Response:
+    """Worker-reported spans for a claimed job (the remote workers'
+    half of the trace; local daemons write job_spans directly).
+
+    Claim-gated like progress: only the current claim holder may attach
+    spans, and the server overrides the trace id with the job's own —
+    a confused worker cannot graft spans onto another trace. Stage
+    spans feed the server's stage-duration histograms, so the server
+    ``/metrics`` sees fleet-wide stage timings without a second scrape
+    hop to every worker.
+    """
+    db = request.app[DB]
+    job_id = int(request.match_info["job_id"])
+    job = await db.fetch_one("SELECT * FROM jobs WHERE id=:id",
+                             {"id": job_id})
+    if job is None:
+        return _json_error(404, "no such job")
+    try:
+        js.guard_progress(job, request[IDENTITY].worker_name, now=db_now())
+    except js.JobStateError as exc:
+        return _json_error(409, str(exc))
+    body = await request.json()
+    raw_spans = body.get("spans")
+    if not isinstance(raw_spans, list):
+        return _json_error(400, "spans must be a list")
+    spans = [s for s in map(_clean_span,
+                            raw_spans[:obs_store.MAX_SPANS_PER_REPORT])
+             if s is not None]
+    if not config.TRACE_ENABLED or not spans:
+        # With tracing off there is no stored-span dedupe, so a retried
+        # report could double-observe histograms — skip ingestion whole.
+        # Only the server's vlog_fleet_* view dims: each worker's own
+        # vlog_stage_*/vlog_rung_* histograms (health-port /metrics) are
+        # observed locally and never depend on span shipping.
+        return web.json_response({"ok": True, "stored": 0})
+    from vlog_tpu.obs.trace import STAGE_KEYS, Span
+
+    trace_id, _root, _ = await obs_store.ensure_root(
+        db, job_id, created_at=job["created_at"])
+    inserted = await obs_store.record_spans(
+        db, job_id, [Span(trace_id=trace_id, **sp) for sp in spans],
+        origin="worker", trace_id=trace_id)
+    fresh = set(inserted)
+    # Histograms: only genuinely-new spans (a retried report whose first
+    # response was lost must not double-observe), and labels come from
+    # CLOSED sets, never worker-chosen names — a hostile/buggy claim
+    # holder embedding per-job ids in span names must not mint unbounded
+    # series in the process registry (same cardinality rule as
+    # _route_label).
+    stage_ok = {k[:-2] for k in STAGE_KEYS}
+    rung_ok = set(config.LADDER_BY_NAME)
+    m = obs_runtime()
+    for sp in spans:
+        if sp["duration_s"] is None or sp["span_id"] not in fresh:
+            continue
+        fresh.discard(sp["span_id"])   # same id twice in one report
+        if sp["name"].startswith("stage.") and sp["name"][6:] in stage_ok:
+            m.fleet_stage_seconds.labels(
+                sp["name"][6:]).observe(sp["duration_s"])
+        elif sp["name"].startswith("rung.") and sp["name"][5:] in rung_ok:
+            m.fleet_rung_seconds.labels(
+                sp["name"][5:]).observe(sp["duration_s"])
+    return web.json_response({"ok": True, "stored": len(inserted)})
+
+
 async def poll_commands(request: web.Request) -> web.Response:
     """Remote workers pick up their management commands with the same
     cadence local daemons do (reference command_listener over pub/sub)."""
@@ -634,6 +721,7 @@ def build_worker_app(db: Database, video_dir: Path | None = None) -> web.Applica
     app.router.add_post("/api/worker/jobs/{job_id:\\d+}/complete", complete)
     app.router.add_post("/api/worker/jobs/{job_id:\\d+}/fail", fail)
     app.router.add_post("/api/worker/jobs/{job_id:\\d+}/release", release)
+    app.router.add_post("/api/worker/jobs/{job_id:\\d+}/spans", post_spans)
     app.router.add_get("/api/worker/source/{video_id:\\d+}", download_source)
     app.router.add_put("/api/worker/upload/{video_id:\\d+}/{tail:.+}", upload)
     app.router.add_get("/api/worker/upload/{video_id:\\d+}/status",

@@ -212,6 +212,16 @@ GC_DELETED_RETENTION_S: float = _env_float("VLOG_GC_DELETED_RETENTION",
                                            7 * 86400.0, lo=0.0)
 
 # --------------------------------------------------------------------------
+# Observability plane (obs/): job traces + the process-wide metrics
+# registry. Tracing writes one root span per job life plus claim/
+# complete markers and worker attempt spans to the job_spans table.
+# --------------------------------------------------------------------------
+
+# Gate for span creation/persistence (metrics are always on — a counter
+# bump is too cheap to gate). Off = no job_spans writes anywhere.
+TRACE_ENABLED: bool = _env_bool("VLOG_TRACE_ENABLED", True)
+
+# --------------------------------------------------------------------------
 # Transcription (reference: config.py:263-267)
 # --------------------------------------------------------------------------
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from vlog_tpu.db.core import Database, now
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 # Each entry: (version, [statements]). Append-only.
 MIGRATIONS: list[tuple[int, list[str]]] = [
@@ -347,6 +347,47 @@ MIGRATIONS: list[tuple[int, list[str]]] = [
             """,
             "CREATE INDEX IF NOT EXISTS idx_job_failures_job"
             " ON job_failures(job_id, id)",
+        ],
+    ),
+    (
+        6,
+        [
+            # -- trace plane (obs/) ------------------------------------------
+            # One trace per job life: the root row (parent_id IS NULL,
+            # name 'job') is minted at enqueue; claim/complete markers
+            # (jobs/claims.py) and worker attempt/stage/rung spans
+            # (worker daemon directly, remote workers via
+            # POST /api/worker/jobs/{id}/spans) parent under it. Rows
+            # are deleted with the other per-life tables on job
+            # reset/requeue, so a fresh life gets a fresh trace.
+            """
+            CREATE TABLE IF NOT EXISTS job_spans (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                job_id INTEGER NOT NULL REFERENCES jobs(id) ON DELETE CASCADE,
+                trace_id TEXT NOT NULL,
+                span_id TEXT NOT NULL,
+                parent_id TEXT,
+                name TEXT NOT NULL,
+                origin TEXT NOT NULL DEFAULT 'server',
+                started_at REAL NOT NULL,
+                duration_s REAL,
+                status TEXT NOT NULL DEFAULT 'ok',
+                attributes TEXT NOT NULL DEFAULT '{}',
+                created_at REAL NOT NULL,
+                UNIQUE (job_id, span_id),
+                CHECK (origin IN ('server','worker')),
+                CHECK (status IN ('ok','error'))
+            )
+            """,
+            "CREATE INDEX IF NOT EXISTS idx_job_spans_job"
+            " ON job_spans(job_id, started_at)",
+            "CREATE INDEX IF NOT EXISTS idx_job_spans_trace"
+            " ON job_spans(trace_id)",
+            # exactly one root per job: concurrent ensure_root callers
+            # (enqueue post-commit racing a fast claim) collapse onto
+            # one row instead of forking the trace
+            "CREATE UNIQUE INDEX IF NOT EXISTS idx_job_spans_root"
+            " ON job_spans(job_id) WHERE parent_id IS NULL",
         ],
     ),
 ]

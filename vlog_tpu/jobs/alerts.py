@@ -31,6 +31,16 @@ class AlertMetrics:
     suppressed: int = 0
     errors: int = 0
 
+    def bump(self, outcome: str) -> None:
+        """Count an outcome here AND in the process metrics registry
+        (``vlog_alerts_total{outcome}``) — these used to be write-only
+        fields nothing ever scraped."""
+        setattr(self, outcome, getattr(self, outcome) + 1)
+        from vlog_tpu.obs.metrics import runtime
+
+        runtime().alerts.labels(
+            {"errors": "error"}.get(outcome, outcome)).inc()
+
 
 @dataclass
 class AlertSink:
@@ -53,7 +63,7 @@ class AlertSink:
         now = time.monotonic()
         last = self._last_sent.get(key)
         if last is not None and now - last < self.min_interval_s:
-            self.metrics.suppressed += 1
+            self.metrics.bump("suppressed")
             return False
         self._last_sent[key] = now
         return True
@@ -81,9 +91,9 @@ class AlertSink:
             log.debug("alert %s failed: %s", alert, exc)
             ok = False
         if ok:
-            self.metrics.sent += 1
+            self.metrics.bump("sent")
         else:
-            self.metrics.errors += 1
+            self.metrics.bump("errors")
         return ok
 
     def send_fire_and_forget(self, alert: str, message: str,

@@ -24,15 +24,36 @@ reference's local transcoder bypassed the HTTP plane.
 from __future__ import annotations
 
 import json
+import logging
 import random
-from typing import Any
+from typing import Any, Awaitable, Callable
 
 from vlog_tpu import config
 from vlog_tpu.db.core import Database, Row, now as db_now
 from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
 from vlog_tpu.jobs import state as js
 from vlog_tpu.jobs.events import CH_JOBS, CH_PROGRESS, wake as _wake
+from vlog_tpu.obs import store as obs_store
+from vlog_tpu.obs.metrics import runtime as obs_runtime
 from vlog_tpu.utils import failpoints
+
+log = logging.getLogger("vlog_tpu.claims")
+
+
+async def _trace_write(label: str, fn: Callable[[], Awaitable[Any]]) -> None:
+    """Best-effort post-commit span write.
+
+    These run AFTER the state transaction committed, inside callables
+    that with_retries may re-run — a raising trace write would re-run
+    an already-applied claim/complete/fail (double-claim, or a
+    committed completion reported as 409/failure). Tracing is telemetry;
+    it must never alter job-plane outcomes.
+    """
+    try:
+        await fn()
+    except Exception:  # noqa: BLE001 — observability never fails the job
+        log.warning("trace write failed (%s); span dropped", label,
+                    exc_info=True)
 
 
 def retry_backoff_s(attempt: int, *, base: float | None = None,
@@ -176,7 +197,16 @@ async def enqueue_job(
                 "DELETE FROM job_failures WHERE job_id=:id",
                 {"id": existing["id"]},
             )
+            # fresh life -> fresh trace (same rule as job_failures)
+            await tx.execute(
+                "DELETE FROM job_spans WHERE job_id=:id",
+                {"id": existing["id"]},
+            )
             jid = int(existing["id"])
+    if config.TRACE_ENABLED:
+        # root span post-commit: the trace id every later hop joins
+        await _trace_write(
+            "enqueue", lambda: obs_store.ensure_root(db, jid, created_at=t))
     # after commit, so a woken claimant always sees the row
     _wake(db, CH_JOBS, {"job_id": jid, "kind": kind.value})
     return jid
@@ -309,6 +339,34 @@ async def claim_job(
     # terminal transitions the sweep performed, announced post-commit
     for jid in dead:
         _wake(db, CH_PROGRESS, {"job_id": jid, "event": "failed"})
+    if claimed is not None and config.TRACE_ENABLED:
+        # Trace anchors, post-commit (span writes must never grow the
+        # fleet's contention-point transaction, nor fail it — the
+        # claim is already committed, and a raising write here would
+        # make with_retries claim a SECOND job): the queue wait since
+        # the last state change, and the claim event itself.
+        async def _claim_spans() -> None:
+            trace_id, root, _ = await obs_store.ensure_root(
+                db, claimed["id"], created_at=claimed["created_at"])
+            # stash for the HTTP claim handler so it can hand the worker
+            # the trace context without re-reading the root row (rows
+            # are plain dicts; serializing callers pop the key)
+            claimed["_trace"] = {"trace_id": trace_id,
+                                 "parent_span_id": root}
+            wait_start = row["updated_at"] or row["created_at"] or t
+            await obs_store.record(
+                db, claimed["id"], trace_id=trace_id, parent_id=root,
+                name="queue.wait", started_at=wait_start,
+                duration_s=max(0.0, t - wait_start),
+                attrs={"attempt": claimed["attempt"]})
+            await obs_store.record(
+                db, claimed["id"], trace_id=trace_id, parent_id=root,
+                name="server.claim", started_at=t,
+                duration_s=max(0.0, db_now() - t),
+                attrs={"worker": worker_name, "kind": claimed["kind"],
+                       "attempt": claimed["attempt"]})
+
+        await _trace_write("claim", _claim_spans)
     return claimed
 
 
@@ -377,6 +435,17 @@ async def complete_job(db: Database, job_id: int, worker_name: str) -> Row:
         )
         out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         assert out is not None
+    if config.TRACE_ENABLED:
+        async def _complete_spans() -> None:
+            trace_id, root, _ = await obs_store.ensure_root(
+                db, job_id, created_at=out["created_at"])
+            await obs_store.close_root(db, job_id, t)
+            await obs_store.record(
+                db, job_id, trace_id=trace_id, parent_id=root,
+                name="job.complete", started_at=t, duration_s=0.0,
+                attrs={"worker": worker_name})
+
+        await _trace_write("complete", _complete_spans)
     _wake(db, CH_PROGRESS, {"job_id": job_id, "event": "completed"})
     return out
 
@@ -433,6 +502,24 @@ async def fail_job(
                               error, failure_class, t)
         out = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         assert out is not None
+    if not exhausted:
+        obs_runtime().job_backoff.inc()
+    if config.TRACE_ENABLED:
+        async def _fail_spans() -> None:
+            trace_id, root, _ = await obs_store.ensure_root(
+                db, job_id, created_at=out["created_at"])
+            if exhausted:
+                await obs_store.close_root(db, job_id, t)
+            await obs_store.record(
+                db, job_id, trace_id=trace_id, parent_id=root,
+                name="job.fail", started_at=t, duration_s=0.0,
+                status="error",
+                attrs={"worker": worker_name, "error": error[:300],
+                       "failure_class": failure_class.value,
+                       "terminal": exhausted,
+                       "attempt": row["attempt"] or 0})
+
+        await _trace_write("fail", _fail_spans)
     _wake(db, CH_PROGRESS, {"job_id": job_id,
                             "event": "failed" if exhausted else "retrying"})
     if not exhausted:

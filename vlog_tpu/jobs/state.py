@@ -102,6 +102,28 @@ SQL_EXPIRED_CLAIM = (
 )
 
 
+def sql_state_case(alias: str = "") -> str:
+    """The :func:`derive_state` rules as one SQL CASE expression
+    (caller supplies ``:now``). ``alias`` prefixes every column (e.g.
+    ``"j."``) for joined queries. One definition serves the admin queue
+    browser's per-state counts/filters AND the /metrics job-state
+    gauges, so the SQL and Python derivations cannot drift apart."""
+    a = alias
+    return f"""
+    CASE
+      WHEN {a}completed_at IS NOT NULL THEN 'completed'
+      WHEN {a}failed_at IS NOT NULL THEN 'failed'
+      WHEN {a}claimed_by IS NOT NULL AND ({a}claim_expires_at IS NULL
+           OR {a}claim_expires_at > :now) THEN 'claimed'
+      WHEN {a}claimed_by IS NOT NULL THEN 'expired'
+      WHEN {a}attempt > 0 AND {a}next_retry_at IS NOT NULL
+           AND {a}next_retry_at > :now THEN 'backoff'
+      WHEN {a}attempt > 0 THEN 'retrying'
+      ELSE 'unclaimed'
+    END
+    """
+
+
 # --------------------------------------------------------------------------
 # Transition guards — raise JobStateError on contract violations
 # --------------------------------------------------------------------------

@@ -1,0 +1,23 @@
+"""Observability plane: tracing + process-wide metrics.
+
+The first cross-process observability layer in the codebase. Three
+modules, deliberately dependency-light so every process (API servers,
+worker daemon, remote worker) can import them without dragging in HTTP
+frameworks or backends:
+
+- :mod:`vlog_tpu.obs.trace` — spans (ids, parent ids, attributes,
+  monotonic durations), thread- and asyncio-safe via contextvars, with
+  explicit context capture for compute threads and HTTP hops.
+- :mod:`vlog_tpu.obs.metrics` — the per-app HTTP :class:`Metrics`
+  registry (generalized out of ``api/worker_api.py``) plus the
+  process-wide :func:`runtime` registry every subsystem (breaker,
+  backoff, GC, alerts, failpoints, stage timings) reports into.
+- :mod:`vlog_tpu.obs.store` — persistence of spans to the ``job_spans``
+  table and span-tree assembly for ``GET /api/jobs/{id}/trace``.
+
+One trace id stitches a job's whole lifecycle: minted at enqueue
+(``job_spans`` root row), carried to workers in the claim response and
+on ``X-Trace-Id`` / ``X-Parent-Span`` headers, and joined back by
+worker-reported spans — so the admin waterfall shows where a job's
+wall-clock actually went, per stage and per rung.
+"""

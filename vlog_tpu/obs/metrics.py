@@ -1,0 +1,260 @@
+"""Unified metrics: per-app HTTP registry + process-wide runtime registry.
+
+Two registries on purpose:
+
+- :class:`Metrics` — the HTTP-plane families (request counts, claim /
+  complete / fail counters, upload integrity counters), one instance
+  per aiohttp app so tests get a fresh registry per server. This is the
+  class that used to live inside ``api/worker_api.py``; it now also
+  carries stage-duration histograms and appends the runtime registry
+  when rendering, so one scrape of the server ``/metrics`` sees both.
+- :func:`runtime` — ONE registry per process for everything that is not
+  an HTTP handler: stage-duration histograms, pipeline overlap gauges,
+  circuit-breaker transitions, retry-backoff entries, GC totals, alert
+  outcomes, failpoint fires, and worker job-lifecycle counts. The
+  worker daemon and remote worker have no HTTP app; this registry is
+  what their health server's ``/metrics`` route exposes, and what
+  previously write-only surfaces (``AlertMetrics``, ``DaemonStats``,
+  ``storage.gc.TOTALS``, ``failpoints.counters()``) now feed.
+
+Scrape cost: the DB-derived gauges in :meth:`Metrics.render` aggregate
+in SQL (``GROUP BY`` over the derived-state CASE, jobs/state.py) — one
+O(states) query per scrape, never a full-table read into Python.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+try:
+    from prometheus_client import (CollectorRegistry, Counter, Gauge,
+                                   Histogram, generate_latest)
+    HAVE_PROMETHEUS = True
+except ImportError:  # pragma: no cover — exercised only in minimal envs
+    # This module is imported by the whole job plane (claims, workers,
+    # CLI); prometheus-client must stay optional there. Without it,
+    # metric objects are no-ops and renders are empty — tracing and the
+    # job plane work unchanged.
+    HAVE_PROMETHEUS = False
+
+    class CollectorRegistry:                       # type: ignore[no-redef]
+        def collect(self):
+            return []
+
+    class _NoopMetric:
+        def __init__(self, *args, **kwargs):
+            pass
+
+        def labels(self, *args, **kwargs):
+            return self
+
+        def inc(self, *args):
+            pass
+
+        def observe(self, *args):
+            pass
+
+        def set(self, *args):
+            pass
+
+    Counter = Gauge = Histogram = _NoopMetric      # type: ignore[misc]
+
+    def generate_latest(_registry) -> bytes:       # type: ignore[no-redef]
+        return b""
+
+from vlog_tpu import config
+from vlog_tpu.obs.trace import STAGE_KEYS
+from vlog_tpu.utils import failpoints
+
+# Transcode stages run minutes at ladder scale; sub-second buckets catch
+# the sprite/transcription tail.
+STAGE_BUCKETS = (0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0)
+
+_BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
+
+
+class RuntimeMetrics:
+    """Process-wide registry (one per process; see :func:`runtime`)."""
+
+    def __init__(self) -> None:
+        self.registry = CollectorRegistry()
+        self.stage_seconds = Histogram(
+            "vlog_stage_duration_seconds",
+            "Per-stage busy seconds of one transcode run "
+            "(RunResult.stage_s fields)",
+            ["stage"], buckets=STAGE_BUCKETS, registry=self.registry)
+        self.rung_seconds = Histogram(
+            "vlog_rung_duration_seconds",
+            "Per-rung consume busy seconds of one transcode run",
+            ["rung"], buckets=STAGE_BUCKETS, registry=self.registry)
+        # The server's ingested view of worker-REPORTED spans is a
+        # separate family from the worker's own observations: a remote
+        # run lands in vlog_stage_* on its worker's health port and in
+        # vlog_fleet_stage_* on the server, so a Prometheus setup
+        # scraping both endpoints never double-counts a run inside one
+        # family's sum().
+        self.fleet_stage_seconds = Histogram(
+            "vlog_fleet_stage_duration_seconds",
+            "Per-stage busy seconds ingested from worker span reports",
+            ["stage"], buckets=STAGE_BUCKETS, registry=self.registry)
+        self.fleet_rung_seconds = Histogram(
+            "vlog_fleet_rung_duration_seconds",
+            "Per-rung consume busy seconds ingested from worker span reports",
+            ["rung"], buckets=STAGE_BUCKETS, registry=self.registry)
+        self.pipeline_gauges = Gauge(
+            "vlog_pipeline_gauge",
+            "Last run's pipeline overlap gauges (pipeline_depth, "
+            "max_in_flight, host_busy_s, host_wall_s, host_occupancy)",
+            ["name"], registry=self.registry)
+        self.breaker_transitions = Counter(
+            "vlog_breaker_transitions_total",
+            "Circuit-breaker state transitions", ["state"],
+            registry=self.registry)
+        self.breaker_state = Gauge(
+            "vlog_breaker_state",
+            "Current breaker state (0 closed, 1 half-open, 2 open)",
+            registry=self.registry)
+        self.job_backoff = Counter(
+            "vlog_job_backoff_total",
+            "Failed attempts stamped with retry backoff (next_retry_at)",
+            registry=self.registry)
+        self.worker_jobs = Counter(
+            "vlog_worker_jobs_total",
+            "Worker job lifecycle events (DaemonStats fields)",
+            ["event"], registry=self.registry)
+        self.gc_runs = Counter(
+            "vlog_gc_runs_total", "Orphan-GC sweeps run",
+            registry=self.registry)
+        self.gc_files_removed = Counter(
+            "vlog_gc_files_removed_total", "Entries reclaimed by GC sweeps",
+            registry=self.registry)
+        self.gc_bytes_reclaimed = Counter(
+            "vlog_gc_bytes_reclaimed_total", "Bytes reclaimed by GC sweeps",
+            registry=self.registry)
+        self.gc_errors = Counter(
+            "vlog_gc_errors_total", "Errors hit during GC sweeps",
+            registry=self.registry)
+        self.alerts = Counter(
+            "vlog_alerts_total", "Alert webhook outcomes (AlertMetrics)",
+            ["outcome"], registry=self.registry)
+        self.failpoint_fires = Counter(
+            "vlog_failpoint_fires_total", "Armed failpoint fires by site",
+            ["site"], registry=self.registry)
+        self.spans_recorded = Counter(
+            "vlog_spans_recorded_total", "Spans persisted to job_spans",
+            ["origin"], registry=self.registry)
+        # the fires counter must see every fire in the process, wherever
+        # the site lives — failpoints stays dependency-free, we observe
+        failpoints.add_observer(
+            lambda site: self.failpoint_fires.labels(site).inc())
+
+    def observe_run(self, stage_s: dict | None) -> None:
+        """Feed one RunResult.stage_s into histograms + overlap gauges."""
+        if not stage_s:
+            return
+        for key, val in stage_s.items():
+            try:
+                num = float(val)
+            except (TypeError, ValueError):
+                continue
+            if key in STAGE_KEYS:
+                self.stage_seconds.labels(key[:-2]).observe(num)
+            elif key.startswith("rung_") and key.endswith("_s"):
+                self.rung_seconds.labels(key[5:-2]).observe(num)
+            else:
+                self.pipeline_gauges.labels(key).set(num)
+
+    def observe_breaker(self, state: str) -> None:
+        """Record a breaker transition (worker/breaker.py calls this)."""
+        self.breaker_transitions.labels(state).inc()
+        self.breaker_state.set(_BREAKER_STATE_VALUES.get(state, -1))
+
+    def render_text(self) -> str:
+        return generate_latest(self.registry).decode()
+
+
+_runtime: RuntimeMetrics | None = None
+_runtime_lock = threading.Lock()
+
+
+def runtime() -> RuntimeMetrics:
+    """The process-wide runtime registry (lazy singleton)."""
+    global _runtime
+    if _runtime is None:
+        with _runtime_lock:
+            if _runtime is None:
+                _runtime = RuntimeMetrics()
+    return _runtime
+
+
+class Metrics:
+    """HTTP-plane Prometheus registry (one per app, test-safe)."""
+
+    def __init__(self) -> None:
+        self.registry = CollectorRegistry()
+        self.http_requests = Counter(
+            "vlog_http_requests_total", "HTTP requests",
+            ["method", "route", "status"], registry=self.registry)
+        self.jobs_claimed = Counter(
+            "vlog_jobs_claimed_total", "Jobs claimed over HTTP",
+            ["kind"], registry=self.registry)
+        self.jobs_completed = Counter(
+            "vlog_jobs_completed_total", "Jobs completed over HTTP",
+            ["kind"], registry=self.registry)
+        self.jobs_failed = Counter(
+            "vlog_jobs_failed_total", "Job failures reported over HTTP",
+            ["kind"], registry=self.registry)
+        self.bytes_uploaded = Counter(
+            "vlog_upload_bytes_total", "Output bytes uploaded by workers",
+            registry=self.registry)
+        self.upload_digest_mismatch = Counter(
+            "vlog_upload_digest_mismatch_total",
+            "Uploads rejected for an X-Content-SHA256 mismatch (422)",
+            registry=self.registry)
+        self.upload_disk_rejected = Counter(
+            "vlog_upload_disk_rejected_total",
+            "Uploads rejected under disk pressure (507)",
+            registry=self.registry)
+        self.manifest_rejects = Counter(
+            "vlog_manifest_verify_failures_total",
+            "Completions rejected by outputs.json tree verification (422)",
+            registry=self.registry)
+
+    async def render(self, db: Any) -> str:
+        """One scrape: app registry + DB gauges + the runtime registry.
+
+        The job-state gauges aggregate in SQL (GROUP BY over the
+        derived-state CASE) so scrape cost is O(states), not O(jobs).
+        """
+        # lazy: jobs/claims imports this module, so a module-level
+        # jobs.state import would be circular when obs loads first
+        from vlog_tpu.db.core import now as db_now
+        from vlog_tpu.jobs import state as js
+
+        text = generate_latest(self.registry).decode()
+        t = db_now()
+        state_rows = await db.fetch_all(
+            f"SELECT {js.sql_state_case()} AS state, COUNT(*) AS n "
+            "FROM jobs GROUP BY state", {"now": t})
+        counts = {r["state"]: int(r["n"] or 0) for r in state_rows}
+        lines = ["# HELP vlog_jobs Jobs by derived state",
+                 "# TYPE vlog_jobs gauge"]
+        for st, n in sorted(counts.items()):
+            lines.append(f'vlog_jobs{{state="{st}"}} {n}')
+        # flat queue-depth gauge: what the worker HPA scales on
+        # (deploy/k8s/worker-autoscaling.yaml) — claimable work only;
+        # jobs waiting out retry backoff are deliberately excluded (they
+        # cannot be claimed yet, so they must not trigger scale-up)
+        queued = (counts.get("unclaimed", 0) + counts.get("retrying", 0)
+                  + counts.get("expired", 0))
+        lines.append("# HELP vlog_jobs_queued Jobs waiting for a worker")
+        lines.append("# TYPE vlog_jobs_queued gauge")
+        lines.append(f"vlog_jobs_queued {queued}")
+        online = await db.fetch_val(
+            "SELECT COUNT(*) FROM workers WHERE last_heartbeat_at > :cut",
+            {"cut": t - config.WORKER_OFFLINE_THRESHOLD_S})
+        lines.append("# HELP vlog_workers_online Workers with a fresh heartbeat")
+        lines.append("# TYPE vlog_workers_online gauge")
+        lines.append(f"vlog_workers_online {online or 0}")
+        return text + "\n".join(lines) + "\n" + runtime().render_text()

@@ -318,6 +318,13 @@ class PipelineExecutor:
         self.prof_add("device_pull_s", time.perf_counter() - t0)
         failpoints.hit("backend.entropy")
         self._process(rname, batch, host)
+        # Per-rung consume busy seconds (pull + entropy + package for
+        # this rung's batches). Flows into RunResult.stage_s as
+        # ``rung_<name>_s`` so the trace plane can attribute time per
+        # ladder rung; NOT a _BUSY_KEYS member — the global stage sums
+        # already count this time, adding it again would double the
+        # occupancy numerator.
+        self.prof_add(f"rung_{rname}_s", time.perf_counter() - t0)
 
     def _fail(self, exc: BaseException) -> None:
         with self._cond:

@@ -286,6 +286,16 @@ def _record(report: GCReport) -> None:
             TOTALS["files_removed"] += len(report.removed)
             TOTALS["bytes_reclaimed"] += report.bytes_reclaimed
         TOTALS["errors"] += len(report.errors)
+    # mirror the totals into the process metrics registry so GC health
+    # is scrapeable, not just visible in the admin report endpoint
+    from vlog_tpu.obs.metrics import runtime
+
+    m = runtime()
+    m.gc_runs.inc()
+    if not report.dry_run:
+        m.gc_files_removed.inc(len(report.removed))
+        m.gc_bytes_reclaimed.inc(report.bytes_reclaimed)
+    m.gc_errors.inc(len(report.errors))
     if report.removed or report.errors:
         log.info("gc%s: removed=%d bytes=%d errors=%d",
                  " (dry-run)" if report.dry_run else "",

@@ -113,6 +113,18 @@ _active: dict[str, _Failpoint] = {}
 _lock = threading.Lock()
 _rng = random.Random(0)
 
+# Fire observers: called with the site name on every fire, outside the
+# lock and before the raise. This keeps failpoints dependency-free while
+# letting the metrics plane (obs/metrics.py) count fires per site —
+# observers must never raise (they are fault *instrumentation*).
+_observers: list = []
+
+
+def add_observer(fn) -> None:
+    """Register a ``fn(site: str)`` called on every failpoint fire."""
+    if fn not in _observers:
+        _observers.append(fn)
+
 
 def arm(site: str, *, count: int | None = None, prob: float | None = None,
         skip: int = 0) -> None:
@@ -198,6 +210,11 @@ def hit(site: str) -> None:
         if fp.prob is not None and _rng.random() >= fp.prob:
             return
         fp.fires += 1
+    for fn in list(_observers):
+        try:
+            fn(site)
+        except Exception:  # noqa: BLE001 — instrumentation never masks
+            pass           # the injected fault
     raise FailpointError(site)
 
 

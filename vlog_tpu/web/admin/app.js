@@ -1044,7 +1044,8 @@ async function loadQueuePage(more) {
     }
     cells(tr, [`#${jb.id}`, jb.title, jb.kind, state,
       jb.attempt, prog, jb.current_step || "—", jb.claimed_by || "—",
-      fmtAgo(jb.updated_at)]);
+      fmtAgo(jb.updated_at),
+      actionBtn("trace", async () => showTrace(jb.id))]);
     tb.appendChild(tr);
   }
   $("queue-empty").hidden = tb.rows.length > 0;
@@ -1054,6 +1055,66 @@ async function loadQueuePage(more) {
 $("q-refresh").onclick = () => loadQueue();
 $("q-more").onclick = () => loadQueue(true);
 $("q-state").addEventListener("change", () => loadQueue());
+$("trace-close").onclick = () => { $("trace-panel").hidden = true; };
+
+/* -- trace waterfall: GET /api/jobs/{id}/trace -> horizontal timeline -- */
+
+function flattenSpans(nodes, depth, out) {
+  for (const n of nodes) {
+    out.push([n, depth]);
+    flattenSpans(n.children || [], depth + 1, out);
+  }
+  return out;
+}
+
+function fmtSecs(s) {
+  if (s == null) return "";
+  if (s < 0.001) return "<1ms";
+  if (s < 1) return `${Math.round(s * 1000)}ms`;
+  if (s < 120) return `${s.toFixed(s < 10 ? 2 : 1)}s`;
+  return `${(s / 60).toFixed(1)}m`;
+}
+
+async function showTrace(jobId) {
+  const d = await api(`/api/jobs/${jobId}/trace`);
+  const flat = flattenSpans(d.spans || [], 0, []);
+  $("trace-panel").hidden = false;
+  $("trace-title").textContent =
+    `Trace for job #${jobId}` + (d.trace_id ? ` · ${d.trace_id}` : "");
+  const wrap = $("trace-rows");
+  wrap.textContent = "";
+  $("trace-empty").hidden = flat.length > 0;
+  if (!flat.length) return;
+  // absolute axis: earliest span start -> latest known end
+  const t0 = Math.min(...flat.map(([n]) => n.started_at));
+  const t1 = Math.max(...flat.map(([n]) => n.started_at + (n.duration_s || 0)));
+  const total = Math.max(t1 - t0, 1e-6);
+  for (const [n, depth] of flat) {
+    const row = document.createElement("div");
+    row.className = "wf-row";
+    const label = document.createElement("div");
+    label.className = "wf-label";
+    label.style.paddingLeft = `${depth * 14}px`;
+    label.textContent = n.name;
+    label.title = `${n.name} (${n.origin})\n` +
+      JSON.stringify(n.attrs, null, 1);
+    const track = document.createElement("div");
+    track.className = "wf-track";
+    const bar = document.createElement("div");
+    bar.className = "wf-bar" + (n.status === "error" ? " error" : "") +
+      (n.attrs && n.attrs.synthetic ? " synthetic" : "");
+    const left = ((n.started_at - t0) / total) * 100;
+    const width = ((n.duration_s || 0) / total) * 100;
+    bar.style.left = `${Math.min(left, 99.5).toFixed(2)}%`;
+    bar.style.width = `${Math.max(width, 0.5).toFixed(2)}%`;
+    track.appendChild(bar);
+    const dur = document.createElement("div");
+    dur.className = "wf-dur dim";
+    dur.textContent = n.duration_s != null ? fmtSecs(n.duration_s) : "·";
+    row.append(label, track, dur);
+    wrap.appendChild(row);
+  }
+}
 
 /* ------------------------------------------------- audit -------------- */
 

@@ -69,6 +69,7 @@ class CircuitBreaker:
         if self._state is BreakerState.OPEN:
             if self._clock() - self._opened_at >= self.cooldown_s:
                 self._state = BreakerState.HALF_OPEN
+                self._observe()
                 log.info("breaker half-open: sending probe")
                 return True
             return False
@@ -85,11 +86,13 @@ class CircuitBreaker:
         if self._state is BreakerState.HALF_OPEN:
             self._state = BreakerState.OPEN
             self._opened_at = self._clock() - self.cooldown_s
+            self._observe()
 
     def record_success(self) -> None:
         if self._state is not BreakerState.CLOSED:
             log.info("breaker closed: probe succeeded")
-        self._state = BreakerState.CLOSED
+            self._state = BreakerState.CLOSED
+            self._observe()
         self._consecutive_failures = 0
 
     def record_failure(self) -> None:
@@ -105,9 +108,17 @@ class CircuitBreaker:
         self._state = BreakerState.OPEN
         self._opened_at = self._clock()
         self.opens += 1
+        self._observe()
         log.warning(
             "breaker OPEN after %d consecutive failures; pausing claims "
             "for %.0fs", self._consecutive_failures, self.cooldown_s)
+
+    def _observe(self) -> None:
+        """Report the transition to the process metrics registry (the
+        breaker used to be visible only through the stats command)."""
+        from vlog_tpu.obs.metrics import runtime
+
+        runtime().observe_breaker(self._state.value)
 
     def snapshot(self) -> dict:
         """Stats-command / heartbeat surface."""

@@ -61,6 +61,15 @@ class DaemonStats:
     released: int = 0
     last_error: str | None = None
 
+    def bump(self, event: str, n: int = 1) -> None:
+        """Count a lifecycle event here AND in the process metrics
+        registry (``vlog_worker_jobs_total{event}``) — these used to be
+        write-only fields only the stats command could see."""
+        setattr(self, event, getattr(self, event) + n)
+        from vlog_tpu.obs.metrics import runtime
+
+        runtime().worker_jobs.labels(event).inc(n)
+
 
 # Async event hook: (event_name, payload) — wired to webhook delivery.
 EventFn = Callable[[str, dict], Awaitable[None]]
@@ -329,7 +338,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
             except js.JobStateError:
                 pass
             return False
-        self.stats.claimed += 1
+        self.stats.bump("claimed")
         self._cancel.clear()
         self._cancel_reason = ""
         self._current_job_id = job["id"]
@@ -352,7 +361,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
         if video is None:
             await claims.fail_job(self.db, job["id"], self.name,
                                   "video row vanished", permanent=True)
-            self.stats.failed += 1
+            self.stats.bump("failed")
             return
         handler = {
             JobKind.TRANSCODE: self._run_transcode,
@@ -360,47 +369,99 @@ class WorkerDaemon(ComputeWatchdogMixin):
             JobKind.SPRITE: self._run_sprites,
             JobKind.TRANSCRIPTION: self._run_transcription,
         }[kind]
-        failed_before = self.stats.failed
+        # Trace the attempt: a local daemon shares the server's DB, so
+        # its spans (worker origin) go straight into job_spans under the
+        # job's root span — the same tree a remote worker ships over
+        # the spans endpoint.
+        from vlog_tpu.obs import store as obs_store, trace as obs_trace
+
+        tctx = None
+        stashed = job.pop("_trace", None)   # claim_job left us the root
+        if config.TRACE_ENABLED and stashed is not None:
+            tctx = obs_trace.TraceContext(stashed["trace_id"],
+                                          stashed["parent_span_id"],
+                                          obs_trace.TraceBuffer())
+        elif config.TRACE_ENABLED:
+            try:
+                trace_id, root, _ = await obs_store.ensure_root(
+                    self.db, job["id"], created_at=job["created_at"])
+                tctx = obs_trace.TraceContext(trace_id, root,
+                                              obs_trace.TraceBuffer())
+            except Exception:  # noqa: BLE001 — a failed root mint must
+                # not abandon the claimed job (it would idle to lease
+                # expiry and be misattributed worker_crash); run untraced
+                log.warning("trace root for job %s unavailable; running "
+                            "untraced", job["id"], exc_info=True)
         try:
-            failpoints.hit("daemon.compute")
-            await handler(job, video)
-            # A handler can return normally after dead-lettering a DATA
-            # problem internally (missing source, duration cap, bad
-            # payload) — that says nothing about compute health, so it
-            # must neither close a half-open breaker nor count against
-            # it (poll_once's finally releases any probe). Only a run
-            # with no failure recorded is a success.
-            if self.stats.failed == failed_before:
-                self.breaker.record_success()
-        except JobCancelled as exc:
-            if self._stop.is_set():
-                # Graceful shutdown: hand the claim back, attempt refunded.
-                # The lease may have lapsed (or been reclaimed) while the
-                # compute thread wound down — then there is nothing to
-                # release and the job is already claimable elsewhere.
+            with obs_trace.attach(tctx):
+                await self._run_attempt(job, video, handler)
+        finally:
+            if tctx is not None:
                 try:
-                    await claims.release_job(self.db, job["id"], self.name)
-                    self.stats.released += 1
-                    log.info("released job %s on shutdown", job["id"])
-                except js.JobStateError as rel_exc:
-                    log.warning("shutdown release of job %s skipped: %s",
-                                job["id"], rel_exc)
-            else:
+                    await obs_store.record_spans(
+                        self.db, job["id"], tctx.buffer.drain(),
+                        trace_id=tctx.trace_id)
+                except Exception:  # noqa: BLE001 — tracing must never
+                    # take the worker down with the job
+                    log.exception("span persistence failed for job %s",
+                                  job["id"])
+
+    async def _run_attempt(self, job: Row, video: Row, handler) -> None:
+        from vlog_tpu.obs import trace as obs_trace
+
+        failed_before = self.stats.failed
+        with obs_trace.span("worker.attempt", worker=self.name,
+                            kind=job["kind"], attempt=job["attempt"]) as att:
+            try:
+                failpoints.hit("daemon.compute")
+                await handler(job, video)
+                # A handler can return normally after dead-lettering a DATA
+                # problem internally (missing source, duration cap, bad
+                # payload) — that says nothing about compute health, so it
+                # must neither close a half-open breaker nor count against
+                # it (poll_once's finally releases any probe). Only a run
+                # with no failure recorded is a success.
+                if self.stats.failed == failed_before:
+                    self.breaker.record_success()
+                else:
+                    att.set_error(self.stats.last_error or "dead-lettered")
+            except JobCancelled as exc:
+                if self._stop.is_set():
+                    # Graceful shutdown: hand the claim back, attempt
+                    # refunded. The lease may have lapsed (or been
+                    # reclaimed) while the compute thread wound down — then
+                    # there is nothing to release and the job is already
+                    # claimable elsewhere.
+                    try:
+                        await claims.release_job(self.db, job["id"],
+                                                 self.name)
+                        att.attrs["released"] = True
+                        self.stats.bump("released")
+                        log.info("released job %s on shutdown", job["id"])
+                    except js.JobStateError as rel_exc:
+                        att.attrs["release_skipped"] = str(rel_exc)[:200]
+                        log.warning("shutdown release of job %s skipped: %s",
+                                    job["id"], rel_exc)
+                else:
+                    att.set_error(f"cancelled: {exc.reason}")
+                    self.breaker.record_failure()
+                    fc = (FailureClass.STALLED
+                          if exc.reason.startswith("stalled")
+                          else FailureClass.TRANSIENT)
+                    await self._fail(job, video, f"cancelled: {exc.reason}",
+                                     failure_class=fc)
+            except js.JobStateError as exc:
+                # Lost the claim (lease lapsed + reclaimed); nothing to
+                # write. Not a breaker event: contention, not compute health.
+                att.set_error(f"claim lost: {exc}")
+                log.warning("job %s claim lost: %s", job["id"], exc)
+                self.stats.last_error = str(exc)
+            except Exception as exc:  # noqa: BLE001 — worker must survive
+                # any job
+                att.set_error(f"{type(exc).__name__}: {exc}")
+                log.exception("job %s failed", job["id"])
                 self.breaker.record_failure()
-                fc = (FailureClass.STALLED
-                      if exc.reason.startswith("stalled")
-                      else FailureClass.TRANSIENT)
-                await self._fail(job, video, f"cancelled: {exc.reason}",
-                                 failure_class=fc)
-        except js.JobStateError as exc:
-            # Lost the claim (lease lapsed + reclaimed); nothing to write.
-            # Not a breaker event: contention, not compute health.
-            log.warning("job %s claim lost: %s", job["id"], exc)
-            self.stats.last_error = str(exc)
-        except Exception as exc:  # noqa: BLE001 — worker must survive any job
-            log.exception("job %s failed", job["id"])
-            self.breaker.record_failure()
-            await self._fail(job, video, f"{type(exc).__name__}: {exc}")
+                await self._fail(job, video, f"{type(exc).__name__}: {exc}")
 
     async def _fail(self, job: Row, video: Row, error: str, *,
                     permanent: bool = False,
@@ -408,7 +469,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
         row = await claims.fail_job(self.db, job["id"], self.name, error,
                                     permanent=permanent,
                                     failure_class=failure_class)
-        self.stats.failed += 1
+        self.stats.bump("failed")
         self.stats.last_error = error
         terminal = row["failed_at"] is not None
         if terminal and JobKind(job["kind"]) is JobKind.TRANSCODE:
@@ -492,7 +553,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
                                   "video exceeds duration cap", permanent=True)
             await vids.set_status(self.db, video["id"], VideoStatus.FAILED,
                                   error="video exceeds duration cap")
-            self.stats.failed += 1
+            self.stats.bump("failed")
             return
 
         rungs = config.ladder_for_source(info.height)
@@ -508,7 +569,16 @@ class WorkerDaemon(ComputeWatchdogMixin):
             return process_video(source, out_dir, backend=self.backend,
                                  progress_cb=cb, rungs=rungs)
 
-        result = await self._run_with_timeout(work, timeout, "transcode")
+        from vlog_tpu.obs import trace as obs_trace
+        from vlog_tpu.obs.metrics import runtime as obs_runtime
+
+        with obs_trace.span("worker.transcode",
+                            rungs=[r.name for r in rungs]) as tsp:
+            result = await self._run_with_timeout(work, timeout, "transcode")
+        # stage busy-sums + per-rung times -> trace leaves; histograms
+        # feed this process's /metrics on the worker health port
+        obs_trace.record_run_stages(tsp, result.run.stage_s)
+        obs_runtime().observe_run(result.run.stage_s)
 
         qualities = [
             {**q, "playlist_path": str(out_dir / q["quality"] / "playlist.m3u8")}
@@ -520,7 +590,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
             self.db, job, video, probe=result.source, qualities=qualities,
             thumbnail_path=result.run.thumbnail_path)
         await claims.complete_job(self.db, job["id"], self.name)
-        self.stats.completed += 1
+        self.stats.bump("completed")
         await self._emit("video.ready", {
             "video_id": video["id"], "slug": video["slug"],
             "qualities": [q["quality"] for q in result.qualities]})
@@ -564,7 +634,14 @@ class WorkerDaemon(ComputeWatchdogMixin):
                                  write_manifest=False,
                                  streaming_format=fmt, codec=codec)
 
-        result = await self._run_with_timeout(work, timeout, "reencode")
+        from vlog_tpu.obs import trace as obs_trace
+        from vlog_tpu.obs.metrics import runtime as obs_runtime
+
+        with obs_trace.span("worker.transcode", rungs=[r.name for r in rungs],
+                            streaming_format=fmt, codec=codec) as tsp:
+            result = await self._run_with_timeout(work, timeout, "reencode")
+        obs_trace.record_run_stages(tsp, result.run.stage_s)
+        obs_runtime().observe_run(result.run.stage_s)
         # Drop the previous format's leftovers so clients can never follow
         # stale manifests into a mixed tree.
         _cleanup_other_format(out_dir, fmt)
@@ -586,7 +663,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
             thumbnail_path=result.run.thumbnail_path,
             streaming_format=fmt, codec=codec, enqueue_downstream=False)
         await claims.complete_job(self.db, job["id"], self.name)
-        self.stats.completed += 1
+        self.stats.bump("completed")
         await self._emit("video.reencoded", {
             "video_id": video["id"], "slug": video["slug"],
             "streaming_format": fmt, "codec": codec})
@@ -608,7 +685,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
 
         result = await self._run_with_timeout(work, timeout, "sprites")
         await claims.complete_job(self.db, job["id"], self.name)
-        self.stats.completed += 1
+        self.stats.bump("completed")
         await self._emit("video.sprites_ready", {
             "video_id": video["id"], "slug": video["slug"],
             "sheets": result.sheet_count})
@@ -660,7 +737,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
             self.db, video["id"], language=result.language,
             model=result.model, vtt_path=result.vtt_path, text=result.text)
         await claims.complete_job(self.db, job["id"], self.name)
-        self.stats.completed += 1
+        self.stats.bump("completed")
         await self._emit("video.transcribed", {
             "video_id": video["id"], "slug": video["slug"],
             "language": result.language})

@@ -72,6 +72,7 @@ class WorkerHealthServer:
         app = web.Application()
         app.router.add_get("/health", self._health)
         app.router.add_get("/ready", self._ready)
+        app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -95,3 +96,14 @@ class WorkerHealthServer:
             ok, detail = False, f"{type(exc).__name__}: {exc}"
         return web.json_response({"ready": ok, "detail": detail},
                                  status=200 if ok else 503)
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        """The worker process's share of the fleet's metrics: stage
+        histograms, breaker/backoff, job lifecycle counts, GC totals,
+        alert outcomes, failpoint fires (obs/metrics.py runtime
+        registry). Worker daemons and remote workers have no HTTP app
+        of their own — before this route they exported nothing."""
+        from vlog_tpu.obs.metrics import runtime
+
+        return web.Response(text=runtime().render_text(),
+                            content_type="text/plain")

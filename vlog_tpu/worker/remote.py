@@ -30,6 +30,8 @@ import httpx
 from vlog_tpu import config
 from vlog_tpu.codecs import validate_codec_format
 from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
+from vlog_tpu.obs import trace as obs_trace
+from vlog_tpu.obs.metrics import runtime as obs_runtime
 from vlog_tpu.storage import integrity
 from vlog_tpu.utils import failpoints
 from vlog_tpu.worker.breaker import CircuitBreaker
@@ -92,7 +94,23 @@ class WorkerAPIClient:
             r.raise_for_status()
             return r.json()["api_key"]
 
+    @staticmethod
+    def _trace_headers() -> dict[str, str]:
+        """Propagate the active trace across the HTTP hop (the server's
+        request-id middleware honors X-Trace-Id / X-Parent-Span, so its
+        spans for this call join the job's trace)."""
+        ctx = obs_trace.current()
+        if ctx is None:
+            return {}
+        headers = {"X-Trace-Id": ctx.trace_id}
+        if ctx.span_id:
+            headers["X-Parent-Span"] = ctx.span_id
+        return headers
+
     async def _request(self, method: str, path: str, **kw) -> httpx.Response:
+        headers = {**self._trace_headers(), **(kw.pop("headers", None) or {})}
+        if headers:
+            kw["headers"] = headers
         delay = 0.5
         for attempt in range(self.retries + 1):
             try:
@@ -198,7 +216,7 @@ class WorkerAPIClient:
 
         delay = 0.5
         url = f"/api/worker/upload/{video_id}/{rel}"
-        headers = {"X-Content-SHA256": digest}
+        headers = {"X-Content-SHA256": digest, **self._trace_headers()}
         for attempt in range(self.retries + 1):
             try:
                 failpoints.hit("remote.upload")
@@ -225,6 +243,12 @@ class WorkerAPIClient:
         r = await self._request("GET",
                                 f"/api/worker/upload/{video_id}/status")
         return r.json()["files"]
+
+    async def post_spans(self, job_id: int, spans: list[dict]) -> None:
+        """Ship finished worker spans into the job's server-side trace
+        (claim-gated server-side; call before complete/fail)."""
+        await self._request("POST", f"/api/worker/jobs/{job_id}/spans",
+                            json={"spans": spans})
 
     async def poll_commands(self) -> list[dict]:
         r = await self._request("GET", "/api/worker/commands")
@@ -395,6 +419,7 @@ class RemoteWorker(ComputeWatchdogMixin):
         self.stats = DaemonStats()
         self.restart_requested = False
         self.disk_paused = False
+        self._span_buffer = None      # the active attempt's TraceBuffer
         self._next_pressure_sweep = 0.0
         self._stop = asyncio.Event()
         self._cancel = threading.Event()
@@ -540,7 +565,7 @@ class RemoteWorker(ComputeWatchdogMixin):
             except (ClaimLost, TransientAPIError):
                 pass
             return False
-        self.stats.claimed += 1
+        self.stats.bump("claimed")
         self._cancel.clear()
         self._cancel_reason = ""
         self._reset_watchdog()
@@ -552,42 +577,60 @@ class RemoteWorker(ComputeWatchdogMixin):
             await self._safe_fail(job["id"], "video row vanished",
                                   permanent=True)
             return True
+        # Join the server's trace for this job (claim response carries
+        # the trace id + root span id); finished spans collect in the
+        # buffer and ship via POST .../spans before complete/fail.
+        tr = (claimed.get("trace") or {}) if config.TRACE_ENABLED else {}
+        tctx = None
+        if tr.get("trace_id"):
+            tctx = obs_trace.TraceContext(tr["trace_id"],
+                                          tr.get("parent_span_id"),
+                                          obs_trace.TraceBuffer())
+        self._span_buffer = tctx.buffer if tctx else None
         failed_before = self.stats.failed
-        try:
-            await self._dispatch(job, video)
-            # data problems dead-lettered inside the handler (missing
-            # source, bad payload) say nothing about compute health —
-            # only a failure-free run closes/armors the breaker
-            if self.stats.failed == failed_before:
-                self.breaker.record_success()
-        except JobCancelled as exc:
-            if self._stop.is_set():
-                try:
-                    await self.client.release(job["id"])
-                    self.stats.released += 1
-                except (ClaimLost, TransientAPIError):
-                    pass
-            else:
+        with obs_trace.attach(tctx):
+            try:
+                await self._dispatch(job, video)
+                # data problems dead-lettered inside the handler (missing
+                # source, bad payload) say nothing about compute health —
+                # only a failure-free run closes/armors the breaker
+                if self.stats.failed == failed_before:
+                    self.breaker.record_success()
+            except JobCancelled as exc:
+                if self._stop.is_set():
+                    try:
+                        await self.client.release(job["id"])
+                        self.stats.bump("released")
+                    except (ClaimLost, TransientAPIError):
+                        pass
+                else:
+                    obs_trace.event("worker.cancelled", status="error",
+                                    error=exc.reason)
+                    self.breaker.record_failure()
+                    fc = (FailureClass.STALLED
+                          if exc.reason.startswith("stalled")
+                          else FailureClass.TRANSIENT)
+                    await self._safe_fail(job["id"],
+                                          f"cancelled: {exc.reason}",
+                                          failure_class=fc)
+            except ClaimLost as exc:
+                log.warning("job %s claim lost: %s", job["id"], exc)
+                self.stats.last_error = str(exc)
+            except Exception as exc:  # noqa: BLE001
+                obs_trace.event("worker.error", status="error",
+                                error=f"{type(exc).__name__}: {exc}")
+                log.exception("job %s failed", job["id"])
                 self.breaker.record_failure()
-                fc = (FailureClass.STALLED
-                      if exc.reason.startswith("stalled")
-                      else FailureClass.TRANSIENT)
-                await self._safe_fail(job["id"], f"cancelled: {exc.reason}",
-                                      failure_class=fc)
-        except ClaimLost as exc:
-            log.warning("job %s claim lost: %s", job["id"], exc)
-            self.stats.last_error = str(exc)
-        except Exception as exc:  # noqa: BLE001
-            log.exception("job %s failed", job["id"])
-            self.breaker.record_failure()
-            await self._safe_fail(job["id"], f"{type(exc).__name__}: {exc}")
-        finally:
-            # Resolve any half-open probe the dispatch left unrecorded
-            # (claim-lost, shutdown release, pre-dispatch faults) — a
-            # wedged HALF_OPEN would never claim again.
-            self.breaker.release_probe()
-            if not self.keep_work_dirs:
-                shutil.rmtree(self._job_dir(video), ignore_errors=True)
+                await self._safe_fail(job["id"],
+                                      f"{type(exc).__name__}: {exc}")
+            finally:
+                # Resolve any half-open probe the dispatch left unrecorded
+                # (claim-lost, shutdown release, pre-dispatch faults) — a
+                # wedged HALF_OPEN would never claim again.
+                self.breaker.release_probe()
+                self._span_buffer = None
+                if not self.keep_work_dirs:
+                    shutil.rmtree(self._job_dir(video), ignore_errors=True)
         return True
 
     async def _sweep_workspaces(self, why: str) -> None:
@@ -608,11 +651,28 @@ class RemoteWorker(ComputeWatchdogMixin):
             # the claim loop
             log.exception("workspace gc failed")
 
+    async def _post_spans(self, job_id: int) -> None:
+        """Ship the attempt's finished spans to the server while the
+        claim is still held (the spans endpoint is claim-gated). Best
+        effort: a lost trace must never fail the job."""
+        buf = getattr(self, "_span_buffer", None)
+        if buf is None or not len(buf):
+            return
+        spans = [sp.to_dict() for sp in buf.drain()]
+        try:
+            await self.client.post_spans(job_id, spans)
+        except (ClaimLost, TransientAPIError, httpx.HTTPError) as exc:
+            # httpx.HTTPError covers non-retryable statuses (e.g. a 500
+            # from a flaky span insert) — a lost trace must never fail
+            # a job that already did its work
+            log.debug("span report for job %s dropped: %s", job_id, exc)
+
     async def _safe_fail(self, job_id: int, error: str, *,
                          permanent: bool = False,
                          failure_class: FailureClass | None = None) -> None:
-        self.stats.failed += 1
+        self.stats.bump("failed")
         self.stats.last_error = error
+        await self._post_spans(job_id)
         try:
             await self.client.fail(
                 job_id, error, permanent=permanent,
@@ -682,7 +742,13 @@ class RemoteWorker(ComputeWatchdogMixin):
             if src_dir.exists() else []
         if existing:
             return existing[0]
-        return await self.client.download_source(video["id"], src_dir)
+        with obs_trace.span("worker.download") as sp:
+            out = await self.client.download_source(video["id"], src_dir)
+            try:
+                sp.attrs["bytes"] = out.stat().st_size
+            except OSError:
+                pass
+            return out
 
     async def _run_transcode(self, job: dict, video: dict) -> None:
         from vlog_tpu.media.probe import get_video_info
@@ -709,11 +775,20 @@ class RemoteWorker(ComputeWatchdogMixin):
                                  keep_original=False, write_manifest=False)
 
         try:
-            result = await self._run_with_timeout(work, timeout, "transcode")
+            with obs_trace.span("worker.transcode",
+                                rungs=[r.name for r in rungs]) as tsp:
+                result = await self._run_with_timeout(work, timeout,
+                                                      "transcode")
         finally:
             uploader.stop()
             await asyncio.gather(up_task, return_exceptions=True)
-        await uploader.drain()
+        obs_trace.record_run_stages(tsp, result.run.stage_s)
+        obs_runtime().observe_run(result.run.stage_s)
+        with obs_trace.span("worker.upload") as usp:
+            await uploader.drain()
+            usp.attrs.update(files=len(uploader.uploaded),
+                             bytes=uploader.bytes_sent)
+        await self._post_spans(job["id"])
 
         await self.client.complete(job["id"], {
             "probe": {
@@ -726,7 +801,7 @@ class RemoteWorker(ComputeWatchdogMixin):
             "qualities": result.qualities,
             "thumbnail": "thumbnail.jpg" if result.run.thumbnail_path else None,
         })
-        self.stats.completed += 1
+        self.stats.bump("completed")
         log.info("job %s complete: %d files, %d bytes streamed",
                  job["id"], len(uploader.uploaded), uploader.bytes_sent)
 
@@ -762,11 +837,21 @@ class RemoteWorker(ComputeWatchdogMixin):
                                  streaming_format=fmt, codec=codec)
 
         try:
-            result = await self._run_with_timeout(work, timeout, "reencode")
+            with obs_trace.span("worker.transcode",
+                                rungs=[r.name for r in rungs],
+                                streaming_format=fmt, codec=codec) as tsp:
+                result = await self._run_with_timeout(work, timeout,
+                                                      "reencode")
         finally:
             uploader.stop()
             await asyncio.gather(up_task, return_exceptions=True)
-        await uploader.drain()
+        obs_trace.record_run_stages(tsp, result.run.stage_s)
+        obs_runtime().observe_run(result.run.stage_s)
+        with obs_trace.span("worker.upload") as usp:
+            await uploader.drain()
+            usp.attrs.update(files=len(uploader.uploaded),
+                             bytes=uploader.bytes_sent)
+        await self._post_spans(job["id"])
         await self.client.complete(job["id"], {
             "probe": {
                 "duration_s": result.source.duration_s,
@@ -780,7 +865,7 @@ class RemoteWorker(ComputeWatchdogMixin):
             "streaming_format": fmt,
             "codec": codec,
         })
-        self.stats.completed += 1
+        self.stats.bump("completed")
 
     async def _run_sprites(self, job: dict, video: dict) -> None:
         from vlog_tpu.worker.sprites import generate_sprites
@@ -794,14 +879,19 @@ class RemoteWorker(ComputeWatchdogMixin):
         def work():
             return generate_sprites(src, out_dir, progress_cb=cb)
 
-        result = await self._run_with_timeout(work, timeout, "sprites")
-        for p in sorted(Path(result.vtt_path).parent.glob("*")):
-            if p.is_file() and not p.name.endswith(".tmp"):
-                await self.client.upload_file(
-                    video["id"], f"sprites/{p.name}", p)
+        with obs_trace.span("worker.sprites") as sp:
+            result = await self._run_with_timeout(work, timeout, "sprites")
+            sp.attrs.update(sheets=result.sheet_count,
+                            tiles=result.tile_count)
+        with obs_trace.span("worker.upload"):
+            for p in sorted(Path(result.vtt_path).parent.glob("*")):
+                if p.is_file() and not p.name.endswith(".tmp"):
+                    await self.client.upload_file(
+                        video["id"], f"sprites/{p.name}", p)
+        await self._post_spans(job["id"])
         await self.client.complete(job["id"], {
             "sheets": result.sheet_count, "tiles": result.tile_count})
-        self.stats.completed += 1
+        self.stats.bump("completed")
 
     async def _run_transcription(self, job: dict, video: dict) -> None:
         from vlog_tpu.worker.transcribe import transcribe_video
@@ -816,13 +906,18 @@ class RemoteWorker(ComputeWatchdogMixin):
             return transcribe_video(src, out_dir, progress_cb=cb,
                                     model_dir=self.transcription_model_dir)
 
-        result = await self._run_with_timeout(work, timeout, "transcription")
-        await self.client.upload_file(video["id"], "captions.vtt",
-                                      Path(result.vtt_path))
+        with obs_trace.span("worker.transcription") as sp:
+            result = await self._run_with_timeout(work, timeout,
+                                                  "transcription")
+            sp.attrs.update(language=result.language, model=result.model)
+        with obs_trace.span("worker.upload"):
+            await self.client.upload_file(video["id"], "captions.vtt",
+                                          Path(result.vtt_path))
+        await self._post_spans(job["id"])
         await self.client.complete(job["id"], {
             "language": result.language, "model": result.model,
             "vtt": "captions.vtt", "text": result.text})
-        self.stats.completed += 1
+        self.stats.bump("completed")
 
 
 # --------------------------------------------------------------------------
