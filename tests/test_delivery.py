@@ -1,0 +1,863 @@
+"""Delivery plane: origin segment cache, single-flight, admission,
+publish-keyed invalidation, conditional/range serving (vlog_tpu/delivery/).
+
+The acceptance bar this suite holds: a steady-state cached segment hit
+performs ZERO database queries and ZERO disk opens (asserted through
+``Database.query_count`` and the plane's ``disk_reads`` counter), and
+cached responses are byte-identical to uncached ones — including 206
+ranges and ETag/304 revalidation — because both paths run through one
+response builder.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import random
+import time
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from vlog_tpu import config, delivery
+from vlog_tpu.api.admin_api import build_admin_app
+from vlog_tpu.api.public_api import DELIVERY, build_public_app
+from vlog_tpu.delivery.cache import CacheEntry, SegmentCache, SingleFlight
+from vlog_tpu.jobs import videos as vids
+from vlog_tpu.storage import integrity
+from vlog_tpu.utils import failpoints
+
+README = Path(__file__).parent.parent / "README.md"
+
+
+# --------------------------------------------------------------------------
+# Helpers
+# --------------------------------------------------------------------------
+
+def _entry(slug="s", rel="a.m4s", body=b"x" * 100, *, immutable=True,
+           expires_at=None) -> CacheEntry:
+    return CacheEntry(slug=slug, rel=rel, version="v1", body=body,
+                      etag='"t"', mime="video/iso.segment", mtime=1.0,
+                      immutable=immutable, expires_at=expires_at)
+
+
+async def _publish_tree(db, video_dir: Path, title="Demo Clip", *,
+                        n_seg=3, seg_len=4096) -> dict:
+    """A ready video row + a tiny CMAF-ish tree with a real manifest."""
+    v = await vids.create_video(db, title)
+    root = Path(video_dir) / v["slug"]
+    (root / "360p").mkdir(parents=True, exist_ok=True)
+    (root / "master.m3u8").write_text("#EXTM3U\n# master\n")
+    (root / "360p" / "playlist.m3u8").write_text("#EXTM3U\n# variant\n")
+    rng = random.Random(len(title))
+    for i in range(1, n_seg + 1):
+        body = bytes(rng.randrange(256) for _ in range(seg_len))
+        (root / "360p" / f"segment_{i:05d}.m4s").write_bytes(body)
+    (root / "original.y4m").write_bytes(b"YUV4MPEG2 fake source\n")
+    integrity.write_manifest(root, integrity.build_manifest(root))
+    await db.execute("UPDATE videos SET status='ready' WHERE id=:i",
+                     {"i": v["id"]})
+    row = await vids.get_video(db, v["id"])
+    assert row is not None
+    return row
+
+
+async def _client(app) -> TestClient:
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+# --------------------------------------------------------------------------
+# SegmentCache / SingleFlight units
+# --------------------------------------------------------------------------
+
+def test_lru_byte_budget_and_eviction_order():
+    evicted = []
+    c = SegmentCache(250, on_evict=evicted.append)
+    c.put(_entry(rel="a"))
+    c.put(_entry(rel="b"))
+    assert c.bytes_cached == 200 and len(c) == 2
+    # touch "a" so "b" is the LRU victim
+    assert c.get(("s", "a")) is not None
+    c.put(_entry(rel="c"))
+    assert c.get(("s", "b")) is None            # evicted
+    assert c.get(("s", "a")) is not None
+    assert c.get(("s", "c")) is not None
+    assert c.evictions == 1 and evicted == [100]
+    assert c.bytes_cached == 200
+    # an entry bigger than the whole budget is refused outright
+    assert c.put(_entry(rel="huge", body=b"y" * 300)) is False
+    # zero budget refuses everything (the cache-off topology)
+    assert SegmentCache(0).put(_entry()) is False
+
+
+def test_replacing_same_key_accounts_bytes():
+    c = SegmentCache(1000)
+    c.put(_entry(rel="a", body=b"1" * 400))
+    c.put(_entry(rel="a", body=b"2" * 100))
+    assert c.bytes_cached == 100 and len(c) == 1
+
+
+def test_mutable_entry_ttl_expiry():
+    c = SegmentCache(10_000)
+    c.put(_entry(rel="m.m3u8", immutable=False, expires_at=100.0))
+    assert c.get(("s", "m.m3u8"), now=99.9) is not None
+    assert c.get(("s", "m.m3u8"), now=100.1) is None
+    assert c.expirations == 1
+    assert c.bytes_cached == 0
+
+
+def test_invalidate_slug_drops_only_that_slug():
+    c = SegmentCache(10_000)
+    c.put(_entry(slug="one", rel="a"))
+    c.put(_entry(slug="one", rel="b"))
+    c.put(_entry(slug="two", rel="a"))
+    assert c.invalidate_slug("one") == 2
+    assert c.get(("two", "a")) is not None
+    assert c.get(("one", "a")) is None
+
+
+def test_single_flight_collapses_concurrent_misses(run):
+    sf = SingleFlight()
+    calls = []
+
+    async def factory():
+        calls.append(1)
+        await asyncio.sleep(0.05)
+        return "payload"
+
+    async def go():
+        results = await asyncio.gather(
+            *[sf.run(("s", "k"), factory) for _ in range(6)])
+        assert results == ["payload"] * 6
+
+    run(go())
+    assert len(calls) == 1
+    assert sf.collapses == 5
+    assert sf.inflight() == 0
+
+
+def test_single_flight_failure_propagates_and_clears(run):
+    sf = SingleFlight()
+    attempts = []
+
+    async def boom():
+        attempts.append(1)
+        await asyncio.sleep(0.02)
+        raise OSError("disk went away")
+
+    async def ok():
+        return "fine"
+
+    async def go():
+        results = await asyncio.gather(
+            *[sf.run(("s", "k"), boom) for _ in range(4)],
+            return_exceptions=True)
+        assert all(isinstance(r, OSError) for r in results)
+        # the failed fill left nothing behind: a new run is a new leader
+        assert await sf.run(("s", "k"), ok) == "fine"
+
+    run(go())
+    assert len(attempts) == 1
+
+
+def test_single_flight_leader_cancel_spares_followers(run):
+    """A disconnecting leader (aiohttp cancels its handler) must not
+    abort followers still riding the same fill."""
+    sf = SingleFlight()
+    calls = []
+
+    async def go():
+        release = asyncio.Event()
+
+        async def factory():
+            calls.append(1)
+            await release.wait()
+            return "payload"
+
+        leader = asyncio.create_task(sf.run(("s", "k"), factory))
+        await asyncio.sleep(0.01)           # fill is in flight
+        followers = [asyncio.create_task(sf.run(("s", "k"), factory))
+                     for _ in range(3)]
+        await asyncio.sleep(0.01)
+        leader.cancel()
+        await asyncio.sleep(0.01)           # cancellation lands
+        release.set()
+        assert await asyncio.gather(*followers) == ["payload"] * 3
+        with pytest.raises(asyncio.CancelledError):
+            await leader
+
+    run(go())
+    assert len(calls) == 1
+    assert sf.inflight() == 0
+
+
+def test_if_range_date_must_match_exactly():
+    """RFC 9110 §13.1.5: a date If-Range validator matches only the
+    EXACT Last-Modified — a tree restored with an older mtime must not
+    let a client splice ranges across two different bodies."""
+    from email.utils import formatdate
+
+    from vlog_tpu.delivery.http import _if_range_allows
+
+    entry = _entry()
+    entry.mtime = 1_000_000.0
+    assert _if_range_allows(None, entry)                    # no header
+    assert _if_range_allows(formatdate(1_000_000.0, usegmt=True), entry)
+    for stale in (formatdate(2_000_000.0, usegmt=True),     # newer
+                  formatdate(500_000.0, usegmt=True),       # older
+                  "not a date"):
+        assert not _if_range_allows(stale, entry), stale
+
+
+# --------------------------------------------------------------------------
+# HTTP: the serving path end to end
+# --------------------------------------------------------------------------
+
+def test_cached_hit_zero_db_queries_zero_disk_opens(run, db, tmp_path):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        client = await _client(app)
+        plane = app[DELIVERY]
+        url = f"/videos/{video['slug']}/360p/segment_00001.m4s"
+        try:
+            first = await client.get(url)
+            body = await first.read()
+            assert first.status == 200 and len(body) == 4096
+            # steady state: N more requests, zero DB statements, zero
+            # disk reads, all hits
+            q0 = db.query_count
+            reads0 = plane.counters["disk_reads"]
+            hits0 = plane.counters["hits"]
+            for _ in range(5):
+                r = await client.get(url)
+                assert await r.read() == body
+            assert db.query_count - q0 == 0
+            assert plane.counters["disk_reads"] - reads0 == 0
+            assert plane.counters["hits"] - hits0 == 5
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_etag_is_manifest_sha256_and_304(run, db, tmp_path):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        root = tmp_path / "videos" / video["slug"]
+        manifest = integrity.load_manifest(root)
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        client = await _client(app)
+        url = f"/videos/{video['slug']}/360p/segment_00001.m4s"
+        try:
+            r = await client.get(url)
+            want = f'"{manifest["360p/segment_00001.m4s"]["sha256"]}"'
+            assert r.headers["ETag"] == want
+            assert "immutable" in r.headers["Cache-Control"]
+            assert r.headers["Access-Control-Allow-Origin"] == "*"
+            # revalidation: exact, list, weak, star — all 304
+            for inm in (want, f'"zzz", {want}', f"W/{want}", "*"):
+                r2 = await client.get(url, headers={"If-None-Match": inm})
+                assert r2.status == 304, inm
+                assert await r2.read() == b""
+                assert r2.headers["ETag"] == want
+            r3 = await client.get(url, headers={"If-None-Match": '"nope"'})
+            assert r3.status == 200
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_range_semantics_from_cached_buffers(run, db, tmp_path):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        client = await _client(app)
+        url = f"/videos/{video['slug']}/360p/segment_00002.m4s"
+        try:
+            full = await (await client.get(url)).read()
+            size = len(full)
+            cases = {
+                "bytes=0-99": (206, full[:100], f"bytes 0-99/{size}"),
+                "bytes=100-": (206, full[100:],
+                               f"bytes 100-{size - 1}/{size}"),
+                "bytes=-50": (206, full[-50:],
+                              f"bytes {size - 50}-{size - 1}/{size}"),
+                # end past EOF clamps (RFC 9110)
+                f"bytes=0-{size + 999}": (206, full,
+                                          f"bytes 0-{size - 1}/{size}"),
+            }
+            for hdr, (status, body, crange) in cases.items():
+                r = await client.get(url, headers={"Range": hdr})
+                assert r.status == status, hdr
+                assert await r.read() == body, hdr
+                assert r.headers["Content-Range"] == crange, hdr
+            # start past EOF: 416 + the */size form
+            r = await client.get(url, headers={"Range": f"bytes={size}-"})
+            assert r.status == 416
+            assert r.headers["Content-Range"] == f"bytes */{size}"
+            # multi-range and malformed: the full 200 body
+            for hdr in ("bytes=0-1,5-6", "bytes=abc-def", "chunks=0-1"):
+                r = await client.get(url, headers={"Range": hdr})
+                assert r.status == 200, hdr
+                assert await r.read() == full
+            # If-Range: matching ETag honors the range...
+            etag = (await client.get(url)).headers["ETag"]
+            r = await client.get(url, headers={
+                "Range": "bytes=0-9", "If-Range": etag})
+            assert r.status == 206
+            # ...a stale validator serves the full body (no stale splice)
+            r = await client.get(url, headers={
+                "Range": "bytes=0-9", "If-Range": '"stale"'})
+            assert r.status == 200 and await r.read() == full
+            # ...and a stale validator SUPPRESSES 416 too: a resume
+            # against a republished-smaller body gets the new 200, not
+            # an abort (RFC 9110: ignore Range outright on mismatch)
+            r = await client.get(url, headers={
+                "Range": f"bytes={size + 10}-", "If-Range": '"stale"'})
+            assert r.status == 200 and await r.read() == full
+            # If-Modified-Since revalidation (ETag-less clients)
+            lm = (await client.get(url)).headers["Last-Modified"]
+            r = await client.get(url, headers={"If-Modified-Since": lm})
+            assert r.status == 304 and await r.read() == b""
+            r = await client.get(url, headers={
+                "If-Modified-Since": "Thu, 01 Jan 1970 00:00:01 GMT"})
+            assert r.status == 200
+            # If-None-Match wins over If-Modified-Since when both sent
+            r = await client.get(url, headers={
+                "If-None-Match": '"nope"', "If-Modified-Since": lm})
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_head_and_options_preflight(run, db, tmp_path):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        client = await _client(app)
+        url = f"/videos/{video['slug']}/360p/segment_00001.m4s"
+        try:
+            g = await client.get(url)
+            h = await client.head(url)
+            assert h.status == 200
+            assert await h.read() == b""
+            assert h.headers["Content-Length"] == str(len(await g.read()))
+            assert h.headers["ETag"] == g.headers["ETag"]
+            assert h.headers["Accept-Ranges"] == "bytes"
+            # ranged HEAD mirrors the 206 metadata
+            hr = await client.head(url, headers={"Range": "bytes=0-9"})
+            assert hr.status == 206
+            assert hr.headers["Content-Length"] == "10"
+            o = await client.options(url)
+            assert o.status == 204
+            assert "GET" in o.headers["Access-Control-Allow-Methods"]
+            assert "Range" in o.headers["Access-Control-Allow-Headers"]
+            assert o.headers["Access-Control-Allow-Origin"] == "*"
+            exposed = g.headers["Access-Control-Expose-Headers"]
+            assert "Content-Range" in exposed and "ETag" in exposed
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_cached_and_uncached_responses_byte_identical(run, db, tmp_path,
+                                                      monkeypatch):
+    """VLOG_DELIVERY_CACHE_BYTES=0 must change performance, not bytes."""
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        cached_app = build_public_app(db, video_dir=tmp_path / "videos")
+        monkeypatch.setattr(config, "DELIVERY_CACHE_BYTES", 0)
+        uncached_app = build_public_app(db, video_dir=tmp_path / "videos")
+        assert uncached_app[DELIVERY].cache.max_bytes == 0
+        c1 = await _client(cached_app)
+        c2 = await _client(uncached_app)
+        url = f"/videos/{video['slug']}/360p/segment_00001.m4s"
+        etag = (await c1.get(url)).headers["ETag"]
+        probes = [
+            {},
+            {"Range": "bytes=5-128"},
+            {"Range": "bytes=-1"},
+            {"If-None-Match": etag},
+            {"Range": "bytes=999999-"},
+        ]
+        compare = ("ETag", "Content-Type", "Cache-Control", "Content-Range",
+                   "Accept-Ranges", "Last-Modified",
+                   "Access-Control-Allow-Origin")
+        try:
+            for headers in probes:
+                r1 = await c1.get(url, headers=headers)   # cache path
+                r1b = await c1.get(url, headers=headers)  # warm hit
+                r2 = await c2.get(url, headers=headers)   # uncached
+                assert r1.status == r1b.status == r2.status, headers
+                b1, b1b, b2 = (await r1.read(), await r1b.read(),
+                               await r2.read())
+                assert b1 == b1b == b2, headers
+                for h in compare:
+                    assert r1.headers.get(h) == r2.headers.get(h), (headers, h)
+            # and the uncached app truly caches nothing
+            assert len(uncached_app[DELIVERY].cache) == 0
+        finally:
+            await c1.close()
+            await c2.close()
+
+    run(go())
+
+
+def test_mutable_playlist_ttl_and_immutable_segment_pin(run, db, tmp_path):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        plane = app[DELIVERY]
+        plane.manifest_ttl_s = 0.05
+        client = await _client(app)
+        slug = video["slug"]
+        try:
+            first = await (await client.get(f"/videos/{slug}/master.m3u8")).text()
+            assert "# master" in first
+            (tmp_path / "videos" / slug / "master.m3u8").write_text(
+                "#EXTM3U\n# rewritten\n")
+            # within TTL: still the cached copy
+            assert await (await client.get(
+                f"/videos/{slug}/master.m3u8")).text() == first
+            await asyncio.sleep(0.08)
+            assert "# rewritten" in await (await client.get(
+                f"/videos/{slug}/master.m3u8")).text()
+        finally:
+            await client.close()
+
+    run(go())
+
+
+# --------------------------------------------------------------------------
+# Invalidation: publish / delete / restore / endpoint
+# --------------------------------------------------------------------------
+
+def test_delete_and_restore_invalidate_immediately(run, db, tmp_path):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        pub = build_public_app(db, video_dir=tmp_path / "videos")
+        adm = build_admin_app(db, upload_dir=tmp_path / "up",
+                              video_dir=tmp_path / "videos")
+        pub[DELIVERY].state_ttl_s = 3600.0   # TTL may NOT be the rescuer
+        pc = await _client(pub)
+        ac = await _client(adm)
+        url = f"/videos/{video['slug']}/360p/segment_00001.m4s"
+        try:
+            assert (await pc.get(url)).status == 200
+            r = await ac.delete(f"/api/videos/{video['id']}")
+            assert r.status == 200
+            assert (await pc.get(url)).status == 404    # visible NOW
+            r = await ac.post(f"/api/videos/{video['id']}/restore")
+            assert r.status == 200
+            assert (await pc.get(url)).status == 200
+        finally:
+            await pc.close()
+            await ac.close()
+
+    run(go())
+
+
+def test_finalize_ready_and_reencode_evict_cached_segments(run, db, tmp_path):
+    from types import SimpleNamespace
+
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        plane = app[DELIVERY]
+        client = await _client(app)
+        slug = video["slug"]
+        url = f"/videos/{slug}/360p/segment_00001.m4s"
+        try:
+            old = await (await client.get(url)).read()
+            old_etag = (await client.get(url)).headers["ETag"]
+            assert len(plane.cache) > 0
+            # a re-encode rewrites the tree then republishes through
+            # finalize_ready — the cache must drop the slug on publish
+            root = tmp_path / "videos" / slug
+            (root / "360p" / "segment_00001.m4s").write_bytes(b"R" * 512)
+            integrity.write_manifest(root, integrity.build_manifest(root))
+            await vids.finalize_ready(
+                db, video["id"],
+                probe=SimpleNamespace(duration_s=1.0, width=64, height=48,
+                                      fps=24.0),
+                qualities=[], thumbnail_path=None)
+            assert plane.cache.get((slug, "360p/segment_00001.m4s")) is None
+            fresh = await client.get(url)
+            body = await fresh.read()
+            assert body == b"R" * 512 and body != old
+            assert fresh.headers["ETag"] != old_etag
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_admin_invalidate_endpoint_and_stats_panel_shape(run, db, tmp_path):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        pub = build_public_app(db, video_dir=tmp_path / "videos")
+        adm = build_admin_app(db, upload_dir=tmp_path / "up",
+                              video_dir=tmp_path / "videos")
+        pc = await _client(pub)
+        ac = await _client(adm)
+        url = f"/videos/{video['slug']}/360p/segment_00001.m4s"
+        try:
+            await pc.get(url)
+            assert len(pub[DELIVERY].cache) > 0
+            r = await ac.post("/api/delivery/invalidate",
+                              json={"slug": video["slug"]})
+            assert r.status == 200
+            assert (await r.json())["entries_dropped"] >= 1
+            assert len(pub[DELIVERY].cache) == 0
+            assert (await ac.post("/api/delivery/invalidate",
+                                  json={})).status == 400
+            await pc.get(url)
+            r = await ac.post("/api/delivery/invalidate", json={"all": True})
+            assert (await r.json())["target"] == "*"
+            assert len(pub[DELIVERY].cache) == 0
+            s = await (await ac.get("/api/delivery/stats")).json()
+            assert s["plane_count"] >= 1
+            for key in ("hits", "misses", "shed", "single_flight_collapses",
+                        "cache_bytes", "cache_budget_bytes", "evictions",
+                        "invalidations", "state_hits", "state_misses"):
+                assert key in s["totals"], key
+        finally:
+            await pc.close()
+            await ac.close()
+
+    run(go())
+
+
+# --------------------------------------------------------------------------
+# Single-flight over HTTP, shedding, failpoints
+# --------------------------------------------------------------------------
+
+def test_n_concurrent_misses_one_disk_read(run, db, tmp_path):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        plane = app[DELIVERY]
+        client = await _client(app)
+        url = f"/videos/{video['slug']}/360p/segment_00003.m4s"
+        real = plane._read_entry
+
+        def slow_read(slug, rel):
+            time.sleep(0.1)     # hold the fill open so misses pile up
+            return real(slug, rel)
+
+        plane._read_entry = slow_read
+        try:
+            # warm the publish-state cache without touching the segment
+            await client.get(f"/videos/{video['slug']}/master.m3u8")
+            responses = await asyncio.gather(
+                *[client.get(url) for _ in range(8)])
+            bodies = await asyncio.gather(*[r.read() for r in responses])
+            assert all(r.status == 200 for r in responses)
+            assert len({bytes(b) for b in bodies}) == 1
+            assert plane.counters["disk_reads"] == 2   # playlist + ONE fill
+            assert plane.flight.collapses == 7
+        finally:
+            plane._read_entry = real
+            await client.close()
+
+    run(go())
+
+
+def test_shed_returns_503_with_retry_after(run, db, tmp_path):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        plane = app[DELIVERY]
+        client = await _client(app)
+        url = f"/videos/{video['slug']}/360p/segment_00001.m4s"
+        try:
+            plane.max_inflight_reads = 0    # every distinct miss sheds
+            r = await client.get(url)
+            assert r.status == 503
+            assert r.headers["Retry-After"].isdigit()
+            assert r.headers["Access-Control-Allow-Origin"] == "*"
+            assert plane.counters["shed"] == 1
+            plane.max_inflight_reads = 4    # recovery is immediate
+            assert (await client.get(url)).status == 200
+            # the failpoint forces the same branch whatever the bound
+            failpoints.arm("delivery.shed", count=1)
+            plane.invalidate_all()
+            assert (await client.get(url)).status == 503
+            assert (await client.get(url)).status == 200
+        finally:
+            failpoints.reset()
+            await client.close()
+
+    run(go())
+
+
+def test_read_failpoint_errors_do_not_poison_cache(run, db, tmp_path):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        plane = app[DELIVERY]
+        client = await _client(app)
+        url = f"/videos/{video['slug']}/360p/segment_00002.m4s"
+        try:
+            failpoints.arm("delivery.read", count=1)
+            r = await client.get(url)
+            assert r.status == 500          # sanitized boundary error
+            assert len(plane.cache) == 0    # nothing cached from the wreck
+            r = await client.get(url)       # disarmed: clean retry
+            assert r.status == 200 and len(await r.read()) == 4096
+            assert plane.cache.get(
+                (video["slug"], "360p/segment_00002.m4s")) is not None
+        finally:
+            failpoints.reset()
+            await client.close()
+
+    run(go())
+
+
+def test_invalidation_during_fill_is_not_cached(run, db, tmp_path):
+    """A fill that straddles an invalidation may have read bytes from
+    BEFORE a tree rewrite: serve them to its waiters, cache nothing."""
+    import threading
+
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        plane = delivery.DeliveryPlane(db, tmp_path / "videos")
+        rel = "360p/segment_00001.m4s"
+        loop = asyncio.get_running_loop()
+        reading = asyncio.Event()
+        proceed = threading.Event()
+        real = plane._read_entry
+
+        def stalled(slug, r):
+            loop.call_soon_threadsafe(reading.set)
+            assert proceed.wait(5)
+            return real(slug, r)
+
+        plane._read_entry = stalled
+        fetch = asyncio.create_task(plane.fetch(video["slug"], rel))
+        await reading.wait()
+        plane.invalidate_slug(video["slug"])    # lands mid-read
+        proceed.set()
+        got = await fetch
+        assert isinstance(got, CacheEntry)      # the waiter is served
+        assert plane.cache.get((video["slug"], rel)) is None  # not kept
+        # the next fetch (no invalidation in flight) caches normally
+        plane._read_entry = real
+        await plane.fetch(video["slug"], rel)
+        assert plane.cache.get((video["slug"], rel)) is not None
+
+    run(go())
+
+
+def test_segment_ttl_bounds_cross_process_staleness(run, db, tmp_path):
+    """Default: segment bodies are pinned (zero-syscall steady state).
+    With VLOG_DELIVERY_SEGMENT_TTL set — the split-deployment knob —
+    their cache life is bounded so republished trees converge."""
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        pinned = delivery.DeliveryPlane(db, tmp_path / "videos")
+        got = await pinned.fetch(video["slug"], "360p/segment_00001.m4s")
+        assert got.expires_at is None
+        bounded = delivery.DeliveryPlane(db, tmp_path / "videos",
+                                         segment_ttl_s=30.0)
+        got = await bounded.fetch(video["slug"], "360p/segment_00001.m4s")
+        assert got.expires_at is not None
+        assert got.fresh(time.monotonic())
+        assert not got.fresh(time.monotonic() + 31)
+
+    run(go())
+
+
+def test_invalidate_delivery_skips_query_without_planes(run, db, tmp_path):
+    """The documented 'no-op in processes that serve no media' must be
+    real: no SELECT per status flip in worker processes."""
+    from vlog_tpu.delivery import plane as plane_mod
+
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        # simulate a worker process: empty plane registry
+        saved = list(plane_mod._PLANES)
+        for p in saved:
+            plane_mod._PLANES.discard(p)
+        try:
+            assert not delivery.has_planes()
+            q0 = db.query_count
+            await vids.invalidate_delivery(db, video["id"])
+            assert db.query_count == q0
+        finally:
+            for p in saved:
+                plane_mod._PLANES.add(p)
+
+    run(go())
+
+
+# --------------------------------------------------------------------------
+# Hardening: symlink escape, gates
+# --------------------------------------------------------------------------
+
+def test_symlink_escape_rejected_as_404(run, db, tmp_path):
+    async def go():
+        secret = tmp_path / "secret.txt"
+        secret.write_text("hostname=prod-db-1\n")
+        video = await _publish_tree(db, tmp_path / "videos")
+        root = tmp_path / "videos" / video["slug"]
+        # lexically clean tail, symlink escapes the slug tree: the old
+        # ".." check let this through
+        (root / "360p" / "leak.vtt").symlink_to(secret)
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        client = await _client(app)
+        try:
+            r = await client.get(f"/videos/{video['slug']}/360p/leak.vtt")
+            assert r.status == 404
+            assert "hostname" not in await r.text()
+            # a legitimate sibling still serves
+            assert (await client.get(
+                f"/videos/{video['slug']}/360p/segment_00001.m4s")).status \
+                == 200
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_pending_and_deleted_slugs_stay_hidden(run, db, tmp_path):
+    async def go():
+        v = await vids.create_video(db, "Not Ready")
+        root = tmp_path / "videos" / v["slug"]
+        root.mkdir(parents=True)
+        (root / "master.m3u8").write_text("#EXTM3U\n")
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        client = await _client(app)
+        try:
+            # pending: tree exists on disk but must not leak
+            assert (await client.get(
+                f"/videos/{v['slug']}/master.m3u8")).status == 404
+            # unknown slug: negative state is cached, not re-queried
+            q0 = db.query_count
+            for _ in range(3):
+                assert (await client.get(
+                    "/videos/no-such/master.m3u8")).status == 404
+            assert db.query_count - q0 == 1
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_downloads_gate_still_enforced(run, db, tmp_path, monkeypatch):
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos")
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        client = await _client(app)
+        url = f"/videos/{video['slug']}/original.y4m"
+        try:
+            monkeypatch.setattr(config, "DOWNLOADS_ENABLED", False)
+            assert (await client.get(url)).status == 403
+            monkeypatch.setattr(config, "DOWNLOADS_ENABLED", True)
+            assert (await client.get(url)).status == 200
+        finally:
+            await client.close()
+
+    run(go())
+
+
+# --------------------------------------------------------------------------
+# Registry / docs agreement (PR 2/3/4 lint pattern, delivery edition)
+# --------------------------------------------------------------------------
+
+class TestDeliveryAgreement:
+    KNOBS = ("VLOG_DELIVERY_CACHE_BYTES", "VLOG_DELIVERY_MAX_INFLIGHT_READS",
+             "VLOG_DELIVERY_MANIFEST_TTL", "VLOG_DELIVERY_SEGMENT_TTL",
+             "VLOG_DELIVERY_STATE_TTL", "VLOG_DELIVERY_MAX_ENTRY_BYTES")
+    METRICS = ("vlog_delivery_requests_total", "vlog_delivery_bytes_total",
+               "vlog_delivery_evictions_total",
+               "vlog_delivery_collapses_total", "vlog_delivery_cache_bytes",
+               "vlog_delivery_inflight_reads")
+    SITES = ("delivery.read", "delivery.shed")
+
+    def test_knobs_parsed_and_documented(self):
+        import re
+
+        cfg_src = Path(config.__file__).read_text()
+        parsed = set(re.findall(r'"(VLOG_[A-Z_]+)"', cfg_src))
+        readme = README.read_text()
+        for knob in self.KNOBS:
+            assert knob in parsed, f"{knob} not parsed in config.py"
+            assert knob in readme, f"{knob} missing from README"
+
+    def test_metrics_registered_and_documented(self):
+        from vlog_tpu.obs.metrics import runtime
+
+        rendered = runtime().render_text()
+        readme = README.read_text()
+        for name in self.METRICS:
+            assert name in readme, f"{name} missing from README"
+            assert name.removesuffix("_total") in rendered, name
+
+    def test_failpoint_sites_registered_and_documented(self):
+        readme = README.read_text()
+        for site in self.SITES:
+            assert site in failpoints.SITES, site
+            assert f"`{site}`" in readme, f"{site} missing from README"
+
+
+# --------------------------------------------------------------------------
+# Throughput microbench (slow): hot cache vs cold origin
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_delivery_throughput_microbench(run, db, tmp_path):
+    """Requests/sec against one published ladder, hot (cache serving)
+    vs cold (every request re-opens the tree). Recorded next to the
+    existing bench output so regressions show in the same place."""
+    async def go():
+        video = await _publish_tree(db, tmp_path / "videos", n_seg=8,
+                                    seg_len=64 * 1024)
+        app = build_public_app(db, video_dir=tmp_path / "videos")
+        plane = app[DELIVERY]
+        client = await _client(app)
+        urls = [f"/videos/{video['slug']}/360p/segment_{i:05d}.m4s"
+                for i in range(1, 9)]
+
+        async def measure(seconds: float, *, cold: bool) -> float:
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                if cold:
+                    plane.cache.clear()
+                r = await client.get(urls[n % len(urls)])
+                assert r.status == 200
+                await r.read()
+                n += 1
+            return n / (time.perf_counter() - t0)
+
+        try:
+            await measure(0.3, cold=False)          # warmup
+            hot = await measure(2.0, cold=False)
+            cold = await measure(2.0, cold=True)
+        finally:
+            await client.close()
+        record = {
+            "metric": "delivery_origin_rps",
+            "hot_cache_rps": round(hot, 1),
+            "cold_origin_rps": round(cold, 1),
+            "speedup_x": round(hot / max(cold, 1e-9), 2),
+            "segment_bytes": 64 * 1024,
+        }
+        out = Path(__file__).parent.parent / "BENCH_delivery.json"
+        out.write_text(json.dumps(record, indent=1) + "\n")
+        print(json.dumps(record))
+        assert hot > 0 and cold > 0
+        # the whole point of the plane: hits must not be slower than
+        # re-reading the tree (allow slack for scheduler noise)
+        assert hot >= cold * 0.8
+
+    run(go())

@@ -689,6 +689,10 @@ async def regenerate_manifests(request: web.Request) -> web.Response:
         audit.record("video.manifests_regenerated", video_id=vid,
                      variants=result["variants"],
                      skipped=result["skipped"])
+    # the master/mpd (and outputs.json) just changed on disk
+    from vlog_tpu import delivery
+
+    delivery.invalidate_slug(video["slug"])
     return web.json_response({"ok": True, **result})
 
 
@@ -820,6 +824,8 @@ async def requeue_job(request: web.Request) -> web.Response:
 
 async def delete_video(request: web.Request) -> web.Response:
     """Soft delete (reference admin.py:2500: restorable)."""
+    from vlog_tpu import delivery
+
     db = request.app[DB]
     video = await vids.get_video(db, _path_id(request, "video_id"))
     if video is None:
@@ -827,6 +833,8 @@ async def delete_video(request: web.Request) -> web.Response:
     await db.execute(
         "UPDATE videos SET status='deleted', deleted_at=:t, updated_at=:t "
         "WHERE id=:id", {"t": db_now(), "id": video["id"]})
+    # a deleted video must stop serving NOW, not at publish-state TTL
+    delivery.invalidate_slug(video["slug"])
     return web.json_response({"ok": True})
 
 
@@ -920,12 +928,19 @@ async def verify_video(request: web.Request) -> web.Response:
     if audit is not None:
         audit.record("video.verified", video_id=video["id"],
                      ok=not problems, problems=len(problems))
+    # a verify run re-read the tree's ground truth: drop cached buffers
+    # so nothing keeps serving bytes the verification just disowned
+    from vlog_tpu import delivery
+
+    delivery.invalidate_slug(video["slug"])
     return web.json_response({
         "ok": not problems, "video_id": video["id"],
         "files_checked": len(manifest), "problems": problems})
 
 
 async def restore_video(request: web.Request) -> web.Response:
+    from vlog_tpu import delivery
+
     db = request.app[DB]
     video = await vids.get_video(db, _path_id(request, "video_id"))
     if video is None or video["deleted_at"] is None:
@@ -936,6 +951,7 @@ async def restore_video(request: web.Request) -> web.Response:
         "WHERE id=:id",
         {"s": "ready" if has_master else "pending", "t": db_now(),
          "id": video["id"]})
+    delivery.invalidate_slug(video["slug"])
     return web.json_response({"ok": True})
 
 
@@ -1257,6 +1273,46 @@ async def healthz(request: web.Request) -> web.Response:
 
 
 # --------------------------------------------------------------------------
+# Delivery plane (delivery/): cache stats + operator invalidation.
+# Planes register per process, so these see every plane co-hosted with
+# this admin app (the single-process dev/test topology). In a split
+# deployment the public process exposes its own counters on
+# :9000/metrics and converges via the TTL windows — publish state and
+# manifests always; segment bodies only when the operator sets
+# VLOG_DELIVERY_SEGMENT_TTL (they are pinned by default).
+# --------------------------------------------------------------------------
+
+async def delivery_stats(request: web.Request) -> web.Response:
+    from vlog_tpu import delivery
+
+    return web.json_response(delivery.stats_snapshot())
+
+
+async def delivery_invalidate(request: web.Request) -> web.Response:
+    """Evict delivery caches: body ``{"slug": "..."}`` for one video,
+    ``{"all": true}`` for everything (post-restore-from-backup, rsync'd
+    trees, any mutation the hooks can't see)."""
+    from vlog_tpu import delivery
+
+    body = await request.json() if request.can_read_body else {}
+    slug = (body.get("slug") or "").strip()
+    if not slug and not body.get("all"):
+        return _json_error(400, "need slug or all:true")
+    if body.get("all"):
+        dropped = delivery.invalidate_all()
+        target = "*"
+    else:
+        dropped = delivery.invalidate_slug(slug)
+        target = slug
+    audit = request.app.get(AUDIT)
+    if audit is not None:
+        audit.record("delivery.invalidated", target=target,
+                     entries_dropped=dropped)
+    return web.json_response({"ok": True, "target": target,
+                              "entries_dropped": dropped})
+
+
+# --------------------------------------------------------------------------
 # App assembly
 # --------------------------------------------------------------------------
 
@@ -1312,6 +1368,8 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_get("/api/storage/status", storage_status)
     r.add_get("/api/storage/gc", storage_gc_report)
     r.add_post("/api/storage/gc", run_storage_gc)
+    r.add_get("/api/delivery/stats", delivery_stats)
+    r.add_post("/api/delivery/invalidate", delivery_invalidate)
     r.add_get("/api/events/progress", sse_progress)
     r.add_get("/api/settings", get_settings)
     r.add_put("/api/settings/{key}", put_setting)

@@ -21,28 +21,16 @@ from aiohttp import web
 
 from vlog_tpu import config
 from vlog_tpu.db.core import Database, now as db_now, open_database
+# MIME table lives with the delivery plane now (delivery/http.py);
+# re-exported because it is part of this module's public surface.
+from vlog_tpu.delivery.http import MEDIA_MIME  # noqa: F401
 from vlog_tpu.jobs import videos as vids
 
 log = logging.getLogger("vlog_tpu.public_api")
 
 DB = web.AppKey("db", Database)
 VIDEO_DIR = web.AppKey("video_dir", Path)
-
-# The reference subclasses StaticFiles for exactly this table
-# (HLSStaticFiles, docs/ARCHITECTURE.md:59-62).
-MEDIA_MIME = {
-    ".m3u8": "application/vnd.apple.mpegurl",
-    ".mpd": "application/dash+xml",
-    ".m4s": "video/iso.segment",
-    ".mp4": "video/mp4",
-    ".ts": "video/mp2t",
-    ".vtt": "text/vtt",
-    ".jpg": "image/jpeg",
-    ".jpeg": "image/jpeg",
-    ".png": "image/png",
-    ".y4m": "application/octet-stream",
-    ".aac": "audio/aac",
-}
+DELIVERY = web.AppKey("delivery", object)
 
 _PUBLIC_VIDEO_FIELDS = ("id", "slug", "title", "description", "duration_s",
                         "width", "height", "fps", "status", "category",
@@ -370,34 +358,77 @@ SETTINGS_SVC = web.AppKey("settings_svc", object)
 
 
 # --------------------------------------------------------------------------
-# Media static serving with correct MIME (HLSStaticFiles analog)
+# Media serving through the delivery plane (delivery/): publish-state
+# cache + byte-bounded segment cache + single-flight + admission, with
+# conditional/range/CORS semantics built from cached buffers. A steady-
+# state hit performs zero DB queries and zero disk opens.
 # --------------------------------------------------------------------------
 
+def _media_error(status: int, message: str) -> web.Response:
+    """Media-route errors carry CORS too: a cross-origin player must be
+    able to SEE the 403/404/503, not get an opaque CORS failure."""
+    from vlog_tpu.delivery.http import CORS_HEADERS
+
+    return web.json_response({"error": message}, status=status,
+                             headers=CORS_HEADERS)
+
+
+async def media_preflight(request: web.Request) -> web.Response:
+    from vlog_tpu.delivery import http as delivery_http
+
+    return delivery_http.preflight_response()
+
+
 async def serve_media(request: web.Request) -> web.StreamResponse:
+    from vlog_tpu import delivery
+    from vlog_tpu.delivery import http as delivery_http
+
     slug = request.match_info["slug"]
     tail = request.match_info["tail"]
-    db = request.app[DB]
-    row = await vids.get_video_by_slug(db, slug)
-    # Only published videos serve media: a mid-transcode tree (status
-    # pending/processing) must not leak through guessable slugs.
-    if row is None or row["deleted_at"] or row["status"] != "ready":
-        return _json_error(404, "no such video")
+    plane: delivery.DeliveryPlane = request.app[DELIVERY]
     rel = Path(tail)
     if rel.is_absolute() or ".." in rel.parts or len(rel.parts) > 4:
-        return _json_error(400, "bad path")
+        return _media_error(400, "bad path")
+    # Only published videos serve media: a mid-transcode tree (status
+    # pending/processing) must not leak through guessable slugs. The
+    # publish-state cache answers this without touching the DB.
+    state = await plane.serving_state(slug)
+    if state.status != "ready":
+        return _media_error(404, "no such video")
     if rel.parts and rel.parts[0].startswith("original"):
         # downloads of the source are gated (reference config.py:602-616)
         if not config.DOWNLOADS_ENABLED:
-            return _json_error(403, "downloads disabled")
-    path = request.app[VIDEO_DIR] / slug / rel
-    if not path.is_file():
-        return _json_error(404, "not found")
-    mime = MEDIA_MIME.get(path.suffix.lower(), "application/octet-stream")
-    return web.FileResponse(path, headers={
-        "Content-Type": mime,
-        "Cache-Control": ("no-cache" if path.suffix in (".m3u8", ".mpd")
-                          else "public, max-age=31536000, immutable"),
-        "Access-Control-Allow-Origin": "*"})
+            return _media_error(403, "downloads disabled")
+    try:
+        got = await plane.fetch(slug, tail)
+    except delivery.LoadShedError as exc:
+        resp = _media_error(503, "origin overloaded, retry shortly")
+        resp.headers["Retry-After"] = str(exc.retry_after_s)
+        return resp
+    except (FileNotFoundError, delivery.MediaEscapeError):
+        # a symlink escape reports like any missing file: revealing
+        # "exists but refused" would leak tree shape
+        return _media_error(404, "not found")
+    if isinstance(got, delivery.BypassFile):
+        # too large for the buffer cache: stream, FileResponse handles
+        # its own Range/conditional semantics
+        return web.FileResponse(got.path, headers={
+            "Content-Type": got.mime,
+            "Cache-Control": (
+                delivery_http.CACHE_MUTABLE
+                if got.path.suffix.lower() in delivery_http.MUTABLE_SUFFIXES
+                else delivery_http.CACHE_IMMUTABLE),
+            **delivery_http.CORS_HEADERS})
+    return delivery_http.entry_response(request, got)
+
+
+async def metrics_endpoint(request: web.Request) -> web.Response:
+    """Prometheus view of this serving process (the delivery counters
+    live here, not in the admin process — scrape :9000/metrics)."""
+    from vlog_tpu.obs.metrics import runtime
+
+    return web.Response(text=runtime().render_text(),
+                        content_type="text/plain")
 
 
 async def healthz(request: web.Request) -> web.Response:
@@ -425,6 +456,7 @@ async def error_middleware(request: web.Request, handler):
 def build_public_app(db: Database, *, video_dir: Path | None = None
                      ) -> web.Application:
     from vlog_tpu.api.settings import SettingsService
+    from vlog_tpu.delivery import DeliveryPlane
 
     from vlog_tpu.api.errors import request_id_middleware
 
@@ -432,6 +464,7 @@ def build_public_app(db: Database, *, video_dir: Path | None = None
                                        error_middleware])
     app[DB] = db
     app[VIDEO_DIR] = Path(video_dir or config.VIDEO_DIR)
+    app[DELIVERY] = DeliveryPlane(db, app[VIDEO_DIR])
     app[SETTINGS_SVC] = SettingsService(db)
     r = app.router
     r.add_get("/api/videos", list_videos)
@@ -447,7 +480,9 @@ def build_public_app(db: Database, *, video_dir: Path | None = None
     r.add_post("/api/videos/{slug}/session", start_session)
     r.add_post("/api/sessions/heartbeat", session_heartbeat)
     r.add_post("/api/sessions/end", end_session)
-    r.add_get("/videos/{slug}/{tail:.+}", serve_media)
+    r.add_get("/videos/{slug}/{tail:.+}", serve_media)   # GET + HEAD
+    r.add_route("OPTIONS", "/videos/{slug}/{tail:.+}", media_preflight)
+    r.add_get("/metrics", metrics_endpoint)
     r.add_get("/healthz", healthz)
     from vlog_tpu.web import attach_ui
 

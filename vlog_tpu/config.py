@@ -222,6 +222,44 @@ GC_DELETED_RETENTION_S: float = _env_float("VLOG_GC_DELETED_RETENTION",
 TRACE_ENABLED: bool = _env_bool("VLOG_TRACE_ENABLED", True)
 
 # --------------------------------------------------------------------------
+# Delivery plane (delivery/): origin-side segment cache + admission
+# between serve_media and the filesystem/DB. Steady-state playback must
+# not touch Postgres or re-open published segments per request.
+# --------------------------------------------------------------------------
+
+# Byte budget of the in-memory LRU segment cache (0 disables caching;
+# requests still flow through the same response builder, so cached and
+# uncached responses stay byte-identical).
+DELIVERY_CACHE_BYTES: int = _env_int(
+    "VLOG_DELIVERY_CACHE_BYTES", 256 * 1024**2, lo=0)
+# Distinct cache-miss disk reads allowed in flight at once; misses past
+# the bound answer 503 + Retry-After instead of queueing on the volume
+# (single-flight already collapses same-segment misses to one read).
+DELIVERY_MAX_INFLIGHT_READS: int = _env_int(
+    "VLOG_DELIVERY_MAX_INFLIGHT_READS", 64, lo=1)
+# Mutable manifests (.m3u8/.mpd) cache for this long; segments are
+# immutable (digest-keyed) and live until evicted or invalidated.
+DELIVERY_MANIFEST_TTL_S: float = _env_float(
+    "VLOG_DELIVERY_MANIFEST_TTL", 2.0, lo=0.0)
+# Segment bodies are pinned by default (0): in-process invalidation
+# covers every publish/re-encode path and steady state stays
+# zero-syscall. In a SPLIT deployment — trees mutated by an admin or
+# worker PROCESS the serving process can't see — invalidation cannot
+# fan out, so set a TTL here to bound how long a republished segment
+# may serve stale from this cache.
+DELIVERY_SEGMENT_TTL_S: float = _env_float(
+    "VLOG_DELIVERY_SEGMENT_TTL", 0.0, lo=0.0)
+# Publish-state (slug -> ready/deleted/missing) cache TTL: the window in
+# which a publish/delete in ANOTHER process may be stale here. In-process
+# mutations invalidate explicitly and are visible immediately.
+DELIVERY_STATE_TTL_S: float = _env_float(
+    "VLOG_DELIVERY_STATE_TTL", 5.0, lo=0.0)
+# Objects larger than this bypass the buffer cache and stream from disk
+# (sized well above any 4-6 s segment; catches source downloads).
+DELIVERY_MAX_ENTRY_BYTES: int = _env_int(
+    "VLOG_DELIVERY_MAX_ENTRY_BYTES", 32 * 1024**2, lo=1)
+
+# --------------------------------------------------------------------------
 # Transcription (reference: config.py:263-267)
 # --------------------------------------------------------------------------
 

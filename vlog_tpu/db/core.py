@@ -116,6 +116,10 @@ class Database:
         self.path = parse_database_url(url)
         self._conn: sqlite3.Connection | None = None
         self._lock = asyncio.Lock()
+        # Statements executed over this facade's lifetime. Serving-path
+        # tests assert steady-state deltas of exactly zero (the delivery
+        # plane's "a cached segment hit performs no DB queries").
+        self.query_count = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -191,19 +195,23 @@ class Database:
 
     def _run_execute(self, sql: str, params: Params) -> int:
         conn = self._require_conn()
+        self.query_count += 1
         cur = conn.execute(sql, dict(params or {}))
         verb = sql.lstrip().split(None, 1)[0].upper() if sql.strip() else ""
         return cur.lastrowid if verb == "INSERT" else cur.rowcount
 
     def _run_execute_many(self, sql: str, seq: list[Mapping[str, Any]]) -> None:
+        self.query_count += 1
         self._require_conn().executemany(sql, [dict(p) for p in seq])
 
     def _run_fetch_one(self, sql: str, params: Params) -> Row | None:
+        self.query_count += 1
         cur = self._require_conn().execute(sql, dict(params or {}))
         row = cur.fetchone()
         return dict(row) if row is not None else None
 
     def _run_fetch_all(self, sql: str, params: Params) -> list[Row]:
+        self.query_count += 1
         cur = self._require_conn().execute(sql, dict(params or {}))
         return [dict(r) for r in cur.fetchall()]
 

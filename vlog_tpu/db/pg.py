@@ -316,6 +316,9 @@ class PgDatabase:
         self._connected = False
         self._id_tables: set[str] | None = None
         self._grow_lock = asyncio.Lock()
+        # Same counter contract as the sqlite facade: statements issued
+        # over this facade's lifetime (serving-path zero-query asserts).
+        self.query_count = 0
 
     @staticmethod
     def greatest(*exprs: str) -> str:
@@ -378,6 +381,7 @@ class PgDatabase:
     async def _run(self, conn: _PgConn, sql: str, params: Params) -> Any:
         """Dispatch one statement, honoring the facade's return contract:
         INSERT -> new id (when the table has one), else affected count."""
+        self.query_count += 1
         verb = sql.lstrip().split(None, 1)[0].upper() if sql.strip() else ""
         if verb == "CREATE" or verb == "ALTER":
             sql = translate_ddl(sql)
@@ -404,6 +408,10 @@ class PgDatabase:
                            seq: Iterable[Mapping[str, Any]]) -> None:
         conn = await self._acquire()
         try:
+            # one increment per call, not per row — the sqlite facade
+            # counts executemany once, and exact-delta asserts must see
+            # the same number on both backends
+            self.query_count += 1
             for params in seq:
                 await asyncio.to_thread(conn.query, sql, params)
         finally:
@@ -412,6 +420,7 @@ class PgDatabase:
     async def fetch_one(self, sql: str, params: Params = None) -> Row | None:
         conn = await self._acquire()
         try:
+            self.query_count += 1
             rows, _ = await asyncio.to_thread(conn.query, sql, params)
             return rows[0] if rows else None
         finally:
@@ -420,6 +429,7 @@ class PgDatabase:
     async def fetch_all(self, sql: str, params: Params = None) -> list[Row]:
         conn = await self._acquire()
         try:
+            self.query_count += 1
             rows, _ = await asyncio.to_thread(conn.query, sql, params)
             return rows
         finally:
@@ -470,14 +480,17 @@ class PgTransaction:
 
     async def execute_many(self, sql: str,
                            seq: Iterable[Mapping[str, Any]]) -> None:
+        self._db.query_count += 1   # per call, matching the sqlite facade
         for params in seq:
             await asyncio.to_thread(self._conn.query, sql, params)
 
     async def fetch_one(self, sql: str, params: Params = None) -> Row | None:
+        self._db.query_count += 1
         rows, _ = await asyncio.to_thread(self._conn.query, sql, params)
         return rows[0] if rows else None
 
     async def fetch_all(self, sql: str, params: Params = None) -> list[Row]:
+        self._db.query_count += 1
         rows, _ = await asyncio.to_thread(self._conn.query, sql, params)
         return rows
 

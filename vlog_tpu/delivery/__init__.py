@@ -1,0 +1,41 @@
+"""Delivery plane: origin segment cache, single-flight, admission,
+publish-keyed invalidation (see delivery/plane.py for the design note).
+
+Import surface for the rest of the codebase:
+
+- :class:`DeliveryPlane` — one per serving process (public API).
+- :func:`invalidate_slug` / :func:`invalidate_all` — called by the
+  publish/re-encode/delete/verify paths and the admin endpoint; fan out
+  to every plane registered in this process.
+- :func:`stats_snapshot` — the admin stats panel's data source.
+"""
+
+from vlog_tpu.delivery.cache import CacheEntry, SegmentCache, SingleFlight
+from vlog_tpu.delivery.plane import (
+    BypassFile,
+    DeliveryPlane,
+    LoadShedError,
+    MediaEscapeError,
+    ServingState,
+    has_planes,
+    invalidate_all,
+    invalidate_slug,
+    register,
+    stats_snapshot,
+)
+
+__all__ = [
+    "BypassFile",
+    "CacheEntry",
+    "DeliveryPlane",
+    "LoadShedError",
+    "MediaEscapeError",
+    "SegmentCache",
+    "ServingState",
+    "SingleFlight",
+    "has_planes",
+    "invalidate_all",
+    "invalidate_slug",
+    "register",
+    "stats_snapshot",
+]

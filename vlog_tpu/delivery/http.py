@@ -1,0 +1,215 @@
+"""HTTP semantics for cached media: conditional, range, CORS, HEAD.
+
+One response builder serves BOTH the cached and the uncached path — a
+cache-off deployment (``VLOG_DELIVERY_CACHE_BYTES=0``) still builds its
+responses from the same :class:`~vlog_tpu.delivery.cache.CacheEntry`
+the fill produced, it just doesn't retain the entry. That is what makes
+"cached responses are byte-identical to uncached ones" a structural
+property instead of a test hope.
+
+Implemented subset (what MSE/hls players actually send):
+
+- strong ETags (the manifest sha256 when the tree has one), handled for
+  ``If-None-Match`` (list form, ``W/`` prefixes, ``*``) -> **304**;
+  ``If-Modified-Since`` -> **304** for ETag-less revalidators
+  (``If-None-Match`` takes precedence when both are present)
+- single-range ``Range: bytes=a-b | a- | -n`` -> **206** with
+  ``Content-Range``; syntactically-valid-but-unsatisfiable -> **416**;
+  multi-range requests are answered with the full **200** body (allowed
+  by RFC 9110 §14.2 — no media player sends them)
+- ``If-Range`` with either an ETag or an HTTP-date validator; a failed
+  validator serves the full 200 body (never a stale-ranged splice)
+- HEAD mirrors every header including ``Content-Length`` with an empty
+  body; OPTIONS answers CORS preflight so cross-origin players can
+  probe segments (the reference relies on its CDN for this tier).
+"""
+
+from __future__ import annotations
+
+from email.utils import formatdate, parsedate_to_datetime
+
+from aiohttp import web
+
+from vlog_tpu.delivery.cache import CacheEntry
+
+# The reference subclasses StaticFiles for exactly this table
+# (HLSStaticFiles, docs/ARCHITECTURE.md:59-62).
+MEDIA_MIME = {
+    ".m3u8": "application/vnd.apple.mpegurl",
+    ".mpd": "application/dash+xml",
+    ".m4s": "video/iso.segment",
+    ".mp4": "video/mp4",
+    ".ts": "video/mp2t",
+    ".vtt": "text/vtt",
+    ".jpg": "image/jpeg",
+    ".jpeg": "image/jpeg",
+    ".png": "image/png",
+    ".y4m": "application/octet-stream",
+    ".aac": "audio/aac",
+}
+
+# Mutable playlist suffixes: short-TTL cache entries, no-cache clients.
+MUTABLE_SUFFIXES = (".m3u8", ".mpd")
+
+CACHE_IMMUTABLE = "public, max-age=31536000, immutable"
+CACHE_MUTABLE = "no-cache"
+
+# Cross-origin playback surface: players fetch manifests/segments with
+# Range and revalidation headers and must be able to READ the range /
+# validator response headers, not just receive the bytes.
+CORS_HEADERS = {
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Expose-Headers":
+        "Content-Length, Content-Range, Accept-Ranges, ETag, Last-Modified",
+}
+PREFLIGHT_HEADERS = {
+    **CORS_HEADERS,
+    "Access-Control-Allow-Methods": "GET, HEAD, OPTIONS",
+    "Access-Control-Allow-Headers":
+        "Range, If-None-Match, If-Modified-Since, If-Range",
+    "Access-Control-Max-Age": "86400",
+}
+
+
+def preflight_response() -> web.Response:
+    """CORS preflight for the media routes (OPTIONS)."""
+    return web.Response(status=204, headers=PREFLIGHT_HEADERS)
+
+
+def cache_control(entry: CacheEntry) -> str:
+    return CACHE_IMMUTABLE if entry.immutable else CACHE_MUTABLE
+
+
+def etag_matches(header: str, etag: str) -> bool:
+    """RFC 9110 If-None-Match: comma list, weak prefixes, ``*``."""
+    if header.strip() == "*":
+        return True
+    for cand in header.split(","):
+        cand = cand.strip()
+        if cand.startswith("W/"):
+            cand = cand[2:]
+        if cand == etag:
+            return True
+    return False
+
+
+class RangeNotSatisfiable(ValueError):
+    """A syntactically valid bytes range outside the representation."""
+
+
+def parse_range(header: str, size: int) -> tuple[int, int] | None:
+    """``(start, end_inclusive)`` for a single satisfiable bytes range.
+
+    None means "serve the full body": absent/other units, malformed
+    syntax (RFC 9110 says ignore), or multi-range. Raises
+    :class:`RangeNotSatisfiable` for well-formed ranges that miss the
+    representation entirely (416 + ``Content-Range: bytes */size``).
+    """
+    if not header or not header.startswith("bytes="):
+        return None
+    spec = header[len("bytes="):].strip()
+    if "," in spec:             # multi-range: legal to answer with 200
+        return None
+    start_s, dash, end_s = spec.partition("-")
+    if not dash:
+        return None
+    start_s, end_s = start_s.strip(), end_s.strip()
+    try:
+        if not start_s:                     # suffix form: last N bytes
+            n = int(end_s)
+            if n <= 0:
+                raise RangeNotSatisfiable(header)
+            if size == 0:
+                raise RangeNotSatisfiable(header)
+            return max(0, size - n), size - 1
+        start = int(start_s)
+        if start >= size:
+            raise RangeNotSatisfiable(header)
+        end = int(end_s) if end_s else size - 1
+    except ValueError as exc:
+        if isinstance(exc, RangeNotSatisfiable):
+            raise
+        return None                         # malformed -> full body
+    if end < start:
+        return None
+    return start, min(end, size - 1)
+
+
+def _unmodified_since(header: str | None, entry: CacheEntry) -> bool:
+    """If-Modified-Since -> 304 eligibility (ETag-less revalidators —
+    the header the preflight invites clients to send)."""
+    if header is None:
+        return False
+    try:
+        cut = parsedate_to_datetime(header).timestamp()
+    except (TypeError, ValueError):
+        return False
+    return int(entry.mtime) <= cut
+
+
+def _if_range_allows(header: str | None, entry: CacheEntry) -> bool:
+    """True when a Range header may be honored under this If-Range."""
+    if header is None:
+        return True
+    header = header.strip()
+    if header.startswith(('"', "W/")):
+        # entity-tag form; weak tags never match for ranges (RFC 9110)
+        return header == entry.etag
+    try:
+        cut = parsedate_to_datetime(header).timestamp()
+    except (TypeError, ValueError):
+        return False
+    # RFC 9110 §13.1.5: the date must EXACTLY match the current
+    # Last-Modified ("not earlier than"-style laxity would let a tree
+    # restored with an older mtime splice ranges across two bodies).
+    # Last-Modified granularity is whole seconds on the wire.
+    return int(entry.mtime) == int(cut)
+
+
+def entry_response(request: web.Request, entry: CacheEntry,
+                   ) -> web.Response:
+    """The full conditional/range state machine over a cached buffer."""
+    base = {
+        "Content-Type": entry.mime,
+        "ETag": entry.etag,
+        "Last-Modified": formatdate(entry.mtime, usegmt=True),
+        "Accept-Ranges": "bytes",
+        "Cache-Control": cache_control(entry),
+        **CORS_HEADERS,
+    }
+    inm = request.headers.get("If-None-Match")
+    if inm is not None and etag_matches(inm, entry.etag):
+        not_modified = dict(base)
+        not_modified.pop("Content-Type")    # 304 carries no payload head
+        return web.Response(status=304, headers=not_modified)
+    if inm is None and _unmodified_since(
+            request.headers.get("If-Modified-Since"), entry):
+        not_modified = dict(base)
+        not_modified.pop("Content-Type")
+        return web.Response(status=304, headers=not_modified)
+
+    size = len(entry.body)
+    rng = None
+    # RFC 9110 §13.1.5: a non-matching If-Range means IGNORE the Range
+    # header outright — including its 416 path, or a resume against a
+    # republished-smaller body would 416 instead of getting the new 200.
+    if _if_range_allows(request.headers.get("If-Range"), entry):
+        try:
+            rng = parse_range(request.headers.get("Range", ""), size)
+        except RangeNotSatisfiable:
+            return web.Response(
+                status=416,
+                headers={**base, "Content-Range": f"bytes */{size}"})
+
+    if rng is None:
+        status, body = 200, entry.body
+    else:
+        start, end = rng
+        status, body = 206, entry.body[start:end + 1]
+        base["Content-Range"] = f"bytes {start}-{end}/{size}"
+
+    if request.method == "HEAD":
+        # mirror the GET headers (Content-Length included) sans body
+        base["Content-Length"] = str(len(body))
+        return web.Response(status=status, headers=base)
+    return web.Response(status=status, body=body, headers=base)

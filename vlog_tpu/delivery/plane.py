@@ -1,0 +1,376 @@
+"""The delivery plane: origin-side caching + admission for serve_media.
+
+Sits between the public API's media route and the filesystem/DB so that
+steady-state playback — every 4-second ``.m4s`` of every concurrent
+viewer — touches neither Postgres nor ``open()``:
+
+- a **publish-state cache** (slug -> ready/deleted/missing, TTL +
+  explicit invalidation) answers the "may this slug serve at all?"
+  gate from memory, via the narrow ``get_video_serving_state`` query on
+  miss instead of the old ``SELECT * FROM videos`` per segment;
+- the **segment cache** (delivery/cache.py) holds response buffers
+  under a byte budget, ETags seeded from the PR-2 ``outputs.json``
+  manifest so revalidation compares the real published sha256;
+- **single-flight** collapses N concurrent misses for one segment onto
+  one disk read;
+- an **admission bound** sheds distinct-key misses past
+  ``VLOG_DELIVERY_MAX_INFLIGHT_READS`` with 503 + ``Retry-After``
+  rather than queueing unbounded reads on the volume;
+- **invalidation** — publish/re-encode/delete/restore/verify paths call
+  :func:`invalidate_slug`, which fans out to every plane registered in
+  this process (plus ``POST /api/delivery/invalidate`` for operators).
+  Cross-process staleness of publish state and manifests is bounded by
+  ``VLOG_DELIVERY_STATE_TTL`` / ``VLOG_DELIVERY_MANIFEST_TTL``; segment
+  BODIES are pinned by default, so a split deployment (admin/worker
+  mutating trees in another process) must set
+  ``VLOG_DELIVERY_SEGMENT_TTL`` for republished segments to converge.
+
+Counters go two places on purpose: plain ints on the plane (the admin
+stats panel and tests read exact deltas) and the process-wide
+``obs.metrics.runtime()`` registry (Prometheus families
+``vlog_delivery_*`` — scraped via the public API's ``/metrics``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import stat as stat_mod
+import time
+import weakref
+from dataclasses import dataclass
+from pathlib import Path
+
+from vlog_tpu import config
+from vlog_tpu.delivery.cache import CacheEntry, SegmentCache, SingleFlight
+from vlog_tpu.delivery.http import MEDIA_MIME, MUTABLE_SUFFIXES
+from vlog_tpu.obs.metrics import runtime
+from vlog_tpu.utils import failpoints
+
+# Publish-state entries (including negative "missing" ones) are tiny;
+# this bound only matters under a random-slug 404 storm.
+_STATE_CACHE_MAX = 16384
+# Per-slug manifest digest maps are bigger (one {rel: (size, sha)} per
+# published file); bound them so a long-lived process serving a huge
+# catalog doesn't accumulate one map per slug ever touched.
+_DIGEST_CACHE_MAX = 2048
+
+
+class LoadShedError(RuntimeError):
+    """Admission refused: too many origin reads in flight (HTTP 503)."""
+
+    def __init__(self, retry_after_s: int = 1):
+        super().__init__("origin overloaded; retry shortly")
+        self.retry_after_s = retry_after_s
+
+
+class MediaEscapeError(PermissionError):
+    """A resolved path escaped the slug's tree (symlink traversal)."""
+
+
+@dataclass(frozen=True)
+class ServingState:
+    """What the media route needs to gate a request — nothing more."""
+
+    video_id: int | None
+    status: str                 # 'ready' | 'deleted' | 'missing' | other
+
+
+@dataclass(frozen=True)
+class BypassFile:
+    """An object too large to buffer: stream it from disk instead."""
+
+    path: Path
+    mime: str
+    size: int
+
+
+class DeliveryPlane:
+    """One per serving process; constructed by ``build_public_app``."""
+
+    def __init__(self, db, video_dir: str | Path, *,
+                 cache_bytes: int | None = None,
+                 max_inflight_reads: int | None = None,
+                 manifest_ttl_s: float | None = None,
+                 segment_ttl_s: float | None = None,
+                 state_ttl_s: float | None = None,
+                 max_entry_bytes: int | None = None):
+        self.db = db
+        self.video_dir = Path(video_dir)
+        self.max_inflight_reads = (config.DELIVERY_MAX_INFLIGHT_READS
+                                   if max_inflight_reads is None
+                                   else max_inflight_reads)
+        self.manifest_ttl_s = (config.DELIVERY_MANIFEST_TTL_S
+                               if manifest_ttl_s is None else manifest_ttl_s)
+        self.segment_ttl_s = (config.DELIVERY_SEGMENT_TTL_S
+                              if segment_ttl_s is None else segment_ttl_s)
+        self.state_ttl_s = (config.DELIVERY_STATE_TTL_S
+                            if state_ttl_s is None else state_ttl_s)
+        self.max_entry_bytes = (config.DELIVERY_MAX_ENTRY_BYTES
+                                if max_entry_bytes is None
+                                else max_entry_bytes)
+        m = runtime()
+        self.cache = SegmentCache(
+            config.DELIVERY_CACHE_BYTES if cache_bytes is None
+            else cache_bytes,
+            on_evict=lambda _size: m.delivery_evictions.inc())
+        self.flight = SingleFlight(
+            on_collapse=lambda: m.delivery_collapses.inc())
+        self._states: dict[str, tuple[ServingState, float]] = {}
+        # slug -> (outputs.json mtime_ns | None, {rel: (size, sha256)})
+        self._digests: dict[str, tuple[int | None,
+                                       dict[str, tuple[int, str]]]] = {}
+        self._root_resolved: Path | None = None
+        self._inflight_reads = 0
+        # bumped by every invalidation: a fill that straddles one must
+        # not cache what it read (the tree may have been rewritten
+        # between its read and its put)
+        self._fill_gen = 0
+        self.counters = {
+            "hits": 0, "misses": 0, "bypass": 0, "shed": 0,
+            "disk_reads": 0, "state_hits": 0, "state_misses": 0,
+            "invalidations": 0,
+        }
+        register(self)
+
+    # -- publish-state gate ------------------------------------------------
+
+    async def serving_state(self, slug: str) -> ServingState:
+        """ready/deleted/missing for one slug, DB-free in steady state."""
+        now = time.monotonic()
+        cached = self._states.get(slug)
+        if cached is not None and now < cached[1]:
+            self.counters["state_hits"] += 1
+            return cached[0]
+        self.counters["state_misses"] += 1
+        from vlog_tpu.jobs import videos as vids   # lazy: no import cycle
+
+        row = await vids.get_video_serving_state(self.db, slug)
+        if row is None:
+            st = ServingState(None, "missing")
+        elif row["deleted_at"]:
+            st = ServingState(row["id"], "deleted")
+        else:
+            st = ServingState(row["id"], row["status"])
+        if len(self._states) >= _STATE_CACHE_MAX:
+            self._states.clear()        # coarse but bounded; re-warms
+        self._states[slug] = (st, now + self.state_ttl_s)
+        return st
+
+    # -- segment fetch -----------------------------------------------------
+
+    async def fetch(self, slug: str, rel: str
+                    ) -> CacheEntry | BypassFile:
+        """The media body for ``slug/rel`` — cached, or read via
+        single-flight under the admission bound.
+
+        Raises FileNotFoundError (404), :class:`MediaEscapeError`
+        (symlink traversal, also a 404 — don't leak tree shape),
+        :class:`LoadShedError` (503), and any armed
+        ``delivery.read`` failpoint error (the fill fails, nothing is
+        cached, the next request retries).
+        """
+        entry = self.cache.get((slug, rel))
+        if entry is not None:
+            self.counters["hits"] += 1
+            m = runtime()
+            m.delivery_requests.labels("hit").inc()
+            m.delivery_bytes.labels("cache").inc(entry.size)
+            return entry
+        return await self.flight.run((slug, rel),
+                                     lambda: self._fill(slug, rel))
+
+    async def _fill(self, slug: str, rel: str) -> CacheEntry | BypassFile:
+        # a just-finished leader may have filled it while we queued
+        entry = self.cache.get((slug, rel))
+        if entry is not None:
+            self.counters["hits"] += 1
+            runtime().delivery_requests.labels("hit").inc()
+            runtime().delivery_bytes.labels("cache").inc(entry.size)
+            return entry
+        m = runtime()
+        try:
+            failpoints.hit("delivery.shed")
+        except failpoints.FailpointError:
+            self.counters["shed"] += 1
+            m.delivery_requests.labels("shed").inc()
+            raise LoadShedError() from None
+        if self._inflight_reads >= self.max_inflight_reads:
+            self.counters["shed"] += 1
+            m.delivery_requests.labels("shed").inc()
+            raise LoadShedError()
+        self._inflight_reads += 1
+        m.delivery_inflight_reads.set(self._inflight_reads)
+        gen = self._fill_gen
+        try:
+            got = await asyncio.to_thread(self._read_entry, slug, rel)
+        finally:
+            self._inflight_reads -= 1
+            m.delivery_inflight_reads.set(self._inflight_reads)
+        self.counters["disk_reads"] += 1
+        if isinstance(got, BypassFile):
+            self.counters["bypass"] += 1
+            m.delivery_requests.labels("bypass").inc()
+            return got
+        self.counters["misses"] += 1
+        m.delivery_requests.labels("miss").inc()
+        m.delivery_bytes.labels("disk").inc(got.size)
+        if gen == self._fill_gen:
+            # an invalidation mid-read means these bytes may predate a
+            # tree rewrite: serve them to the waiters, cache nothing
+            self.cache.put(got)
+        m.delivery_cache_bytes.set(self.cache.bytes_cached)
+        return got
+
+    # -- blocking internals (run in a thread) ------------------------------
+
+    def _video_root(self) -> Path:
+        if self._root_resolved is None:
+            self._root_resolved = self.video_dir.resolve()
+        return self._root_resolved
+
+    def _read_entry(self, slug: str, rel: str) -> CacheEntry | BypassFile:
+        failpoints.hit("delivery.read")
+        raw = self.video_dir / slug / rel
+        # ONE resolve per fill (not per hit): the lexical ".." check in
+        # the route catches textual traversal; this catches a symlink
+        # inside the tree pointing outside VIDEO_DIR/slug.
+        resolved = raw.resolve()
+        slug_root = self._video_root() / slug
+        if not (resolved == slug_root
+                or str(resolved).startswith(str(slug_root) + os.sep)):
+            raise MediaEscapeError(f"{slug}/{rel} escapes its tree")
+        try:
+            st = resolved.stat()
+        except OSError as exc:
+            raise FileNotFoundError(str(raw)) from exc
+        if not stat_mod.S_ISREG(st.st_mode):
+            raise FileNotFoundError(str(raw))
+        suffix = resolved.suffix.lower()
+        mime = MEDIA_MIME.get(suffix, "application/octet-stream")
+        if st.st_size > self.max_entry_bytes:
+            return BypassFile(path=resolved, mime=mime, size=st.st_size)
+        body = resolved.read_bytes()
+        digest = self._digest_for(slug, rel, len(body))
+        mutable = suffix in MUTABLE_SUFFIXES
+        if digest is not None:
+            version, etag = digest, f'"{digest}"'
+        else:
+            version = f"{st.st_mtime_ns:x}"
+            etag = f'"{st.st_mtime_ns:x}-{len(body):x}"'
+        expires = None
+        if mutable:
+            expires = time.monotonic() + self.manifest_ttl_s
+        elif self.segment_ttl_s > 0:
+            # split deployments: bound staleness of republished bodies
+            expires = time.monotonic() + self.segment_ttl_s
+        return CacheEntry(
+            slug=slug, rel=rel, version=version, body=body, etag=etag,
+            mime=mime, mtime=st.st_mtime, immutable=not mutable,
+            expires_at=expires)
+
+    def _digest_for(self, slug: str, rel: str, size: int) -> str | None:
+        """The manifest sha256 for one published file, or None.
+
+        The per-slug digest map loads from ``outputs.json`` on first
+        use and revalidates by the manifest's mtime_ns per fill (a stat,
+        not a re-read — fills are misses, already off the hot path). A
+        size mismatch means the manifest is stale for this rel: fall
+        back to the mtime ETag rather than lie about content.
+        """
+        from vlog_tpu.storage import integrity
+
+        root = self.video_dir / slug
+        cached = self._digests.get(slug)
+        try:
+            current_ns = (root / integrity.MANIFEST_NAME).stat().st_mtime_ns
+        except OSError:
+            current_ns = None
+        if cached is None or cached[0] != current_ns:
+            cached = integrity.manifest_digests(root)
+            if len(self._digests) >= _DIGEST_CACHE_MAX:
+                self._digests.clear()   # coarse but bounded; re-warms
+            self._digests[slug] = cached
+        want = cached[1].get(rel)
+        if want is None or want[0] != size:
+            return None
+        return want[1]
+
+    # -- invalidation + stats ---------------------------------------------
+
+    def invalidate_slug(self, slug: str) -> int:
+        """Evict everything known about one slug; returns entries dropped."""
+        n = self.cache.invalidate_slug(slug)
+        self._states.pop(slug, None)
+        self._digests.pop(slug, None)
+        self._fill_gen += 1
+        self.counters["invalidations"] += 1
+        runtime().delivery_cache_bytes.set(self.cache.bytes_cached)
+        return n
+
+    def invalidate_all(self) -> int:
+        n = self.cache.clear()
+        self._states.clear()
+        self._digests.clear()
+        self._fill_gen += 1
+        self.counters["invalidations"] += 1
+        runtime().delivery_cache_bytes.set(self.cache.bytes_cached)
+        return n
+
+    def stats(self) -> dict:
+        return {
+            **self.counters,
+            "single_flight_collapses": self.flight.collapses,
+            "evictions": self.cache.evictions,
+            "expirations": self.cache.expirations,
+            "cache_entries": len(self.cache),
+            "cache_bytes": self.cache.bytes_cached,
+            "cache_budget_bytes": self.cache.max_bytes,
+            "state_entries": len(self._states),
+            "inflight_reads": self._inflight_reads,
+            "max_inflight_reads": self.max_inflight_reads,
+        }
+
+
+# --------------------------------------------------------------------------
+# Process-wide plane registry: the invalidation hooks in jobs/ and the
+# admin API fan out here. WeakSet: a plane lives exactly as long as the
+# app that built it.
+# --------------------------------------------------------------------------
+
+_PLANES: "weakref.WeakSet[DeliveryPlane]" = weakref.WeakSet()
+
+
+def register(plane: DeliveryPlane) -> None:
+    _PLANES.add(plane)
+
+
+def has_planes() -> bool:
+    """Whether this process serves media at all — lets invalidation
+    hooks skip their slug lookup in worker/admin-only processes."""
+    return len(_PLANES) > 0
+
+
+def invalidate_slug(slug: str) -> int:
+    """Evict one slug from every delivery plane in this process.
+
+    Returns total entries dropped. Safe (a no-op) in processes that
+    serve no media — workers and the admin API call it unconditionally.
+    """
+    return sum(p.invalidate_slug(slug) for p in list(_PLANES))
+
+
+def invalidate_all() -> int:
+    return sum(p.invalidate_all() for p in list(_PLANES))
+
+
+def stats_snapshot() -> dict:
+    """Aggregated + per-plane stats for the admin panel."""
+    per_plane = [p.stats() for p in list(_PLANES)]
+    totals: dict[str, int] = {}
+    for s in per_plane:
+        for k, v in s.items():
+            if isinstance(v, int):
+                totals[k] = totals.get(k, 0) + v
+    return {"planes": per_plane, "totals": totals,
+            "plane_count": len(per_plane)}

@@ -80,3 +80,6 @@ async def finalize_transcription(
     await db.execute(
         "UPDATE videos SET transcription_status='completed', updated_at=:t "
         "WHERE id=:id", {"t": t, "id": video_id})
+    # captions.vtt just changed under the slug: evict any cached copy
+    # (transcode publish invalidates via vids.finalize_ready already)
+    await vids.invalidate_delivery(db, video_id)

@@ -76,6 +76,30 @@ async def get_video_by_slug(db: Database, slug: str) -> Row | None:
     return await db.fetch_one("SELECT * FROM videos WHERE slug=:s", {"s": slug})
 
 
+async def get_video_serving_state(db: Database, slug: str) -> Row | None:
+    """The narrow row the delivery plane's publish-state cache fills
+    from: id/slug/status/deleted_at only. The per-segment path must not
+    drag the full tag/description payload out of the DB per miss."""
+    return await db.fetch_one(
+        "SELECT id, slug, status, deleted_at FROM videos WHERE slug=:s",
+        {"s": slug})
+
+
+async def invalidate_delivery(db: Database, video_id: int) -> None:
+    """Evict a video from any in-process delivery-plane caches after a
+    publish-visible mutation (status flip, publish, re-encode). A no-op
+    in processes that serve no media; lazy import keeps the job plane
+    free of a delivery dependency at import time."""
+    from vlog_tpu import delivery
+
+    if not delivery.has_planes():
+        return      # worker/admin-only process: skip the slug lookup
+    row = await db.fetch_one("SELECT slug FROM videos WHERE id=:id",
+                             {"id": video_id})
+    if row is not None:
+        delivery.invalidate_slug(row["slug"])
+
+
 async def set_status(
     db: Database, video_id: int, status: VideoStatus, *, error: str | None = None
 ) -> None:
@@ -83,6 +107,7 @@ async def set_status(
         "UPDATE videos SET status=:s, error=:e, updated_at=:t WHERE id=:id",
         {"s": status.value, "e": error, "t": db_now(), "id": video_id},
     )
+    await invalidate_delivery(db, video_id)
 
 
 async def finalize_ready(
@@ -137,3 +162,6 @@ async def finalize_ready(
                     "pp": q.get("playlist_path"), "t": t,
                 },
             )
+    # publish-keyed invalidation: a (re)published tree must be visible
+    # to in-process delivery caches immediately, not after the TTL
+    await invalidate_delivery(db, video_id)

@@ -144,6 +144,33 @@ class RuntimeMetrics:
         self.spans_recorded = Counter(
             "vlog_spans_recorded_total", "Spans persisted to job_spans",
             ["origin"], registry=self.registry)
+        # Delivery plane (delivery/): origin segment cache + admission.
+        self.delivery_requests = Counter(
+            "vlog_delivery_requests_total",
+            "Delivery-plane media request outcomes "
+            "(hit, miss, bypass, shed)",
+            ["outcome"], registry=self.registry)
+        self.delivery_bytes = Counter(
+            "vlog_delivery_bytes_total",
+            "Payload bytes produced by the delivery plane, by source "
+            "(cache buffer vs origin disk read)",
+            ["source"], registry=self.registry)
+        self.delivery_evictions = Counter(
+            "vlog_delivery_evictions_total",
+            "Segment-cache entries evicted to stay under the byte budget",
+            registry=self.registry)
+        self.delivery_collapses = Counter(
+            "vlog_delivery_collapses_total",
+            "Concurrent same-key misses collapsed onto one disk read",
+            registry=self.registry)
+        self.delivery_cache_bytes = Gauge(
+            "vlog_delivery_cache_bytes",
+            "Bytes currently held by the delivery segment cache",
+            registry=self.registry)
+        self.delivery_inflight_reads = Gauge(
+            "vlog_delivery_inflight_reads",
+            "Cache-fill disk reads currently in flight",
+            registry=self.registry)
         # the fires counter must see every fire in the process, wherever
         # the site lives — failpoints stays dependency-free, we observe
         failpoints.add_observer(

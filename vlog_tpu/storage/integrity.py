@@ -244,6 +244,29 @@ def verify_tree(root: str | Path, files: dict[str, dict],
     return problems
 
 
+def manifest_digests(root: str | Path
+                     ) -> tuple[int | None, dict[str, tuple[int, str]]]:
+    """``(manifest mtime_ns, {rel: (size, sha256)})`` for a tree.
+
+    The delivery plane seeds segment ETags from this so revalidation
+    compares the real published digest, not an mtime proxy. Returns
+    ``(None, {})`` when the tree has no (readable, well-formed) manifest
+    — absence just downgrades ETags, it must never fail a serve. The
+    mtime_ns is the staleness guard: ``outputs.json`` is rewritten by
+    every publish/regenerate, so a changed mtime invalidates the map.
+    """
+    path = Path(root) / MANIFEST_NAME
+    try:
+        mtime_ns = path.stat().st_mtime_ns
+        files = load_manifest(root)
+    except (OSError, ManifestError):
+        return None, {}
+    if files is None:
+        return None, {}
+    return mtime_ns, {rel: (entry["size"], entry["sha256"])
+                      for rel, entry in files.items()}
+
+
 # --------------------------------------------------------------------------
 # Disk admission control
 # --------------------------------------------------------------------------

@@ -30,6 +30,12 @@ site                where it fires
 ``storage.verify``  at storage.integrity.verify_tree entry — forces a
                     manifest-verification rejection
 ``storage.gc``      at storage.gc.run_gc entry — the armed sweep aborts
+``delivery.read``   in the delivery plane's cache-fill disk read
+                    (delivery/plane.py) — the miss errors, the cache is
+                    not poisoned, the next request retries
+``delivery.shed``   at the delivery plane's admission check — forces the
+                    load-shed branch (503 + Retry-After) regardless of
+                    the in-flight read count
 ==================  =====================================================
 
 Every legitimate site name is listed in :data:`SITES`;
@@ -85,6 +91,8 @@ SITES: dict[str, str] = {
                       "the digest header stays true",
     "storage.verify": "storage.integrity.verify_tree entry",
     "storage.gc": "storage.gc.run_gc entry",
+    "delivery.read": "delivery plane cache-fill, before the disk read",
+    "delivery.shed": "delivery plane admission check; forces load-shed",
 }
 
 

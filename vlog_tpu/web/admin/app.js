@@ -896,7 +896,44 @@ async function loadStorage() {
   $("st-totals").textContent =
     `lifetime: ${t.runs} sweeps, ${t.files_removed} removed, ` +
     `${fmtBytes(t.bytes_reclaimed)} reclaimed, ${t.errors} errors`;
+  await loadDeliveryStats();
 }
+
+async function loadDeliveryStats() {
+  const d = await api("/api/delivery/stats");
+  const tb = $("dl-stats").tBodies[0];
+  tb.textContent = "";
+  $("dl-empty").hidden = d.plane_count > 0;
+  $("dl-stats").hidden = d.plane_count === 0;
+  if (d.plane_count === 0) { $("dl-summary").textContent = ""; return; }
+  const s = d.totals;
+  const served = s.hits + s.misses;
+  const rate = served ? ((100 * s.hits) / served).toFixed(1) + "%" : "—";
+  const tr = document.createElement("tr");
+  cells(tr, [String(s.hits), String(s.misses), rate,
+    `${fmtBytes(s.cache_bytes)} / ${fmtBytes(s.cache_budget_bytes)}`,
+    String(s.cache_entries), String(s.single_flight_collapses),
+    String(s.evictions), String(s.shed), String(s.state_hits),
+    String(s.state_misses)]);
+  tb.appendChild(tr);
+  $("dl-summary").textContent =
+    `${d.plane_count} plane(s), ${s.invalidations} invalidations, ` +
+    `${s.inflight_reads}/${s.max_inflight_reads} reads in flight`;
+}
+
+$("dl-invalidate").onclick = async () => {
+  const slug = $("dl-slug").value.trim();
+  const body = slug ? { slug } : { all: true };
+  try {
+    const r = await api("/api/delivery/invalidate", {
+      method: "POST", headers: { "Content-Type": "application/json" },
+      body: JSON.stringify(body),
+    });
+    $("dl-msg").textContent =
+      `evicted ${r.entries_dropped} entries (${r.target})`;
+    loadDeliveryStats();
+  } catch (e) { toast(e.message, true); }
+};
 
 $("st-gc-run").onclick = async () => {
   const body = { dry_run: $("st-dry").checked };
