@@ -647,11 +647,14 @@ def main() -> int:
             time.sleep(SMOKE_RETRY_SLEEP_S)
 
     # Phase 2: the measurement body on the accelerator.
+    reason = "tunnel_unreachable_smoke_failed"
     if smoke_ok:
         t = min(TPU_TIMEOUT_S, _budget_left(CPU_FALLBACK_RESERVE_S))
         line = None
-        if t >= 120:
-            line, _ = _attempt("--body", "tpu", t)
+        tpu_timed_out = False
+        body_ran = t >= 120
+        if body_ran:
+            line, tpu_timed_out = _attempt("--body", "tpu", t)
         else:
             print("bench: tpu body skipped (budget exhausted after "
                   "smoke); falling back to labeled CPU measurement",
@@ -660,23 +663,42 @@ def main() -> int:
             print(json.dumps(_merge_entropy(json.loads(line),
                                             entropy_line)))
             return 0
-        print("bench: tpu body failed after healthy smoke",
-              file=sys.stderr)
+        if not body_ran:
+            reason = "tpu_body_skipped_budget_exhausted"
+        elif tpu_timed_out:
+            reason = "tpu_body_timed_out"
+        else:
+            reason = "tpu_body_failed_after_healthy_smoke"
+            print("bench: tpu body failed after healthy smoke",
+                  file=sys.stderr)
     else:
         print("bench: accelerator unreachable (smoke failed); "
               "falling back to labeled CPU measurement", file=sys.stderr)
 
-    line, _ = _attempt("--body", "cpu",
-                       max(120, min(CPU_TIMEOUT_S, _budget_left())))
+    line, cpu_timed_out = _attempt(
+        "--body", "cpu", max(120, min(CPU_TIMEOUT_S, _budget_left())))
     if line:
-        print(json.dumps(_merge_entropy(json.loads(line), entropy_line)))
+        record = _merge_entropy(json.loads(line), entropy_line)
+        # The fallback record carries WHY the TPU number is absent, so
+        # a tunnel-down round reads as "unreachable, here is the CPU
+        # floor" instead of an unlabeled rc=124 with nothing parseable
+        # (the round-5 failure mode).
+        record.setdefault("fallback_reason", reason)
+        record.setdefault("smoke_ok", smoke_ok)
+        print(json.dumps(record))
         return 0
-    print(json.dumps({
+    # Even total failure publishes a clean labeled record (entropy is a
+    # host property and usually survives a dead tunnel — keep it).
+    print(json.dumps(_merge_entropy({
         "metric": "ladder_device_realtime_x",
         "value": 0.0,
         "unit": "bench_failed_all_platforms",
         "vs_baseline": 0.0,
-    }))
+        "fallback_reason": (f"{reason}+cpu_fallback_"
+                            f"{'timeout' if cpu_timed_out else 'failed'}"),
+        "smoke_ok": smoke_ok,
+        "budget_left_s": _budget_left(),
+    }, entropy_line)))
     return 1
 
 

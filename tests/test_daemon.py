@@ -240,3 +240,81 @@ def test_release_job_refunds_attempt(run, db, tmp_path, video_job):
             await claims.release_job(db, job_id, "w1")
 
     run(go())
+
+def test_daemon_concurrent_slot_claims(run, db, tmp_path):
+    """Mesh scheduler claim loop: two queued jobs are claimed in one
+    fill round, run CONCURRENTLY on 2x4-device slot leases, and both
+    reach ready — with mesh.slot / mesh.width / mesh.wait_s span attrs
+    on each job's transcode span."""
+    import json
+
+    import jax
+
+    from vlog_tpu.parallel.scheduler import MeshScheduler
+
+    srcs, vids_rows, job_ids = [], [], []
+    for i in range(2):
+        src = make_y4m(tmp_path / f"src{i}.y4m", n_frames=8, width=128,
+                       height=96, fps=24)
+        video = run(vids.create_video(db, f"Slot Job {i}",
+                                      source_path=str(src),
+                                      size_bytes=src.stat().st_size))
+        job_ids.append(run(claims.enqueue_job(db, video["id"])))
+        vids_rows.append(video)
+        srcs.append(src)
+
+    sched = MeshScheduler(devices=list(jax.devices()), slots=2)
+    daemon = make_daemon(db, tmp_path, scheduler=sched)
+
+    async def go():
+        assert await daemon._poll_fill() is True
+        # both slots were admitted in one round -> no capacity left
+        assert len(daemon._tasks) == 2
+        await asyncio.gather(*daemon._tasks)
+
+    run(go())
+    assert daemon.stats.claimed == 2 and daemon.stats.completed == 2
+    assert sched.capacity() == 2          # every lease came back
+    widths = []
+    for video, job_id in zip(vids_rows, job_ids):
+        row = run(vids.get_video(db, video["id"]))
+        assert row["status"] == "ready", row["error"]
+        span = run(db.fetch_one(
+            "SELECT * FROM job_spans WHERE job_id=:j AND name=:n",
+            {"j": job_id, "n": "worker.transcode"}))
+        attrs = json.loads(span["attributes"] or "{}")
+        assert attrs.get("mesh.width") == 4, attrs
+        assert attrs.get("mesh.slot") in (0, 1)
+        assert "mesh.wait_s" in attrs
+        widths.append(attrs["mesh.slot"])
+    assert sorted(widths) == [0, 1]       # one job per slot
+
+
+def test_daemon_single_job_under_scheduler_gets_full_mesh(run, db, tmp_path,
+                                                          video_job):
+    """Work-conserving fallback through the daemon: a lone claimed job
+    leases the whole mesh even with slots configured."""
+    import json
+
+    import jax
+
+    from vlog_tpu.parallel.scheduler import MeshScheduler
+
+    video, job_id, _ = video_job
+    sched = MeshScheduler(devices=list(jax.devices()), slots=2)
+    daemon = make_daemon(db, tmp_path, scheduler=sched)
+
+    async def go():
+        assert await daemon._poll_fill() is True
+        await asyncio.gather(*daemon._tasks)
+
+    run(go())
+    row = run(vids.get_video(db, video["id"]))
+    assert row["status"] == "ready"
+    span = run(db.fetch_one(
+        "SELECT * FROM job_spans WHERE job_id=:j AND name=:n",
+        {"j": job_id, "n": "worker.transcode"}))
+    attrs = json.loads(span["attributes"] or "{}")
+    assert attrs.get("mesh.width") == 8
+    assert attrs.get("mesh.slot") == "full"
+    assert sched.capacity() == 2

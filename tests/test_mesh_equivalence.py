@@ -121,3 +121,178 @@ def test_hevc_backend_run_on_mesh_matches_single_device(tmp_path):
                    fps=10)
     rung = config.QualityRung("360p", 360, 0, 0, base_qp=30)  # constant QP
     _compare_runs(tmp_path, src, "p+h265", {"rungs": (rung,)})
+
+
+# --------------------------------------------------------------------------
+# Mesh job scheduler (parallel/scheduler.py): slot-width byte identity,
+# concurrent-vs-serialized equivalence, and chaos drain.
+# --------------------------------------------------------------------------
+
+def _narrow_lease(sched):
+    """A width-(n/slots) lease: admit a second ticket so the grant
+    renegotiates away from the work-conserving full mesh, then withdraw
+    it."""
+    t1, t2 = sched.admit(), sched.admit()
+    lease = t1.acquire()
+    t2.close()
+    return t1, lease
+
+
+@pytest.mark.slow
+def test_slot_widths_4_and_8_byte_identical(tmp_path):
+    """The same job on a 4-chip slot lease, on a full-mesh (width-8)
+    lease, and with no scheduler at all must publish byte-identical
+    trees — the mesh-equivalence invariant extended to slot submeshes
+    (all-intra: identity must hold INCLUDING closed-loop rate
+    control)."""
+    import jax
+
+    from vlog_tpu.parallel.scheduler import MeshScheduler
+    from vlog_tpu.worker.pipeline import process_video
+
+    assert len(jax.devices()) == 8
+    src = make_y4m(tmp_path / "src.y4m", n_frames=20, width=128, height=96,
+                   fps=10)
+
+    ref_out = tmp_path / "nosched"
+    process_video(src, ref_out, audio=False, segment_duration_s=1.0,
+                  gop_mode="intra")
+    ref_files = _tree_files(ref_out)
+    assert any(k.endswith(".m4s") for k in ref_files)
+
+    sched = MeshScheduler(devices=list(jax.devices()), slots=2)
+
+    # width 4: a narrow slot lease
+    t1, lease = _narrow_lease(sched)
+    assert lease.width == 4
+    with lease:
+        process_video(src, tmp_path / "slot4", audio=False,
+                      segment_duration_s=1.0, gop_mode="intra")
+    t1.close()
+
+    # width 8: the lone-job work-conserving full-mesh lease
+    t_full = sched.admit()
+    lease8 = t_full.acquire()
+    assert lease8.width == 8
+    with lease8:
+        process_video(src, tmp_path / "slot8", audio=False,
+                      segment_duration_s=1.0, gop_mode="intra")
+    t_full.close()
+
+    for label in ("slot4", "slot8"):
+        files = _tree_files(tmp_path / label)
+        assert set(files) == set(ref_files), label
+        for rel, data in ref_files.items():
+            assert files[rel] == data, (
+                f"{label}/{rel}: differs from the unscheduled full-mesh "
+                f"tree ({len(files[rel])} vs {len(data)} bytes)")
+
+
+@pytest.mark.slow
+def test_two_concurrent_slot_jobs_match_serialized(tmp_path):
+    """Two jobs admitted to 2x4-chip slots concurrently publish the
+    same trees as back-to-back full-pipeline runs (per-slot executors
+    share one entropy pool; output must not care)."""
+    import threading
+
+    import jax
+
+    from vlog_tpu.parallel.scheduler import MeshScheduler
+    from vlog_tpu.worker.pipeline import process_video
+
+    assert len(jax.devices()) == 8
+    srcs = [make_y4m(tmp_path / f"src{i}.y4m", n_frames=12 + 4 * i,
+                     width=128, height=96, fps=10) for i in range(2)]
+
+    refs = []
+    for i, src in enumerate(srcs):
+        out = tmp_path / f"serial{i}"
+        process_video(src, out, audio=False, segment_duration_s=1.0,
+                      gop_mode="intra")
+        refs.append(_tree_files(out))
+
+    sched = MeshScheduler(devices=list(jax.devices()), slots=2)
+    tickets = [sched.admit() for _ in range(2)]
+    errors = []
+
+    def job(i: int) -> None:
+        try:
+            lease = tickets[i].acquire()
+            assert lease.width == 4, lease
+            with lease:
+                process_video(srcs[i], tmp_path / f"conc{i}", audio=False,
+                              segment_duration_s=1.0, gop_mode="intra")
+        except BaseException as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+        finally:
+            tickets[i].close()
+
+    threads = [threading.Thread(target=job, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert sched.capacity() == 2
+    for i, ref in enumerate(refs):
+        conc = _tree_files(tmp_path / f"conc{i}")
+        assert set(conc) == set(ref)
+        for rel, data in ref.items():
+            assert conc[rel] == data, f"job {i}: {rel} differs"
+
+
+@pytest.mark.slow
+def test_chaos_slot_job_death_frees_slot(tmp_path):
+    """Kill one slot's job mid-flight: the other slot's job completes
+    untouched, the dead job's slot frees, and the next (lone) job gets
+    the full mesh back."""
+    import threading
+
+    import jax
+
+    from vlog_tpu.parallel.scheduler import MeshScheduler
+    from vlog_tpu.worker.pipeline import process_video
+
+    assert len(jax.devices()) == 8
+    srcs = [make_y4m(tmp_path / f"src{i}.y4m", n_frames=12, width=128,
+                     height=96, fps=10) for i in range(2)]
+
+    sched = MeshScheduler(devices=list(jax.devices()), slots=2)
+    tickets = [sched.admit() for _ in range(2)]
+    outcomes: dict[int, BaseException | str] = {}
+
+    def doomed_cb(done, total, msg):
+        raise RuntimeError("chaos: slot job killed mid-flight")
+
+    def job(i: int) -> None:
+        try:
+            lease = tickets[i].acquire()
+            with lease:
+                process_video(srcs[i], tmp_path / f"out{i}", audio=False,
+                              segment_duration_s=1.0, gop_mode="intra",
+                              progress_cb=doomed_cb if i == 0 else None)
+            outcomes[i] = "ok"
+        except BaseException as exc:  # noqa: BLE001 — the assertion target
+            outcomes[i] = exc
+        finally:
+            tickets[i].close()
+
+    threads = [threading.Thread(target=job, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert isinstance(outcomes[0], RuntimeError)       # the kill landed
+    assert outcomes[1] == "ok", outcomes[1]            # survivor finished
+    survivor = _tree_files(tmp_path / "out1")
+    assert any(k.endswith(".m4s") for k in survivor)
+    assert "master.m3u8" in survivor
+
+    # both slots are free again, and a lone newcomer renegotiates back
+    # to the full mesh (the freed slot really returned to the pool)
+    assert sched.capacity() == 2
+    t_next = sched.admit()
+    lease = t_next.acquire(timeout=5)
+    assert lease.width == 8 and lease.is_full_mesh
+    t_next.close()

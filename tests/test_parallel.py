@@ -109,3 +109,323 @@ def test_sharded_ladder_step_stats_psum():
     err = recon.astype(np.float64) - ys.astype(np.float64)
     expect = 10 * np.log10(255 ** 2 / np.mean(err * err, axis=(1, 2)).mean())
     assert abs(psnr - expect) < 0.05
+
+
+# --------------------------------------------------------------------------
+# Mesh job scheduler (parallel/scheduler.py): slot arbitration units.
+# Devices are opaque to the grant logic, so these drive it with strings
+# and touch no XLA compute.
+# --------------------------------------------------------------------------
+
+import threading
+import time as _time
+
+from vlog_tpu.parallel.scheduler import (
+    FULL_MESH_SLOT,
+    MeshScheduler,
+    current_lease,
+    host_pool_for_run,
+    mesh_for_run,
+)
+
+DEVS = tuple("d%d" % i for i in range(8))
+
+
+def _sched(slots=2, devices=DEVS):
+    return MeshScheduler(devices=list(devices), slots=slots)
+
+
+def test_scheduler_partition_and_clamp():
+    s = _sched(slots=2)
+    assert s.slots == 2 and s.slot_width == 4
+    assert s._slot_devices(0) == DEVS[:4]
+    assert s._slot_devices(1) == DEVS[4:]
+    # more slots than devices clamps; each slot is >= 1 wide
+    s = MeshScheduler(devices=["a", "b"], slots=8)
+    assert s.slots == 2 and s.slot_width == 1
+    # non-dividing slot counts cover EVERY device (no stranded chips):
+    # the first n % slots slots are one wider
+    s = MeshScheduler(devices=list(DEVS), slots=3)
+    parts = [s._slot_devices(i) for i in range(3)]
+    assert [len(p) for p in parts] == [3, 3, 2]
+    assert tuple(d for p in parts for d in p) == DEVS
+
+
+def test_lone_job_gets_full_mesh_work_conserving():
+    s = _sched(slots=2)
+    t = s.admit()
+    lease = t.acquire()
+    assert lease.is_full_mesh and lease.width == 8
+    assert s.capacity() == 0          # full lease saturates admission
+    t.close()
+    assert s.capacity() == 2
+
+
+def test_two_admitted_jobs_get_narrow_slots():
+    s = _sched(slots=2)
+    t1, t2 = s.admit(), s.admit()     # both admitted BEFORE either acquires
+    l1, l2 = t1.acquire(), t2.acquire()
+    assert {l1.slot, l2.slot} == {0, 1}
+    assert l1.width == l2.width == 4
+    assert set(l1.devices) | set(l2.devices) == set(DEVS)
+    assert not (set(l1.devices) & set(l2.devices))
+    t1.close()
+    assert s.capacity() == 1          # freed slot is admittable again
+    t2.close()
+
+
+def test_width_renegotiates_at_job_boundary():
+    """A job arriving under a full-mesh lease waits for the boundary,
+    then — alone — gets the full mesh itself (work-conserving)."""
+    s = _sched(slots=2)
+    wide = s.admit()
+    wide_lease = wide.acquire()
+    assert wide_lease.is_full_mesh
+    late = s.admit()
+    got = []
+    th = threading.Thread(target=lambda: got.append(late.acquire()))
+    th.start()
+    _time.sleep(0.1)
+    assert not got                    # blocked on the job boundary
+    wide.close()
+    th.join(timeout=5)
+    assert got and got[0].is_full_mesh and got[0].width == 8
+    assert got[0].wait_s > 0.05       # queue-wait-for-slot was recorded
+    late.close()
+
+
+def test_two_waiters_renegotiate_to_narrow():
+    s = _sched(slots=2)
+    wide = s.admit()
+    wide.acquire()
+    waiters = [s.admit(), s.admit()]
+    got = []
+    threads = [threading.Thread(target=lambda t=t: got.append(t.acquire()))
+               for t in waiters]
+    for th in threads:
+        th.start()
+    _time.sleep(0.1)
+    assert not got
+    wide.close()
+    for th in threads:
+        th.join(timeout=5)
+    assert sorted(l.width for l in got) == [4, 4]
+    assert {l.slot for l in got} == {0, 1}
+    for t in waiters:
+        t.close()
+
+
+def test_capacity_counts_pending_tickets():
+    s = _sched(slots=2)
+    t1 = s.admit()
+    assert s.capacity() == 1          # un-acquired demand still reserves
+    t2 = s.admit()
+    assert s.capacity() == 0
+    t2.close()                        # died before compute: withdrawn
+    assert s.capacity() == 1
+    t1.close()
+    assert s.capacity() == 2
+
+
+def test_lease_context_manager_releases_on_exception():
+    s = _sched(slots=2)
+    t = s.admit()
+    with pytest.raises(RuntimeError):
+        with t.acquire():
+            assert current_lease() is not None
+            raise RuntimeError("job died mid-flight")
+    assert current_lease() is None
+    t.close()
+    assert s.capacity() == 2          # the slot survived the crash
+
+
+def test_acquire_timeout():
+    s = _sched(slots=2)
+    wide = s.admit()
+    wide.acquire()
+    late = s.admit()
+    with pytest.raises(TimeoutError):
+        late.acquire(timeout=0.05)
+    late.close()
+    wide.close()
+
+
+def test_mesh_for_run_uses_lease_devices():
+    import jax
+
+    devs = list(jax.devices())
+    s = MeshScheduler(devices=devs, slots=2)
+    t1, t2 = s.admit(), s.admit()
+    with t1.acquire():
+        mesh = mesh_for_run()
+        assert mesh is not None and mesh.devices.size == 4
+        assert list(mesh.devices.flat) == devs[:4]
+        assert host_pool_for_run() is s.host_pool()
+    t1.close()
+    t2.close()
+    # without a lease: the classic ad-hoc all-devices mesh, own pool
+    assert mesh_for_run().devices.size == len(devs)
+    assert host_pool_for_run() is None
+
+
+def test_single_slot_scheduler_serializes():
+    s = _sched(slots=1)
+    t1 = s.admit()
+    l1 = t1.acquire()
+    assert l1.width == 8 and l1.slot == 0
+    assert s.capacity() == 0
+    t1.close()
+
+
+def test_scheduler_gauges_and_wait_histogram():
+    from vlog_tpu.obs.metrics import runtime
+
+    s = _sched(slots=2)
+    t1, t2 = s.admit(), s.admit()
+    t1.acquire(), t2.acquire()
+    text = runtime().render_text()
+    if text:                          # prometheus-client installed
+        assert 'vlog_mesh_slot_occupancy 2.0' in text
+        assert 'vlog_mesh_slot_width{slot="0"} 4.0' in text
+        assert "vlog_mesh_slot_wait_seconds" in text
+    t1.close()
+    t2.close()
+    text = runtime().render_text()
+    if text:
+        assert 'vlog_mesh_slot_occupancy 0.0' in text
+
+
+# --------------------------------------------------------------------------
+# Registry / docs agreement (PR 2/3/4/5 lint pattern, scheduler edition)
+# --------------------------------------------------------------------------
+
+class TestMeshSchedulerAgreement:
+    KNOBS = ("VLOG_MESH_SLOTS",)
+    METRICS = ("vlog_mesh_slots", "vlog_mesh_slot_occupancy",
+               "vlog_mesh_slot_width", "vlog_mesh_slot_wait_seconds")
+    SPAN_ATTRS = ("mesh.slot", "mesh.width")
+
+    def test_knobs_parsed_and_documented(self):
+        import re
+        from pathlib import Path
+
+        from vlog_tpu import config
+
+        cfg_src = Path(config.__file__).read_text()
+        readme = Path(config.__file__).parents[1].joinpath(
+            "README.md").read_text()
+        parsed = set(re.findall(r'"(VLOG_[A-Z_]+)"', cfg_src))
+        for knob in self.KNOBS:
+            assert knob in parsed, f"{knob} not parsed in config.py"
+            assert knob in readme, f"{knob} missing from README"
+        assert isinstance(config.MESH_SLOTS, int)
+
+    def test_metrics_registered_and_documented(self):
+        from pathlib import Path
+
+        from vlog_tpu import config
+        from vlog_tpu.obs.metrics import HAVE_PROMETHEUS, runtime
+
+        readme = Path(config.__file__).parents[1].joinpath(
+            "README.md").read_text()
+        rendered = runtime().render_text()
+        for name in self.METRICS:
+            assert name in readme, f"{name} missing from README"
+            if HAVE_PROMETHEUS:
+                assert name.removesuffix("_total") in rendered, name
+
+    def test_span_attrs_documented(self):
+        from pathlib import Path
+
+        from vlog_tpu import config
+
+        readme = Path(config.__file__).parents[1].joinpath(
+            "README.md").read_text()
+        for attr in self.SPAN_ATTRS:
+            assert f"`{attr}`" in readme, f"{attr} missing from README"
+
+
+def test_close_while_waiting_aborts_acquire_exactly_once():
+    """close() racing a blocked acquire: the waiter aborts with
+    SlotCancelled, the demand is withdrawn exactly once (capacity never
+    over-reports), and no lease is granted to the closed ticket."""
+    from vlog_tpu.parallel.scheduler import SlotCancelled
+
+    s = _sched(slots=2)
+    wide = s.admit()
+    wide.acquire()
+    late = s.admit()
+    result = []
+
+    def waiter():
+        try:
+            late.acquire()
+            result.append("granted")
+        except SlotCancelled:
+            result.append("cancelled")
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    _time.sleep(0.1)
+    late.close()                      # abandon while blocked
+    wide.close()                      # boundary: would grant if alive
+    th.join(timeout=5)
+    assert result == ["cancelled"]
+    assert late.lease is None
+    assert s.capacity() == 2          # exactly-once withdrawal
+    # counter integrity: a fresh lone job still gets the full mesh
+    t = s.admit()
+    assert t.acquire(timeout=1).width == 8
+    t.close()
+
+
+def test_cancel_event_aborts_blocked_acquire():
+    """A supervisor cancel (watchdog/shutdown) reaches a thread parked
+    on a busy mesh: acquire aborts instead of waiting forever."""
+    from vlog_tpu.parallel.scheduler import SlotCancelled
+
+    s = _sched(slots=2)
+    wide = s.admit()
+    wide.acquire()
+    late = s.admit()
+    cancel = threading.Event()
+    result = []
+
+    def waiter():
+        try:
+            late.acquire(cancel=cancel)
+        except SlotCancelled:
+            result.append("cancelled")
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    _time.sleep(0.1)
+    cancel.set()
+    th.join(timeout=5)
+    assert result == ["cancelled"]
+    late.close()                      # idempotent after the abort
+    wide.close()
+    assert s.capacity() == 2
+
+
+def test_hold_freezes_grants_until_round_completes():
+    """scheduler.hold(): a claim round in flight freezes width
+    decisions, so a job that acquires mid-round waits and then
+    renegotiates against the round's COMPLETE demand instead of
+    racing to the full mesh."""
+    s = _sched(slots=2)
+    t1 = s.admit()
+    got = []
+    with s.hold():
+        th = threading.Thread(target=lambda: got.append(t1.acquire()))
+        th.start()
+        _time.sleep(0.15)
+        assert not got                # grant frozen during the round
+        t2 = s.admit()                # a second job joins the round
+    th.join(timeout=5)
+    assert got and got[0].width == 4  # saw the full round's demand
+    l2 = t2.acquire()
+    assert l2.width == 4
+    t1.close()
+    t2.close()
+    assert s.capacity() == 2

@@ -158,6 +158,18 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
 
         from vlog_tpu.ops.resize import resize_yuv420
         from vlog_tpu.parallel.executor import PipelineExecutor
+        from vlog_tpu.parallel.mesh import pad_batch, shard_frames
+        from vlog_tpu.parallel.scheduler import (host_pool_for_run,
+                                                 mesh_for_run)
+
+        # Mesh parity with the first-party paths: the device resize
+        # shards the frame axis over the mesh when >1 device is visible
+        # (slot submesh under the scheduler, all devices otherwise), so
+        # AV1 jobs can be placed on narrow slots too. Frames are
+        # independent, so sharded and unsharded resizes are identical;
+        # pad_batch rounds the batch up to the mesh and the pull trims.
+        mesh = mesh_for_run()
+        n_mesh = int(mesh.devices.size) if mesh is not None else 1
 
         fifo: queue_mod.Queue = queue_mod.Queue(maxsize=1)
         eof = object()
@@ -256,9 +268,14 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
             by, bu, bv = batch.extra
             if (rung.height, rung.width) == (by.shape[1], by.shape[2]):
                 return by, bu, bv
+            n = by.shape[0]
+            if mesh is not None and n_mesh > 1:
+                (by, bu, bv), _ = pad_batch(n_mesh, by, bu, bv)
+                by, bu, bv = shard_frames(mesh, by, bu, bv)
             ry, ru, rv = resize_yuv420(by, bu, bv, rung.height,
                                        rung.width)
-            return np.asarray(ry), np.asarray(ru), np.asarray(rv)
+            return (np.asarray(ry)[:n], np.asarray(ru)[:n],
+                    np.asarray(rv)[:n])
 
         def process(name, batch, host):
             rung = rungs_by_name[name]
@@ -283,7 +300,9 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
 
         pipe = PipelineExecutor(
             [r.name for r in plan.rungs], pull=pull, process=process,
-            on_batch_done=on_batch_done, prof=prof, name="vlog-pipe")
+            on_batch_done=on_batch_done,
+            host_pool=host_pool_for_run(),   # shared across slot executors
+            prof=prof, name="vlog-pipe")
 
         try:
             while True:

@@ -95,7 +95,9 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         from vlog_tpu.parallel.executor import (LaggedRateControl,
                                                 PipelineExecutor)
         from vlog_tpu.parallel.hevc_ladder import hevc_chain_ladder_program
-        from vlog_tpu.parallel.mesh import make_mesh, shard_frames
+        from vlog_tpu.parallel.mesh import shard_frames
+        from vlog_tpu.parallel.scheduler import (host_pool_for_run,
+                                                 mesh_for_run)
 
         # closed-loop VBR toward each rung's ladder bitrate, same
         # controller the H.264 path uses (per-frame QP is traced, so
@@ -120,12 +122,14 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
 
         # --- fused all-rungs chain ladder (parallel/hevc_ladder.py): one
         # dispatch per batch emits every hvc1 rung; chains shard over the
-        # mesh when >1 device (SURVEY §2d.2/§2d.5 applied to HEVC).
+        # mesh when >1 device (SURVEY §2d.2/§2d.5 applied to HEVC). The
+        # mesh is the job's slot submesh under the scheduler, the
+        # all-devices mesh otherwise (parallel/scheduler.py).
         src_h, src_w = plan.source.height, plan.source.width
         rungs_spec = tuple((r.name, r.height, r.width, r.qp)
                            for r in plan.rungs)
-        n_dev = len(jax.devices())
-        mesh = make_mesh() if n_dev > 1 else None
+        mesh = mesh_for_run()
+        n_dev = int(mesh.devices.size) if mesh is not None else 1
         clen = max(1, plan.gop_len)
         chains_per = max(1, -(-plan.frame_batch // clen))
         dev = max(n_dev, 1)
@@ -262,6 +266,7 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         pipe = PipelineExecutor(
             [r.name for r in plan.rungs], pull=pull, process=process,
             ready=wait_device, on_batch_done=on_batch_done,
+            host_pool=host_pool_for_run(),   # shared across slot executors
             prof=prof, name="vlog-pipe")
 
         batch_idx = 0

@@ -331,17 +331,22 @@ class JaxBackend:
 
         # --- the one-pass ladder program: ONE dispatch per GOP batch
         # emits quantized levels for EVERY rung (SURVEY §2d.2); frames
-        # shard over the device mesh when >1 chip (§2d.5).
+        # shard over the device mesh when >1 chip (§2d.5). Under the
+        # mesh job scheduler the mesh is this job's SLOT submesh
+        # (parallel/scheduler.py) so concurrent jobs split the chips;
+        # without a lease it is the classic all-devices mesh.
         import jax
 
         from vlog_tpu.parallel.ladder import ladder_encode_program
-        from vlog_tpu.parallel.mesh import make_mesh, shard_frames
+        from vlog_tpu.parallel.mesh import shard_frames
+        from vlog_tpu.parallel.scheduler import (host_pool_for_run,
+                                                 mesh_for_run)
 
         src_h, src_w = plan.source.height, plan.source.width
         rungs_spec = tuple((r.name, r.height, r.width, r.qp)
                            for r in plan.rungs)
-        n_dev = len(jax.devices())
-        mesh = make_mesh() if n_dev > 1 else None
+        mesh = mesh_for_run()
+        n_dev = int(mesh.devices.size) if mesh is not None else 1
         chain_mode = plan.gop_len > 1
         if chain_mode:
             from vlog_tpu.parallel.ladder import ladder_chain_program
@@ -574,6 +579,7 @@ class JaxBackend:
             pull=pull_chain if chain_mode else pull_intra,
             process=process_chain if chain_mode else process_intra,
             ready=wait_device, on_batch_done=on_batch_done,
+            host_pool=host_pool_for_run(),   # shared across slot executors
             prof=prof, name="vlog-pipe")
 
         # Decode prefetch: a producer thread reads/decodes the NEXT batches

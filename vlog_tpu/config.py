@@ -359,6 +359,14 @@ ENTROPY_THREADS: int = _env_int(
     lo=1, hi=256)
 # Mesh axis layout, e.g. "data:8" or "data:4,chunk:2". Parsed by parallel.mesh.
 TPU_MESH_SPEC: str = _env_str("VLOG_TPU_MESH", "data:-1")
+# Mesh job slots (parallel/scheduler.py): the process's devices partition
+# into this many equal-width slots so the scheduler can admit that many
+# queued jobs onto the mesh CONCURRENTLY (e.g. 2 on a v5e-8 = two
+# 4-chip jobs instead of back-to-back full-mesh runs). 1 = the classic
+# one-job-owns-every-chip mode. Work-conserving: a lone job always
+# leases the full mesh regardless of this knob; widths renegotiate at
+# job boundaries.
+MESH_SLOTS: int = _env_int("VLOG_MESH_SLOTS", 1, lo=1, hi=64)
 
 CODE_VERSION: str = "1"
 

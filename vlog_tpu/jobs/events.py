@@ -72,15 +72,18 @@ class Subscription:
             n += 1
         return n
 
-    async def wait_or(self, stop: asyncio.Event,
-                      timeout: float) -> None:
-        """Sleep until a wakeup, the timeout, or ``stop`` — whichever
-        comes first. The wake-or-stop idle pattern every consumer loop
-        needs, with the cancellation bookkeeping in one place."""
+    async def wait_or(self, stop: asyncio.Event, timeout: float,
+                      extra=()) -> None:
+        """Sleep until a wakeup, the timeout, ``stop``, or any of the
+        ``extra`` awaitables completing — whichever comes first. The
+        wake-or-stop idle pattern every consumer loop needs, with the
+        cancellation bookkeeping in one place. ``extra`` members (e.g.
+        the daemon's in-flight slot job tasks) are only waited on,
+        never cancelled or consumed."""
         wake = asyncio.ensure_future(self.get(timeout=timeout))
         stop_t = asyncio.ensure_future(stop.wait())
         try:
-            await asyncio.wait({wake, stop_t},
+            await asyncio.wait({wake, stop_t, *extra},
                                return_when=asyncio.FIRST_COMPLETED)
         finally:
             for f in (wake, stop_t):

@@ -171,6 +171,28 @@ class RuntimeMetrics:
             "vlog_delivery_inflight_reads",
             "Cache-fill disk reads currently in flight",
             registry=self.registry)
+        # Mesh job scheduler (parallel/scheduler.py): slot arbitration
+        # over the process's device mesh.
+        self.mesh_slots = Gauge(
+            "vlog_mesh_slots",
+            "Configured mesh job slots (VLOG_MESH_SLOTS, clamped to the "
+            "device count)",
+            registry=self.registry)
+        self.mesh_slot_occupancy = Gauge(
+            "vlog_mesh_slot_occupancy",
+            "Mesh slot leases currently held by running jobs",
+            registry=self.registry)
+        self.mesh_slot_width = Gauge(
+            "vlog_mesh_slot_width",
+            "Devices held by each active slot lease (0 = slot free; "
+            "slot label \"full\" is the work-conserving full-mesh lease)",
+            ["slot"], registry=self.registry)
+        self.mesh_slot_wait = Histogram(
+            "vlog_mesh_slot_wait_seconds",
+            "Seconds a claimed job waited for a mesh slot lease "
+            "(queue-wait-for-slot)",
+            buckets=(0.001, 0.01, 0.1, 0.5, 2.0, 10.0, 60.0, 300.0),
+            registry=self.registry)
         # the fires counter must see every fire in the process, wherever
         # the site lives — failpoints stays dependency-free, we observe
         failpoints.add_observer(

@@ -17,6 +17,15 @@ from vlog_tpu.parallel.mesh import (  # noqa: F401
     parse_mesh_spec,
     shard_frames,
 )
+from vlog_tpu.parallel.scheduler import (  # noqa: F401
+    MeshScheduler,
+    SlotLease,
+    SlotTicket,
+    current_lease,
+    get_scheduler,
+    host_pool_for_run,
+    mesh_for_run,
+)
 from vlog_tpu.parallel.ladder import (  # noqa: F401
     ladder_local,
     ladder_matrices,

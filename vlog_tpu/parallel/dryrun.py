@@ -1,4 +1,5 @@
-"""Multi-chip dry run body: the FULL sharded ladder step on n devices.
+"""Multi-chip dry run body: the FULL sharded ladder step on n devices,
+plus the mesh-shape / scheduler throughput harness.
 
 Run as ``python -m vlog_tpu.parallel.dryrun N`` in a subprocess whose
 environment pins ``JAX_PLATFORMS=cpu`` and
@@ -10,11 +11,25 @@ The body is the real multi-chip path the TPU worker dispatches per frame
 batch: ``shard_map`` over a data mesh, per-device resize + full intra
 H.264 DSP for every rung, cross-device ``psum`` PSNR reduction over ICI
 (SURVEY.md §2d.5).
+
+After the correctness asserts, the harness measures and prints (as the
+final JSON line the MULTICHIP_r*.json record captures):
+
+- per-mesh-shape chain-ladder throughput at 1/2/4/8 devices
+  (``shape_fps``), and
+- the mesh job scheduler's 2-slots-vs-1 comparison: two queued jobs
+  whose batches underfill the full mesh, run serialized on full-mesh
+  leases vs concurrently on 2 narrow slots through the REAL
+  ``parallel.scheduler`` admit/acquire path (``sched``: wall seconds,
+  jobs/min, speedup) — the number the ISSUE-6 acceptance criterion
+  reads.
 """
 
 from __future__ import annotations
 
+import json
 import sys
+import time
 
 
 def run(n_devices: int) -> None:
@@ -105,6 +120,183 @@ def run(n_devices: int) -> None:
     print(f"dryrun ok: {n_devices} devices, rungs "
           f"{[(r[0], round(float(stats[r[0]]), 2)) for r in rungs]}, "
           f"chain clen={clen} ok, hevc chain ok")
+
+    record = {"multichip": "ok", "devices": n_devices,
+              "shape_fps": measure_mesh_shapes(devices, rungs, h, w, clen),
+              "sched": measure_scheduler_packing(devices, rungs, h, w,
+                                                 clen)}
+    print(json.dumps(record), flush=True)
+
+
+def _chain_batch(rng_seed: int, n_chains: int, clen: int, h: int, w: int):
+    import numpy as np
+
+    rng = np.random.default_rng(rng_seed)
+    y = rng.integers(0, 256, (n_chains, clen, h, w)).astype(np.uint8)
+    u = rng.integers(0, 256,
+                     (n_chains, clen, h // 2, w // 2)).astype(np.uint8)
+    v = rng.integers(0, 256,
+                     (n_chains, clen, h // 2, w // 2)).astype(np.uint8)
+    return y, u, v
+
+
+def _dispatch_chains(fn, mats, mesh, rungs, y, u, v, clen):
+    """One chain-ladder dispatch (sharded when mesh is not None);
+    blocks until the device work completes and pulls one output —
+    the dispatch+pull shape the production consume loop pays."""
+    import jax
+    import numpy as np
+
+    from vlog_tpu.parallel.mesh import shard_frames
+
+    n_chains = y.shape[0]
+    qps = {name: np.full((n_chains, clen), qp, np.int32)
+           for name, _, _, qp in rungs}
+    rc = {name: {"budget": np.float32(2000.0), "alpha": np.float32(0.0)}
+          for name, _, _, _ in rungs}
+    if mesh is not None:
+        y, u, v = shard_frames(mesh, y, u, v)
+        qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
+    outs = fn(y, u, v, mats, qps, rc)
+    jax.block_until_ready(outs)
+    np.asarray(outs[rungs[0][0]]["sse_y"])
+
+
+def measure_mesh_shapes(devices, rungs, h: int, w: int, clen: int,
+                        shapes=(1, 2, 4, 8), iters: int = 3) -> dict:
+    """Chain-ladder throughput (frames/s) per mesh shape: one chain per
+    device, so each shape measures its own scale-out, not padding."""
+    from vlog_tpu import config
+    from vlog_tpu.parallel.ladder import ladder_chain_program
+    from vlog_tpu.parallel.mesh import make_mesh
+
+    out = {}
+    for k in shapes:
+        if k > len(devices):
+            continue
+        mesh = make_mesh("data:-1", devices=list(devices[:k])) \
+            if k > 1 else None
+        fn, mats = ladder_chain_program(rungs, h, w, search=4, mesh=mesh,
+                                        deblock=config.H264_DEBLOCK)
+        y, u, v = _chain_batch(7, k, clen, h, w)
+        _dispatch_chains(fn, mats, mesh, rungs, y, u, v, clen)   # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            _dispatch_chains(fn, mats, mesh, rungs, y, u, v, clen)
+        dt = (time.perf_counter() - t0) / iters
+        out[str(k)] = round(k * clen / dt, 2)
+    return out
+
+
+def measure_scheduler_packing(devices, rungs, h: int, w: int, clen: int,
+                              chains_per_job: int | None = None,
+                              dispatches: int = 3) -> dict:
+    """Two queued jobs, 2x4-chip slots vs serialized full-mesh runs.
+
+    Each job's batch carries half-mesh-width chains — the shape where a
+    full-mesh lease pads every dispatch 2x (devices idle between
+    useful work) and two narrow slots fit exactly. Serialized mode runs
+    the jobs back to back on work-conserving full-mesh leases;
+    slotted mode admits both through the real scheduler so each leases
+    a 4-chip slot and they run concurrently."""
+    import threading
+
+    from vlog_tpu import config
+    from vlog_tpu.parallel.ladder import ladder_chain_program
+    from vlog_tpu.parallel.mesh import make_mesh, pad_batch
+    from vlog_tpu.parallel.scheduler import MeshScheduler
+
+    n_dev = len(devices)
+    if n_dev < 2:
+        # One device = one slot: the two-party barrier below would
+        # deadlock against the single grant. Nothing to pack.
+        return {"skipped": "needs >= 2 devices for 2 slots"}
+    slots = 2
+    chains = chains_per_job or max(1, n_dev // 2)
+
+    def prepare_job(lease, seed: int):
+        """Build + compile this job's program on its lease's mesh;
+        returns the timed dispatch loop (compile excluded from timing
+        in BOTH modes)."""
+        mesh = make_mesh("data:-1", devices=list(lease.devices)) \
+            if lease.width > 1 else None
+        fn, mats = ladder_chain_program(rungs, h, w, search=4, mesh=mesh,
+                                        deblock=config.H264_DEBLOCK)
+        y, u, v = _chain_batch(seed, chains, clen, h, w)
+        if lease.width > 1:
+            (y, u, v), _ = pad_batch(lease.width, y, u, v)
+        _dispatch_chains(fn, mats, mesh, rungs, y, u, v, clen)  # compile
+
+        def go() -> None:
+            for _ in range(dispatches):
+                _dispatch_chains(fn, mats, mesh, rungs, y, u, v, clen)
+        return go
+
+    # --- serialized: each job is alone, so the work-conserving
+    # fallback hands it the FULL mesh; the queue runs behind it.
+    sched = MeshScheduler(devices=list(devices), slots=slots)
+    serial_s = 0.0
+    serial_widths = []
+    for seed in (11, 12):
+        ticket = sched.admit()
+        lease = ticket.acquire()
+        serial_widths.append(lease.width)
+        try:
+            go = prepare_job(lease, seed)
+            t0 = time.perf_counter()
+            go()
+            serial_s += time.perf_counter() - t0
+        finally:
+            ticket.close()
+
+    # --- slotted: both jobs admitted before either acquires, so the
+    # grant renegotiates to two narrow slots and they run concurrently;
+    # a barrier aligns the timed regions after per-slot compiles.
+    sched = MeshScheduler(devices=list(devices), slots=slots)
+    tickets = [sched.admit() for _ in range(2)]
+    barrier = threading.Barrier(2)
+    slot_widths = []
+    spans = []
+    errors = []
+
+    def slot_job(ticket, seed: int) -> None:
+        try:
+            lease = ticket.acquire()
+            slot_widths.append(lease.width)
+            try:
+                go = prepare_job(lease, seed)
+                barrier.wait()
+                t0 = time.perf_counter()
+                go()
+                spans.append((t0, time.perf_counter()))
+            finally:
+                ticket.close()
+        except BaseException as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+            barrier.abort()
+
+    threads = [threading.Thread(target=slot_job, args=(t, 21 + i))
+               for i, t in enumerate(tickets)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    slotted_s = max(t1 for _, t1 in spans) - min(t0 for t0, _ in spans)
+
+    return {
+        "jobs": 2,
+        "chains_per_job_batch": chains,
+        "dispatches_per_job": dispatches,
+        "serial_widths": serial_widths,
+        "slot_widths": sorted(slot_widths),
+        "serial_full_mesh_s": round(serial_s, 3),
+        "two_slot_s": round(slotted_s, 3),
+        "speedup": round(serial_s / slotted_s, 3) if slotted_s else 0.0,
+        "jobs_per_min_1slot": round(2 * 60.0 / serial_s, 2),
+        "jobs_per_min_2slot": round(2 * 60.0 / slotted_s, 2),
+    }
 
 
 if __name__ == "__main__":

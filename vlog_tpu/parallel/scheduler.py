@@ -1,0 +1,427 @@
+"""Mesh job scheduler: a per-process device arbiter over slot submeshes.
+
+The transcode core used to run one job per mesh: whichever worker
+claimed a job owned EVERY chip for the job's whole life, and the queue
+serialized behind it even while the job's batches left devices idle
+between dispatches. This module turns the device set into a small pool
+of **slots** so multiple queued jobs run concurrently on one host:
+
+- ``VLOG_MESH_SLOTS`` partitions the process's devices into that many
+  equal-width contiguous groups (e.g. ``2`` on a v5e-8 = two 4-chip
+  slots). Each admitted job leases one slot and builds its
+  ``shard_map`` mesh over the slot's devices only (``make_mesh``
+  submeshes — the same NamedSharding program shape at a narrower data
+  axis, so the mesh-equivalence byte-identity invariant carries over
+  unchanged).
+- **Work-conserving fallback**: slot widths renegotiate at job
+  boundaries. A lone job (nothing else admitted) leases the FULL mesh,
+  whatever the knob says; when several jobs are admitted together they
+  get narrow slots; when a full-width job is running, later arrivals
+  wait for the job boundary and the grant re-evaluates demand then.
+- The worker claim loop admits jobs only while :meth:`capacity` is
+  positive (never hoarding claims it cannot run — a queued job stays
+  claimable by OTHER workers while this host is saturated), takes a
+  :class:`SlotTicket` per claimed job, and the job's compute thread
+  blocks in :meth:`SlotTicket.acquire` for its lease.
+- Per-slot pipeline executors share ONE host entropy pool
+  (:meth:`MeshScheduler.host_pool`, sized ``VLOG_ENTROPY_THREADS``):
+  two concurrent jobs must not each spin up a core-count-sized pool.
+
+Observability: ``vlog_mesh_slots`` / ``vlog_mesh_slot_occupancy`` /
+``vlog_mesh_slot_width{slot}`` gauges and the
+``vlog_mesh_slot_wait_seconds`` histogram (queue-wait-for-slot) ride
+the process runtime registry; the worker attaches ``mesh.slot`` /
+``mesh.width`` / ``mesh.wait_s`` attrs to each job's transcode span.
+
+The lease travels to the codec backends through a contextvar
+(``asyncio.to_thread`` copies context into the compute thread):
+:func:`mesh_for_run` returns the slot submesh under a lease and falls
+back to the classic ad-hoc all-devices mesh otherwise, so direct
+``process_video`` callers and tests see unchanged behavior.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Sequence
+
+from vlog_tpu import config
+
+__all__ = [
+    "MeshScheduler", "SlotCancelled", "SlotLease", "SlotTicket",
+    "current_lease", "get_scheduler", "host_pool_for_run", "mesh_for_run",
+]
+
+
+class SlotCancelled(RuntimeError):
+    """Raised out of :meth:`SlotTicket.acquire` when the wait is aborted
+    (ticket closed from another thread, or the caller's cancel event
+    fired) — the blocked compute thread must die cleanly instead of
+    zombie-running on a lease granted to an already-abandoned job."""
+
+# Slot id of a work-conserving full-mesh lease (every device).
+FULL_MESH_SLOT = -1
+
+_CURRENT: contextvars.ContextVar["SlotLease | None"] = \
+    contextvars.ContextVar("vlog_mesh_lease", default=None)
+
+
+def current_lease() -> "SlotLease | None":
+    """The slot lease attached to the current context (or None)."""
+    return _CURRENT.get()
+
+
+def mesh_for_run():
+    """The device mesh the current run should shard over.
+
+    Under a slot lease: a mesh over the slot's devices (None when the
+    slot is one device wide — the backends' single-device fast path).
+    Without a lease (direct ``process_video`` calls, tests, the
+    CLI): the classic ad-hoc mesh over every visible device.
+    """
+    from vlog_tpu.parallel.mesh import make_mesh
+
+    lease = current_lease()
+    if lease is not None:
+        if lease.width <= 1:
+            return None
+        # Always a plain data axis sized to the slot: a custom
+        # VLOG_TPU_MESH spec (e.g. "data:8", "data:4,model:2") is sized
+        # for the FULL device count and would reject (or mis-shape) a
+        # narrow slot's device subset.
+        return make_mesh("data:-1", devices=list(lease.devices))
+    import jax
+
+    return make_mesh() if len(jax.devices()) > 1 else None
+
+
+def host_pool_for_run() -> ThreadPoolExecutor | None:
+    """The scheduler's shared host entropy pool when running under a
+    slot lease; None otherwise (the executor then owns its own pool,
+    exactly the pre-scheduler behavior)."""
+    lease = current_lease()
+    if lease is None:
+        return None
+    return lease.scheduler.host_pool()
+
+
+class SlotLease:
+    """One job's hold on a mesh slot (or the full mesh).
+
+    Context-manager use attaches the lease to the current context (so
+    :func:`mesh_for_run` sees it down-stack on the same thread) and
+    releases the slot on exit — including on exceptions, which is what
+    lets a crashed job's slot go straight back into rotation.
+    """
+
+    __slots__ = ("slot", "devices", "width", "wait_s", "scheduler",
+                 "_released", "_token")
+
+    def __init__(self, scheduler: "MeshScheduler", slot: int,
+                 devices: tuple):
+        self.scheduler = scheduler
+        self.slot = slot
+        self.devices = tuple(devices)
+        self.width = len(self.devices)
+        self.wait_s = 0.0
+        self._released = False
+        self._token = None
+
+    @property
+    def is_full_mesh(self) -> bool:
+        return self.slot == FULL_MESH_SLOT
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self.scheduler._release(self)
+
+    def __enter__(self) -> "SlotLease":
+        self._token = _CURRENT.set(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        tag = "full" if self.is_full_mesh else str(self.slot)
+        return f"<SlotLease slot={tag} width={self.width}>"
+
+
+class SlotTicket:
+    """Admission for one claimed job, handed out by :meth:`admit`.
+
+    The ticket counts as demand from the moment it is issued — that is
+    what lets two jobs claimed in one poll round both get narrow slots
+    instead of the first racing to the full mesh. ``acquire`` blocks
+    (compute thread) until a slot is grantable; ``close`` is idempotent
+    and must always run (it releases the lease, withdraws un-acquired
+    demand, or — when another thread is still blocked in ``acquire`` —
+    aborts that wait with :class:`SlotCancelled` so the demand is
+    withdrawn exactly once and no lease is ever granted to a closed
+    ticket)."""
+
+    def __init__(self, scheduler: "MeshScheduler"):
+        self._sched = scheduler
+        self.lease: SlotLease | None = None
+        self._closed = False
+        self._waiting = False
+
+    def acquire(self, timeout: float | None = None,
+                cancel: threading.Event | None = None) -> SlotLease:
+        """Block until a slot is grantable. ``cancel``: an event polled
+        while waiting (the job supervisor's cancel flag) — firing it
+        aborts the wait with :class:`SlotCancelled` instead of leaving
+        an uncancellable thread parked on the condition. The grant
+        itself assigns :attr:`lease` under the scheduler lock, so a
+        concurrent ``close`` always sees either an open wait (which it
+        aborts) or the granted lease (which it releases) — never a gap
+        it could double-withdraw through."""
+        if self._closed:
+            raise SlotCancelled("ticket already closed")
+        if self.lease is None:
+            self._sched._acquire(self, timeout, cancel)
+        return self.lease
+
+    def close(self) -> None:
+        with self._sched._cond:
+            if self._closed:
+                return
+            self._closed = True
+            lease = self.lease
+            if lease is None and not self._waiting:
+                # never entered acquire: withdraw the demand here.
+                # (A thread still inside acquire withdraws it itself
+                # when it wakes and sees _closed — exactly once.)
+                self._sched._open_tickets = max(
+                    0, self._sched._open_tickets - 1)
+            self._sched._cond.notify_all()
+        if lease is not None:
+            lease.release()
+
+
+class MeshScheduler:
+    """Partitions a device list into slots and arbitrates leases.
+
+    Thread-safe by design: tickets are admitted on the worker's event
+    loop, leases acquired/released from per-job compute threads.
+    ``devices`` may be any opaque objects (tests drive the grant logic
+    with strings); JAX enters only when a lease builds its mesh.
+    """
+
+    def __init__(self, devices: Sequence | None = None,
+                 slots: int | None = None):
+        if devices is None:
+            import jax
+
+            devices = list(jax.devices())
+        self.devices = tuple(devices)
+        n = max(1, len(self.devices))
+        want = config.MESH_SLOTS if slots is None else int(slots)
+        # Never more slots than devices; each slot is at least one wide.
+        self.slots = max(1, min(want, n))
+        self.slot_width = n // self.slots
+        # Contiguous partition covering EVERY device: when slots does
+        # not divide n, the first n % slots slots are one device wider
+        # (no silently stranded chips at full occupancy).
+        base, rem = divmod(n, self.slots)
+        bounds, at = [], 0
+        for i in range(self.slots):
+            w = base + (1 if i < rem else 0)
+            bounds.append((at, at + w))
+            at += w
+        self._slot_bounds = tuple(bounds)
+        self._cond = threading.Condition()
+        self._active: dict[int, SlotLease] = {}
+        self._open_tickets = 0           # admitted, not yet granted
+        self._holds = 0                  # claim rounds freezing grants
+        self._host_pool: ThreadPoolExecutor | None = None
+        self._pool_lock = threading.Lock()
+        self._metrics().mesh_slots.set(self.slots)
+
+    # ---- admission ---------------------------------------------------
+    def capacity(self) -> int:
+        """Jobs this scheduler can admit right now. Zero while a
+        full-mesh lease runs (arrivals would only wait for the job
+        boundary while hoarding a claim another worker could serve)."""
+        with self._cond:
+            if FULL_MESH_SLOT in self._active:
+                return 0
+            return max(0, self.slots - len(self._active)
+                       - self._open_tickets)
+
+    def admit(self) -> SlotTicket:
+        """Register one claimed job's demand and return its ticket."""
+        with self._cond:
+            self._open_tickets += 1
+        return SlotTicket(self)
+
+    @contextlib.contextmanager
+    def hold(self):
+        """Freeze slot grants while a claim round is in flight.
+
+        The claim loop's capacity check, DB claim round-trips, and
+        ticket admissions span several lock windows; without the hold,
+        an earlier job's compute thread can acquire mid-round and pick
+        its width against INCOMPLETE demand — a lone job narrowing
+        itself against a claim that comes back empty, or grabbing the
+        full mesh while this round's job is being claimed (then
+        stranding it a whole job life). Grants wait out the hold
+        (claims are ms-scale); admissions, closes, and releases flow
+        normally."""
+        with self._cond:
+            self._holds += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._holds = max(0, self._holds - 1)
+                self._cond.notify_all()
+
+    def snapshot(self) -> dict:
+        """Stats surface (worker ``stats`` command / debugging)."""
+        with self._cond:
+            return {
+                "slots": self.slots,
+                "slot_width": self.slot_width,
+                "devices": len(self.devices),
+                "active": len(self._active),
+                "pending": self._open_tickets,
+                "leases": {("full" if s == FULL_MESH_SLOT else s): l.width
+                           for s, l in self._active.items()},
+            }
+
+    # ---- grant engine ------------------------------------------------
+    def _slot_devices(self, slot: int) -> tuple:
+        lo, hi = self._slot_bounds[slot]
+        return self.devices[lo:hi]
+
+    def _try_grant_locked(self) -> SlotLease | None:
+        if not self._active:
+            # Work-conserving fallback: a lone job (this ticket is the
+            # only demand) gets every device, whatever the slot knob
+            # says. Widths renegotiate here, at the job boundary.
+            if self._open_tickets == 1 or self.slots == 1:
+                return SlotLease(self, FULL_MESH_SLOT if self.slots > 1
+                                 else 0,
+                                 self.devices)
+            return SlotLease(self, 0, self._slot_devices(0))
+        if FULL_MESH_SLOT in self._active:
+            return None                  # wait for the job boundary
+        for slot in range(self.slots):
+            if slot not in self._active:
+                return SlotLease(self, slot, self._slot_devices(slot))
+        return None
+
+    def _acquire(self, ticket: SlotTicket, timeout: float | None,
+                 cancel: threading.Event | None) -> SlotLease:
+        t0 = time.monotonic()
+        deadline = None if timeout is None else t0 + timeout
+        with self._cond:
+            ticket._waiting = True
+            try:
+                while True:
+                    if ticket._closed:
+                        # close() raced our wait: withdraw the demand
+                        # here (close() deliberately left it to us) and
+                        # die instead of running on a dead job's lease.
+                        self._withdraw_locked()
+                        raise SlotCancelled(
+                            "slot ticket closed while waiting")
+                    if cancel is not None and cancel.is_set():
+                        ticket._closed = True
+                        self._withdraw_locked()
+                        raise SlotCancelled(
+                            "job cancelled while waiting for a mesh slot")
+                    lease = None
+                    if self._holds == 0:
+                        # grants freeze while a claim round is in
+                        # flight (hold()) — width must be decided
+                        # against the round's COMPLETE demand
+                        lease = self._try_grant_locked()
+                    if lease is not None:
+                        self._open_tickets -= 1
+                        self._active[lease.slot] = lease
+                        # assign under the lock: close() must never see
+                        # a granted-but-unassigned ticket
+                        ticket.lease = lease
+                        break
+                    remaining = None if deadline is None \
+                        else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        ticket._closed = True
+                        self._withdraw_locked()
+                        raise TimeoutError(
+                            f"no mesh slot free within {timeout:.1f}s")
+                    # bounded waits so the cancel event stays observable
+                    wait_s = 0.2 if cancel is not None else remaining
+                    if remaining is not None:
+                        wait_s = remaining if wait_s is None \
+                            else min(wait_s, remaining)
+                    self._cond.wait(timeout=wait_s)
+            finally:
+                ticket._waiting = False
+        lease.wait_s = time.monotonic() - t0
+        m = self._metrics()
+        m.mesh_slot_wait.observe(lease.wait_s)
+        m.mesh_slot_occupancy.set(len(self._active))
+        m.mesh_slot_width.labels(self._slot_label(lease.slot)).set(
+            lease.width)
+        return lease
+
+    def _withdraw_locked(self) -> None:
+        """Remove one unit of un-granted demand (caller holds _cond)."""
+        self._open_tickets = max(0, self._open_tickets - 1)
+        self._cond.notify_all()
+
+    def _release(self, lease: SlotLease) -> None:
+        with self._cond:
+            self._active.pop(lease.slot, None)
+            occupancy = len(self._active)
+            self._cond.notify_all()
+        m = self._metrics()
+        m.mesh_slot_occupancy.set(occupancy)
+        m.mesh_slot_width.labels(self._slot_label(lease.slot)).set(0)
+
+    @staticmethod
+    def _slot_label(slot: int) -> str:
+        return "full" if slot == FULL_MESH_SLOT else str(slot)
+
+    @staticmethod
+    def _metrics():
+        from vlog_tpu.obs.metrics import runtime
+
+        return runtime()
+
+    # ---- shared resources --------------------------------------------
+    def host_pool(self) -> ThreadPoolExecutor:
+        """One process-wide host entropy pool for every slot executor
+        (``VLOG_ENTROPY_THREADS`` is sized for the whole host; two slot
+        jobs each building their own pool would oversubscribe 2x)."""
+        with self._pool_lock:
+            if self._host_pool is None:
+                self._host_pool = ThreadPoolExecutor(
+                    max_workers=config.ENTROPY_THREADS,
+                    thread_name_prefix="vlog-mesh-host")
+            return self._host_pool
+
+
+_scheduler: MeshScheduler | None = None
+_scheduler_lock = threading.Lock()
+
+
+def get_scheduler() -> MeshScheduler:
+    """The process-wide scheduler over every visible device (lazy)."""
+    global _scheduler
+    if _scheduler is None:
+        with _scheduler_lock:
+            if _scheduler is None:
+                _scheduler = MeshScheduler()
+    return _scheduler

@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
+import contextvars
 import json
 import logging
 import signal
@@ -73,6 +75,43 @@ class DaemonStats:
 
 # Async event hook: (event_name, payload) — wired to webhook delivery.
 EventFn = Callable[[str, dict], Awaitable[None]]
+
+# Per-job supervision context: with the mesh scheduler admitting several
+# jobs at once, each job's asyncio task carries its own supervisor and
+# slot ticket through these vars (asyncio.to_thread copies context, so
+# the compute thread sees them too). Unset = the daemon's own fields —
+# the single-job path and direct test calls are unchanged.
+_SUP: contextvars.ContextVar["JobSupervisor | None"] = \
+    contextvars.ContextVar("vlog_job_supervisor", default=None)
+_TICKET: contextvars.ContextVar[Any] = \
+    contextvars.ContextVar("vlog_job_slot_ticket", default=None)
+
+
+class JobSupervisor(ComputeWatchdogMixin):
+    """Per-job cancellation + stall-watchdog state.
+
+    One instance per in-flight job, so concurrent slot jobs cancel and
+    stall-track independently; ``request_stop`` broadcasts to every
+    active supervisor. The daemon itself remains a
+    :class:`ComputeWatchdogMixin` so code (and tests) that drive
+    ``daemon._run_with_timeout`` / ``daemon._cancel`` directly keep
+    working."""
+
+    def __init__(self, daemon: "WorkerDaemon"):
+        self.cancel_grace_s = daemon.cancel_grace_s
+        self.stall_window_s = daemon.stall_window_s
+        self.watchdog_tick_s = daemon.watchdog_tick_s
+        self._cancel = threading.Event()
+        self._cancel_reason = ""
+        # THIS job's first recorded failure (per-job success detection:
+        # the daemon-wide stats.failed counter moves under concurrent
+        # slot jobs, so it cannot attribute an attempt's outcome)
+        self.failed_error: str | None = None
+        self._reset_watchdog()
+
+    def cancel(self, reason: str) -> None:
+        self._cancel_reason = self._cancel_reason or reason
+        self._cancel.set()
 
 
 def _cleanup_other_format(out_dir: Path, new_fmt: str) -> None:
@@ -120,6 +159,11 @@ class WorkerDaemon(ComputeWatchdogMixin):
     watchdog_tick_s: float = 1.0
     # Circuit breaker over the compute path; None builds one from config.
     breaker: CircuitBreaker | None = None
+    # Mesh job scheduler (parallel/scheduler.py). None + VLOG_MESH_SLOTS
+    # > 1 + a backend builds the process-wide one lazily in run();
+    # tests inject a MeshScheduler directly. With slots == 1 (default)
+    # the claim loop is the classic one-job-at-a-time poll.
+    scheduler: Any = None
 
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
@@ -129,6 +173,8 @@ class WorkerDaemon(ComputeWatchdogMixin):
         self._cancel = threading.Event()   # aborts the in-flight compute
         self._cancel_reason = ""
         self._current_job_id: int | None = None
+        self._active_sups: dict[int, JobSupervisor] = {}  # job id -> sup
+        self._tasks: set[asyncio.Task] = set()            # slot job tasks
         if self.breaker is None:
             self.breaker = CircuitBreaker()
         self._reset_watchdog()
@@ -145,6 +191,13 @@ class WorkerDaemon(ComputeWatchdogMixin):
         self._stop.set()
         self._cancel_reason = self._cancel_reason or "shutdown"
         self._cancel.set()
+        for sup in list(self._active_sups.values()):
+            sup.cancel("shutdown")
+
+    def _sup(self) -> ComputeWatchdogMixin:
+        """The supervisor for the current job context (self when none —
+        the direct-call / legacy path)."""
+        return _SUP.get() or self
 
     async def startup(self) -> None:
         """Recovery sweep + worker registration.
@@ -216,8 +269,11 @@ class WorkerDaemon(ComputeWatchdogMixin):
 
             return {**asdict(self.stats),
                     "current_job_id": self._current_job_id,
+                    "active_job_ids": sorted(self._active_sups),
                     "breaker": self.breaker.snapshot(),
                     "disk_paused": self.disk_paused,
+                    "mesh": (self.scheduler.snapshot()
+                             if self.scheduler is not None else None),
                     "kinds": [k.value for k in self.kinds]}
         if command == "stop":
             log.info("remote stop command received")
@@ -261,6 +317,12 @@ class WorkerDaemon(ComputeWatchdogMixin):
             # not keep the worker down; lapsed leases are also swept
             # inside every claim transaction
             log.exception("startup recovery failed; polling anyway")
+        if (self.scheduler is None and config.MESH_SLOTS > 1
+                and self.backend is not None):
+            from vlog_tpu.parallel.scheduler import get_scheduler
+
+            self.scheduler = get_scheduler()
+            log.info("mesh scheduler active: %s", self.scheduler.snapshot())
         bus = bus_for(self.db)
         await bus.start()
         jobs_sub = bus.subscribe(CH_JOBS)
@@ -268,7 +330,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
         try:
             while not self._stop.is_set():
                 try:
-                    worked = await self.poll_once()
+                    worked = await self._poll_fill()
                 except Exception:  # noqa: BLE001 — the daemon must outlive
                     # any single poll cycle (transient DB faults, injected
                     # failpoints); pause briefly so a persistent fault
@@ -282,18 +344,102 @@ class WorkerDaemon(ComputeWatchdogMixin):
                     # loop, so clear them
                     jobs_sub.drain()
                     continue
-                await jobs_sub.wait_or(self._stop, self.poll_interval_s)
+                await self._idle_wait(jobs_sub)
         finally:
             jobs_sub.close()
             self._stop.set()
+            if self._tasks:
+                # in-flight slot jobs: request_stop already broadcast
+                # the cancel; let each hand its claim back
+                await asyncio.gather(*self._tasks, return_exceptions=True)
             hb.cancel()
             await asyncio.gather(hb, return_exceptions=True)
             await self.db.execute(
                 "UPDATE workers SET status='offline' WHERE name=:n",
                 {"n": self.name})
 
+    async def _poll_fill(self) -> bool:
+        """Admit work for every free mesh slot (the scheduler-aware claim
+        loop). Without a multi-slot scheduler this is exactly one
+        blocking :meth:`poll_once`. With one, up to ``slots`` jobs are
+        claimed while the scheduler reports capacity and each runs as
+        its own task on its own slot lease."""
+        if self.scheduler is None or self.scheduler.slots <= 1:
+            return await self.poll_once()
+        device_kinds = (JobKind.TRANSCODE, JobKind.REENCODE)
+        batch: list[tuple[Row, Any]] = []
+        try:
+            # The hold freezes slot grants for the round, making the
+            # capacity check + claims + admissions atomic with respect
+            # to width decisions: an earlier job's compute thread
+            # cannot acquire against this round's incomplete demand
+            # (grabbing the full mesh while another job is mid-claim,
+            # or narrowing itself against a claim that returns empty).
+            with self.scheduler.hold():
+                while (not self._stop.is_set()
+                       and (len(self._tasks) + len(batch)
+                            < self.scheduler.slots)):
+                    # Device jobs need slot capacity; CPU-only kinds
+                    # (sprites, transcription) ride the same
+                    # concurrency bound but never register device
+                    # demand — a transcode claimed alongside one still
+                    # work-conservingly gets the full mesh. With zero
+                    # capacity (a full-width lease running) only CPU
+                    # kinds are claimable; device jobs stay in the
+                    # queue for other workers.
+                    kinds = self.kinds
+                    if self.scheduler.capacity() <= 0:
+                        kinds = tuple(k for k in self.kinds
+                                      if k not in device_kinds)
+                        if not kinds:
+                            break
+                    job = await self._admit_and_claim(kinds=kinds)
+                    if job is None:
+                        break
+                    ticket = (self.scheduler.admit()
+                              if JobKind(job["kind"]) in device_kinds
+                              else None)
+                    batch.append((job, ticket))
+        finally:
+            for job, ticket in batch:
+                task = asyncio.create_task(
+                    self._run_slot_job(job, ticket))
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
+        return bool(batch)
+
+    async def _run_slot_job(self, job: Row, ticket: Any) -> None:
+        """One slot job's task body: _process_claimed with the same
+        outlive-any-job exception wall the legacy loop has — an escaped
+        error (transient DB fault in dispatch bookkeeping) must be
+        logged, not vanish into an unretrieved task exception."""
+        try:
+            await self._process_claimed(job, ticket)
+        except Exception:  # noqa: BLE001 — the daemon must outlive any job
+            log.exception("slot job %s failed outside the attempt wall",
+                          job["id"])
+
+    async def _idle_wait(self, jobs_sub) -> None:
+        """Sleep until a job event, the poll interval, shutdown, or — in
+        slot mode — any in-flight job finishing (a freed slot means the
+        loop should try to claim again)."""
+        await jobs_sub.wait_or(self._stop, self.poll_interval_s,
+                               extra=set(self._tasks))
+
     async def poll_once(self) -> bool:
         """Claim and process at most one job. Returns True if one ran."""
+        job = await self._admit_and_claim()
+        if job is None:
+            return False
+        await self._process_claimed(job)
+        return True
+
+    async def _admit_and_claim(self, kinds: tuple[JobKind, ...] | None = None
+                               ) -> Row | None:
+        """Admission gates (disk, breaker) + one claim attempt. Returns
+        the claimed job row, or None when nothing should run now.
+        ``kinds`` narrows the claim (slot mode claims CPU-only kinds
+        while a full-width lease saturates the mesh)."""
         from vlog_tpu.db.retry import with_retries
         from vlog_tpu.storage import integrity
 
@@ -307,12 +453,12 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 log.warning("output volume under disk pressure; pausing "
                             "claiming (%s)", self.video_dir)
             self.disk_paused = True
-            return False
+            return None
         self.disk_paused = False
         if not self.breaker.allow():
             # breaker open: leave the queue alone until the cooldown
             # lapses and a half-open probe is due
-            return False
+            return None
         # From here on, every exit that does not end in record_success /
         # record_failure must call release_probe() (a no-op unless this
         # poll holds the half-open probe) — otherwise the breaker wedges
@@ -320,7 +466,8 @@ class WorkerDaemon(ComputeWatchdogMixin):
         try:
             job = await with_retries(
                 lambda: claims.claim_job(
-                    self.db, self.name, kinds=self.kinds,
+                    self.db, self.name,
+                    kinds=self.kinds if kinds is None else kinds,
                     accelerator=self.accelerator),
                 label="daemon-claim")
         except BaseException:
@@ -328,7 +475,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
             raise
         if job is None:
             self.breaker.release_probe()
-            return False
+            return None
         if self._stop.is_set():
             # Shutdown arrived while the claim was in flight: hand it
             # straight back instead of starting (and then abandoning) work.
@@ -337,21 +484,41 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 await claims.release_job(self.db, job["id"], self.name)
             except js.JobStateError:
                 pass
-            return False
+            return None
+        return job
+
+    async def _process_claimed(self, job: Row, ticket: Any = None) -> None:
+        """Run one claimed job to its outcome under its own supervisor.
+        ``ticket`` is the job's mesh-slot admission when the scheduler
+        claimed it (closed here however the job ends, so a job that dies
+        before compute cannot strand slot capacity)."""
         self.stats.bump("claimed")
         self._cancel.clear()
         self._cancel_reason = ""
         self._current_job_id = job["id"]
         self._reset_watchdog()
+        sup = JobSupervisor(self)
+        self._active_sups[job["id"]] = sup
+        if self._stop.is_set():
+            # request_stop raced the registration above: its broadcast
+            # missed this supervisor, so deliver the cancel ourselves.
+            sup.cancel("shutdown")
+        tok_sup = _SUP.set(sup)
+        tok_ticket = _TICKET.set(ticket)
         try:
             await self._dispatch(job)
         finally:
+            _SUP.reset(tok_sup)
+            _TICKET.reset(tok_ticket)
+            self._active_sups.pop(job["id"], None)
+            if ticket is not None:
+                ticket.close()
             # Resolve any half-open probe _dispatch leaked — e.g. an
             # exception before its try block (video lookup) records no
             # outcome; a wedged HALF_OPEN would never claim again.
             self.breaker.release_probe()
-            self._current_job_id = None
-        return True
+            if self._current_job_id == job["id"]:
+                self._current_job_id = None
 
     # -- job dispatch ------------------------------------------------------
 
@@ -409,6 +576,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
     async def _run_attempt(self, job: Row, video: Row, handler) -> None:
         from vlog_tpu.obs import trace as obs_trace
 
+        sup = _SUP.get()
         failed_before = self.stats.failed
         with obs_trace.span("worker.attempt", worker=self.name,
                             kind=job["kind"], attempt=job["attempt"]) as att:
@@ -420,11 +588,19 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 # payload) — that says nothing about compute health, so it
                 # must neither close a half-open breaker nor count against
                 # it (poll_once's finally releases any probe). Only a run
-                # with no failure recorded is a success.
-                if self.stats.failed == failed_before:
+                # with no failure recorded is a success. With a per-job
+                # supervisor the failure marker is per-attempt; the
+                # daemon-wide counter is only the direct-call fallback
+                # (another slot job's failure must not be attributed here).
+                if sup is not None:
+                    ok, err = sup.failed_error is None, sup.failed_error
+                else:
+                    ok = self.stats.failed == failed_before
+                    err = self.stats.last_error
+                if ok:
                     self.breaker.record_success()
                 else:
-                    att.set_error(self.stats.last_error or "dead-lettered")
+                    att.set_error(err or "dead-lettered")
             except JobCancelled as exc:
                 if self._stop.is_set():
                     # Graceful shutdown: hand the claim back, attempt
@@ -463,6 +639,13 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 self.breaker.record_failure()
                 await self._fail(job, video, f"{type(exc).__name__}: {exc}")
 
+    def _mark_failed(self, error: str) -> None:
+        """Record a failure against the CURRENT job's supervisor (the
+        per-attempt outcome marker _run_attempt reads)."""
+        sup = _SUP.get()
+        if sup is not None and sup.failed_error is None:
+            sup.failed_error = error
+
     async def _fail(self, job: Row, video: Row, error: str, *,
                     permanent: bool = False,
                     failure_class: FailureClass | None = None) -> None:
@@ -471,6 +654,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
                                     failure_class=failure_class)
         self.stats.bump("failed")
         self.stats.last_error = error
+        self._mark_failed(error)
         terminal = row["failed_at"] is not None
         if terminal and JobKind(job["kind"]) is JobKind.TRANSCODE:
             await vids.set_status(self.db, video["id"], VideoStatus.FAILED,
@@ -499,6 +683,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
         loop = asyncio.get_running_loop()
         last_write = 0.0
         claim_lost = threading.Event()
+        sup = self._sup()   # this job's supervisor (or the daemon itself)
 
         async def write(progress: float, msg: str) -> None:
             try:
@@ -514,9 +699,9 @@ class WorkerDaemon(ComputeWatchdogMixin):
 
         def cb(done: int, total: int, msg: str) -> None:
             nonlocal last_write
-            self._note_progress(done)   # stall-watchdog feed
-            if self._cancel.is_set():
-                raise JobCancelled(self._cancel_reason or "cancelled")
+            sup._note_progress(done)   # stall-watchdog feed
+            if sup._cancel.is_set():
+                raise JobCancelled(sup._cancel_reason or "cancelled")
             if claim_lost.is_set():
                 raise JobCancelled("claim lost (lease expired and reclaimed)")
             now = time.monotonic()
@@ -536,6 +721,41 @@ class WorkerDaemon(ComputeWatchdogMixin):
     # (worker/watchdog.py) — shared with RemoteWorker so timeout, stall
     # and cancel semantics cannot drift between the two workers.
 
+    @contextlib.contextmanager
+    def _slot_scope(self):
+        """Compute-thread scope around device work: blocks for this
+        job's mesh slot lease and attaches it to the context, so the
+        backend builds its mesh over the slot's devices and the shared
+        entropy pool. No-op without a scheduler ticket — direct calls
+        and slots=1 keep the classic full-mesh behavior. The wait
+        honors the job's cancel flag (watchdog/timeout/shutdown), so a
+        thread parked on a busy mesh aborts as a normal JobCancelled
+        instead of being abandoned un-cancellably."""
+        ticket = _TICKET.get()
+        if ticket is None:
+            yield None
+            return
+        from vlog_tpu.parallel.scheduler import SlotCancelled
+
+        sup = self._sup()
+        try:
+            lease = ticket.acquire(cancel=getattr(sup, "_cancel", None))
+        except SlotCancelled as exc:
+            raise JobCancelled(getattr(sup, "_cancel_reason", "")
+                               or str(exc)) from exc
+        with lease:
+            yield lease
+
+    def _mesh_span_attrs(self, span) -> None:
+        """Stamp the job's slot placement onto its transcode span."""
+        ticket = _TICKET.get()
+        lease = getattr(ticket, "lease", None)
+        if lease is not None:
+            span.attrs["mesh.slot"] = ("full" if lease.is_full_mesh
+                                       else lease.slot)
+            span.attrs["mesh.width"] = lease.width
+            span.attrs["mesh.wait_s"] = round(lease.wait_s, 3)
+
     # -- handlers ----------------------------------------------------------
 
     async def _run_transcode(self, job: Row, video: Row) -> None:
@@ -554,6 +774,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
             await vids.set_status(self.db, video["id"], VideoStatus.FAILED,
                                   error="video exceeds duration cap")
             self.stats.bump("failed")
+            self._mark_failed("video exceeds duration cap")
             return
 
         rungs = config.ladder_for_source(info.height)
@@ -566,15 +787,18 @@ class WorkerDaemon(ComputeWatchdogMixin):
                                     [r.name for r in rungs])
 
         def work():
-            return process_video(source, out_dir, backend=self.backend,
-                                 progress_cb=cb, rungs=rungs)
+            with self._slot_scope():
+                return process_video(source, out_dir, backend=self.backend,
+                                     progress_cb=cb, rungs=rungs)
 
         from vlog_tpu.obs import trace as obs_trace
         from vlog_tpu.obs.metrics import runtime as obs_runtime
 
         with obs_trace.span("worker.transcode",
                             rungs=[r.name for r in rungs]) as tsp:
-            result = await self._run_with_timeout(work, timeout, "transcode")
+            result = await self._sup()._run_with_timeout(
+                work, timeout, "transcode")
+            self._mesh_span_attrs(tsp)
         # stage busy-sums + per-rung times -> trace leaves; histograms
         # feed this process's /metrics on the worker health port
         obs_trace.record_run_stages(tsp, result.run.stage_s)
@@ -629,17 +853,20 @@ class WorkerDaemon(ComputeWatchdogMixin):
             # write_manifest=False: the manifest is rebuilt below after
             # _cleanup_other_format anyway — hashing the tree twice
             # inside the timeout envelope would be pure waste.
-            return process_video(source, out_dir, backend=self.backend,
-                                 progress_cb=cb, rungs=rungs, resume=False,
-                                 write_manifest=False,
-                                 streaming_format=fmt, codec=codec)
+            with self._slot_scope():
+                return process_video(source, out_dir, backend=self.backend,
+                                     progress_cb=cb, rungs=rungs,
+                                     resume=False, write_manifest=False,
+                                     streaming_format=fmt, codec=codec)
 
         from vlog_tpu.obs import trace as obs_trace
         from vlog_tpu.obs.metrics import runtime as obs_runtime
 
         with obs_trace.span("worker.transcode", rungs=[r.name for r in rungs],
                             streaming_format=fmt, codec=codec) as tsp:
-            result = await self._run_with_timeout(work, timeout, "reencode")
+            result = await self._sup()._run_with_timeout(
+                work, timeout, "reencode")
+            self._mesh_span_attrs(tsp)
         obs_trace.record_run_stages(tsp, result.run.stage_s)
         obs_runtime().observe_run(result.run.stage_s)
         # Drop the previous format's leftovers so clients can never follow
@@ -683,7 +910,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
         def work():
             return generate_sprites(source, out_dir, progress_cb=cb)
 
-        result = await self._run_with_timeout(work, timeout, "sprites")
+        result = await self._sup()._run_with_timeout(work, timeout, "sprites")
         await claims.complete_job(self.db, job["id"], self.name)
         self.stats.bump("completed")
         await self._emit("video.sprites_ready", {
@@ -711,7 +938,8 @@ class WorkerDaemon(ComputeWatchdogMixin):
                                     model_dir=self.transcription_model_dir)
 
         try:
-            result = await self._run_with_timeout(work, timeout, "transcription")
+            result = await self._sup()._run_with_timeout(
+                work, timeout, "transcription")
         except js.JobStateError:
             # Claim lost: another worker owns this job now — do not stomp
             # whatever status it is writing.
