@@ -54,6 +54,26 @@ def _json_error(status: int, message: str) -> web.Response:
     return web.json_response({"error": message}, status=status)
 
 
+def _claim_epoch(request: web.Request) -> int | None:
+    """The ``X-Claim-Epoch`` fencing token (the claim's attempt number).
+
+    Every claim-gated write carries it so a partitioned worker whose
+    lease was swept and re-claimed — even under the same worker name —
+    gets 409 instead of corrupting the successor attempt's tree/trace
+    (``jobs.state.guard_epoch``). Absent header = pre-fencing client,
+    ownership guards only; garbage is a 400 client bug.
+    """
+    raw = request.headers.get("X-Claim-Epoch")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": f"bad X-Claim-Epoch {raw!r}"}),
+            content_type="application/json") from None
+
+
 def _job_payload(row: Row) -> dict:
     out = dict(row)
     out["payload"] = json.loads(out.get("payload") or "{}")
@@ -205,7 +225,8 @@ async def progress(request: web.Request) -> web.Response:
                 db, job_id, request[IDENTITY].worker_name,
                 progress=body.get("progress"),
                 current_step=body.get("current_step"),
-                checkpoint=body.get("checkpoint")),
+                checkpoint=body.get("checkpoint"),
+                epoch=_claim_epoch(request)),
             label="progress")
     except js.JobStateError as exc:
         return _json_error(409, str(exc))
@@ -228,10 +249,13 @@ async def complete(request: web.Request) -> web.Response:
     job = await db.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
     if job is None:
         return _json_error(404, "no such job")
-    # Ownership gate BEFORE any finalize side effect: a worker whose lease
-    # lapsed (and whose job was reclaimed) must not overwrite the current
-    # owner's published state — it gets the 409 abort signal up front.
+    # Ownership + epoch gate BEFORE any finalize side effect: a worker
+    # whose lease lapsed (and whose job was reclaimed) must not overwrite
+    # the current owner's published state — it gets the 409 abort signal
+    # up front.
+    epoch = _claim_epoch(request)
     try:
+        js.guard_epoch(job, epoch)
         js.guard_complete(job, worker, now=db_now())
     except js.JobStateError as exc:
         return _json_error(409, str(exc))
@@ -286,10 +310,11 @@ async def complete(request: web.Request) -> web.Response:
             return _json_error(400, f"uploaded tree failed validation: {exc}")
     try:
         # Terminal-state transition FIRST: complete_job atomically re-checks
-        # ownership inside its transaction, so a stale worker that lost the
-        # claim gets its 409 before any published state changes.
+        # ownership AND the epoch inside its transaction, so a stale worker
+        # that lost the claim gets its 409 before any published state
+        # changes.
         await with_retries(
-            lambda: claims.complete_job(db, job_id, worker),
+            lambda: claims.complete_job(db, job_id, worker, epoch=epoch),
             label="complete")
         if kind in (JobKind.TRANSCODE, JobKind.REENCODE):
             reenc = kind is JobKind.REENCODE
@@ -373,7 +398,7 @@ async def fail(request: web.Request) -> web.Response:
                 db, job_id, request[IDENTITY].worker_name,
                 str(body.get("error") or "unspecified"),
                 permanent=bool(body.get("permanent")),
-                failure_class=fc),
+                failure_class=fc, epoch=_claim_epoch(request)),
             label="fail")
     except js.JobStateError as exc:
         return _json_error(409, str(exc))
@@ -392,7 +417,8 @@ async def release(request: web.Request) -> web.Response:
     db = request.app[DB]
     job_id = int(request.match_info["job_id"])
     try:
-        await claims.release_job(db, job_id, request[IDENTITY].worker_name)
+        await claims.release_job(db, job_id, request[IDENTITY].worker_name,
+                                 epoch=_claim_epoch(request))
     except js.JobStateError as exc:
         return _json_error(409, str(exc))
     return web.json_response({"ok": True})
@@ -437,14 +463,21 @@ def _safe_relpath(tail: str) -> Path | None:
     return p
 
 
-async def _worker_holds_claim(db: Database, worker: str, video_id: int) -> bool:
-    row = await db.fetch_one(
+async def _active_claim_row(db: Database, worker: str,
+                            video_id: int) -> Row | None:
+    """The job row backing the worker's active claim on this video (or
+    None) — the row the upload path fences its epoch check against."""
+    return await db.fetch_one(
         f"""
-        SELECT 1 FROM jobs WHERE video_id=:v AND claimed_by=:w
+        SELECT * FROM jobs WHERE video_id=:v AND claimed_by=:w
           AND {js.SQL_ACTIVELY_CLAIMED}
+        ORDER BY claimed_at DESC LIMIT 1
         """,
         {"v": video_id, "w": worker, "now": db_now()})
-    return row is not None
+
+
+async def _worker_holds_claim(db: Database, worker: str, video_id: int) -> bool:
+    return await _active_claim_row(db, worker, video_id) is not None
 
 
 async def upload(request: web.Request) -> web.Response:
@@ -464,8 +497,15 @@ async def upload(request: web.Request) -> web.Response:
     video = await vids.get_video(db, video_id)
     if video is None:
         return _json_error(404, "no such video")
-    if not await _worker_holds_claim(db, worker, video_id):
+    claim_row = await _active_claim_row(db, worker, video_id)
+    if claim_row is None:
         return _json_error(409, "no active claim on this video")
+    try:
+        # epoch fence BEFORE a byte lands: a swept-and-reclaimed job's
+        # previous incarnation must not overwrite the successor's tree
+        js.guard_epoch(claim_row, _claim_epoch(request))
+    except js.JobStateError as exc:
+        return _json_error(409, str(exc))
     rel = _safe_relpath(request.match_info["tail"])
     if rel is None:
         return _json_error(400, "bad upload path")
@@ -609,6 +649,7 @@ async def post_spans(request: web.Request) -> web.Response:
     if job is None:
         return _json_error(404, "no such job")
     try:
+        js.guard_epoch(job, _claim_epoch(request))
         js.guard_progress(job, request[IDENTITY].worker_name, now=db_now())
     except js.JobStateError as exc:
         return _json_error(409, str(exc))

@@ -191,6 +191,23 @@ BREAKER_COOLDOWN_S: float = _env_float("VLOG_BREAKER_COOLDOWN", 60.0, lo=0.0)
 # within this window, even while lease renewals keep it nominally alive.
 # 0 disables the watchdog.
 STALL_WINDOW_S: float = _env_float("VLOG_STALL_WINDOW", 900.0, lo=0.0)
+# Device-fault quarantine (parallel/scheduler.py): a slot's devices are
+# quarantined after this many device-classified faults (parallel/faults.py)
+# are attributed to them; a quarantined device rejoins the rotation only
+# after the cheap probe computation passes on it.
+QUARANTINE_THRESHOLD: int = _env_int("VLOG_QUARANTINE_THRESHOLD", 1, lo=1)
+# Cadence of the quarantined-device probe sweep in the worker daemon;
+# 0 disables the loop (devices then stay quarantined until restart or an
+# explicit probe_quarantined call).
+DEVICE_PROBE_INTERVAL_S: float = _env_float(
+    "VLOG_DEVICE_PROBE_INTERVAL_S", 60.0, lo=0.0)
+# Coordination-plane brownout breaker (worker/brownout.py): this many
+# CONSECUTIVE transient DB/API errors in a worker's claim loop mark the
+# worker browned-out (readiness degrades, claim attempts pause on
+# jittered backoff) until the plane answers again.
+DB_BREAKER_THRESHOLD: int = _env_int("VLOG_DB_BREAKER_THRESHOLD", 3, lo=1)
+DB_BREAKER_COOLDOWN_S: float = _env_float(
+    "VLOG_DB_BREAKER_COOLDOWN", 15.0, lo=0.0)
 
 # --------------------------------------------------------------------------
 # Storage integrity plane: orphan GC (storage/gc.py). MIN_FREE_DISK_BYTES

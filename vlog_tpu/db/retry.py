@@ -65,6 +65,57 @@ _PG_RETRYABLE_FRAGMENTS = (
 )
 
 
+# Connection-drop message shapes (libpq, sqlite-over-NFS, sockets).
+# Deliberately broader than _PG_RETRYABLE_*: these are NOT safe for
+# with_retries (a drop can land after COMMIT) but they ARE the signal
+# the claim-loop brownout breaker paces itself on — the loop re-reads
+# queue state every poll, so double-apply is not a concern there.
+_CONNECTION_FRAGMENTS = (
+    "connection refused",
+    "connection reset",
+    "connection timed out",
+    "server closed the connection",
+    "could not connect",
+    "broken pipe",
+    "connection is closed",
+    "unavailable",
+)
+
+
+def is_transient_db_error(exc: BaseException) -> bool:
+    """Is this the coordination plane flapping (vs a code/data bug)?
+
+    Used by the worker claim loops' brownout breaker (worker/brownout.py)
+    to decide between jittered backoff (transient: Postgres restarting,
+    network partition, lock storms) and the generic crash-log path. Not
+    used by :func:`with_retries` — see _CONNECTION_FRAGMENTS.
+
+    Message fragments are only consulted on I/O and database-driver
+    error families (same restraint as parallel/faults.py's
+    RuntimeError-only matching): a code bug whose TEXT happens to say
+    "unavailable" must not be routed into the brownout path, where its
+    traceback would be suppressed and the worker pulled from rotation
+    for the wrong reason.
+    """
+    if is_retryable(exc):
+        return True
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if hasattr(exc, "sqlstate"):          # the PgError family
+        sqlstate = exc.sqlstate
+        if isinstance(sqlstate, str) and sqlstate[:2] in ("08", "57"):
+            return True
+    if isinstance(exc, RetriesExhausted):
+        return True
+    import sqlite3
+
+    if not (isinstance(exc, (OSError, sqlite3.Error))
+            or hasattr(exc, "sqlstate")):
+        return False
+    msg = str(exc).lower()
+    return any(f in msg for f in _CONNECTION_FRAGMENTS)
+
+
 class RetriesExhausted(RuntimeError):
     """All attempts failed with retryable errors; carries the last one."""
 

@@ -390,6 +390,47 @@ MIGRATIONS: list[tuple[int, list[str]]] = [
             " ON job_spans(job_id) WHERE parent_id IS NULL",
         ],
     ),
+    (
+        7,
+        [
+            # -- fault-domain isolation plane --------------------------------
+            # device_fault joins the failure taxonomy (enums.FailureClass):
+            # the accelerator — not the input — failed the attempt, the
+            # attempt is refunded and the scheduler quarantines the slot's
+            # devices. The CHECK constraint can't be altered in place on
+            # sqlite, so the table rebuilds (portable on Postgres too:
+            # RENAME + recreate + copy + drop). The copy deliberately does
+            # NOT carry explicit ids: on Postgres the recreated BIGSERIAL
+            # sequence starts at 1 and explicit-id rows would leave it
+            # behind the data (the next insert would collide); re-keying
+            # in ORDER BY id keeps both backends' sequences consistent and
+            # preserves the only ordering anything reads (per-job history
+            # is ORDER BY id; ids are never stored elsewhere).
+            "ALTER TABLE job_failures RENAME TO job_failures_old",
+            "DROP INDEX IF EXISTS idx_job_failures_job",
+            """
+            CREATE TABLE IF NOT EXISTS job_failures (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                job_id INTEGER NOT NULL REFERENCES jobs(id) ON DELETE CASCADE,
+                attempt INTEGER NOT NULL,
+                worker TEXT,
+                error TEXT,
+                failure_class TEXT NOT NULL DEFAULT 'transient',
+                created_at REAL NOT NULL,
+                CHECK (failure_class IN
+                       ('transient','permanent','worker_crash','stalled',
+                        'device_fault'))
+            )
+            """,
+            "INSERT INTO job_failures (job_id, attempt, worker, error,"
+            " failure_class, created_at)"
+            " SELECT job_id, attempt, worker, error, failure_class,"
+            " created_at FROM job_failures_old ORDER BY id",
+            "DROP TABLE job_failures_old",
+            "CREATE INDEX IF NOT EXISTS idx_job_failures_job"
+            " ON job_failures(job_id, id)",
+        ],
+    ),
 ]
 
 

@@ -129,7 +129,7 @@ class DeliveryPlane:
         self.counters = {
             "hits": 0, "misses": 0, "bypass": 0, "shed": 0,
             "disk_reads": 0, "state_hits": 0, "state_misses": 0,
-            "invalidations": 0,
+            "state_stale": 0, "invalidations": 0,
         }
         register(self)
 
@@ -145,7 +145,23 @@ class DeliveryPlane:
         self.counters["state_misses"] += 1
         from vlog_tpu.jobs import videos as vids   # lazy: no import cycle
 
-        row = await vids.get_video_serving_state(self.db, slug)
+        try:
+            row = await vids.get_video_serving_state(self.db, slug)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            from vlog_tpu.db.retry import is_transient_db_error
+
+            if cached is None or not is_transient_db_error(exc):
+                raise
+            # Stale-while-unavailable: the coordination plane is
+            # flapping (brownout) but this slug's last known publish
+            # state is in hand — keep playback alive on it rather than
+            # 500 every viewer. Re-extend by one TTL so a flap costs one
+            # probe per slug per TTL, not one per request.
+            self.counters["state_stale"] += 1
+            runtime().delivery_stale_state.inc()
+            st = cached[0]
+            self._states[slug] = (st, now + self.state_ttl_s)
+            return st
         if row is None:
             st = ServingState(None, "missing")
         elif row["deleted_at"]:

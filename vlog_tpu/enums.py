@@ -52,12 +52,17 @@ class FailureClass(str, enum.Enum):
       expired-claim sweep and by a restarted daemon's startup recovery).
     - STALLED: compute was cancelled by the stall watchdog — lease renewals
       kept the claim alive but ``progress`` stopped advancing.
+    - DEVICE_FAULT: the accelerator runtime failed under the job
+      (parallel/faults.py classification) — the job was innocent, so
+      ``fail_job`` refunds the attempt instead of burning budget, and the
+      scheduler quarantines the offending slot's devices.
     """
 
     TRANSIENT = "transient"
     PERMANENT = "permanent"
     WORKER_CRASH = "worker_crash"
     STALLED = "stalled"
+    DEVICE_FAULT = "device_fault"
 
 
 class GCTarget(str, enum.Enum):

@@ -297,6 +297,15 @@ async def claim_job(
     (``required_accelerator``) are only handed to matching workers; jobs
     demanding a newer code version are skipped (worker_api.py:1398-1434).
     """
+    try:
+        # chaos hook for the coordination-plane brownout: an armed
+        # db.claim surfaces as the connection fault a flapping Postgres
+        # produces, so the worker loops' backoff/breaker path is
+        # drivable from VLOG_FAILPOINTS
+        failpoints.hit("db.claim")
+    except failpoints.FailpointError as exc:
+        raise ConnectionError(
+            "claim query unavailable (injected db.claim)") from exc
     t = db_now()
     lease = lease_s if lease_s is not None else config.CLAIM_LEASE_S
     kind_list = ",".join(f"'{k.value}'" for k in kinds)
@@ -379,19 +388,22 @@ async def update_progress(
     current_step: str | None = None,
     checkpoint: dict[str, Any] | None = None,
     extend_lease: bool = True,
+    epoch: int | None = None,
 ) -> Row:
     """Record progress and extend the claim lease.
 
     Reference parity: worker_api.py:1747-1860 — every progress update renews
     the lease, which is what keeps long jobs alive past the base lease.
     Raises :class:`JobStateError` if the caller no longer holds the claim
-    (the 409-abort signal remote workers act on).
+    (the 409-abort signal remote workers act on) or ``epoch`` (the
+    claim's attempt number, the fencing token) is stale.
     """
     t = db_now()
     async with db.transaction() as tx:
         row = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         if row is None:
             raise js.JobStateError(f"job {job_id} does not exist")
+        js.guard_epoch(row, epoch)
         js.guard_progress(row, worker_name, now=t)
         sets = ["updated_at=:t"]
         params: dict[str, Any] = {"t": t, "id": job_id}
@@ -416,13 +428,15 @@ async def update_progress(
     return out
 
 
-async def complete_job(db: Database, job_id: int, worker_name: str) -> Row:
+async def complete_job(db: Database, job_id: int, worker_name: str, *,
+                       epoch: int | None = None) -> Row:
     """Mark a job completed (terminal). Reference: worker_api.py:1864-2070."""
     t = db_now()
     async with db.transaction() as tx:
         row = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         if row is None:
             raise js.JobStateError(f"job {job_id} does not exist")
+        js.guard_epoch(row, epoch)
         js.guard_complete(row, worker_name, now=t)
         failpoints.hit("claims.complete")
         await tx.execute(
@@ -458,6 +472,7 @@ async def fail_job(
     *,
     permanent: bool = False,
     failure_class: FailureClass | str | None = None,
+    epoch: int | None = None,
 ) -> Row:
     """Record a failed attempt; terminal only when the retry budget is gone.
 
@@ -468,26 +483,56 @@ async def fail_job(
     derives BACKOFF until due. Every call appends a classified
     ``job_failures`` row; ``failure_class`` defaults to PERMANENT when
     ``permanent`` else TRANSIENT.
+
+    ``DEVICE_FAULT`` is the innocent-job class: the accelerator (not the
+    input, not the code) failed the attempt, so the attempt counter is
+    REFUNDED and no backoff is stamped — the job goes straight back to
+    the claimable pool while the faulting worker's quarantined devices
+    keep it from immediately re-running on the same sick hardware.
+
+    The refund is BOUNDED at ``max_attempts`` device-fault attributions
+    per job life: a failure that looks like a device fault on every
+    device it touches (a ladder that deterministically OOMs HBM, a
+    poison input tickling the runtime) is the job's fault after all —
+    past the bound it burns budget like any transient, so it
+    dead-letters instead of livelocking through endless
+    quarantine/heal/refund cycles.
     """
     if failure_class is None:
         failure_class = (FailureClass.PERMANENT if permanent
                          else FailureClass.TRANSIENT)
     else:
         failure_class = FailureClass(failure_class)
+    refund = failure_class is FailureClass.DEVICE_FAULT and not permanent
     t = db_now()
     async with db.transaction() as tx:
         row = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         if row is None:
             raise js.JobStateError(f"job {job_id} does not exist")
+        js.guard_epoch(row, epoch)
         js.guard_fail(row, worker_name, now=t)
         failpoints.hit("claims.fail")
-        exhausted = permanent or (row["attempt"] or 0) >= (row["max_attempts"] or 1)
-        retry_at = None if exhausted else t + retry_backoff_s(row["attempt"] or 1)
+        if refund:
+            prior = await tx.fetch_one(
+                "SELECT COUNT(*) AS n FROM job_failures "
+                "WHERE job_id=:j AND failure_class='device_fault'",
+                {"j": job_id})
+            if (prior["n"] or 0) >= (row["max_attempts"] or 1):
+                # refund bound reached: this "device fault" follows the
+                # job across devices — charge the job from here on
+                refund = False
+        exhausted = permanent or (
+            not refund
+            and (row["attempt"] or 0) >= (row["max_attempts"] or 1))
+        retry_at = None if (exhausted or refund) \
+            else t + retry_backoff_s(row["attempt"] or 1)
+        attempt_sql = (f"attempt={db.greatest('attempt - 1', '0')},"
+                       if refund else "")
         await tx.execute(
-            """
+            f"""
             UPDATE jobs SET claimed_by=NULL, claimed_at=NULL, claim_expires_at=NULL,
-                   failed_at=:failed_at, error=:err, next_retry_at=:nra,
-                   updated_at=:t
+                   {attempt_sql} failed_at=:failed_at, error=:err,
+                   next_retry_at=:nra, updated_at=:t
             WHERE id=:id
             """,
             {
@@ -530,7 +575,8 @@ async def fail_job(
 
 
 async def release_job(
-    db: Database, job_id: int, worker_name: str, *, refund_attempt: bool = True
+    db: Database, job_id: int, worker_name: str, *,
+    refund_attempt: bool = True, epoch: int | None = None
 ) -> Row:
     """Hand an in-flight claim back to the pool.
 
@@ -553,6 +599,7 @@ async def release_job(
         row = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
         if row is None:
             raise js.JobStateError(f"job {job_id} does not exist")
+        js.guard_epoch(row, epoch)
         # Same ownership rule as progress: only the claim holder may release.
         js.guard_progress(row, worker_name, now=t)
         exhausted = (not refund_attempt

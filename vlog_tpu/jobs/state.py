@@ -136,6 +136,23 @@ def guard_claim(row: Mapping[str, Any], *, now: float) -> None:
         raise JobStateError("retry budget exhausted")
 
 
+def guard_epoch(row: Mapping[str, Any], epoch: int | None) -> None:
+    """Fencing-token check: the claim's attempt number is its epoch.
+
+    A partitioned worker whose lease was swept and re-claimed — even
+    under the SAME worker name, where the ownership guards above cannot
+    tell the incarnations apart — carries the old attempt number and
+    must not write into the successor attempt's tree or trace. ``None``
+    (no ``X-Claim-Epoch`` header) skips the check for pre-fencing
+    clients; every call the shipped client makes carries it.
+    """
+    if epoch is not None and int(epoch) != (row.get("attempt") or 0):
+        raise JobStateError(
+            f"stale claim epoch {epoch}: job is on attempt "
+            f"{row.get('attempt') or 0} (lease was swept and re-claimed)"
+        )
+
+
 def guard_progress(row: Mapping[str, Any], worker: str, *, now: float) -> None:
     state = derive_state(row, now=now)
     if state is not JobState.CLAIMED:

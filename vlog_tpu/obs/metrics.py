@@ -193,6 +193,34 @@ class RuntimeMetrics:
             "(queue-wait-for-slot)",
             buckets=(0.001, 0.01, 0.1, 0.5, 2.0, 10.0, 60.0, 300.0),
             registry=self.registry)
+        # Fault-domain isolation plane: device quarantine + claim-loop
+        # brownout (parallel/scheduler.py, worker/brownout.py).
+        self.slot_quarantined = Counter(
+            "vlog_slot_quarantined_total",
+            "Slot quarantine events (device-fault classified failures "
+            "that took the lease's devices out of rotation)",
+            ["slot"], registry=self.registry)
+        self.device_quarantined = Gauge(
+            "vlog_device_quarantined",
+            "Devices currently quarantined (awaiting a passing probe)",
+            registry=self.registry)
+        self.device_probe = Counter(
+            "vlog_device_probe_total",
+            "Quarantined-device reinstatement probe outcomes",
+            ["outcome"], registry=self.registry)
+        self.claim_errors = Counter(
+            "vlog_claim_errors_total",
+            "Transient coordination-plane (DB/API) errors hit by worker "
+            "claim loops", ["source"], registry=self.registry)
+        self.claim_breaker_open = Gauge(
+            "vlog_claim_breaker_open",
+            "1 while the worker's coordination-plane brownout breaker "
+            "is open", registry=self.registry)
+        self.delivery_stale_state = Counter(
+            "vlog_delivery_stale_state_total",
+            "Publish-state answers served stale because the database "
+            "was unavailable (coordination-plane brownout)",
+            registry=self.registry)
         # the fires counter must see every fire in the process, wherever
         # the site lives — failpoints stays dependency-free, we observe
         failpoints.add_observer(

@@ -27,11 +27,25 @@ of **slots** so multiple queued jobs run concurrently on one host:
   (:meth:`MeshScheduler.host_pool`, sized ``VLOG_ENTROPY_THREADS``):
   two concurrent jobs must not each spin up a core-count-sized pool.
 
+- **Device-fault quarantine**: a failure the classification oracle
+  (parallel/faults.py) attributes to the hardware takes the faulting
+  lease's devices out of rotation (``report_device_fault``). Sick slots
+  stop granting immediately; the partition renegotiates around the hole
+  at the next job boundary (the same boundary widths already
+  renegotiate at), so remaining jobs keep running on the healthy
+  devices. A periodic cheap probe computation
+  (:meth:`MeshScheduler.probe_quarantined`, driven by the worker
+  daemon every ``VLOG_DEVICE_PROBE_INTERVAL_S``) reinstates devices
+  that compute again. ``VLOG_QUARANTINE_THRESHOLD`` faults are needed
+  per device before it is quarantined.
+
 Observability: ``vlog_mesh_slots`` / ``vlog_mesh_slot_occupancy`` /
 ``vlog_mesh_slot_width{slot}`` gauges and the
 ``vlog_mesh_slot_wait_seconds`` histogram (queue-wait-for-slot) ride
 the process runtime registry; the worker attaches ``mesh.slot`` /
 ``mesh.width`` / ``mesh.wait_s`` attrs to each job's transcode span.
+Quarantine adds ``vlog_slot_quarantined_total{slot}``,
+``vlog_device_quarantined`` and ``vlog_device_probe_total{outcome}``.
 
 The lease travels to the codec backends through a contextvar
 (``asyncio.to_thread`` copies context into the compute thread):
@@ -223,39 +237,78 @@ class MeshScheduler:
 
             devices = list(jax.devices())
         self.devices = tuple(devices)
-        n = max(1, len(self.devices))
         want = config.MESH_SLOTS if slots is None else int(slots)
-        # Never more slots than devices; each slot is at least one wide.
-        self.slots = max(1, min(want, n))
-        self.slot_width = n // self.slots
-        # Contiguous partition covering EVERY device: when slots does
-        # not divide n, the first n % slots slots are one device wider
-        # (no silently stranded chips at full occupancy).
-        base, rem = divmod(n, self.slots)
-        bounds, at = [], 0
-        for i in range(self.slots):
-            w = base + (1 if i < rem else 0)
-            bounds.append((at, at + w))
-            at += w
-        self._slot_bounds = tuple(bounds)
+        self._want_slots = max(1, want)
         self._cond = threading.Condition()
         self._active: dict[int, SlotLease] = {}
         self._open_tickets = 0           # admitted, not yet granted
         self._holds = 0                  # claim rounds freezing grants
+        # Device-fault quarantine: device -> quarantined-at (monotonic)
+        # and per-device fault attributions toward the threshold.
+        self._quarantined: dict = {}
+        self._fault_counts: dict = {}
+        # set on quarantine/heal; the partition renegotiates around the
+        # hole at the next job boundary (no active leases)
+        self._partition_dirty = False
+        with self._cond:
+            self._rebuild_locked()
         self._host_pool: ThreadPoolExecutor | None = None
         self._pool_lock = threading.Lock()
         self._metrics().mesh_slots.set(self.slots)
+
+    def _rebuild_locked(self) -> None:
+        """Recompute the slot partition over the currently healthy
+        devices (caller holds ``_cond``; only safe with no active
+        leases — the claim-boundary renegotiation point).
+
+        Contiguous partition covering every healthy device: never more
+        slots than devices, each slot at least one wide; when slots
+        does not divide n, the first n % slots slots are one device
+        wider (no silently stranded chips at full occupancy). With
+        every device quarantined, slots is 0 and nothing grants until
+        a probe heals one.
+        """
+        self._healthy: tuple = tuple(d for d in self.devices
+                                     if d not in self._quarantined)
+        n = len(self._healthy)
+        self.slots = max(1, min(self._want_slots, n)) if n else 0
+        self.slot_width = (n // self.slots) if self.slots else 0
+        bounds, at = [], 0
+        if self.slots:
+            base, rem = divmod(n, self.slots)
+            for i in range(self.slots):
+                w = base + (1 if i < rem else 0)
+                bounds.append((at, at + w))
+                at += w
+        self._slot_bounds = tuple(bounds)
+        self._partition_dirty = False
+
+    def _maybe_rebuild_locked(self) -> None:
+        if self._partition_dirty and not self._active:
+            before = self.slots
+            self._rebuild_locked()
+            if self.slots != before:
+                self._metrics().mesh_slots.set(self.slots)
+
+    def _slot_healthy_locked(self, slot: int) -> bool:
+        return all(d not in self._quarantined
+                   for d in self._slot_devices(slot))
 
     # ---- admission ---------------------------------------------------
     def capacity(self) -> int:
         """Jobs this scheduler can admit right now. Zero while a
         full-mesh lease runs (arrivals would only wait for the job
-        boundary while hoarding a claim another worker could serve)."""
+        boundary while hoarding a claim another worker could serve).
+        Slots holding a quarantined device do not count — their work
+        belongs on another worker until a probe heals them."""
         with self._cond:
+            self._maybe_rebuild_locked()
             if FULL_MESH_SLOT in self._active:
                 return 0
-            return max(0, self.slots - len(self._active)
-                       - self._open_tickets)
+            free = sum(1 for s in range(self.slots)
+                       if s not in self._active
+                       and self._slot_healthy_locked(s))
+            return max(0, free - self._open_tickets)
 
     def admit(self) -> SlotTicket:
         """Register one claimed job's demand and return its ticket."""
@@ -288,35 +341,108 @@ class MeshScheduler:
     def snapshot(self) -> dict:
         """Stats surface (worker ``stats`` command / debugging)."""
         with self._cond:
+            self._maybe_rebuild_locked()
             return {
                 "slots": self.slots,
                 "slot_width": self.slot_width,
                 "devices": len(self.devices),
+                "healthy": len(self.devices) - len(self._quarantined),
+                "quarantined": len(self._quarantined),
                 "active": len(self._active),
                 "pending": self._open_tickets,
                 "leases": {("full" if s == FULL_MESH_SLOT else s): l.width
                            for s, l in self._active.items()},
             }
 
+    # ---- device-fault quarantine -------------------------------------
+    def report_device_fault(self, lease: SlotLease, *,
+                            reason: str = "") -> tuple:
+        """Attribute a device-classified fault to the lease's devices.
+
+        The runtime rarely names the sick chip, so every device of the
+        faulting slot takes one attribution; devices reaching
+        ``VLOG_QUARANTINE_THRESHOLD`` leave the rotation. Sick slots
+        stop granting immediately; the partition renegotiates around
+        the hole at the next job boundary. Returns the devices newly
+        quarantined by this report."""
+        t = time.monotonic()
+        newly = []
+        with self._cond:
+            for d in lease.devices:
+                if d in self._quarantined:
+                    continue
+                self._fault_counts[d] = self._fault_counts.get(d, 0) + 1
+                if self._fault_counts[d] >= config.QUARANTINE_THRESHOLD:
+                    self._quarantined[d] = t
+                    newly.append(d)
+            if newly:
+                self._partition_dirty = True
+                self._cond.notify_all()
+            count = len(self._quarantined)
+        if newly:
+            m = self._metrics()
+            m.slot_quarantined.labels(self._slot_label(lease.slot)).inc()
+            m.device_quarantined.set(count)
+        return tuple(newly)
+
+    def quarantined_count(self) -> int:
+        with self._cond:
+            return len(self._quarantined)
+
+    def probe_quarantined(self, probe_fn=None) -> dict:
+        """Probe every quarantined device with a cheap computation;
+        passing devices rejoin the rotation (the partition renegotiates
+        at the next job boundary). Returns ``{device: passed}``.
+        Blocking — callers run it in a thread."""
+        with self._cond:
+            targets = list(self._quarantined)
+        if not targets:
+            return {}
+        fn = probe_fn or _default_probe
+        m = self._metrics()
+        results, healed = {}, []
+        for d in targets:
+            try:
+                ok = bool(fn(d))
+            except Exception:  # noqa: BLE001 — a raising probe IS a
+                ok = False     # failing probe; the device stays out
+            results[d] = ok
+            m.device_probe.labels("pass" if ok else "fail").inc()
+            if ok:
+                healed.append(d)
+        if healed:
+            with self._cond:
+                for d in healed:
+                    self._quarantined.pop(d, None)
+                    self._fault_counts.pop(d, None)
+                self._partition_dirty = True
+                self._cond.notify_all()
+                count = len(self._quarantined)
+            m.device_quarantined.set(count)
+        return results
+
     # ---- grant engine ------------------------------------------------
     def _slot_devices(self, slot: int) -> tuple:
         lo, hi = self._slot_bounds[slot]
-        return self.devices[lo:hi]
+        return self._healthy[lo:hi]
 
     def _try_grant_locked(self) -> SlotLease | None:
+        self._maybe_rebuild_locked()
+        if not self._healthy:
+            return None      # every device quarantined: wait for a probe
         if not self._active:
             # Work-conserving fallback: a lone job (this ticket is the
-            # only demand) gets every device, whatever the slot knob
-            # says. Widths renegotiate here, at the job boundary.
+            # only demand) gets every healthy device, whatever the slot
+            # knob says. Widths renegotiate here, at the job boundary.
             if self._open_tickets == 1 or self.slots == 1:
                 return SlotLease(self, FULL_MESH_SLOT if self.slots > 1
                                  else 0,
-                                 self.devices)
+                                 self._healthy)
             return SlotLease(self, 0, self._slot_devices(0))
         if FULL_MESH_SLOT in self._active:
             return None                  # wait for the job boundary
         for slot in range(self.slots):
-            if slot not in self._active:
+            if slot not in self._active and self._slot_healthy_locked(slot):
                 return SlotLease(self, slot, self._slot_devices(slot))
         return None
 
@@ -411,6 +537,18 @@ class MeshScheduler:
                     max_workers=config.ENTROPY_THREADS,
                     thread_name_prefix="vlog-mesh-host")
             return self._host_pool
+
+
+def _default_probe(device) -> bool:
+    """The cheap reinstatement probe: put a tiny array on the device,
+    reduce it, pull the result. Anything a sick chip does wrong —
+    allocation, dispatch, the d2h pull — fails it (and a raising probe
+    counts as failing in :meth:`MeshScheduler.probe_quarantined`)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.device_put(jnp.arange(8, dtype=jnp.float32), device)
+    return float(jax.block_until_ready(x).sum()) == 28.0
 
 
 _scheduler: MeshScheduler | None = None

@@ -36,6 +36,16 @@ site                where it fires
 ``delivery.shed``   at the delivery plane's admission check — forces the
                     load-shed branch (503 + Retry-After) regardless of
                     the in-flight read count
+``device.fault``    compute thread, start of the backend ladder run
+                    (worker/pipeline.py) — re-raised as a synthetic
+                    XLA-like device error (parallel/faults.py) so the
+                    quarantine/requeue/probe loop runs end to end
+``claim.fence``     WorkerAPIClient's epoch header — the armed write
+                    sends a STALE ``X-Claim-Epoch``, so the server's
+                    409 fence is what must catch it
+``db.claim``        jobs.claims.claim_job entry — the claim query fails
+                    with a synthetic connection error (the
+                    coordination-plane brownout path)
 ==================  =====================================================
 
 Every legitimate site name is listed in :data:`SITES`;
@@ -93,6 +103,12 @@ SITES: dict[str, str] = {
     "storage.gc": "storage.gc.run_gc entry",
     "delivery.read": "delivery plane cache-fill, before the disk read",
     "delivery.shed": "delivery plane admission check; forces load-shed",
+    "device.fault": "compute thread, start of the backend ladder run; "
+                    "re-raised as a synthetic XLA-like device error",
+    "claim.fence": "WorkerAPIClient epoch header; the armed write sends "
+                   "a stale X-Claim-Epoch",
+    "db.claim": "claim_job entry; the claim query fails with a synthetic "
+                "connection error",
 }
 
 

@@ -159,6 +159,9 @@ class WorkerDaemon(ComputeWatchdogMixin):
     watchdog_tick_s: float = 1.0
     # Circuit breaker over the compute path; None builds one from config.
     breaker: CircuitBreaker | None = None
+    # Coordination-plane brownout breaker (worker/brownout.py) pacing the
+    # claim loop through transient DB faults; None builds one from config.
+    db_breaker: Any = None
     # Mesh job scheduler (parallel/scheduler.py). None + VLOG_MESH_SLOTS
     # > 1 + a backend builds the process-wide one lazily in run();
     # tests inject a MeshScheduler directly. With slots == 1 (default)
@@ -177,6 +180,10 @@ class WorkerDaemon(ComputeWatchdogMixin):
         self._tasks: set[asyncio.Task] = set()            # slot job tasks
         if self.breaker is None:
             self.breaker = CircuitBreaker()
+        if self.db_breaker is None:
+            from vlog_tpu.worker.brownout import CoordinationBreaker
+
+            self.db_breaker = CoordinationBreaker(source="daemon")
         self._reset_watchdog()
         # recent-log ring so the get_logs command verb can answer
         # without a log file (utils/logring.py)
@@ -271,6 +278,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
                     "current_job_id": self._current_job_id,
                     "active_job_ids": sorted(self._active_sups),
                     "breaker": self.breaker.snapshot(),
+                    "db_breaker": self.db_breaker.snapshot(),
                     "disk_paused": self.disk_paused,
                     "mesh": (self.scheduler.snapshot()
                              if self.scheduler is not None else None),
@@ -327,17 +335,37 @@ class WorkerDaemon(ComputeWatchdogMixin):
         await bus.start()
         jobs_sub = bus.subscribe(CH_JOBS)
         hb = asyncio.create_task(self._heartbeat_loop())
+        probe = None
+        if self.scheduler is not None and config.DEVICE_PROBE_INTERVAL_S > 0:
+            probe = asyncio.create_task(self._device_probe_loop())
         try:
             while not self._stop.is_set():
                 try:
                     worked = await self._poll_fill()
-                except Exception:  # noqa: BLE001 — the daemon must outlive
-                    # any single poll cycle (transient DB faults, injected
-                    # failpoints); pause briefly so a persistent fault
-                    # cannot hot-loop
-                    log.exception("poll cycle failed; continuing")
+                    self.db_breaker.record_success()
+                except Exception as exc:  # noqa: BLE001 — the daemon must
+                    # outlive any single poll cycle (transient DB faults,
+                    # injected failpoints)
+                    from vlog_tpu.db.retry import is_transient_db_error
+
                     worked = False
-                    await asyncio.sleep(min(self.poll_interval_s, 1.0))
+                    if is_transient_db_error(exc):
+                        # coordination-plane brownout: jittered growing
+                        # backoff instead of a fixed-pace reconnect herd;
+                        # readiness degrades once the breaker opens
+                        delay = self.db_breaker.record_error(exc)
+                        # exc_info even on the paced path: if a code bug
+                        # ever text-matches as transient, the traceback
+                        # must still land in the log
+                        log.warning("claim loop DB error (%s); backing "
+                                    "off %.1fs", exc, delay, exc_info=True)
+                        with contextlib.suppress(asyncio.TimeoutError):
+                            await asyncio.wait_for(self._stop.wait(), delay)
+                    else:
+                        # pause briefly so a persistent fault cannot
+                        # hot-loop
+                        log.exception("poll cycle failed; continuing")
+                        await asyncio.sleep(min(self.poll_interval_s, 1.0))
                 if worked or self._stop.is_set():
                     # a poll that found work already consumed the queue
                     # head; stale wakeups would only cause a hot no-op
@@ -352,8 +380,10 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 # in-flight slot jobs: request_stop already broadcast
                 # the cancel; let each hand its claim back
                 await asyncio.gather(*self._tasks, return_exceptions=True)
-            hb.cancel()
-            await asyncio.gather(hb, return_exceptions=True)
+            tasks = [t for t in (hb, probe) if t is not None]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
             await self.db.execute(
                 "UPDATE workers SET status='offline' WHERE name=:n",
                 {"n": self.name})
@@ -418,6 +448,47 @@ class WorkerDaemon(ComputeWatchdogMixin):
         except Exception:  # noqa: BLE001 — the daemon must outlive any job
             log.exception("slot job %s failed outside the attempt wall",
                           job["id"])
+
+    async def _device_probe_loop(self) -> None:
+        """Periodically probe quarantined devices so healed hardware
+        rejoins the slot rotation (``VLOG_DEVICE_PROBE_INTERVAL_S``)."""
+        while not self._stop.is_set():
+            try:
+                await asyncio.wait_for(self._stop.wait(),
+                                       config.DEVICE_PROBE_INTERVAL_S)
+            except asyncio.TimeoutError:
+                pass
+            if self._stop.is_set():
+                return
+            try:
+                if self.scheduler.quarantined_count():
+                    results = await asyncio.to_thread(
+                        self.scheduler.probe_quarantined)
+                    healed = sum(1 for ok in results.values() if ok)
+                    if healed:
+                        log.info("device probe reinstated %d of %d "
+                                 "quarantined devices", healed,
+                                 len(results))
+            except Exception:  # noqa: BLE001 — a failing probe sweep
+                # must not kill the loop; the devices just stay out
+                log.exception("device probe sweep failed")
+
+    def _quarantine_for_fault(self, exc: BaseException) -> tuple:
+        """After a device-classified fault, quarantine the faulting
+        lease's devices (the slot renegotiates around the hole). Returns
+        the newly quarantined devices (empty without a scheduler lease —
+        direct calls and slots=1-without-scheduler have nothing to
+        quarantine)."""
+        ticket = _TICKET.get()
+        lease = getattr(ticket, "lease", None)
+        if self.scheduler is None or lease is None:
+            return ()
+        newly = self.scheduler.report_device_fault(lease, reason=str(exc))
+        if newly:
+            log.error("quarantined %d device(s) of slot %s after device "
+                      "fault: %s", len(newly),
+                      "full" if lease.is_full_mesh else lease.slot, exc)
+        return newly
 
     async def _idle_wait(self, jobs_sub) -> None:
         """Sleep until a job event, the poll interval, shutdown, or — in
@@ -634,10 +705,30 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 self.stats.last_error = str(exc)
             except Exception as exc:  # noqa: BLE001 — worker must survive
                 # any job
+                from vlog_tpu.parallel import faults
+
                 att.set_error(f"{type(exc).__name__}: {exc}")
                 log.exception("job %s failed", job["id"])
-                self.breaker.record_failure()
-                await self._fail(job, video, f"{type(exc).__name__}: {exc}")
+                if faults.is_device_fault(exc):
+                    # The HARDWARE failed the attempt, not the job: take
+                    # the slot's devices out of rotation and requeue
+                    # without burning the attempt budget (fail_job
+                    # refunds DEVICE_FAULT). Quarantine — not the
+                    # compute breaker — is the containment here: healthy
+                    # slots must keep claiming while the sick chips sit
+                    # out; the breaker still covers the no-scheduler
+                    # case, where nothing else would stop the bleeding.
+                    quarantined = self._quarantine_for_fault(exc)
+                    att.attrs["device_fault"] = True
+                    if not quarantined:
+                        self.breaker.record_failure()
+                    await self._fail(
+                        job, video, f"{type(exc).__name__}: {exc}",
+                        failure_class=FailureClass.DEVICE_FAULT)
+                else:
+                    self.breaker.record_failure()
+                    await self._fail(job, video,
+                                     f"{type(exc).__name__}: {exc}")
 
     def _mark_failed(self, error: str) -> None:
         """Record a failure against the CURRENT job's supervisor (the
@@ -1020,10 +1111,11 @@ async def _amain(args: argparse.Namespace) -> None:
             return False, f"db unreachable: {exc}"
         return True, "ok"
 
-    from vlog_tpu.worker.health import combine, disk_check
+    from vlog_tpu.worker.health import breaker_check, combine, disk_check
 
     health = WorkerHealthServer(
-        combine(db_ready, disk_check(daemon.video_dir, label="output")))
+        combine(db_ready, disk_check(daemon.video_dir, label="output"),
+                breaker_check(daemon.db_breaker)))
     await health.start()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):

@@ -56,6 +56,22 @@ def disk_check(path, *, label: str = "scratch") -> ReadyFn:
     return ready
 
 
+def breaker_check(breaker, *, label: str = "coordination plane") -> ReadyFn:
+    """Degrade readiness while a brownout breaker (worker/brownout.py)
+    is open: the worker is alive and probing on backoff, but routing it
+    work (or counting it available for scale decisions) while its
+    database/API is flapping only grows the retry herd."""
+
+    async def ready() -> tuple[bool, str]:
+        snap = breaker.snapshot()
+        if snap.get("open"):
+            return False, (f"{label} brownout: "
+                           f"{snap.get('last_error') or 'unreachable'}")
+        return True, "ok"
+
+    return ready
+
+
 class WorkerHealthServer:
     def __init__(self, ready_fn: ReadyFn, *, port: int | None = None,
                  host: str = "0.0.0.0"):

@@ -157,6 +157,12 @@ def process_video(
         original = str(dst)
 
     # Step 3: ladder (+ thumbnail + per-rung playlists + master/DASH)
+    # device.fault failpoint: an armed chaos run injects a synthetic
+    # XLA-shaped device error here, on the compute thread, mid-job —
+    # exercising the quarantine/refund/requeue loop end to end.
+    from vlog_tpu.parallel import faults
+
+    faults.maybe_inject_device_fault()
     be = backend or select_backend()
     plan = be.plan(info, rungs, out_dir, **plan_opts)
     if plan.streaming_format == "hls_ts" and audio and info.audio_codec:

@@ -73,6 +73,13 @@ class WorkerAPIClient:
         self.base_url = base_url.rstrip("/")
         self.retries = retries
         self.api_key = api_key
+        # Fencing tokens: job id -> the claim's attempt number, sent as
+        # X-Claim-Epoch on every claim-gated write so a swept-and-
+        # reclaimed job's stale incarnation gets 409 instead of
+        # corrupting the successor attempt (video map serves uploads,
+        # which are addressed by video id).
+        self._epochs: dict[int, int] = {}
+        self._video_jobs: dict[int, int] = {}
         self._client = httpx.AsyncClient(
             base_url=self.base_url, timeout=timeout,
             headers={"Authorization": f"Bearer {api_key}"})
@@ -93,6 +100,48 @@ class WorkerAPIClient:
                              headers={"X-Admin-Secret": admin_secret})
             r.raise_for_status()
             return r.json()["api_key"]
+
+    def _epoch_headers(self, *, job_id: int | None = None,
+                       video_id: int | None = None) -> dict[str, str]:
+        """The X-Claim-Epoch fencing header for a claim-gated write.
+
+        The ``claim.fence`` failpoint forces a STALE epoch onto the next
+        armed write — chaos runs use it to prove the server's 409 fence
+        actually holds."""
+        if job_id is None and video_id is not None:
+            job_id = self._video_jobs.get(video_id)
+        epoch = self._epochs.get(job_id) if job_id is not None else None
+        if epoch is None:
+            return {}
+        try:
+            failpoints.hit("claim.fence")
+        except failpoints.FailpointError:
+            epoch = max(0, epoch - 1)
+        return {"X-Claim-Epoch": str(epoch)}
+
+    def _forget_claim(self, job_id: int | None) -> None:
+        if job_id is None:
+            return
+        self._epochs.pop(job_id, None)
+        for vid, jid in list(self._video_jobs.items()):
+            if jid == job_id:
+                self._video_jobs.pop(vid, None)
+
+    async def _fenced_request(self, method: str, path: str, *,
+                              job_id: int | None = None,
+                              video_id: int | None = None,
+                              **kw) -> httpx.Response:
+        """A claim-gated write carrying X-Claim-Epoch. Fencing state is
+        deliberately KEPT on ClaimLost: a zombie incarnation must keep
+        sending its stale epoch (and keep bouncing 409) rather than
+        degrade to epochless writes the ownership gate would re-admit
+        under the same worker name. The job-lifecycle owner
+        (RemoteWorker.poll_once, or complete/fail/release success)
+        forgets the entry when the attempt is over, so the map is
+        bounded by in-flight jobs, not lost-claim history."""
+        headers = {**self._epoch_headers(job_id=job_id, video_id=video_id),
+                   **(kw.pop("headers", None) or {})}
+        return await self._request(method, path, headers=headers, **kw)
 
     @staticmethod
     def _trace_headers() -> dict[str, str]:
@@ -142,29 +191,43 @@ class WorkerAPIClient:
                                       "code_version": config.CODE_VERSION})
         if r.status_code == 204:
             return None
-        return r.json()
+        data = r.json()
+        job = data.get("job") or {}
+        if job.get("id") is not None:
+            # the claim's attempt number IS the fencing epoch for every
+            # write this attempt will make
+            self._epochs[job["id"]] = int(job.get("attempt") or 0)
+            if job.get("video_id") is not None:
+                self._video_jobs[job["video_id"]] = job["id"]
+        return data
 
     async def progress(self, job_id: int, *, progress: float | None = None,
                        current_step: str | None = None,
                        qualities: dict | None = None) -> None:
-        await self._request("POST", f"/api/worker/jobs/{job_id}/progress",
-                            json={"progress": progress,
-                                  "current_step": current_step,
-                                  "qualities": qualities})
+        await self._fenced_request(
+            "POST", f"/api/worker/jobs/{job_id}/progress", job_id=job_id,
+            json={"progress": progress, "current_step": current_step,
+                  "qualities": qualities})
 
     async def complete(self, job_id: int, result: dict) -> None:
-        await self._request("POST", f"/api/worker/jobs/{job_id}/complete",
-                            json={"result": result})
+        await self._fenced_request(
+            "POST", f"/api/worker/jobs/{job_id}/complete", job_id=job_id,
+            json={"result": result})
+        self._forget_claim(job_id)
 
     async def fail(self, job_id: int, error: str, *,
                    permanent: bool = False,
                    failure_class: str | None = None) -> None:
-        await self._request("POST", f"/api/worker/jobs/{job_id}/fail",
-                            json={"error": error, "permanent": permanent,
-                                  "failure_class": failure_class})
+        await self._fenced_request(
+            "POST", f"/api/worker/jobs/{job_id}/fail", job_id=job_id,
+            json={"error": error, "permanent": permanent,
+                  "failure_class": failure_class})
+        self._forget_claim(job_id)
 
     async def release(self, job_id: int) -> None:
-        await self._request("POST", f"/api/worker/jobs/{job_id}/release")
+        await self._fenced_request(
+            "POST", f"/api/worker/jobs/{job_id}/release", job_id=job_id)
+        self._forget_claim(job_id)
 
     async def download_source(self, video_id: int, dest: Path) -> Path:
         """Stream the source into directory ``dest``; returns the file path."""
@@ -216,7 +279,8 @@ class WorkerAPIClient:
 
         delay = 0.5
         url = f"/api/worker/upload/{video_id}/{rel}"
-        headers = {"X-Content-SHA256": digest, **self._trace_headers()}
+        headers = {"X-Content-SHA256": digest, **self._trace_headers(),
+                   **self._epoch_headers(video_id=video_id)}
         for attempt in range(self.retries + 1):
             try:
                 failpoints.hit("remote.upload")
@@ -247,8 +311,9 @@ class WorkerAPIClient:
     async def post_spans(self, job_id: int, spans: list[dict]) -> None:
         """Ship finished worker spans into the job's server-side trace
         (claim-gated server-side; call before complete/fail)."""
-        await self._request("POST", f"/api/worker/jobs/{job_id}/spans",
-                            json={"spans": spans})
+        await self._fenced_request(
+            "POST", f"/api/worker/jobs/{job_id}/spans", job_id=job_id,
+            json={"spans": spans})
 
     async def poll_commands(self) -> list[dict]:
         r = await self._request("GET", "/api/worker/commands")
@@ -414,6 +479,10 @@ class RemoteWorker(ComputeWatchdogMixin):
     stall_window_s: float = field(
         default_factory=lambda: config.STALL_WINDOW_S)
     watchdog_tick_s: float = 1.0
+    # Coordination-plane brownout breaker (worker/brownout.py): paces the
+    # claim loop through an unreachable Worker API instead of fixed-pace
+    # hammering; None builds one from config.
+    db_breaker: Any = None
 
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
@@ -426,6 +495,10 @@ class RemoteWorker(ComputeWatchdogMixin):
         self._cancel_reason = ""
         if self.breaker is None:
             self.breaker = CircuitBreaker()
+        if self.db_breaker is None:
+            from vlog_tpu.worker.brownout import CoordinationBreaker
+
+            self.db_breaker = CoordinationBreaker(source="remote")
         self._reset_watchdog()
         from vlog_tpu.utils.logring import install_ring
 
@@ -443,9 +516,19 @@ class RemoteWorker(ComputeWatchdogMixin):
             while not self._stop.is_set():
                 try:
                     worked = await self.poll_once()
+                    self.db_breaker.record_success()
                 except TransientAPIError as exc:
-                    log.warning("API unreachable: %s", exc)
+                    # coordination-plane brownout: jittered growing
+                    # backoff instead of a fixed-pace reconnect herd;
+                    # readiness degrades once the breaker opens
                     worked = False
+                    delay = self.db_breaker.record_error(exc)
+                    log.warning("API unreachable (%s); backing off %.1fs",
+                                exc, delay)
+                    try:
+                        await asyncio.wait_for(self._stop.wait(), delay)
+                    except asyncio.TimeoutError:
+                        pass
                 except Exception:  # noqa: BLE001 — the worker must outlive
                     # any single poll cycle (unexpected API faults,
                     # injected failpoints), same contract as
@@ -496,6 +579,7 @@ class RemoteWorker(ComputeWatchdogMixin):
 
             return {**asdict(self.stats),
                     "breaker": self.breaker.snapshot(),
+                    "db_breaker": self.db_breaker.snapshot(),
                     "disk_paused": self.disk_paused,
                     "kinds": [k.value for k in self.kinds]}
         if command == "stop":
@@ -617,18 +701,32 @@ class RemoteWorker(ComputeWatchdogMixin):
                 log.warning("job %s claim lost: %s", job["id"], exc)
                 self.stats.last_error = str(exc)
             except Exception as exc:  # noqa: BLE001
+                from vlog_tpu.parallel import faults
+
                 obs_trace.event("worker.error", status="error",
                                 error=f"{type(exc).__name__}: {exc}")
                 log.exception("job %s failed", job["id"])
                 self.breaker.record_failure()
-                await self._safe_fail(job["id"],
-                                      f"{type(exc).__name__}: {exc}")
+                if faults.is_device_fault(exc):
+                    # the server's fail_job refunds the attempt for
+                    # device_fault; the compute breaker (still recorded
+                    # above) is this worker's containment — remote
+                    # workers run no slot scheduler to quarantine into
+                    await self._safe_fail(
+                        job["id"], f"{type(exc).__name__}: {exc}",
+                        failure_class=FailureClass.DEVICE_FAULT)
+                else:
+                    await self._safe_fail(job["id"],
+                                          f"{type(exc).__name__}: {exc}")
             finally:
                 # Resolve any half-open probe the dispatch left unrecorded
                 # (claim-lost, shutdown release, pre-dispatch faults) — a
                 # wedged HALF_OPEN would never claim again.
                 self.breaker.release_probe()
                 self._span_buffer = None
+                # attempt over, whatever the outcome: drop its fencing
+                # state so lost claims don't accumulate epoch entries
+                self.client._forget_claim(job["id"])
                 if not self.keep_work_dirs:
                     shutil.rmtree(self._job_dir(video), ignore_errors=True)
         return True
@@ -943,7 +1041,8 @@ async def _amain(args: argparse.Namespace) -> None:
         kinds=tuple(JobKind(k) for k in args.kinds.split(",")),
         backend=backend, transcription_model_dir=args.whisper_dir)
 
-    from vlog_tpu.worker.health import WorkerHealthServer, combine, disk_check
+    from vlog_tpu.worker.health import (WorkerHealthServer, breaker_check,
+                                        combine, disk_check)
 
     async def api_ready() -> tuple[bool, str]:
         if not await client.healthz():
@@ -953,7 +1052,8 @@ async def _amain(args: argparse.Namespace) -> None:
     # Disk pressure degrades readiness (the orchestrator stops routing /
     # scales) without killing liveness — the worker is healthy, just full.
     health = WorkerHealthServer(
-        combine(api_ready, disk_check(worker.work_dir, label="scratch")))
+        combine(api_ready, disk_check(worker.work_dir, label="scratch"),
+                breaker_check(worker.db_breaker, label="worker API")))
     await health.start()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
