@@ -1,0 +1,487 @@
+"""Static-analysis plane (vlog_tpu/analysis/): pass framework, the five
+passes against seeded fixture packages, baseline suppression, the CLI,
+and the tier-1 gate over the real repo.
+
+Each pass gets a positive fixture (the seeded violation the ISSUE-8
+acceptance names: an unfenced claim-gated route, a guarded-by field
+touched lock-free, a blocking call inside an async handler, an
+uncaptured thread hop, an undocumented knob) and a negative fixture
+proving the disciplined version is clean — so the gate's signal is
+"the rule fires", not "the repo happens to be tidy".
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from vlog_tpu.analysis import (PASSES, default_baseline, default_pkg_dir,
+                               load_baseline, render_baseline, run_passes)
+from vlog_tpu.analysis.__main__ import main as cli_main
+from vlog_tpu.analysis.core import load_package
+
+
+def _pkg(tmp_path: Path, files: dict[str, str],
+         docs: dict[str, str] | None = None) -> Path:
+    """Materialize a fixture package under tmp_path/pkg (docs land next
+    to it, where the registry pass looks for README/DESIGN)."""
+    pkg = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    for rel, text in (docs or {}).items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return pkg
+
+
+def _messages(findings) -> list[str]:
+    return [f.message for f in findings]
+
+
+# --------------------------------------------------------------------------
+# asyncblock
+# --------------------------------------------------------------------------
+
+class TestAsyncBlock:
+    def test_blocking_calls_in_async_handlers_fire(self, tmp_path):
+        pkg = _pkg(tmp_path, {"api/handlers.py": """\
+            import subprocess
+            import time
+            from time import sleep as snooze
+
+            async def handler(request, db):
+                time.sleep(1)
+                snooze(2)
+                fp = open("/tmp/x")
+                subprocess.run(["ls"])
+                await db._run_fetch_one("SELECT 1", None)
+        """})
+        found = _messages(run_passes(pkg, rules=["asyncblock"]))
+        assert len(found) == 5
+        assert any("time.sleep" in m for m in found)
+        assert any("open()" in m for m in found)
+        assert any("subprocess.run" in m for m in found)
+        assert any("_run_fetch_one" in m for m in found)
+        assert all("handler" in m for m in found)
+
+    def test_sync_scopes_and_to_thread_are_clean(self, tmp_path):
+        pkg = _pkg(tmp_path, {"delivery/plane.py": """\
+            import asyncio
+            import time
+
+            def blocking_helper(path):
+                time.sleep(0.1)            # sync scope: fine
+                return open(path).read()
+
+            async def handler(path):
+                # references, not calls — and the lambda re-scopes
+                data = await asyncio.to_thread(blocking_helper, path)
+                more = await asyncio.to_thread(lambda: open(path).read())
+                await asyncio.sleep(0)
+                return data + more
+        """})
+        assert run_passes(pkg, rules=["asyncblock"]) == []
+
+    def test_only_serving_packages_in_scope(self, tmp_path):
+        pkg = _pkg(tmp_path, {"worker/daemon.py": """\
+            import time
+
+            async def loop():
+                time.sleep(1)    # worker/ is out of asyncblock scope
+        """})
+        assert run_passes(pkg, rules=["asyncblock"]) == []
+
+
+# --------------------------------------------------------------------------
+# lockdiscipline
+# --------------------------------------------------------------------------
+
+class TestLockDiscipline:
+    def test_lock_free_access_fires_and_disciplined_forms_pass(
+            self, tmp_path):
+        pkg = _pkg(tmp_path, {"parallel/state.py": """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0          # guarded-by: _lock
+                    # guarded-by: _lock
+                    self._items: dict[str, int] = {}
+
+                def bad_bump(self):
+                    self._count += 1         # VIOLATION: no lock
+
+                def good_bump(self):
+                    with self._lock:
+                        self._count += 1
+
+                def _drain_locked(self):
+                    return len(self._items)  # caller-holds convention
+
+            def helper(box):
+                with box._lock:
+                    return box._count        # owner's lock via attr chain
+
+            def bad_helper(box):
+                return box._items            # VIOLATION
+        """})
+        found = _messages(run_passes(pkg, rules=["lockdiscipline"]))
+        assert len(found) == 2
+        assert any("_count" in m and "bad_bump" in m for m in found)
+        assert any("_items" in m and "bad_helper" in m for m in found)
+
+    def test_annotation_parse_edge_cases(self, tmp_path):
+        pkg = _pkg(tmp_path, {"parallel/edges.py": """\
+            import threading
+
+            class Edge:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    # guarded-by: _a
+                    # a blank-ish comment line between is tolerated
+                    self._wrapped: dict[str, tuple[int,
+                                                   str]] = {}
+                    self._twice = 0     # guarded-by: _a
+
+                def touch(self):
+                    return self._wrapped, self._twice   # two violations
+
+            # guarded-by: _ghost
+            GLOBAL = 1
+
+            class Conflict:
+                def __init__(self):
+                    self._twice = 0     # guarded-by: _b
+        """})
+        found = _messages(run_passes(pkg, rules=["lockdiscipline"]))
+        # dangling annotation (GLOBAL is not a self.field), the lock
+        # conflict on _twice, and the two lock-free reads in touch()
+        assert any("dangling" in m for m in found)
+        assert any("annotated guarded-by both" in m for m in found)
+        assert sum("touch" in m for m in found) == 2
+
+    def test_deferred_bodies_get_no_lock_credit(self, tmp_path):
+        """A closure defined under `with lock:` (or inside a *_locked /
+        __init__ frame) runs LATER, lock-free — the held-lock set and
+        the caller-holds exemptions must not leak into it."""
+        pkg = _pkg(tmp_path, {"parallel/deferred.py": """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._jobs = []          # guarded-by: _lock
+
+                def schedule(self, pool):
+                    with self._lock:
+                        # VIOLATION: lambda body runs after release
+                        pool.submit(lambda: self._jobs.pop())
+
+                def _drain_locked(self):
+                    def later():
+                        return self._jobs    # VIOLATION: deferred
+                    return later
+        """})
+        found = _messages(run_passes(pkg, rules=["lockdiscipline"]))
+        assert len(found) == 2
+        assert any("<lambda>" in m for m in found)
+        assert any("later" in m for m in found)
+
+    def test_with_lock_covers_nested_and_locked_suffix(self, tmp_path):
+        pkg = _pkg(tmp_path, {"worker/ok.py": """\
+            import threading
+
+            class Clean:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._jobs = []          # guarded-by: _cond
+
+                def snapshot(self):
+                    with self._cond:
+                        jobs = list(self._jobs)
+                    return jobs
+
+                def _steal_locked(self, other):
+                    return self._jobs
+        """})
+        assert run_passes(pkg, rules=["lockdiscipline"]) == []
+
+
+# --------------------------------------------------------------------------
+# epochfence
+# --------------------------------------------------------------------------
+
+_FENCE_FIXTURE = """\
+    from aiohttp import web
+
+    def _claim_epoch(request):
+        return request.headers.get("X-Claim-Epoch")
+
+    async def _find_claim(db, worker, video_id):
+        return await _active_claim_row(db, worker, video_id)
+
+    async def _active_claim_row(db, worker, video_id):
+        return await db.fetch_one("SELECT 1")
+
+    async def progress(request):
+        epoch = _claim_epoch(request)
+        return web.json_response({"ok": True})
+
+    async def upload(request):
+        row = await _find_claim(None, "w", 1)   # transitively fenced
+        return web.json_response({"ok": True})
+
+    async def rogue(request):
+        # claim-gated write with NO fence: the seeded violation
+        return web.json_response({"ok": True})
+
+    async def read_only(request):
+        return web.json_response({"ok": True})
+
+    def build_app(app):
+        app.router.add_post("/api/worker/jobs/{job_id}/progress", progress)
+        app.router.add_put("/api/worker/upload/{video_id}/{tail:.+}", upload)
+        app.router.add_post("/api/worker/jobs/{job_id}/rogue", rogue)
+        app.router.add_get("/api/worker/jobs/{job_id}/view", read_only)
+        app.router.add_post("/api/worker/claim", read_only)
+"""
+
+
+class TestEpochFence:
+    def test_unfenced_claim_gated_route_fires(self, tmp_path):
+        pkg = _pkg(tmp_path, {"api/worker_api.py": _FENCE_FIXTURE})
+        found = run_passes(pkg, rules=["epochfence"])
+        assert len(found) == 1
+        [f] = found
+        assert "rogue" in f.message and "/rogue" in f.message
+        assert f.file.endswith("api/worker_api.py")
+
+    def test_real_worker_api_is_fully_fenced(self):
+        assert run_passes(rules=["epochfence"]) == []
+
+
+# --------------------------------------------------------------------------
+# tracehop
+# --------------------------------------------------------------------------
+
+class TestTraceHop:
+    def test_uncaptured_hop_in_traced_module_fires(self, tmp_path):
+        pkg = _pkg(tmp_path, {"worker/traced.py": """\
+            import threading
+            from vlog_tpu.obs import trace as obs_trace
+
+            def spawn(fn):
+                t = threading.Thread(target=fn)   # VIOLATION: no capture
+                t.start()
+
+            def submit_work(self, fn):
+                self.host_pool.submit(fn)         # VIOLATION: no capture
+
+            def disciplined(self, fn):
+                ctx = obs_trace.capture()
+                threading.Thread(target=lambda: obs_trace.attach(ctx)).start()
+
+            def not_a_pool_hop(self, pipe, batch):
+                pipe.submit(batch, 1)             # executor batch queue
+        """})
+        found = _messages(run_passes(pkg, rules=["tracehop"]))
+        assert len(found) == 2
+        assert any("spawn" in m for m in found)
+        assert any("submit_work" in m for m in found)
+
+    def test_untraced_module_out_of_scope(self, tmp_path):
+        pkg = _pkg(tmp_path, {"db/pool.py": """\
+            import threading
+
+            def spawn(fn):
+                threading.Thread(target=fn).start()
+        """})
+        assert run_passes(pkg, rules=["tracehop"]) == []
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+_REGISTRY_FILES = {
+    "config.py": """\
+        import os
+
+        PIPELINE_DEPTH = _env_int("VLOG_FIXTURE_DEPTH", 2)
+        SECRET = os.environ.get("VLOG_FIXTURE_SECRET", "")
+    """,
+    "utils/failpoints.py": """\
+        SITES: dict[str, str] = {
+            "fixture.site": "somewhere",
+        }
+        ENV_VAR = "VLOG_FIXTURE_FAILPOINTS"
+        _SPEC = os.environ.get(ENV_VAR, "")
+    """,
+    "obs/metrics.py": """\
+        class R:
+            def __init__(self, registry):
+                self.hits = Counter("fix_hits", "h", registry=registry)
+                self.depth = Gauge("fix_depth", "d", registry=registry)
+    """,
+    "obs/trace.py": """\
+        STAGE_KEYS = ("decode_wait_s", "entropy_s")
+    """,
+    "worker/run.py": """\
+        from vlog_tpu.obs import trace as obs_trace
+
+        def attempt():
+            with obs_trace.span("fixture.attempt") as sp:
+                obs_trace.capture()
+                return sp
+    """,
+}
+
+_REGISTRY_DOCS_OK = """\
+    # fixture docs
+    Knobs: VLOG_FIXTURE_DEPTH, VLOG_FIXTURE_SECRET,
+    VLOG_FIXTURE_FAILPOINTS. Failpoints: `fixture.site`.
+    Metrics: fix_hits_total, fix_depth. Spans: fixture.attempt,
+    stage.decode_wait, stage.entropy.
+"""
+
+
+class TestRegistry:
+    def test_agreement_holds_when_docs_cover_everything(self, tmp_path):
+        pkg = _pkg(tmp_path, _REGISTRY_FILES,
+                   docs={"README.md": _REGISTRY_DOCS_OK})
+        assert run_passes(pkg, rules=["registry"]) == []
+
+    def test_each_omission_and_drift_direction_fires(self, tmp_path):
+        docs = """\
+            Knobs: VLOG_FIXTURE_DEPTH, VLOG_FIXTURE_FAILPOINTS,
+            VLOG_GHOST_KNOB. Failpoints: `fixture.site`, `fixture.ghost`.
+            Metrics: fix_depth. Spans: stage.decode_wait, stage.entropy.
+        """
+        pkg = _pkg(tmp_path, _REGISTRY_FILES, docs={"README.md": docs})
+        found = _messages(run_passes(pkg, rules=["registry"]))
+        assert any("VLOG_FIXTURE_SECRET" in m and "undocumented" in m
+                   for m in found)
+        assert any("VLOG_GHOST_KNOB" in m and "nothing" in m
+                   for m in found)
+        assert any("fixture.ghost" in m and "no such site" in m
+                   for m in found)
+        assert any("fix_hits_total" in m for m in found)
+        assert any("fixture.attempt" in m for m in found)
+        assert len(found) == 5
+
+    def test_counter_total_suffix_not_doubled(self, tmp_path):
+        pkg = _pkg(tmp_path, {"obs/metrics.py": """\
+            class R:
+                def __init__(self, registry):
+                    self.a = Counter("fix_a_total", "a", registry=registry)
+        """}, docs={"README.md": "fix_a_total\n"})
+        assert run_passes(pkg, rules=["registry"]) == []
+
+    def test_library_asserts_cover_declared_lists(self):
+        from vlog_tpu.analysis import registry as reg
+
+        reg.assert_knobs(("VLOG_PIPELINE_DEPTH", "VLOG_MESH_SLOTS"))
+        reg.assert_failpoint_sites(("delivery.read", "device.fault"))
+        reg.assert_metric_families(("vlog_mesh_slots",
+                                    "vlog_delivery_requests_total"))
+        reg.assert_span_names(("worker.transcode", "queue.wait"))
+        reg.assert_documented(("mesh.slot",), backticked=True)
+        with pytest.raises(AssertionError, match="VLOG_NOT_A_KNOB"):
+            reg.assert_knobs(("VLOG_NOT_A_KNOB",))
+        with pytest.raises(AssertionError, match="not.a.site"):
+            reg.assert_failpoint_sites(("not.a.site",))
+
+
+# --------------------------------------------------------------------------
+# Baseline + CLI
+# --------------------------------------------------------------------------
+
+class TestBaselineAndCli:
+    def _violating_pkg(self, tmp_path):
+        return _pkg(tmp_path, {"api/h.py": """\
+            import time
+
+            async def handler():
+                time.sleep(1)
+        """})
+
+    def test_baseline_suppresses_exactly_its_findings(self, tmp_path):
+        pkg = self._violating_pkg(tmp_path)
+        findings = run_passes(pkg, rules=["asyncblock"])
+        assert len(findings) == 1
+        bl = tmp_path / "BASELINE.txt"
+        bl.write_text(render_baseline(findings))
+        keys = load_baseline(bl)
+        assert {f.key for f in findings} == keys
+        # line drift must not un-suppress: the key carries no line
+        assert all(len(k) == 3 for k in keys)
+        rc = cli_main(["--root", str(pkg), "--rule", "asyncblock",
+                       "--baseline", str(bl)])
+        assert rc == 0
+
+    def test_cli_fails_on_fresh_finding_and_update_writes(self, tmp_path):
+        pkg = self._violating_pkg(tmp_path)
+        bl = tmp_path / "BASELINE.txt"
+        assert cli_main(["--root", str(pkg), "--rule", "asyncblock",
+                         "--baseline", str(bl)]) == 1
+        assert cli_main(["--root", str(pkg), "--rule", "asyncblock",
+                         "--baseline", str(bl), "--baseline-update"]) == 0
+        assert "asyncblock | " in bl.read_text()
+        assert cli_main(["--root", str(pkg), "--rule", "asyncblock",
+                         "--baseline", str(bl)]) == 0
+
+    def test_rule_restricted_update_keeps_other_rules_entries(
+            self, tmp_path):
+        pkg = self._violating_pkg(tmp_path)
+        bl = tmp_path / "BASELINE.txt"
+        grandfathered = "registry | README.md | knob VLOG_OLD undocumented"
+        stale_own = "asyncblock | api/old.py | blocking gone()"
+        bl.write_text("# justified: legacy knob awaiting removal\n"
+                      f"{grandfathered}\n{stale_own}\n")
+        assert cli_main(["--root", str(pkg), "--rule", "asyncblock",
+                         "--baseline", str(bl), "--baseline-update"]) == 0
+        text = bl.read_text()
+        assert grandfathered in text          # other rule's entry survived
+        assert "# justified: legacy knob" in text   # ...with its comment
+        assert stale_own not in text          # selected rule regenerated
+        assert "asyncblock | " in text        # new entry written
+
+    def test_comments_and_blanks_ignored_in_baseline(self, tmp_path):
+        bl = tmp_path / "b.txt"
+        bl.write_text("# justification\n\nasyncblock | a/b.py | msg\n")
+        assert load_baseline(bl) == {("asyncblock", "a/b.py", "msg")}
+
+
+# --------------------------------------------------------------------------
+# The tier-1 gate: the real repo must be clean modulo the committed
+# baseline (this is the test that makes a new unfenced route / blocked
+# loop / undocumented knob fail CI, not code review).
+# --------------------------------------------------------------------------
+
+def test_repo_is_clean_against_committed_baseline():
+    findings = run_passes()
+    known = load_baseline(default_baseline())
+    fresh = [f for f in findings if f.key not in known]
+    assert not fresh, "non-baselined static-analysis findings:\n" + \
+        "\n".join(f.render() for f in fresh)
+
+
+def test_every_pass_ran_over_a_parsed_repo():
+    """The gate must never pass vacuously: the package parses, every
+    registered pass has a RULE, and the scan actually saw the planes
+    the rules guard."""
+    mods = load_package(default_pkg_dir())
+    rels = {m.rel for m in mods}
+    assert "vlog_tpu/api/worker_api.py" in rels
+    assert "vlog_tpu/parallel/scheduler.py" in rels
+    assert "vlog_tpu/delivery/plane.py" in rels
+    assert "vlog_tpu/worker/brownout.py" in rels
+    assert set(PASSES) == {"asyncblock", "lockdiscipline", "epochfence",
+                           "tracehop", "registry"}

@@ -28,7 +28,6 @@ from vlog_tpu.jobs import videos as vids
 from vlog_tpu.storage import integrity
 from vlog_tpu.utils import failpoints
 
-README = Path(__file__).parent.parent / "README.md"
 
 
 # --------------------------------------------------------------------------
@@ -784,29 +783,21 @@ class TestDeliveryAgreement:
     SITES = ("delivery.read", "delivery.shed")
 
     def test_knobs_parsed_and_documented(self):
-        import re
+        from vlog_tpu.analysis import registry as reg
 
-        cfg_src = Path(config.__file__).read_text()
-        parsed = set(re.findall(r'"(VLOG_[A-Z_]+)"', cfg_src))
-        readme = README.read_text()
-        for knob in self.KNOBS:
-            assert knob in parsed, f"{knob} not parsed in config.py"
-            assert knob in readme, f"{knob} missing from README"
+        reg.assert_knobs(self.KNOBS)
 
     def test_metrics_registered_and_documented(self):
-        from vlog_tpu.obs.metrics import runtime
+        from vlog_tpu.analysis import registry as reg
 
-        rendered = runtime().render_text()
-        readme = README.read_text()
-        for name in self.METRICS:
-            assert name in readme, f"{name} missing from README"
-            assert name.removesuffix("_total") in rendered, name
+        reg.assert_metric_families(self.METRICS)
 
     def test_failpoint_sites_registered_and_documented(self):
-        readme = README.read_text()
+        from vlog_tpu.analysis import registry as reg
+
+        reg.assert_failpoint_sites(self.SITES)
         for site in self.SITES:
             assert site in failpoints.SITES, site
-            assert f"`{site}`" in readme, f"{site} missing from README"
 
 
 # --------------------------------------------------------------------------

@@ -138,15 +138,15 @@ def _sched(slots=2, devices=DEVS):
 def test_scheduler_partition_and_clamp():
     s = _sched(slots=2)
     assert s.slots == 2 and s.slot_width == 4
-    assert s._slot_devices(0) == DEVS[:4]
-    assert s._slot_devices(1) == DEVS[4:]
+    assert s._slot_devices_locked(0) == DEVS[:4]
+    assert s._slot_devices_locked(1) == DEVS[4:]
     # more slots than devices clamps; each slot is >= 1 wide
     s = MeshScheduler(devices=["a", "b"], slots=8)
     assert s.slots == 2 and s.slot_width == 1
     # non-dividing slot counts cover EVERY device (no stranded chips):
     # the first n % slots slots are one wider
     s = MeshScheduler(devices=list(DEVS), slots=3)
-    parts = [s._slot_devices(i) for i in range(3)]
+    parts = [s._slot_devices_locked(i) for i in range(3)]
     assert [len(p) for p in parts] == [3, 3, 2]
     assert tuple(d for p in parts for d in p) == DEVS
 
@@ -306,43 +306,39 @@ class TestMeshSchedulerAgreement:
     SPAN_ATTRS = ("mesh.slot", "mesh.width")
 
     def test_knobs_parsed_and_documented(self):
-        import re
-        from pathlib import Path
-
         from vlog_tpu import config
+        from vlog_tpu.analysis import registry as reg
 
-        cfg_src = Path(config.__file__).read_text()
-        readme = Path(config.__file__).parents[1].joinpath(
-            "README.md").read_text()
-        parsed = set(re.findall(r'"(VLOG_[A-Z_]+)"', cfg_src))
-        for knob in self.KNOBS:
-            assert knob in parsed, f"{knob} not parsed in config.py"
-            assert knob in readme, f"{knob} missing from README"
+        reg.assert_knobs(self.KNOBS)
         assert isinstance(config.MESH_SLOTS, int)
 
     def test_metrics_registered_and_documented(self):
-        from pathlib import Path
+        from vlog_tpu.analysis import registry as reg
 
-        from vlog_tpu import config
-        from vlog_tpu.obs.metrics import HAVE_PROMETHEUS, runtime
-
-        readme = Path(config.__file__).parents[1].joinpath(
-            "README.md").read_text()
-        rendered = runtime().render_text()
-        for name in self.METRICS:
-            assert name in readme, f"{name} missing from README"
-            if HAVE_PROMETHEUS:
-                assert name.removesuffix("_total") in rendered, name
+        reg.assert_metric_families(self.METRICS)
 
     def test_span_attrs_documented(self):
-        from pathlib import Path
+        from vlog_tpu.analysis import registry as reg
 
-        from vlog_tpu import config
+        reg.assert_documented(self.SPAN_ATTRS, backticked=True)
 
-        readme = Path(config.__file__).parents[1].joinpath(
-            "README.md").read_text()
-        for attr in self.SPAN_ATTRS:
-            assert f"`{attr}`" in readme, f"{attr} missing from README"
+
+def test_acquire_after_close_never_returns_released_lease():
+    """A closed ticket's lease was RELEASED — its slot may already be
+    inside another job's grant. Re-acquire on the closed ticket must
+    raise SlotCancelled, never hand back the stale lease object."""
+    from vlog_tpu.parallel.scheduler import SlotCancelled
+
+    s = _sched(slots=2)
+    t1 = s.admit()
+    t1.acquire()
+    t1.close()                        # slot freed, back in rotation
+    t2 = s.admit()
+    lease2 = t2.acquire(timeout=1)    # full mesh incl. t1's old devices
+    assert lease2.width == 8
+    with pytest.raises(SlotCancelled):
+        t1.acquire()
+    t2.close()
 
 
 def test_close_while_waiting_aborts_acquire_exactly_once():

@@ -673,40 +673,23 @@ class TestSelfHealingAgreement:
             f"FailureClass members with no classification site: {missing}"
 
     def test_knobs_parsed_and_documented(self):
-        import re
-        from pathlib import Path
+        from vlog_tpu.analysis import registry as reg
 
-        cfg_src = Path(config.__file__).read_text()
-        readme = Path(config.__file__).parents[1].joinpath(
-            "README.md").read_text()
-        parsed = set(re.findall(r'"(VLOG_[A-Z_0-9]+)"', cfg_src))
-        for knob in self.KNOBS:
-            assert knob in parsed, f"{knob} not parsed in config.py"
-            assert knob in readme, f"{knob} missing from README"
+        reg.assert_knobs(self.KNOBS)
         assert isinstance(config.QUARANTINE_THRESHOLD, int)
         assert isinstance(config.DEVICE_PROBE_INTERVAL_S, float)
 
     def test_metrics_registered_and_documented(self):
-        from pathlib import Path
+        from vlog_tpu.analysis import registry as reg
 
-        from vlog_tpu.obs.metrics import HAVE_PROMETHEUS, runtime
-
-        readme = Path(config.__file__).parents[1].joinpath(
-            "README.md").read_text()
-        rendered = runtime().render_text()
-        for name in self.METRICS:
-            assert name in readme, f"{name} missing from README"
-            if HAVE_PROMETHEUS:
-                assert name.removesuffix("_total") in rendered, name
+        reg.assert_metric_families(self.METRICS)
 
     def test_fencing_header_documented_and_new_sites_registered(self):
-        from pathlib import Path
+        from vlog_tpu.analysis import registry as reg
 
-        readme = Path(config.__file__).parents[1].joinpath(
-            "README.md").read_text()
-        assert "X-Claim-Epoch" in readme
-        for site in ("device.fault", "claim.fence", "db.claim"):
-            assert site in failpoints.SITES
+        reg.assert_documented(("X-Claim-Epoch",))
+        reg.assert_failpoint_sites(("device.fault", "claim.fence",
+                                    "db.claim"))
         # arm_from_spec accepts them (the VLOG_FAILPOINTS contract)
         armed = failpoints.arm_from_spec(
             "device.fault=1,claim.fence=1,db.claim=1")

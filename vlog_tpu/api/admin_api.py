@@ -314,7 +314,11 @@ async def upload_video(request: web.Request) -> web.Response:
                 f".upload-{uuid.uuid4().hex}{suffix}"
             tmp.parent.mkdir(parents=True, exist_ok=True)
             try:
-                with open(tmp, "wb") as fp:
+                # open/write hop to threads: a blocking write on the
+                # upload volume would stall the whole admin event loop
+                # (asyncblock lint).
+                fp = await asyncio.to_thread(open, tmp, "wb")
+                try:
                     while True:
                         chunk = await part.read_chunk(_COPY_CHUNK)
                         if not chunk:
@@ -324,7 +328,9 @@ async def upload_video(request: web.Request) -> web.Response:
                             raise web.HTTPRequestEntityTooLarge(
                                 max_size=config.MAX_UPLOAD_SIZE_BYTES,
                                 actual_size=size)
-                        fp.write(chunk)
+                        await asyncio.to_thread(fp.write, chunk)
+                finally:
+                    await asyncio.to_thread(fp.close)
             except BaseException:
                 tmp.unlink(missing_ok=True)
                 raise
@@ -346,7 +352,7 @@ async def upload_video(request: web.Request) -> web.Response:
         size_bytes=size, description=description, category=category)
     # final resting place keyed by video id (stable across retitle)
     dest = request.app[UPLOAD_DIR] / f"{video['id']}{saved.suffix}"
-    saved.rename(dest)
+    await asyncio.to_thread(saved.rename, dest)
     await db.execute(
         "UPDATE videos SET source_path=:p, duration_s=:d, width=:w, "
         "height=:h, fps=:f, updated_at=:t WHERE id=:id",
@@ -615,17 +621,25 @@ async def audit_tail(request: web.Request) -> web.Response:
 
     files = [audit.path] + [audit.path.with_suffix(f".{i}.log")
                             for i in range(1, KEEP_ROTATIONS + 1)]
-    for p in files:
-        if len(entries) >= limit:
-            break
+    def _read_tail(path) -> tuple[int, str] | None:
+        """Blocking tail read — runs in a thread so a cold/slow log
+        volume can't stall the admin event loop (asyncblock lint)."""
         try:
-            with open(p, "rb") as fp:
+            with open(path, "rb") as fp:
                 fp.seek(0, 2)
                 size = fp.tell()
                 fp.seek(max(0, size - cap_bytes))
-                data = fp.read().decode(errors="replace")
+                return size, fp.read().decode(errors="replace")
         except OSError:
+            return None
+
+    for p in files:
+        if len(entries) >= limit:
+            break
+        got = await asyncio.to_thread(_read_tail, p)
+        if got is None:
             continue
+        size, data = got
         lines = data.splitlines()
         if size > cap_bytes and lines:
             lines = lines[1:]               # drop the torn first line
@@ -1197,8 +1211,8 @@ async def detect_chapters(request: web.Request) -> web.Response:
             "SELECT vtt_path FROM transcriptions WHERE video_id=:v "
             "AND status='completed'", {"v": video["id"]})
         if tr and tr["vtt_path"] and Path(tr["vtt_path"]).exists():
-            cues = _parse_vtt_cues(Path(tr["vtt_path"]).read_text())
-            found = suggest_from_transcript(cues)
+            text = await asyncio.to_thread(Path(tr["vtt_path"]).read_text)
+            found = suggest_from_transcript(_parse_vtt_cues(text))
     return web.json_response({"chapters": [
         {"start_s": round(c.start_s, 3), "title": c.title,
          "source": c.source} for c in found]})

@@ -16,6 +16,7 @@ Handlers are mounted into the admin app by
 
 from __future__ import annotations
 
+import asyncio
 import json
 import re
 from pathlib import Path
@@ -478,7 +479,7 @@ async def get_transcript_admin(request: web.Request) -> web.Response:
         return _json_error(404, "no transcript")
     vtt = None
     if tr["vtt_path"] and Path(tr["vtt_path"]).exists():
-        vtt = Path(tr["vtt_path"]).read_text()
+        vtt = await asyncio.to_thread(Path(tr["vtt_path"]).read_text)
     return web.json_response({"transcript": tr, "vtt": vtt})
 
 
@@ -599,7 +600,8 @@ async def get_sprites(request: web.Request) -> web.Response:
         return _json_error(404, "no sprites generated")
     cues = []
     block: list[str] = []
-    for line in vtt.read_text().splitlines() + [""]:
+    text = await asyncio.to_thread(vtt.read_text)
+    for line in text.splitlines() + [""]:
         if line.strip():
             block.append(line.strip())
             continue

@@ -526,14 +526,21 @@ async def upload(request: web.Request) -> web.Response:
     hasher = hashlib.sha256()
     try:
         try:
-            with open(tmp, "wb") as fp:
+            # File ops hop to threads: a synchronous write on a slow or
+            # saturated volume would stall every other request on this
+            # event loop — heartbeats, claims, playback — for its
+            # duration (asyncblock lint).
+            fp = await asyncio.to_thread(open, tmp, "wb")
+            try:
                 async for chunk in request.content.iter_chunked(_COPY_CHUNK):
                     size += len(chunk)
                     if size > MAX_UPLOAD_PART:
                         raise web.HTTPRequestEntityTooLarge(
                             max_size=MAX_UPLOAD_PART, actual_size=size)
                     hasher.update(chunk)
-                    fp.write(chunk)
+                    await asyncio.to_thread(fp.write, chunk)
+            finally:
+                await asyncio.to_thread(fp.close)
         except OSError as exc:
             tmp.unlink(missing_ok=True)
             if exc.errno in (errno.ENOSPC, getattr(errno, "EDQUOT", -1)):
@@ -555,7 +562,9 @@ async def upload(request: web.Request) -> web.Response:
                 422, f"content digest mismatch: received {digest}, "
                      f"caller claimed {claimed_digest}")
         try:
-            tmp.rename(dest)
+            # metadata op, but it follows a multi-GB write the volume
+            # may still be flushing — off the loop with the rest
+            await asyncio.to_thread(tmp.rename, dest)
         except OSError:
             # rename onto an existing directory — the bad-path family,
             # like the mkdir collision above.

@@ -36,6 +36,7 @@ from __future__ import annotations
 import asyncio
 import os
 import stat as stat_mod
+import threading
 import time
 import weakref
 from dataclasses import dataclass
@@ -116,8 +117,15 @@ class DeliveryPlane:
             on_evict=lambda _size: m.delivery_evictions.inc())
         self.flight = SingleFlight(
             on_collapse=lambda: m.delivery_collapses.inc())
+        # loop-confined: _states/_fill_gen/counters are only touched
+        # from event-loop coroutines, never from fill threads
         self._states: dict[str, tuple[ServingState, float]] = {}
         # slug -> (outputs.json mtime_ns | None, {rel: (size, sha256)})
+        # — read AND refreshed inside _read_entry, which runs in
+        # asyncio.to_thread fill workers: concurrent fills for two
+        # slugs would otherwise race the dict (and the bound/clear)
+        self._digest_lock = threading.Lock()
+        # guarded-by: _digest_lock
         self._digests: dict[str, tuple[int | None,
                                        dict[str, tuple[int, str]]]] = {}
         self._root_resolved: Path | None = None
@@ -297,16 +305,21 @@ class DeliveryPlane:
         from vlog_tpu.storage import integrity
 
         root = self.video_dir / slug
-        cached = self._digests.get(slug)
+        with self._digest_lock:
+            cached = self._digests.get(slug)
         try:
             current_ns = (root / integrity.MANIFEST_NAME).stat().st_mtime_ns
         except OSError:
             current_ns = None
         if cached is None or cached[0] != current_ns:
+            # manifest load runs outside the lock (disk I/O); a racing
+            # fill for the same slug just loads twice and the second
+            # store wins — both loads saw the same manifest bytes
             cached = integrity.manifest_digests(root)
-            if len(self._digests) >= _DIGEST_CACHE_MAX:
-                self._digests.clear()   # coarse but bounded; re-warms
-            self._digests[slug] = cached
+            with self._digest_lock:
+                if len(self._digests) >= _DIGEST_CACHE_MAX:
+                    self._digests.clear()   # coarse but bounded; re-warms
+                self._digests[slug] = cached
         want = cached[1].get(rel)
         if want is None or want[0] != size:
             return None
@@ -318,7 +331,8 @@ class DeliveryPlane:
         """Evict everything known about one slug; returns entries dropped."""
         n = self.cache.invalidate_slug(slug)
         self._states.pop(slug, None)
-        self._digests.pop(slug, None)
+        with self._digest_lock:
+            self._digests.pop(slug, None)
         self._fill_gen += 1
         self.counters["invalidations"] += 1
         runtime().delivery_cache_bytes.set(self.cache.bytes_cached)
@@ -327,7 +341,8 @@ class DeliveryPlane:
     def invalidate_all(self) -> int:
         n = self.cache.clear()
         self._states.clear()
-        self._digests.clear()
+        with self._digest_lock:
+            self._digests.clear()
         self._fill_gen += 1
         self.counters["invalidations"] += 1
         runtime().delivery_cache_bytes.set(self.cache.bytes_cached)

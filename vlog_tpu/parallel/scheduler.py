@@ -184,25 +184,28 @@ class SlotTicket:
 
     def __init__(self, scheduler: "MeshScheduler"):
         self._sched = scheduler
-        self.lease: SlotLease | None = None
-        self._closed = False
-        self._waiting = False
+        # Ticket state is shared between the admitting event loop, the
+        # job's compute thread (acquire) and the supervisor (close);
+        # every access outside construction goes through the
+        # scheduler's condition.
+        self.lease: SlotLease | None = None   # guarded-by: _cond
+        self._closed = False                  # guarded-by: _cond
+        self._waiting = False                 # guarded-by: _cond
 
     def acquire(self, timeout: float | None = None,
                 cancel: threading.Event | None = None) -> SlotLease:
         """Block until a slot is grantable. ``cancel``: an event polled
         while waiting (the job supervisor's cancel flag) — firing it
         aborts the wait with :class:`SlotCancelled` instead of leaving
-        an uncancellable thread parked on the condition. The grant
-        itself assigns :attr:`lease` under the scheduler lock, so a
-        concurrent ``close`` always sees either an open wait (which it
-        aborts) or the granted lease (which it releases) — never a gap
-        it could double-withdraw through."""
-        if self._closed:
-            raise SlotCancelled("ticket already closed")
-        if self.lease is None:
-            self._sched._acquire(self, timeout, cancel)
-        return self.lease
+        an uncancellable thread parked on the condition. All ticket
+        state moves under the scheduler lock (the old lock-free
+        ``_closed`` fast path could race a concurrent ``close`` into
+        withdrawing the same demand twice — eating ANOTHER ticket's
+        slot): a concurrent ``close`` now always sees either
+        not-yet-waiting (it withdraws, we raise without withdrawing),
+        an open wait (it aborts, we withdraw), or the granted lease
+        (it releases) — exactly one of them."""
+        return self._sched._acquire(self, timeout, cancel)
 
     def close(self) -> None:
         with self._sched._cond:
@@ -240,19 +243,21 @@ class MeshScheduler:
         want = config.MESH_SLOTS if slots is None else int(slots)
         self._want_slots = max(1, want)
         self._cond = threading.Condition()
-        self._active: dict[int, SlotLease] = {}
-        self._open_tickets = 0           # admitted, not yet granted
-        self._holds = 0                  # claim rounds freezing grants
+        self._active: dict[int, SlotLease] = {}   # guarded-by: _cond
+        # admitted, not yet granted
+        self._open_tickets = 0                    # guarded-by: _cond
+        # claim rounds freezing grants
+        self._holds = 0                           # guarded-by: _cond
         # Device-fault quarantine: device -> quarantined-at (monotonic)
         # and per-device fault attributions toward the threshold.
-        self._quarantined: dict = {}
-        self._fault_counts: dict = {}
+        self._quarantined: dict = {}              # guarded-by: _cond
+        self._fault_counts: dict = {}             # guarded-by: _cond
         # set on quarantine/heal; the partition renegotiates around the
         # hole at the next job boundary (no active leases)
-        self._partition_dirty = False
+        self._partition_dirty = False             # guarded-by: _cond
         with self._cond:
             self._rebuild_locked()
-        self._host_pool: ThreadPoolExecutor | None = None
+        self._host_pool: ThreadPoolExecutor | None = None  # guarded-by: _pool_lock
         self._pool_lock = threading.Lock()
         self._metrics().mesh_slots.set(self.slots)
 
@@ -268,6 +273,7 @@ class MeshScheduler:
         every device quarantined, slots is 0 and nothing grants until
         a probe heals one.
         """
+        # guarded-by: _cond
         self._healthy: tuple = tuple(d for d in self.devices
                                      if d not in self._quarantined)
         n = len(self._healthy)
@@ -280,7 +286,7 @@ class MeshScheduler:
                 w = base + (1 if i < rem else 0)
                 bounds.append((at, at + w))
                 at += w
-        self._slot_bounds = tuple(bounds)
+        self._slot_bounds = tuple(bounds)         # guarded-by: _cond
         self._partition_dirty = False
 
     def _maybe_rebuild_locked(self) -> None:
@@ -292,7 +298,7 @@ class MeshScheduler:
 
     def _slot_healthy_locked(self, slot: int) -> bool:
         return all(d not in self._quarantined
-                   for d in self._slot_devices(slot))
+                   for d in self._slot_devices_locked(slot))
 
     # ---- admission ---------------------------------------------------
     def capacity(self) -> int:
@@ -422,7 +428,7 @@ class MeshScheduler:
         return results
 
     # ---- grant engine ------------------------------------------------
-    def _slot_devices(self, slot: int) -> tuple:
+    def _slot_devices_locked(self, slot: int) -> tuple:
         lo, hi = self._slot_bounds[slot]
         return self._healthy[lo:hi]
 
@@ -438,12 +444,12 @@ class MeshScheduler:
                 return SlotLease(self, FULL_MESH_SLOT if self.slots > 1
                                  else 0,
                                  self._healthy)
-            return SlotLease(self, 0, self._slot_devices(0))
+            return SlotLease(self, 0, self._slot_devices_locked(0))
         if FULL_MESH_SLOT in self._active:
             return None                  # wait for the job boundary
         for slot in range(self.slots):
             if slot not in self._active and self._slot_healthy_locked(slot):
-                return SlotLease(self, slot, self._slot_devices(slot))
+                return SlotLease(self, slot, self._slot_devices_locked(slot))
         return None
 
     def _acquire(self, ticket: SlotTicket, timeout: float | None,
@@ -451,6 +457,17 @@ class MeshScheduler:
         t0 = time.monotonic()
         deadline = None if timeout is None else t0 + timeout
         with self._cond:
+            # closed wins over granted: close() releases the lease but
+            # leaves ticket.lease set, so the order here is what keeps
+            # a cancelled job's re-acquire from returning a RELEASED
+            # lease whose devices another job may already hold.
+            if ticket._closed:
+                # closed before the wait registered: close() already
+                # withdrew the demand (it saw _waiting False) — raise
+                # WITHOUT withdrawing again.
+                raise SlotCancelled("ticket already closed")
+            if ticket.lease is not None:
+                return ticket.lease          # idempotent re-acquire
             ticket._waiting = True
             try:
                 while True:
@@ -494,10 +511,11 @@ class MeshScheduler:
                     self._cond.wait(timeout=wait_s)
             finally:
                 ticket._waiting = False
+            occupancy = len(self._active)    # read under the lock
         lease.wait_s = time.monotonic() - t0
         m = self._metrics()
         m.mesh_slot_wait.observe(lease.wait_s)
-        m.mesh_slot_occupancy.set(len(self._active))
+        m.mesh_slot_occupancy.set(occupancy)
         m.mesh_slot_width.labels(self._slot_label(lease.slot)).set(
             lease.width)
         return lease

@@ -27,6 +27,7 @@ it with a fake clock and zero sleeps.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Callable
 
@@ -52,55 +53,70 @@ class CoordinationBreaker:
                            else cooldown_s)
         self.base_backoff_s = base_backoff_s
         self._clock = clock
-        self._consecutive = 0
-        self._open = False
-        self._opened_at = 0.0
-        self.opens = 0               # lifetime brownouts (stats surface)
-        self.last_error: str | None = None
+        # The claim loop mutates this state while the health server's
+        # readiness thread (worker/health.py breaker_check) and the
+        # stats command read it — every access goes through _lock.
+        self._lock = threading.Lock()
+        self._consecutive = 0                 # guarded-by: _lock
+        self._open = False                    # guarded-by: _lock
+        self._opened_at = 0.0                 # guarded-by: _lock
+        # lifetime brownouts (stats surface)
+        self.opens = 0                        # guarded-by: _lock
+        self.last_error: str | None = None    # guarded-by: _lock
 
     @property
     def is_open(self) -> bool:
-        return self._open
+        with self._lock:
+            return self._open
 
     @property
     def consecutive_errors(self) -> int:
-        return self._consecutive
+        with self._lock:
+            return self._consecutive
 
     def record_error(self, exc: BaseException) -> float:
         """Count one transient coordination error; returns the jittered
         delay the claim loop should sleep before probing again."""
-        self._consecutive += 1
-        self.last_error = f"{type(exc).__name__}: {exc}"[:300]
+        with self._lock:
+            self._consecutive += 1
+            self.last_error = f"{type(exc).__name__}: {exc}"[:300]
+            consecutive = self._consecutive
+            opened = False
+            if not self._open and self._consecutive >= self.threshold:
+                self._open = True
+                self._opened_at = self._clock()
+                self.opens += 1
+                opened = True
+            last_error = self.last_error
         self._metrics().claim_errors.labels(self.source).inc()
-        if not self._open and self._consecutive >= self.threshold:
-            self._open = True
-            self._opened_at = self._clock()
-            self.opens += 1
+        if opened:
             self._metrics().claim_breaker_open.set(1)
             log.warning(
                 "coordination plane browned out after %d consecutive "
                 "errors (%s); claiming paused on backoff, readiness "
-                "degraded", self._consecutive, self.last_error)
+                "degraded", consecutive, last_error)
         # One jittered-exponential policy for the whole failure plane
         # (jobs/claims.py). The exponent is clamped: _consecutive grows
         # without bound through a long outage and 2**1075 would overflow
         # float long after the cap had made growth moot anyway.
         from vlog_tpu.jobs.claims import retry_backoff_s
 
-        return retry_backoff_s(min(self._consecutive, 32),
+        return retry_backoff_s(min(consecutive, 32),
                                base=self.base_backoff_s,
                                cap=max(self.cooldown_s,
                                        self.base_backoff_s))
 
     def record_success(self) -> None:
         """A poll reached the coordination plane: close the brownout."""
-        if self._open:
+        with self._lock:
+            was_open, self._open = self._open, False
+            opened_at = self._opened_at
+            self._consecutive = 0
+            self.last_error = None
+        if was_open:
             log.info("coordination plane recovered after %.1fs brownout",
-                     self._clock() - self._opened_at)
-            self._open = False
+                     self._clock() - opened_at)
             self._metrics().claim_breaker_open.set(0)
-        self._consecutive = 0
-        self.last_error = None
 
     @staticmethod
     def _metrics():
@@ -110,7 +126,8 @@ class CoordinationBreaker:
 
     def snapshot(self) -> dict:
         """Stats-command / readiness surface."""
-        return {"open": self._open,
-                "consecutive_errors": self._consecutive,
-                "opens": self.opens,
-                "last_error": self.last_error}
+        with self._lock:
+            return {"open": self._open,
+                    "consecutive_errors": self._consecutive,
+                    "opens": self.opens,
+                    "last_error": self.last_error}
