@@ -88,13 +88,26 @@ class TestAsyncBlock:
         assert run_passes(pkg, rules=["asyncblock"]) == []
 
     def test_only_serving_packages_in_scope(self, tmp_path):
+        pkg = _pkg(tmp_path, {"codecs/dsp.py": """\
+            import time
+
+            async def loop():
+                time.sleep(1)    # codecs/ is out of asyncblock scope
+        """})
+        assert run_passes(pkg, rules=["asyncblock"]) == []
+
+    def test_worker_package_in_scope(self, tmp_path):
+        """worker/ joined the scope with the drain plane: the worker
+        event loop carries lease heartbeats and drain checkpoints, so a
+        blocking call there is a real finding."""
         pkg = _pkg(tmp_path, {"worker/daemon.py": """\
             import time
 
             async def loop():
-                time.sleep(1)    # worker/ is out of asyncblock scope
+                time.sleep(1)
         """})
-        assert run_passes(pkg, rules=["asyncblock"]) == []
+        fs = run_passes(pkg, rules=["asyncblock"])
+        assert len(fs) == 1 and "time.sleep" in fs[0].message
 
 
 # --------------------------------------------------------------------------

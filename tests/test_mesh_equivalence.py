@@ -40,9 +40,15 @@ process_video(sys.argv[1], sys.argv[2], audio=False, segment_duration_s=1.0,
 
 
 def _tree_files(root: Path) -> dict[str, bytes]:
+    # the rate-control resume journal is run state shaped by the
+    # dispatch-batch (device-count) geometry; the byte-identity
+    # contract covers published artifacts only (as does outputs.json)
+    from vlog_tpu.storage.integrity import RC_JOURNAL_NAME
+
     return {
         str(p.relative_to(root)): p.read_bytes()
-        for p in sorted(root.rglob("*")) if p.is_file()
+        for p in sorted(root.rglob("*"))
+        if p.is_file() and p.name != RC_JOURNAL_NAME
     }
 
 

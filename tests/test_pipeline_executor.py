@@ -180,9 +180,16 @@ class TestExecutorUnit:
 # --------------------------------------------------------------------------
 
 def _tree_digests(root: Path) -> dict[str, str]:
+    # rc_journal.jsonl is resume RUN STATE, not a published artifact: its
+    # bytes are shaped by pipeline depth and dispatch-batch geometry by
+    # design, so the byte-identity contract (segments, playlists,
+    # manifests) deliberately excludes it — as does outputs.json.
+    from vlog_tpu.storage.integrity import RC_JOURNAL_NAME
+
     return {
         str(p.relative_to(root)): hashlib.sha256(p.read_bytes()).hexdigest()
-        for p in sorted(root.rglob("*")) if p.is_file()
+        for p in sorted(root.rglob("*"))
+        if p.is_file() and p.name != RC_JOURNAL_NAME
     }
 
 

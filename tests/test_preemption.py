@@ -1,0 +1,654 @@
+"""Preemption-tolerant workers: the drain → checkpoint → hand-off chaos
+suite.
+
+Eviction on a preemptible fleet is a NOTICE, not a crash. These tests
+hold the whole bounded-loss contract:
+
+- a notice (SIGTERM / file / failpoint / admin command) flips the
+  worker to DRAINING: claiming stops, in-flight work keeps flushing,
+  leases stay extended (the sweep must not steal a draining job);
+- the grace deadline force-cancels stragglers and requeues them as
+  refunded ``preempted`` failures (bounded like device-fault refunds);
+- a second SIGTERM skips the grace window entirely;
+- remote workers stream checkpoints (epoch-fenced — a stale
+  incarnation's checkpoint bounces 409) and flush completed segments +
+  the rate-control journal at the deadline;
+- a successor on a DIFFERENT machine prefetches the verified partials
+  and continues the ladder byte-identically, re-encoding strictly
+  fewer segments (counter-asserted).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import time
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from vlog_tpu import config
+from vlog_tpu.enums import FailureClass, JobKind
+from vlog_tpu.jobs import claims, state as js, videos as vids
+from vlog_tpu.utils import failpoints
+from vlog_tpu.worker.daemon import WorkerDaemon
+from vlog_tpu.worker.drain import (DRAIN_CANCEL_REASON, DrainState,
+                                   PreemptionWatcher)
+from vlog_tpu.worker.remote import (ClaimLost, RemoteWorker,
+                                    StreamingUploader, WorkerAPIClient)
+from tests.fixtures.media import make_y4m
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def make_daemon(db, tmp_path, **kw):
+    kw.setdefault("name", "preempt-worker")
+    kw.setdefault("video_dir", tmp_path / "videos")
+    kw.setdefault("progress_min_interval_s", 0.0)
+    kw.setdefault("drain_tick_s", 0.02)
+    return WorkerDaemon(db, **kw)
+
+
+@pytest.fixture
+def video_job(run, db, tmp_path):
+    src = make_y4m(tmp_path / "src.y4m", n_frames=10, width=128, height=96,
+                   fps=24)
+    video = run(vids.create_video(db, "Preempt", source_path=str(src),
+                                  size_bytes=src.stat().st_size))
+    job_id = run(claims.enqueue_job(db, video["id"]))
+    return video, job_id, src
+
+
+def slow_compute(monkeypatch):
+    """Replace the transcode pipeline with an endless cooperative loop:
+    progress advances every tick, so only a cancel (drain deadline,
+    shutdown) ends it."""
+    import vlog_tpu.worker.pipeline as pl
+
+    def fake(source, out_dir, **kw):
+        cb = kw.get("progress_cb")
+        i = 0
+        while True:
+            i += 1
+            if cb:
+                cb(i, 10_000, "grinding")
+            time.sleep(0.01)
+
+    monkeypatch.setattr(pl, "process_video", fake)
+
+
+def metric_value(name: str) -> float:
+    from vlog_tpu.obs.metrics import runtime
+
+    m = re.search(rf"^{re.escape(name)} ([0-9.e+]+)$",
+                  runtime().render_text(), re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+# --------------------------------------------------------------------------
+# DrainState / PreemptionWatcher units
+# --------------------------------------------------------------------------
+
+def test_drain_state_begin_once_and_deadline():
+    st = DrainState()
+    assert not st.active and not st.expired()
+    assert st.begin("test", 100.0)
+    assert not st.begin("again", 0.0)      # first notice wins
+    assert st.active and not st.expired()
+    assert 90.0 < st.grace_left_s() <= 100.0
+    snap = st.snapshot()
+    assert snap["active"] and snap["reason"] == "test"
+    # the drain.deadline failpoint forces the deadline NOW
+    failpoints.arm("drain.deadline", count=1)
+    assert st.expired()
+    assert not st.expired()                # budget spent; real clock rules
+    zero = DrainState()
+    zero.begin("now", 0.0)
+    assert zero.expired()
+
+
+def test_preemption_watcher_channels(run, tmp_path):
+    # failpoint channel: an armed hit IS the notice
+    failpoints.arm("preempt.notice", count=1)
+    w = PreemptionWatcher(poll_s=0.01)
+    reason = run(w.check())
+    assert reason and "preempt.notice" in reason
+    # file channel
+    notice = tmp_path / "preempted"
+    w2 = PreemptionWatcher(file=notice, poll_s=0.01)
+    assert run(w2.check()) is None
+    notice.touch()
+    assert "notice file" in run(w2.check())
+
+    # watch() fires the callback once and returns
+    async def go():
+        got = []
+        stop = asyncio.Event()
+        await asyncio.wait_for(
+            w2.watch(stop, lambda r: got.append(r) or asyncio.sleep(0)), 5.0)
+        return got
+
+    assert len(run(go())) == 1
+
+
+def test_from_config_armed_failpoint_builds_watcher():
+    assert PreemptionWatcher.from_config() is None
+    failpoints.arm("preempt.notice", count=1)
+    assert PreemptionWatcher.from_config() is not None
+
+
+# --------------------------------------------------------------------------
+# Daemon drain: gating, deadline, double-SIGTERM, lease extension
+# --------------------------------------------------------------------------
+
+def test_drain_gates_claiming_and_marks_status(run, db, tmp_path, video_job):
+    daemon = make_daemon(db, tmp_path, drain_grace_s=30.0)
+
+    async def go():
+        assert daemon.begin_drain("test notice")
+        # no new claims while draining — the queued job stays queued
+        assert await daemon.poll_once() is False
+        await daemon._heartbeat()
+        # the drain loop (no in-flight work) stops the worker promptly
+        await asyncio.wait_for(daemon._drain_task, 5.0)
+
+    run(go())
+    row = run(db.fetch_one("SELECT status FROM workers WHERE name=:n",
+                           {"n": daemon.name}))
+    assert row["status"] == "draining"
+    assert daemon._stop.is_set()
+    job = run(db.fetch_one("SELECT claimed_by FROM jobs"))
+    assert job["claimed_by"] is None
+
+
+def test_drain_deadline_bounded_and_preempted_requeue(run, db, tmp_path,
+                                                      video_job,
+                                                      monkeypatch):
+    """Acceptance: with grace G a signalled worker releases all claims
+    and exits within G plus a small epsilon, and the victim is requeued
+    as a refunded ``preempted`` failure."""
+    video, job_id, _ = video_job
+    slow_compute(monkeypatch)
+    daemon = make_daemon(db, tmp_path, drain_grace_s=0.3)
+
+    async def go():
+        task = asyncio.create_task(daemon.poll_once())
+        while job_id not in daemon._active_sups:   # compute is running
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.05)
+        t0 = time.monotonic()
+        daemon.handle_termination()                # SIGTERM -> drain
+        assert daemon.drain.active
+        assert await asyncio.wait_for(task, 10.0) is True
+        await asyncio.wait_for(daemon._drain_task, 10.0)
+        return time.monotonic() - t0
+
+    elapsed = run(go())
+    assert elapsed < 0.3 + 3.0                     # grace + epsilon
+    assert daemon._stop.is_set()
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id}))
+    assert job["claimed_by"] is None
+    assert job["attempt"] == 0                     # refunded
+    assert job["next_retry_at"] is None            # no backoff: claim now
+    hist = run(claims.get_failure_history(db, job_id))
+    assert hist[-1]["failure_class"] == FailureClass.PREEMPTED.value
+    assert DRAIN_CANCEL_REASON in hist[-1]["error"]
+    assert js.is_claimable(job, now=time.time())
+
+
+def test_second_sigterm_skips_grace(run, db, tmp_path, video_job,
+                                    monkeypatch):
+    """kill -TERM twice always means NOW: the claim is released (not
+    failed) and the worker exits immediately despite a huge grace."""
+    video, job_id, _ = video_job
+    slow_compute(monkeypatch)
+    daemon = make_daemon(db, tmp_path, drain_grace_s=600.0)
+
+    async def go():
+        task = asyncio.create_task(daemon.poll_once())
+        while job_id not in daemon._active_sups:
+            await asyncio.sleep(0.01)
+        daemon.handle_termination()
+        assert daemon.drain.active and not daemon._stop.is_set()
+        t0 = time.monotonic()
+        daemon.handle_termination()                # second signal
+        assert daemon._stop.is_set()
+        await asyncio.wait_for(task, 10.0)
+        await asyncio.wait_for(daemon._drain_task, 10.0)
+        return time.monotonic() - t0
+
+    elapsed = run(go())
+    assert elapsed < 3.0
+    assert daemon.stats.released == 1
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id}))
+    assert job["claimed_by"] is None and job["attempt"] == 0
+
+
+def test_drain_extends_lease_sweep_cannot_reclaim(run, db, tmp_path,
+                                                  video_job, monkeypatch):
+    """The sweep's lapsed-lease predicate must never fire on a draining
+    job: the drain supervisor heartbeat-extends every held claim."""
+    video, job_id, _ = video_job
+    slow_compute(monkeypatch)
+    daemon = make_daemon(db, tmp_path, drain_grace_s=600.0)
+
+    async def go():
+        task = asyncio.create_task(daemon.poll_once())
+        while job_id not in daemon._active_sups:
+            await asyncio.sleep(0.01)
+        daemon.begin_drain("lease test")
+        # age the lease to the brink; the drain extension must renew it
+        await db.execute(
+            "UPDATE jobs SET claim_expires_at=:e WHERE id=:id",
+            {"e": time.time() + 0.5, "id": job_id})
+        await daemon._extend_drain_leases()
+        released = await claims.sweep_expired_claims(db)
+        assert released == 0
+        row = await db.fetch_one("SELECT * FROM jobs WHERE id=:id",
+                                 {"id": job_id})
+        assert row["claimed_by"] == daemon.name
+        assert row["claim_expires_at"] > time.time() + 60
+        daemon.request_stop()                      # end the test quickly
+        await asyncio.wait_for(task, 10.0)
+        await asyncio.wait_for(daemon._drain_task, 10.0)
+
+    run(go())
+
+
+def test_drain_command_and_stats_surface(run, db, tmp_path):
+    from vlog_tpu.jobs import commands as cmds
+
+    daemon = make_daemon(db, tmp_path, drain_grace_s=45.0)
+
+    async def go():
+        cmd_id = await cmds.send_command(db, daemon.name, "drain")
+        handled = await cmds.drain_for_worker(db, daemon.name,
+                                              daemon.handle_command)
+        assert handled == 1
+        resp = (await cmds.get_command(db, cmd_id))["response"]
+        assert resp["draining"] and resp["started"]
+        assert resp["grace_s"] == 45.0
+        stats = await daemon.handle_command("stats", {})
+        assert stats["draining"]["active"]
+        assert stats["draining"]["jobs_remaining"] == 0
+        assert 0 < stats["draining"]["grace_left_s"] <= 45.0
+        await asyncio.wait_for(daemon._drain_task, 5.0)
+
+    run(go())
+
+
+def test_drain_readiness_degrades(run):
+    from vlog_tpu.worker.health import drain_check
+
+    st = DrainState()
+    check = drain_check(st)
+    ok, _ = run(check())
+    assert ok
+    st.begin("eviction notice", 30.0)
+    ok, detail = run(check())
+    assert not ok and "draining" in detail and "grace left" in detail
+
+
+def test_admin_drain_endpoint(run, db, tmp_path):
+    import httpx
+
+    from vlog_tpu.api.admin_api import build_admin_app
+    from vlog_tpu.jobs import commands as cmds
+
+    srv = TestServer(build_admin_app(db, upload_dir=tmp_path,
+                                     video_dir=tmp_path))
+    daemon = make_daemon(db, tmp_path, name="drainable")
+
+    async def go():
+        await srv.start_server()
+        async with httpx.AsyncClient(base_url=str(srv.make_url(""))) as c:
+            r = await c.post("/api/workers/drainable/drain")
+            assert r.status_code == 201
+            assert r.json()["command"] == "drain"
+        # the worker's heartbeat tick picks the command up
+        await cmds.drain_for_worker(db, "drainable", daemon.handle_command)
+        assert daemon.drain.active
+        await asyncio.wait_for(daemon._drain_task, 5.0)
+        await srv.close()
+
+    run(go())
+
+
+# --------------------------------------------------------------------------
+# PREEMPTED refund accounting
+# --------------------------------------------------------------------------
+
+def test_preempted_refund_bounded(run, db, tmp_path, video_job, monkeypatch):
+    """PREEMPTED refunds the attempt — but only ``max_attempts`` times
+    per job life; past the bound it burns budget and dead-letters, so a
+    job that somehow only ever lands on doomed hosts cannot livelock."""
+    monkeypatch.setattr(config, "RETRY_BACKOFF_BASE_S", 0.0)
+    video, job_id, _ = video_job
+    run(db.execute("UPDATE jobs SET max_attempts=2 WHERE id=:id",
+                   {"id": job_id}))
+
+    async def cycle():
+        job = await claims.claim_job(db, "doomed")
+        assert job is not None and job["id"] == job_id
+        return await claims.fail_job(
+            db, job_id, "doomed", "preempted mid-ladder",
+            failure_class=FailureClass.PREEMPTED)
+
+    row = run(cycle())
+    assert row["attempt"] == 0 and row["failed_at"] is None    # refund 1
+    row = run(cycle())
+    assert row["attempt"] == 0 and row["failed_at"] is None    # refund 2
+    row = run(cycle())
+    assert row["attempt"] == 1 and row["failed_at"] is None    # bound hit
+    row = run(cycle())
+    assert row["failed_at"] is not None                        # dead-letter
+    hist = run(claims.get_failure_history(db, job_id))
+    assert [h["failure_class"] for h in hist] == ["preempted"] * 4
+
+
+# --------------------------------------------------------------------------
+# Remote plane: fenced checkpoints, flush, cross-worker resume
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def api(run, db, tmp_path):
+    from vlog_tpu.api.worker_api import build_worker_app
+
+    video_dir = tmp_path / "srv-videos"
+    app = build_worker_app(db, video_dir=video_dir)
+    server = TestServer(app)
+    run(server.start_server())
+    base = str(server.make_url(""))
+    clients = []
+
+    def new_client(name: str) -> WorkerAPIClient:
+        key = run(WorkerAPIClient.register(base, name, accelerator="tpu"))
+        client = WorkerAPIClient(base, key, timeout=30.0, retries=1)
+        clients.append(client)
+        return client
+
+    yield {"base": base, "video_dir": video_dir, "db": db,
+           "new_client": new_client}
+    for c in clients:
+        run(c.aclose())
+    run(server.close())
+
+
+def test_stale_epoch_checkpoint_rejected_409(run, db, tmp_path, api):
+    """Acceptance: a stale-epoch checkpoint upload bounces 409 — a
+    zombie incarnation cannot overwrite the successor's checkpoint."""
+    client = api["new_client"]("ck1")
+    src = make_y4m(tmp_path / "c.y4m", n_frames=8, width=64, height=48)
+    video = run(vids.create_video(db, "Ckpt", source_path=str(src)))
+    run(claims.enqueue_job(db, video["id"]))
+    claimed = run(client.claim(["transcode"], "tpu"))
+    job_id = claimed["job"]["id"]
+
+    run(client.progress(job_id, checkpoint={"files": 3, "bytes": 123}))
+    row = run(db.fetch_one("SELECT last_checkpoint FROM jobs WHERE id=:id",
+                           {"id": job_id}))
+    assert '"files": 3' in row["last_checkpoint"]
+
+    failpoints.arm("claim.fence", count=1)     # next fenced write is stale
+    with pytest.raises(ClaimLost):
+        run(client.progress(job_id, checkpoint={"files": 4}))
+    row = run(db.fetch_one("SELECT last_checkpoint FROM jobs WHERE id=:id",
+                           {"id": job_id}))
+    assert '"files": 3' in row["last_checkpoint"]   # unchanged
+
+
+def test_uploader_posts_incremental_checkpoints(run, db, tmp_path, api):
+    client = api["new_client"]("ck2")
+    src = make_y4m(tmp_path / "u.y4m", n_frames=8, width=64, height=48)
+    video = run(vids.create_video(db, "Incr", source_path=str(src)))
+    run(claims.enqueue_job(db, video["id"]))
+    run(client.claim(["transcode"], "tpu"))
+
+    root = tmp_path / "out"
+    (root / "360p").mkdir(parents=True)
+    (root / "360p" / "segment_00001.m4s").write_bytes(b"a" * 64)
+    seen = []
+
+    async def on_ckpt(summary):
+        seen.append(summary)
+
+    async def go():
+        up = StreamingUploader(client, video["id"], root, poll_s=0.05,
+                               on_checkpoint=on_ckpt)
+        task = asyncio.create_task(up.run())
+        for _ in range(100):
+            if seen:
+                break
+            await asyncio.sleep(0.05)
+        up.stop()
+        await task
+        assert seen and seen[0]["files"] == 1
+        # the flush ships late files AND the deferred rc journal
+        (root / "360p" / "segment_00002.m4s").write_bytes(b"b" * 32)
+        (root / "rc_journal.jsonl").write_text('{"v":1}\n')
+        files, nbytes = await up.flush()
+        assert files == 2 and nbytes == 32 + len('{"v":1}\n')
+        have = await client.upload_status(video["id"])
+        assert "360p/segment_00002.m4s" in have
+        # the journal reaches the server but stays OUT of the published
+        # inventory/manifest (run state, not an artifact)
+        assert "rc_journal.jsonl" not in have
+        assert (api["video_dir"] / video["slug"]
+                / "rc_journal.jsonl").exists()
+        # checkpoint.upload failpoint fails the checkpoint post
+        failpoints.arm("checkpoint.upload", count=1)
+        with pytest.raises(failpoints.FailpointError):
+            await up._checkpoint()
+
+    run(go())
+
+
+def _server_manifest(api, slug):
+    from vlog_tpu.storage import integrity
+
+    return integrity.load_manifest(api["video_dir"] / slug)
+
+
+def test_cross_worker_resume_end_to_end(run, db, tmp_path, api, monkeypatch):
+    """THE acceptance chaos test: worker A is preempted mid-ladder, a
+    second worker resumes from the uploaded partials and publishes a
+    manifest-verified tree byte-identical to an uninterrupted run, with
+    the resumed attempt re-encoding strictly fewer segments."""
+    # small aligned batches on the virtual 8-device mesh: intra mode
+    # gives 8-frame dispatches; 0.5 s @ 8 fps = 4-frame segments, so
+    # resume points land every 2 segments
+    monkeypatch.setattr(config, "GOP_MODE", "intra")
+    monkeypatch.setattr(config, "SEGMENT_DURATION_S", 0.5)
+
+    frames = make_y4m(tmp_path / "content.y4m", n_frames=24, width=128,
+                      height=96, fps=8).read_bytes()
+    (tmp_path / "ctrl.y4m").write_bytes(frames)
+    (tmp_path / "prmt.y4m").write_bytes(frames)
+
+    results = {}
+    import vlog_tpu.worker.pipeline as pl
+
+    real_process = pl.process_video
+
+    def spying_process(source, out_dir, **kw):
+        # stretch each batch boundary so the drain cancel (cooperative,
+        # delivered via the progress callback) deterministically lands
+        # before the tiny test ladder finishes on its own
+        orig_cb = kw.get("progress_cb")
+
+        def throttled_cb(done, total, msg):
+            time.sleep(0.5)
+            if orig_cb is not None:
+                orig_cb(done, total, msg)
+
+        kw["progress_cb"] = throttled_cb
+        res = real_process(source, out_dir, **kw)
+        from pathlib import Path as _P
+
+        results[_P(source).name] = res   # workers stage sources in scratch
+        return res
+
+    monkeypatch.setattr(pl, "process_video", spying_process)
+
+    # ---- control: uninterrupted run ------------------------------------
+    ctrl = run(vids.create_video(db, "Control",
+                                 source_path=str(tmp_path / "ctrl.y4m")))
+    run(claims.enqueue_job(db, ctrl["id"]))
+    wc = RemoteWorker(api["new_client"]("ctrlw"), name="ctrlw",
+                      work_dir=tmp_path / "wc", kinds=(JobKind.TRANSCODE,),
+                      progress_min_interval_s=0.0)
+    assert run(wc.poll_once()) is True
+    assert run(vids.get_video(db, ctrl["id"]))["status"] == "ready"
+    ctrl_manifest = _server_manifest(api, ctrl["slug"])
+    assert ctrl_manifest
+
+    # ---- worker A: preempted mid-ladder --------------------------------
+    prmt = run(vids.create_video(db, "Preempted",
+                                 source_path=str(tmp_path / "prmt.y4m")))
+    run(claims.enqueue_job(db, prmt["id"]))
+    job = run(db.fetch_one("SELECT id FROM jobs WHERE video_id=:v",
+                           {"v": prmt["id"]}))
+    wa = RemoteWorker(api["new_client"]("wa"), name="wa",
+                      work_dir=tmp_path / "wa", kinds=(JobKind.TRANSCODE,),
+                      progress_min_interval_s=0.0,
+                      drain_grace_s=0.0, drain_tick_s=0.02)
+
+    async def run_a():
+        task = asyncio.create_task(wa.poll_once())
+        marker = (tmp_path / "wa" / prmt["slug"] / "out" / "360p"
+                  / "segment_00002.m4s")
+        for _ in range(1200):                       # <= 60 s
+            if marker.exists():
+                break
+            await asyncio.sleep(0.05)
+        assert marker.exists(), "worker A never reached segment 2"
+        wa.begin_drain("chaos eviction")            # grace 0: cancel now
+        assert await asyncio.wait_for(task, 60.0) is True
+        await asyncio.wait_for(wa._drain_task, 10.0)
+
+    run(run_a())
+    row = run(db.fetch_one("SELECT * FROM jobs WHERE id=:id",
+                           {"id": job["id"]}))
+    assert row["claimed_by"] is None and row["attempt"] == 0   # refunded
+    hist = run(claims.get_failure_history(db, job["id"]))
+    assert hist[-1]["failure_class"] == "preempted"
+    assert row["last_checkpoint"] and row["last_checkpoint"] != "{}"
+    srv_tree = api["video_dir"] / prmt["slug"]
+    assert (srv_tree / "rc_journal.jsonl").exists()
+    uploaded_segs = list((srv_tree / "360p").glob("segment_*.m4s"))
+    assert uploaded_segs, "no partial segments reached the server"
+
+    # ---- worker B: cross-worker resume ---------------------------------
+    skipped_before = metric_value("vlog_resume_segments_skipped_total")
+    wb = RemoteWorker(api["new_client"]("wb"), name="wb",
+                      work_dir=tmp_path / "wb", kinds=(JobKind.TRANSCODE,),       # fresh machine
+                      progress_min_interval_s=0.0)
+    assert run(wb.poll_once()) is True
+    assert run(vids.get_video(db, prmt["id"]))["status"] == "ready"
+
+    res_b = results["prmt.y4m"]          # A never finished: B's result
+    total_segs = sum(r.segment_count for r in res_b.run.rungs)
+    assert res_b.run.resumed_segments >= 2, \
+        "successor re-encoded everything — resume did not engage"
+    assert res_b.run.resumed_segments < total_segs
+    assert metric_value("vlog_resume_segments_skipped_total") \
+        >= skipped_before + 2
+
+    # byte-identity: the resumed tree equals the uninterrupted run's,
+    # file for file (manifest digests cover every published byte)
+    prmt_manifest = _server_manifest(api, prmt["slug"])
+    assert prmt_manifest.keys() == ctrl_manifest.keys()
+    diff = [k for k in ctrl_manifest
+            if ctrl_manifest[k]["sha256"] != prmt_manifest[k]["sha256"]]
+    assert not diff, f"resumed tree diverged from control: {diff}"
+
+
+def test_corrupt_journal_degrades_to_shorter_prefix(tmp_path):
+    """The prefetch path skips digest verification on the strength of
+    the journal parser: valid-JSON-but-wrong-shape lines (a corrupted
+    hop) must shorten the replayable prefix, never crash the attempt."""
+    from vlog_tpu.backends import rc_journal as rcj
+
+    p = tmp_path / "rc_journal.jsonl"
+    header = rcj.make_header(batch_n=8, depth=2, frames_per_seg=4,
+                             gop_len=1, rungs=["360p"], tag="t")
+    good = {"k": 0, "obs": {"360p": {"bytes": 10, "frames": 8,
+                                     "qps": [30] * 8, "cost": None}}}
+    import json as _json
+
+    p.write_text("\n".join([_json.dumps(header), _json.dumps(good),
+                            '{"k": 1}', "garbage"]) + "\n")
+    loaded = rcj.load_journal(p)
+    assert loaded is not None
+    assert loaded[0] == header and list(loaded[1]) == [0]
+    # 4 segments scanned = 16 frames, but the journal only covers batch
+    # 0 -> the resume point clamps to 2 segments / 1 batch
+    seg, batch = rcj.aligned_resume_point(
+        4, frames_per_seg=4, batch_n=8, entries=loaded[1], rungs=["360p"])
+    assert (seg, batch) == (2, 1)
+    # a journal that is not even a JSON object is rejected whole
+    p.write_text('["not", "a", "header"]\n')
+    assert rcj.load_journal(p) is None
+
+
+# --------------------------------------------------------------------------
+# Registry / docs agreement (the PR 7-8 lint pattern, preemption edition)
+# --------------------------------------------------------------------------
+
+class TestPreemptionAgreement:
+    KNOBS = ("VLOG_DRAIN_GRACE_S", "VLOG_PREEMPTION_FILE",
+             "VLOG_PREEMPTION_URL", "VLOG_PREEMPTION_POLL_S")
+    METRICS = ("vlog_worker_draining", "vlog_drain_seconds",
+               "vlog_resume_segments_skipped_total")
+    SITES = ("preempt.notice", "drain.deadline", "checkpoint.upload")
+    SPANS = ("worker.drain", "worker.preempted", "worker.resume")
+
+    def test_preempted_has_a_classification_site(self):
+        """The PR-7 failure-class agreement rule, extended: PREEMPTED
+        must be assigned somewhere outside enums.py (both workers
+        classify the drain-deadline cancel into it)."""
+        from pathlib import Path
+
+        pkg = Path(__file__).parent.parent / "vlog_tpu"
+        hits = [p for p in pkg.rglob("*.py")
+                if p.name != "enums.py"
+                and "FailureClass.PREEMPTED" in p.read_text()]
+        assert hits, "no classification site assigns FailureClass.PREEMPTED"
+
+    def test_knobs_parsed_and_documented(self):
+        from vlog_tpu.analysis import registry as reg
+
+        reg.assert_knobs(self.KNOBS)
+        assert isinstance(config.DRAIN_GRACE_S, float)
+        assert isinstance(config.PREEMPTION_POLL_S, float)
+
+    def test_metrics_registered_and_documented(self):
+        from vlog_tpu.analysis import registry as reg
+
+        reg.assert_metric_families(self.METRICS)
+
+    def test_failpoints_registered_and_armable(self):
+        from vlog_tpu.analysis import registry as reg
+
+        reg.assert_failpoint_sites(self.SITES)
+        armed = failpoints.arm_from_spec(
+            "preempt.notice=1,drain.deadline=1,checkpoint.upload=1")
+        assert set(armed) == set(self.SITES)
+        failpoints.reset()
+
+    def test_spans_emitted_and_documented(self):
+        from vlog_tpu.analysis import registry as reg
+
+        reg.assert_span_names(self.SPANS)
+
+    def test_drain_command_known_and_worker_scope_linted(self):
+        from vlog_tpu.analysis.asyncblock import SCOPED_DIRS
+        from vlog_tpu.jobs.commands import KNOWN_COMMANDS
+
+        assert "drain" in KNOWN_COMMANDS
+        assert "worker" in SCOPED_DIRS

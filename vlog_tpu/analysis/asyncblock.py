@@ -32,7 +32,13 @@ by hand). If a plane grows a metadata call on a network filesystem's
 critical path, offload it anyway; the lint is a floor, not the
 ceiling.
 
-Scope: modules under ``api/``, ``delivery/``, ``web/``.
+Scope: modules under ``api/``, ``delivery/``, ``web/``, and — since the
+preemption-tolerant drain plane — ``worker/``. Worker processes are
+event-loop servers too: the same loop runs lease heartbeats, the drain
+supervisor, the incremental-checkpoint uploader, and the health server's
+readiness answers, so a blocking call there stalls exactly the writes
+that keep a draining job from being swept (compute is fine — it runs on
+threads via ``_run_with_timeout``, outside any ``async def``).
 """
 
 from __future__ import annotations
@@ -43,7 +49,7 @@ from vlog_tpu.analysis.core import Finding, Module, dotted_name
 
 RULE = "asyncblock"
 
-SCOPED_DIRS = frozenset({"api", "delivery", "web"})
+SCOPED_DIRS = frozenset({"api", "delivery", "web", "worker"})
 
 # fully-dotted blocking calls (module attribute form)
 _BLOCKING_DOTTED = {

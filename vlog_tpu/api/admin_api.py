@@ -1142,6 +1142,23 @@ async def list_worker_commands(request: web.Request) -> web.Response:
     return web.json_response({"commands": rows})
 
 
+async def drain_worker(request: web.Request) -> web.Response:
+    """Queue a grace-budgeted drain: the worker stops claiming, finishes
+    or checkpoints in-flight work, releases its claims, and exits —
+    operators evacuate a host without shelling into it. Sugar over the
+    command channel (jobs/commands): the worker's next heartbeat tick
+    picks the ``drain`` command up via ``drain_for_worker``."""
+    from vlog_tpu.jobs import commands as cmds
+
+    try:
+        cmd_id = await cmds.send_command(
+            request.app[DB], request.match_info["name"], "drain", {})
+    except ValueError as exc:
+        return _json_error(400, str(exc))
+    return web.json_response({"command_id": cmd_id, "command": "drain"},
+                             status=201)
+
+
 async def revoke_worker(request: web.Request) -> web.Response:
     db = request.app[DB]
     name = request.match_info["name"]
@@ -1395,6 +1412,7 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_delete("/api/webhooks/{webhook_id:\\d+}", delete_webhook)
     r.add_get("/api/workers", list_workers)
     r.add_post("/api/workers/{name}/revoke", revoke_worker)
+    r.add_post("/api/workers/{name}/drain", drain_worker)
     r.add_post("/api/workers/{name}/command", send_worker_command)
     r.add_get("/api/workers/{name}/commands", list_worker_commands)
     r.add_get("/api/videos/{video_id:\\d+}/chapters", get_chapters)

@@ -158,12 +158,15 @@ async def heartbeat(request: web.Request) -> web.Response:
     ident = request[IDENTITY]
     await db.execute(
         """
-        UPDATE workers SET last_heartbeat_at=:t, status='active',
+        UPDATE workers SET last_heartbeat_at=:t, status=:st,
                capabilities=COALESCE(:c, capabilities),
                code_version=COALESCE(:v, code_version)
         WHERE name=:n
         """,
         {"t": db_now(), "n": ident.worker_name,
+         # a draining worker is online-but-not-claimable: a distinct
+         # fleet state the workers table / admin UI must show
+         "st": "draining" if body.get("draining") else "active",
          "c": json.dumps(body["capabilities"]) if body.get("capabilities")
               else None,
          "v": body.get("code_version")})
@@ -447,6 +450,31 @@ async def download_source(request: web.Request) -> web.StreamResponse:
     return web.FileResponse(path, headers={
         "X-Source-Name": path.name,
         "Content-Disposition": f'attachment; filename="{path.name}"'})
+
+
+async def download_output(request: web.Request) -> web.StreamResponse:
+    """Partial-output download for cross-worker resume.
+
+    A successor claiming a preempted job prefetches the predecessor's
+    uploaded, digest-verified segments (plus the rate-control journal)
+    so the ladder continues instead of restarting. Gated exactly like
+    the source download: only the active claim holder may read, and the
+    path gets the upload-side sanitization."""
+    db = request.app[DB]
+    ident = request[IDENTITY]
+    video_id = int(request.match_info["video_id"])
+    if not await _worker_holds_claim(db, ident.worker_name, video_id):
+        return _json_error(403, "no active claim on this video")
+    video = await vids.get_video(db, video_id)
+    if video is None:
+        return _json_error(404, "no such video")
+    rel = _safe_relpath(request.match_info["tail"])
+    if rel is None:
+        return _json_error(400, "bad output path")
+    path = request.app[VIDEO_DIR] / video["slug"] / rel
+    if not path.is_file():
+        return _json_error(404, "no such output file")
+    return web.FileResponse(path)
 
 
 def _safe_relpath(tail: str) -> Path | None:
@@ -773,6 +801,8 @@ def build_worker_app(db: Database, video_dir: Path | None = None) -> web.Applica
     app.router.add_post("/api/worker/jobs/{job_id:\\d+}/release", release)
     app.router.add_post("/api/worker/jobs/{job_id:\\d+}/spans", post_spans)
     app.router.add_get("/api/worker/source/{video_id:\\d+}", download_source)
+    app.router.add_get("/api/worker/output/{video_id:\\d+}/{tail:.+}",
+                       download_output)
     app.router.add_put("/api/worker/upload/{video_id:\\d+}/{tail:.+}", upload)
     app.router.add_get("/api/worker/upload/{video_id:\\d+}/status",
                        upload_status)

@@ -130,6 +130,11 @@ class RunResult:
     # chain length the run actually used (plan_for's segment-divisor
     # logic may pick a different value than config.GOP_LEN; 1 = intra)
     gop_len: int = 1
+    # segments (summed across rungs) this run accepted from disk via
+    # digest/structure-verified resume instead of re-encoding — the
+    # bounded-loss accounting preemption-tolerant workers assert on
+    # (vlog_resume_segments_skipped_total)
+    resumed_segments: int = 0
 
 
 # progress_cb(frames_done, frames_total, message)

@@ -361,4 +361,5 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         variants=variants, fps=fps,
         segment_duration_s=plan.segment_duration_s,
         stage_s={k: round(v, 3) for k, v in prof.items()} | pipe.gauges(),
-        gop_len=plan.gop_len)
+        gop_len=plan.gop_len,
+        resumed_segments=start_segment * len(plan.rungs))

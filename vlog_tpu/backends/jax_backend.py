@@ -267,11 +267,19 @@ class JaxBackend:
                          ts_mode, seg_ext, encoders, tracks, seg_counts,
                          seg_durs, bytes_written, psnr_acc,
                          init_matched) -> RunResult:
+        # Resume CANDIDATE from the on-disk segment scan. The definitive
+        # resume point is fixed below once the dispatch batch size is
+        # known: byte-identical resume must land on a batch boundary the
+        # rate-control journal can replay (backends/rc_journal.py), so
+        # the candidate may be clamped down — or to zero (cold restart,
+        # still deterministic) when the journal is missing or from a
+        # differently-configured run.
         start_segment = 0
+        resume_per_rung: dict[str, list[int]] | None = None
         if resume and not ts_mode and src.exact_seek:
-            start_segment = self._resume_scan(plan, out, timescale,
-                                              seg_counts, seg_durs,
-                                              bytes_written, init_matched)
+            resume_per_rung = self._scan_resume_candidates(plan, out,
+                                                           init_matched)
+            start_segment = min(len(d) for d in resume_per_rung.values())
         start_frame = start_segment * frames_per_seg
 
         pending: dict[str, list[Sample]] = {r.name: [] for r in plan.rungs}
@@ -435,6 +443,61 @@ class JaxBackend:
         rungs_by_name = {r.name: r for r in plan.rungs}
         rc = LaggedRateControl(controllers)
 
+        # --- definitive resume point + rate-control journal. The scan
+        # candidate is clamped to a segment boundary that is ALSO a
+        # dispatch-batch boundary with a complete journal prefix; the
+        # journal then replays the original run's rate-control schedule
+        # so the resumed segments encode byte-identically (the
+        # cross-worker hand-off contract — a successor must continue
+        # the tree the uploaded digests already describe).
+        from vlog_tpu.backends import rc_journal as rcj
+
+        journal = None
+        depth = config.PIPELINE_DEPTH
+        start_batch = 0
+        if not ts_mode:
+            jpath = out / rcj.RC_JOURNAL_NAME
+            header = rcj.make_header(
+                batch_n=batch_n, depth=depth,
+                frames_per_seg=frames_per_seg, gop_len=plan.gop_len,
+                rungs=[r.name for r in plan.rungs],
+                tag=(f"h264:{config.H264_ENTROPY}"
+                     f":deblock={int(config.H264_DEBLOCK and plan.gop_len > 1)}"))
+            if start_segment > 0:
+                loaded = rcj.load_journal(jpath)
+                entries = (loaded[1] if loaded is not None
+                           and loaded[0] == header else {})
+                a_seg, a_batch = rcj.aligned_resume_point(
+                    start_segment, frames_per_seg=frames_per_seg,
+                    batch_n=batch_n, entries=entries,
+                    rungs=header["rungs"])
+                if a_batch > 0:
+                    # byte-identical resume: replay the journal so the
+                    # controllers continue the original timeline
+                    start_segment, start_batch = a_seg, a_batch
+                    rc.replay(entries, start_batch, header["depth"])
+                else:
+                    # no replayable aligned point (journal missing, or
+                    # batch padding outruns the tree): legacy resume —
+                    # completed segments still skip re-encoding, but the
+                    # controllers start cold, so the remaining segments
+                    # are valid-not-identical. The journal is stamped
+                    # with the resumed frame origin so a later run can
+                    # never mistake it for the original timeline.
+                    header = {**header,
+                              "origin_frame": start_segment * frames_per_seg}
+                self._apply_resume_state(
+                    plan, resume_per_rung, start_segment, timescale,
+                    seg_counts, seg_durs, bytes_written)
+            journal = rcj.RCJournal(jpath, header, keep_batches=start_batch)
+            start_frame = start_segment * frames_per_seg
+            frames_done = start_frame
+        if plan.thumbnail and start_segment > 0 \
+                and (out / "thumbnail.jpg").exists():
+            # resumed run: keep the original first-batch thumbnail — a
+            # mid-stream frame would break tree byte-identity
+            thumb_path = str(out / "thumbnail.jpg")
+
         def wait_device(batch):
             jax.block_until_ready(batch.outs)   # device compute, all rungs
 
@@ -515,6 +578,10 @@ class JaxBackend:
             rc.post(name, batch.index, nbytes=batch_bytes,
                     frames=max(n_frames, 1), frame_qps=rc_mix,
                     cost=cost_sum)
+            if journal is not None:
+                journal.record(batch.index, name, nbytes=batch_bytes,
+                               frames=max(n_frames, 1), qps=rc_mix,
+                               cost=cost_sum)
             pipe.prof_add("entropy_s", time.perf_counter() - te)
             tw = time.perf_counter()
             while len(pending[name]) >= frames_per_seg:
@@ -555,6 +622,9 @@ class JaxBackend:
                 batch_bytes += len(ef.avcc)
             rc.post(name, batch.index, nbytes=batch_bytes, frames=n_real,
                     frame_qps=q_used)
+            if journal is not None:
+                journal.record(batch.index, name, nbytes=batch_bytes,
+                               frames=n_real, qps=q_used, cost=None)
             pipe.prof_add("entropy_s", time.perf_counter() - te)
             tw = time.perf_counter()
             while len(pending[name]) >= frames_per_seg:
@@ -660,6 +730,8 @@ class JaxBackend:
             decode_thread.join(timeout=10)
             pipe.close()
             src.close()
+            if journal is not None:
+                journal.close()
 
         # Inexact (libav) sources: the container's frame count is an
         # estimate — trust the frames actually decoded.
@@ -722,6 +794,7 @@ class JaxBackend:
             stage_s={k: round(v, 3) for k, v in prof.items()}
             | pipe.gauges(),
             gop_len=plan.gop_len,
+            resumed_segments=start_segment * len(plan.rungs),
         )
 
     # ------------------------------------------------------------------
@@ -736,21 +809,37 @@ class JaxBackend:
         from a run with a different init (entropy mode, QP base, SPS
         shape changed between runs) cannot be appended to — they
         reference another PPS — so such rungs restart from segment 0."""
+        per_rung = self._scan_resume_candidates(plan, out, init_matched)
+        start_segment = min(len(d) for d in per_rung.values())
+        self._apply_resume_state(plan, per_rung, start_segment, timescale,
+                                 seg_counts, seg_durs, bytes_written)
+        return start_segment
+
+    def _scan_resume_candidates(self, plan, out, init_matched
+                                ) -> dict[str, list[int]]:
+        """Per-rung timescale durations of the contiguous valid segments
+        on disk (the scan half of :meth:`_resume_scan`; the H.264 path
+        applies state separately so the resume point can first be
+        clamped to a journal-replayable batch boundary)."""
         per_rung = {}
         for r in plan.rungs:
             existing = self._existing_segments(out / r.name)
             if existing and not init_matched.get(r.name, False):
                 existing = []
             per_rung[r.name] = existing
-        start_segment = min(len(d) for d in per_rung.values())
+        return per_rung
+
+    @staticmethod
+    def _apply_resume_state(plan, per_rung, start_segment, timescale,
+                            seg_counts, seg_durs, bytes_written) -> None:
+        """Install the resumed prefix into the run's per-rung state."""
         for rung in plan.rungs:
             durs = per_rung[rung.name][:start_segment]
             seg_counts[rung.name] = start_segment
             seg_durs[rung.name] = [d / timescale for d in durs]
             for i in range(start_segment):
-                seg = out / rung.name / f"segment_{i + 1:05d}.m4s"
+                seg = plan.out_dir / rung.name / f"segment_{i + 1:05d}.m4s"
                 bytes_written[rung.name] += seg.stat().st_size
-        return start_segment
 
     @staticmethod
     def _existing_segments(rdir: Path) -> list[int]:

@@ -1,0 +1,213 @@
+"""Persistent rate-control journal: byte-identical mid-stream resume.
+
+The ladder's output bytes after segment K depend on more than the
+pixels: the rate controllers carry cross-segment state (per-QP rate
+estimates, the debt integral, proxy calibration) and the pipeline
+applies their observations on a fixed lag schedule
+(parallel/executor.py LaggedRateControl). A resumed run that restarts
+the controllers cold therefore re-encodes the remaining segments with
+*different* QP plans — valid output, but not the bytes the
+uninterrupted run would have produced, which breaks the cross-worker
+hand-off contract (a successor must continue the tree the manifest
+digests already describe).
+
+This journal closes that gap. The backend appends one canonical JSON
+line per *dispatch batch* recording exactly what each rung's consumer
+posted to the rate controller (achieved bytes, frame count, the plan-QP
+mix, the device bit-proxy cost sum). On resume,
+``LaggedRateControl.replay`` re-runs the dispatch schedule against the
+journal — same lag, same hunting drains — so the controllers reach the
+exact state the original run had when planning the first resumed batch,
+and every subsequent segment encodes byte-identically.
+
+Canonical format (order-independent of consumer-thread interleaving —
+the file itself must be byte-reproducible so published trees stay
+digest-comparable):
+
+- line 1: the header — run parameters that must match for a replay to
+  be meaningful (batch size, pipeline depth, frames per segment, GOP
+  length, rung names, encoder config tag). A mismatch (config changed
+  between runs) discards the journal and the run restarts cold, which
+  is still deterministic.
+- line N+2: batch N's observations for every rung, written only once
+  ALL rungs have posted for that batch, rung keys sorted.
+
+A torn tail line (host died mid-append) is detected by the JSON parse
+and dropped; the contiguous prefix is what resume may use. The journal
+rides the output tree, so the remote streaming uploader ships it with
+the segments and a successor on a different machine can prefetch it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+
+# Canonical name lives with the manifest-exclusion rule: the journal is
+# run state (depth/mesh-shaped bytes), never a published artifact, so
+# build_manifest skips it and tree byte-identity contracts ignore it.
+from vlog_tpu.storage.integrity import RC_JOURNAL_NAME
+
+__all__ = ["RC_JOURNAL_NAME", "RCJournal", "aligned_resume_point",
+           "load_journal", "make_header"]
+
+
+def make_header(*, batch_n: int, depth: int, frames_per_seg: int,
+                gop_len: int, rungs: list[str], tag: str) -> dict:
+    """The run-parameter fingerprint a resume must match exactly.
+
+    ``origin_frame`` 0 marks the original timeline; a legacy
+    (non-batch-aligned) resume stamps the frame it restarted from, so a
+    later resume can never replay its entries as if they were the
+    uninterrupted run's."""
+    return {"v": 1, "batch_n": int(batch_n), "depth": int(depth),
+            "frames_per_seg": int(frames_per_seg), "gop_len": int(gop_len),
+            "rungs": list(rungs), "tag": tag, "origin_frame": 0}
+
+
+def _dump(obj: dict) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+class RCJournal:
+    """Append-side of the journal (one per run; consumer threads call
+    :meth:`record`, batches flush in index order once complete)."""
+
+    def __init__(self, path: Path, header: dict, *, keep_batches: int = 0):
+        self.path = Path(path)
+        self.header = header
+        # a resumed run's pipeline re-indexes batches from 0; the
+        # journal keeps the ORIGINAL timeline so a third resume (or a
+        # digest comparison against an uninterrupted run) lines up
+        self.index_offset = int(keep_batches)
+        self._lock = threading.Lock()
+        # out-of-order completion buffer: batch index -> {rung: obs}
+        self._buf: dict[int, dict] = {}          # guarded-by: _lock
+        self._next = int(keep_batches)           # guarded-by: _lock
+        self._fp = None                          # guarded-by: _lock
+        self._rewrite(keep_batches)
+
+    def _rewrite(self, keep_batches: int) -> None:
+        """Start (or truncate) the on-disk journal: header plus the
+        replayed prefix — entries past the resume point belong to a
+        timeline the resumed run is about to re-encode."""
+        prefix: list[str] = []
+        if keep_batches > 0:
+            loaded = load_journal(self.path)
+            if loaded is not None and loaded[0] == self.header:
+                entries = loaded[1]
+                for k in range(keep_batches):
+                    prefix.append(_dump({"k": k, "obs": entries[k]}))
+        tmp = self.path.with_suffix(".jsonl.tmp")
+        with open(tmp, "w") as fp:
+            fp.write(_dump(self.header) + "\n")
+            for line in prefix:
+                fp.write(line + "\n")
+        tmp.rename(self.path)
+
+    def record(self, batch_index: int, rung: str, *, nbytes: int,
+               frames: int, qps, cost: float | None) -> None:
+        """Mirror one ``LaggedRateControl.post`` call (consumer thread).
+        ``qps`` is the plan-QP mix array/list or None."""
+        obs = {"bytes": int(nbytes), "frames": int(frames),
+               "qps": None if qps is None else [int(q) for q in qps],
+               "cost": None if cost is None else float(cost)}
+        want = set(self.header["rungs"])
+        batch_index += self.index_offset
+        with self._lock:
+            if batch_index < self._next:
+                return          # replayed prefix: already on disk
+            self._buf.setdefault(batch_index, {})[rung] = obs
+            while set(self._buf.get(self._next, ())) >= want:
+                line = _dump({"k": self._next,
+                              "obs": self._buf.pop(self._next)})
+                if self._fp is None:
+                    self._fp = open(self.path, "a")
+                self._fp.write(line + "\n")
+                self._fp.flush()
+                self._next += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fp is not None:
+                self._fp.close()
+                self._fp = None
+
+
+def _clean_entry(obj) -> tuple[int, dict] | None:
+    """Shape-validate one batch line; None rejects it (corrupt journals
+    must degrade to a shorter replayable prefix / cold restart, never
+    crash the resumed attempt — the prefetch path deliberately skips
+    digest verification on the strength of this parser)."""
+    if not isinstance(obj, dict) or not isinstance(obj.get("k"), int) \
+            or not isinstance(obj.get("obs"), dict):
+        return None
+    for rung, ob in obj["obs"].items():
+        if not isinstance(rung, str) or not isinstance(ob, dict):
+            return None
+        if not isinstance(ob.get("bytes"), int) \
+                or not isinstance(ob.get("frames"), int):
+            return None
+        if ob.get("qps") is not None and not isinstance(ob["qps"], list):
+            return None
+        if ob.get("cost") is not None \
+                and not isinstance(ob["cost"], (int, float)):
+            return None
+    return obj["k"], obj["obs"]
+
+
+def load_journal(path: Path) -> tuple[dict, dict[int, dict]] | None:
+    """Parse a journal: ``(header, {batch_index: {rung: obs}})`` or None.
+    A torn/garbled/malformed tail is dropped; only lines before it count."""
+    path = Path(path)
+    if not path.is_file():
+        return None
+    header: dict | None = None
+    entries: dict[int, dict] = {}
+    try:
+        with open(path) as fp:
+            for line in fp:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    break       # torn tail: stop at the last clean line
+                if header is None:
+                    if not isinstance(obj, dict) or obj.get("v") != 1:
+                        return None
+                    header = obj
+                else:
+                    cleaned = _clean_entry(obj)
+                    if cleaned is None:
+                        break   # malformed tail: same verdict as torn
+                    entries[cleaned[0]] = cleaned[1]
+    except OSError:
+        return None
+    if header is None:
+        return None
+    return header, entries
+
+
+def aligned_resume_point(start_segment: int, *, frames_per_seg: int,
+                         batch_n: int, entries: dict[int, dict],
+                         rungs: list[str]) -> tuple[int, int]:
+    """Clamp a segment-scan resume candidate to the nearest point the
+    journal can actually replay: the resume frame must sit on BOTH a
+    segment and a dispatch-batch boundary (the controllers' state is
+    only well-defined between batches), and the journal must hold a
+    complete observation record for every prior batch. Returns
+    ``(start_segment, start_batch)``; ``(0, 0)`` restarts cold."""
+    want = set(rungs)
+    # contiguous complete journal prefix, in batches
+    complete = 0
+    while set(entries.get(complete, ())) >= want:
+        complete += 1
+    while start_segment > 0:
+        frames = start_segment * frames_per_seg
+        if frames % batch_n == 0 and frames // batch_n <= complete:
+            return start_segment, frames // batch_n
+        start_segment -= 1
+    return 0, 0

@@ -174,6 +174,27 @@ MAX_JOB_ATTEMPTS: int = _env_int("VLOG_MAX_JOB_ATTEMPTS", 3, lo=1, hi=20)
 WORKER_POLL_INTERVAL_S: float = _env_float("VLOG_WORKER_POLL_INTERVAL", 5.0, lo=0.1)
 
 # --------------------------------------------------------------------------
+# Preemption-tolerant drain (worker/drain.py): on SIGTERM or a
+# preemption notice the worker stops claiming, lets in-flight compute
+# finish and flush (leases heartbeat-extended), then force-cancels and
+# requeues anything still running once the grace window lapses.
+# --------------------------------------------------------------------------
+
+# Seconds between the first termination/preemption notice and the
+# force-cancel of still-running jobs. 0 = cancel immediately (the
+# pre-drain SIGTERM behavior). Size it just under the platform's
+# eviction window (k8s terminationGracePeriodSeconds, the TPU/GCE
+# preemption notice lead).
+DRAIN_GRACE_S: float = _env_float("VLOG_DRAIN_GRACE_S", 120.0, lo=0.0)
+# Preemption notice channels; empty = not watched. The file form is a
+# path a node agent touches on eviction notice; the URL form is a
+# metadata endpoint that answers 200 once eviction is scheduled.
+PREEMPTION_FILE: str = _env_str("VLOG_PREEMPTION_FILE", "")
+PREEMPTION_URL: str = _env_str("VLOG_PREEMPTION_URL", "")
+# Notice poll cadence (both channels).
+PREEMPTION_POLL_S: float = _env_float("VLOG_PREEMPTION_POLL_S", 2.0, lo=0.1)
+
+# --------------------------------------------------------------------------
 # Failure plane: retry backoff, circuit breaker, stall watchdog
 # --------------------------------------------------------------------------
 

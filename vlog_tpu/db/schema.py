@@ -431,6 +431,42 @@ MIGRATIONS: list[tuple[int, list[str]]] = [
             " ON job_failures(job_id, id)",
         ],
     ),
+    (
+        8,
+        [
+            # -- preemption-tolerant drain plane -----------------------------
+            # preempted joins the failure taxonomy (enums.FailureClass):
+            # the HOST was evicted (preemption notice / SIGTERM) and the
+            # drain grace lapsed mid-attempt — refunded like device_fault,
+            # no backoff, a successor resumes the uploaded partial tree.
+            # Same rebuild ritual as migration 7 (CHECKs can't be altered
+            # in place on sqlite; re-keying keeps Postgres sequences
+            # ahead of the data).
+            "ALTER TABLE job_failures RENAME TO job_failures_old",
+            "DROP INDEX IF EXISTS idx_job_failures_job",
+            """
+            CREATE TABLE IF NOT EXISTS job_failures (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                job_id INTEGER NOT NULL REFERENCES jobs(id) ON DELETE CASCADE,
+                attempt INTEGER NOT NULL,
+                worker TEXT,
+                error TEXT,
+                failure_class TEXT NOT NULL DEFAULT 'transient',
+                created_at REAL NOT NULL,
+                CHECK (failure_class IN
+                       ('transient','permanent','worker_crash','stalled',
+                        'device_fault','preempted'))
+            )
+            """,
+            "INSERT INTO job_failures (job_id, attempt, worker, error,"
+            " failure_class, created_at)"
+            " SELECT job_id, attempt, worker, error, failure_class,"
+            " created_at FROM job_failures_old ORDER BY id",
+            "DROP TABLE job_failures_old",
+            "CREATE INDEX IF NOT EXISTS idx_job_failures_job"
+            " ON job_failures(job_id, id)",
+        ],
+    ),
 ]
 
 

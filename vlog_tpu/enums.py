@@ -56,6 +56,11 @@ class FailureClass(str, enum.Enum):
       (parallel/faults.py classification) — the job was innocent, so
       ``fail_job`` refunds the attempt instead of burning budget, and the
       scheduler quarantines the offending slot's devices.
+    - PREEMPTED: the HOST was evicted (preemption notice / SIGTERM) and
+      the drain grace window lapsed before the attempt finished
+      (worker/drain.py). The job was innocent here too, so the attempt
+      is refunded (bounded like DEVICE_FAULT) and no backoff is stamped
+      — a successor resumes the uploaded partial tree immediately.
     """
 
     TRANSIENT = "transient"
@@ -63,6 +68,7 @@ class FailureClass(str, enum.Enum):
     WORKER_CRASH = "worker_crash"
     STALLED = "stalled"
     DEVICE_FAULT = "device_fault"
+    PREEMPTED = "preempted"
 
 
 class GCTarget(str, enum.Enum):

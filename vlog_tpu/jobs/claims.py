@@ -484,26 +484,30 @@ async def fail_job(
     ``job_failures`` row; ``failure_class`` defaults to PERMANENT when
     ``permanent`` else TRANSIENT.
 
-    ``DEVICE_FAULT`` is the innocent-job class: the accelerator (not the
-    input, not the code) failed the attempt, so the attempt counter is
-    REFUNDED and no backoff is stamped — the job goes straight back to
-    the claimable pool while the faulting worker's quarantined devices
-    keep it from immediately re-running on the same sick hardware.
+    ``DEVICE_FAULT`` and ``PREEMPTED`` are the innocent-job classes: the
+    accelerator (not the input, not the code) failed the attempt, or the
+    HOST was evicted mid-attempt (drain grace lapsed) — so the attempt
+    counter is REFUNDED and no backoff is stamped. The job goes straight
+    back to the claimable pool: for device faults the faulting worker's
+    quarantined devices keep it off the same sick hardware; for
+    preemptions the evicting worker has stopped claiming, so a healthy
+    successor resumes the uploaded partial tree.
 
-    The refund is BOUNDED at ``max_attempts`` device-fault attributions
-    per job life: a failure that looks like a device fault on every
-    device it touches (a ladder that deterministically OOMs HBM, a
-    poison input tickling the runtime) is the job's fault after all —
-    past the bound it burns budget like any transient, so it
-    dead-letters instead of livelocking through endless
-    quarantine/heal/refund cycles.
+    Each refund class is BOUNDED at ``max_attempts`` attributions per
+    job life: a failure that looks innocent every single time (a ladder
+    that deterministically OOMs HBM; a job that somehow rides only
+    doomed hosts) is the job's problem after all — past the bound it
+    burns budget like any transient, so it dead-letters instead of
+    livelocking through endless refund cycles.
     """
     if failure_class is None:
         failure_class = (FailureClass.PERMANENT if permanent
                          else FailureClass.TRANSIENT)
     else:
         failure_class = FailureClass(failure_class)
-    refund = failure_class is FailureClass.DEVICE_FAULT and not permanent
+    refund = (failure_class in (FailureClass.DEVICE_FAULT,
+                                FailureClass.PREEMPTED)
+              and not permanent)
     t = db_now()
     async with db.transaction() as tx:
         row = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id", {"id": job_id})
@@ -515,11 +519,11 @@ async def fail_job(
         if refund:
             prior = await tx.fetch_one(
                 "SELECT COUNT(*) AS n FROM job_failures "
-                "WHERE job_id=:j AND failure_class='device_fault'",
-                {"j": job_id})
+                "WHERE job_id=:j AND failure_class=:c",
+                {"j": job_id, "c": failure_class.value})
             if (prior["n"] or 0) >= (row["max_attempts"] or 1):
-                # refund bound reached: this "device fault" follows the
-                # job across devices — charge the job from here on
+                # refund bound reached: this "innocent" failure follows
+                # the job everywhere — charge the job from here on
                 refund = False
         exhausted = permanent or (
             not refund

@@ -16,8 +16,8 @@ from typing import Any, Awaitable, Callable
 
 from vlog_tpu.db.core import Database, Row, now as db_now
 
-KNOWN_COMMANDS = ("ping", "stats", "stop", "get_logs", "get_metrics",
-                  "restart", "update")
+KNOWN_COMMANDS = ("ping", "stats", "stop", "drain", "get_logs",
+                  "get_metrics", "restart", "update")
 
 # async (command, args) -> response dict
 CommandFn = Callable[[str, dict], Awaitable[dict]]

@@ -221,6 +221,22 @@ class RuntimeMetrics:
             "Publish-state answers served stale because the database "
             "was unavailable (coordination-plane brownout)",
             registry=self.registry)
+        # Preemption-tolerant drain plane (worker/drain.py).
+        self.worker_draining = Gauge(
+            "vlog_worker_draining",
+            "1 while this worker is draining (preemption notice, "
+            "SIGTERM, or admin drain)", registry=self.registry)
+        self.drain_seconds = Histogram(
+            "vlog_drain_seconds",
+            "Seconds from drain start until every in-flight claim "
+            "resolved (completed, flushed + requeued, or released)",
+            buckets=(0.5, 2.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0),
+            registry=self.registry)
+        self.resume_segments_skipped = Counter(
+            "vlog_resume_segments_skipped_total",
+            "Ladder segments accepted from a verified partial tree by "
+            "resume instead of re-encoded (summed across rungs)",
+            registry=self.registry)
         # the fires counter must see every fire in the process, wherever
         # the site lives — failpoints stays dependency-free, we observe
         failpoints.add_observer(

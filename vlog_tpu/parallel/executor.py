@@ -403,3 +403,36 @@ class LaggedRateControl:
     def hunting(self) -> bool:
         """True while ANY controller wants the tight (depth-0) loop."""
         return any(c.hunting for c in self._controllers.values())
+
+    def replay(self, entries: dict[int, dict], start_batch: int,
+               depth: int) -> None:
+        """Rebuild controller state from a rate-control journal
+        (backends/rc_journal.py) as if batches ``0..start_batch-1`` had
+        run live: same per-batch apply lag, same hunting drains. After
+        this, planning the resumed run's batch 0 reads exactly the
+        state the uninterrupted run had when planning batch
+        ``start_batch`` — the keystone of byte-identical mid-stream
+        resume.
+
+        ``entries[k][rung]`` carries what :meth:`post` received for
+        batch k (``bytes``/``frames``/``qps``/``cost``). Observations
+        still in flight at the resume point (posted, not yet applied)
+        are re-indexed into the resumed run's batch space so the lag
+        schedule continues seamlessly."""
+        for k in range(start_batch):
+            # mirror the dispatch loop: apply the lagged window, (plan —
+            # pure, nothing to redo), then this batch's consume posts,
+            # then the hunting drain that forces depth 0 mid-calibration
+            self.apply_upto(k - depth)
+            for name, ob in sorted(entries[k].items()):
+                if name not in self._controllers:
+                    continue
+                self.post(name, k, nbytes=ob["bytes"], frames=ob["frames"],
+                          frame_qps=ob.get("qps"), cost=ob.get("cost"))
+            if self.hunting():
+                self.apply_upto(k)
+        with self._lock:
+            for dq in self._pending.values():
+                shifted = [(k - start_batch, *rest) for (k, *rest) in dq]
+                dq.clear()
+                dq.extend(shifted)

@@ -38,6 +38,13 @@ from vlog_tpu.utils.fsio import atomic_write_text
 
 MANIFEST_NAME = "outputs.json"
 MANIFEST_VERSION = 1
+# Rate-control resume journal (backends/rc_journal.py imports this name).
+# Run STATE, not a published artifact: its bytes are shaped by pipeline
+# depth and dispatch-batch (mesh) geometry, so including it in the
+# manifest would break the cross-depth / cross-mesh tree byte-identity
+# contracts. It lives in the tree (and ships at preemption flush) so a
+# successor can prefetch it, but manifests and verify never describe it.
+RC_JOURNAL_NAME = "rc_journal.jsonl"
 
 _CHUNK = 1 << 20
 
@@ -134,7 +141,7 @@ def build_manifest(root: str | Path, *,
         if not p.is_file() or _is_temp(p.name):
             continue
         rel = p.relative_to(root).as_posix()
-        if rel == MANIFEST_NAME:
+        if rel == MANIFEST_NAME or rel == RC_JOURNAL_NAME:
             continue
         if any(rel.startswith(pre) for pre in skip_prefixes):
             continue

@@ -46,6 +46,14 @@ site                where it fires
 ``db.claim``        jobs.claims.claim_job entry — the claim query fails
                     with a synthetic connection error (the
                     coordination-plane brownout path)
+``preempt.notice``  preemption watcher poll (worker/drain.py) — an
+                    armed hit IS the eviction notice: the worker
+                    begins a grace-budgeted drain
+``drain.deadline``  DrainState.expired — forces the drain grace
+                    deadline to fire now (deadline-enforcement chaos)
+``checkpoint.upload``  the remote uploader's incremental checkpoint
+                    post and drain-time flush — the armed write fails,
+                    so the server keeps only what already streamed
 ==================  =====================================================
 
 Every legitimate site name is listed in :data:`SITES`;
@@ -109,6 +117,14 @@ SITES: dict[str, str] = {
                    "a stale X-Claim-Epoch",
     "db.claim": "claim_job entry; the claim query fails with a synthetic "
                 "connection error",
+    "preempt.notice": "preemption watcher poll (worker/drain.py); an armed "
+                      "hit IS the eviction notice — the worker begins "
+                      "draining",
+    "drain.deadline": "DrainState.expired; forces the drain grace deadline "
+                      "to fire now",
+    "checkpoint.upload": "remote uploader's incremental checkpoint post and "
+                         "the drain-time flush; the armed checkpoint write "
+                         "fails",
 }
 
 

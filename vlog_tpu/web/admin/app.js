@@ -844,13 +844,22 @@ async function loadWorkers() {
     });
     acts.append(cmd("ping"), cmd("stats"), cmd("get_logs"),
       cmd("get_metrics"), cmd("restart"), cmd("stop"),
+      // grace-budgeted evacuation: stop claiming, finish/checkpoint
+      // in-flight work, release claims, exit (worker/drain.py)
+      actionBtn("drain", async () => {
+        await api(`/api/workers/${encodeURIComponent(w.name)}/drain`, { method: "POST" });
+        toast(`drain queued for ${w.name}; worker picks it up on its next heartbeat`);
+        setTimeout(loadWorkers, 3000);
+      }),
       actionBtn("revoke", async () => {
         await api(`/api/workers/${encodeURIComponent(w.name)}/revoke`, { method: "POST" });
         toast(`revoked ${w.name}`);
         loadWorkers();
       }));
     cells(tr, [w.name,
-      badge(w.status === "revoked" ? "revoked" : (w.online ? "online" : "offline")),
+      badge(w.status === "revoked" ? "revoked"
+        : (w.status === "draining" && w.online ? "draining"
+          : (w.online ? "online" : "offline"))),
       w.accelerator, fmtAgo(w.last_heartbeat_at),
       w.capabilities.running_jobs != null ? String(w.capabilities.running_jobs) : "—",
       acts]);

@@ -48,6 +48,8 @@ from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind, VideoStatus
 from vlog_tpu.jobs import claims, state as js, videos as vids
 from vlog_tpu.utils import failpoints
 from vlog_tpu.worker.breaker import CircuitBreaker
+from vlog_tpu.worker.drain import (DRAIN_CANCEL_REASON, DrainState,
+                                   PreemptionWatcher)
 from vlog_tpu.worker.watchdog import ComputeWatchdogMixin, JobCancelled
 
 log = logging.getLogger("vlog_tpu.worker")
@@ -167,6 +169,12 @@ class WorkerDaemon(ComputeWatchdogMixin):
     # tests inject a MeshScheduler directly. With slots == 1 (default)
     # the claim loop is the classic one-job-at-a-time poll.
     scheduler: Any = None
+    # Grace-budgeted drain (worker/drain.py): seconds between a
+    # preemption notice / first SIGTERM and the force-cancel of
+    # still-running jobs; the tick paces the drain supervisor loop.
+    drain_grace_s: float = field(
+        default_factory=lambda: config.DRAIN_GRACE_S)
+    drain_tick_s: float = 0.2
 
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
@@ -178,6 +186,8 @@ class WorkerDaemon(ComputeWatchdogMixin):
         self._current_job_id: int | None = None
         self._active_sups: dict[int, JobSupervisor] = {}  # job id -> sup
         self._tasks: set[asyncio.Task] = set()            # slot job tasks
+        self.drain = DrainState()
+        self._drain_task: asyncio.Task | None = None
         if self.breaker is None:
             self.breaker = CircuitBreaker()
         if self.db_breaker is None:
@@ -200,6 +210,106 @@ class WorkerDaemon(ComputeWatchdogMixin):
         self._cancel.set()
         for sup in list(self._active_sups.values()):
             sup.cancel("shutdown")
+
+    def handle_termination(self) -> None:
+        """SIGTERM policy: the first signal starts a grace-budgeted
+        drain (bounded-loss eviction); a second one during the drain
+        skips the grace window — ``kill -TERM`` twice always means now
+        (in-flight claims are force-cancelled and released)."""
+        if self._stop.is_set():
+            return
+        if self.drain.active:
+            log.warning("second termination signal during drain: skipping "
+                        "the grace window, force-cancelling now")
+            self.request_stop()
+        else:
+            self.begin_drain("SIGTERM")
+
+    def begin_drain(self, reason: str) -> bool:
+        """Enter DRAINING: stop granting claims, let in-flight jobs
+        finish and flush under heartbeat-extended leases, force-cancel
+        at the grace deadline, then stop. False if already draining."""
+        if not self.drain.begin(reason, self.drain_grace_s):
+            return False
+        from vlog_tpu.obs.metrics import runtime
+
+        runtime().worker_draining.set(1)
+        log.warning("entering drain (%s): claiming stopped, %d in-flight "
+                    "job(s), grace %.0fs", reason, len(self._active_sups),
+                    self.drain_grace_s)
+        self._drain_task = asyncio.create_task(self._drain_loop())
+        return True
+
+    async def _drain_loop(self) -> None:
+        """The drain supervisor: lease heartbeats while jobs flush, the
+        grace deadline, and the final stop once the worker is empty."""
+        from vlog_tpu.obs.metrics import runtime
+
+        forced = False
+        last_extend = 0.0
+        try:
+            try:
+                await self._heartbeat()     # publish status='draining'
+            except Exception:  # noqa: BLE001 — a DB flap must not skip
+                # the drain itself
+                log.exception("drain heartbeat failed; draining anyway")
+            while not self._stop.is_set():
+                if not self._active_sups and not self._tasks:
+                    break
+                if forced or self.drain.expired():
+                    if not forced:
+                        forced = True
+                        log.warning(
+                            "drain grace exhausted; force-cancelling %d "
+                            "job(s)", len(self._active_sups))
+                    # re-broadcast every tick (idempotent): a claim that
+                    # raced begin_drain registers its supervisor after
+                    # the first broadcast and must still be cancelled
+                    self._cancel_reason = (self._cancel_reason
+                                           or DRAIN_CANCEL_REASON)
+                    self._cancel.set()
+                    for sup in list(self._active_sups.values()):
+                        sup.cancel(DRAIN_CANCEL_REASON)
+                now = time.monotonic()
+                if not forced and now - last_extend >= min(
+                        self.heartbeat_interval_s, 10.0):
+                    last_extend = now
+                    await self._extend_drain_leases()
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(self._stop.wait(),
+                                           self.drain_tick_s)
+        finally:
+            runtime().worker_draining.set(0)
+            runtime().drain_seconds.observe(self.drain.elapsed_s())
+            log.info("drain complete in %.1fs (%s); stopping worker",
+                     self.drain.elapsed_s(),
+                     "deadline forced" if forced else "clean")
+            self.request_stop()
+
+    async def _extend_drain_leases(self) -> None:
+        """Heartbeat-extend every in-flight claim so the expired-claim
+        sweep cannot hand a draining job away mid-flush (compute may
+        legitimately sit between progress posts while it drains)."""
+        for job_id in list(self._active_sups):
+            try:
+                await claims.update_progress(self.db, job_id, self.name,
+                                             extend_lease=True)
+            except js.JobStateError as exc:
+                # the claim is no longer ours (sweep/admin requeue raced
+                # the drain): cancel that job now — keeping it running
+                # only burns grace for writes that can never land
+                log.warning("claim lost during drain (job %s): "
+                            "cancelling: %s", job_id, exc)
+                sup = self._active_sups.get(job_id)
+                if sup is not None:
+                    sup.cancel("claim lost during drain")
+            except Exception:  # noqa: BLE001 — a flap must not kill the
+                # drain loop; the next tick retries
+                log.exception("drain lease extension failed for job %s",
+                              job_id)
+
+    async def _on_preemption_notice(self, reason: str) -> None:
+        self.begin_drain(reason)
 
     def _sup(self) -> ComputeWatchdogMixin:
         """The supervisor for the current job context (self when none —
@@ -243,10 +353,13 @@ class WorkerDaemon(ComputeWatchdogMixin):
                                  code_version, last_heartbeat_at, created_at)
             VALUES (:n, 'local', :a, :c, :v, :t, :t)
             ON CONFLICT (name) DO UPDATE SET accelerator=:a, capabilities=:c,
-                code_version=:v, last_heartbeat_at=:t, status='active'
+                code_version=:v, last_heartbeat_at=:t, status=:st
             """,
             {"n": self.name, "a": self.accelerator.value,
-             "c": json.dumps(caps), "v": config.CODE_VERSION, "t": db_now()},
+             "c": json.dumps(caps), "v": config.CODE_VERSION, "t": db_now(),
+             # 'draining' is a distinct fleet-visible state: online but
+             # deliberately not claimable (admin workers table + stats)
+             "st": "draining" if self.drain.active else "active"},
         )
 
     async def _heartbeat_loop(self) -> None:
@@ -282,7 +395,14 @@ class WorkerDaemon(ComputeWatchdogMixin):
                     "disk_paused": self.disk_paused,
                     "mesh": (self.scheduler.snapshot()
                              if self.scheduler is not None else None),
+                    "draining": {**self.drain.snapshot(),
+                                 "jobs_remaining": len(self._active_sups)},
                     "kinds": [k.value for k in self.kinds]}
+        if command == "drain":
+            started = self.begin_drain("admin drain command")
+            return {"draining": True, "started": started,
+                    "grace_s": self.drain_grace_s,
+                    "jobs_remaining": len(self._active_sups)}
         if command == "stop":
             log.info("remote stop command received")
             # Defer: the response must be written before shutdown starts
@@ -338,6 +458,11 @@ class WorkerDaemon(ComputeWatchdogMixin):
         probe = None
         if self.scheduler is not None and config.DEVICE_PROBE_INTERVAL_S > 0:
             probe = asyncio.create_task(self._device_probe_loop())
+        watcher = None
+        pw = PreemptionWatcher.from_config()
+        if pw is not None:
+            watcher = asyncio.create_task(
+                pw.watch(self._stop, self._on_preemption_notice))
         try:
             while not self._stop.is_set():
                 try:
@@ -380,7 +505,12 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 # in-flight slot jobs: request_stop already broadcast
                 # the cancel; let each hand its claim back
                 await asyncio.gather(*self._tasks, return_exceptions=True)
-            tasks = [t for t in (hb, probe) if t is not None]
+            if self._drain_task is not None:
+                # the drain supervisor owns the drain_seconds accounting;
+                # give it a moment to notice the stop and wind down
+                await asyncio.gather(self._drain_task,
+                                     return_exceptions=True)
+            tasks = [t for t in (hb, probe, watcher) if t is not None]
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
@@ -514,6 +644,10 @@ class WorkerDaemon(ComputeWatchdogMixin):
         from vlog_tpu.db.retry import with_retries
         from vlog_tpu.storage import integrity
 
+        if self.drain.active:
+            # draining: the scheduler grants no new slots — the whole
+            # point is to empty this host before it disappears
+            return None
         # Disk admission BEFORE the breaker: claiming with a full output
         # volume guarantees ENOSPC mid-write — burning an attempt (and,
         # in HALF_OPEN, the probe slot) to learn what a statvfs already
@@ -673,7 +807,20 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 else:
                     att.set_error(err or "dead-lettered")
             except JobCancelled as exc:
-                if self._stop.is_set():
+                if exc.reason.startswith("preempted"):
+                    # Drain deadline: the HOST is being evicted — not a
+                    # compute-health event (no breaker), not the job's
+                    # fault (PREEMPTED refunds the attempt, bounded).
+                    # Whatever the executor flushed before the cancel
+                    # stays on disk for the successor's resume scan.
+                    obs_trace.event("worker.preempted", status="error",
+                                    error=exc.reason,
+                                    grace_s=self.drain_grace_s)
+                    att.attrs["preempted"] = True
+                    att.set_error(exc.reason)
+                    await self._fail(job, video, exc.reason,
+                                     failure_class=FailureClass.PREEMPTED)
+                elif self._stop.is_set():
                     # Graceful shutdown: hand the claim back, attempt
                     # refunded. The lease may have lapsed (or been
                     # reclaimed) while the compute thread wound down — then
@@ -894,6 +1041,12 @@ class WorkerDaemon(ComputeWatchdogMixin):
         # feed this process's /metrics on the worker health port
         obs_trace.record_run_stages(tsp, result.run.stage_s)
         obs_runtime().observe_run(result.run.stage_s)
+        if result.run.resumed_segments:
+            # bounded-loss accounting: segments a preempted (or crashed)
+            # predecessor encoded that this attempt did NOT re-encode
+            tsp.attrs["resumed_segments"] = result.run.resumed_segments
+            obs_runtime().resume_segments_skipped.inc(
+                result.run.resumed_segments)
 
         qualities = [
             {**q, "playlist_path": str(out_dir / q["quality"] / "playlist.m3u8")}
@@ -1111,15 +1264,20 @@ async def _amain(args: argparse.Namespace) -> None:
             return False, f"db unreachable: {exc}"
         return True, "ok"
 
-    from vlog_tpu.worker.health import breaker_check, combine, disk_check
+    from vlog_tpu.worker.health import (breaker_check, combine, disk_check,
+                                        drain_check)
 
     health = WorkerHealthServer(
         combine(db_ready, disk_check(daemon.video_dir, label="output"),
-                breaker_check(daemon.db_breaker)))
+                breaker_check(daemon.db_breaker),
+                drain_check(daemon.drain)))
     await health.start()
     loop = asyncio.get_running_loop()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        loop.add_signal_handler(sig, daemon.request_stop)
+    # SIGTERM = eviction notice: grace-budgeted drain (twice = now).
+    # SIGINT stays immediate — an operator's ^C should not wait out a
+    # drain window.
+    loop.add_signal_handler(signal.SIGTERM, daemon.handle_termination)
+    loop.add_signal_handler(signal.SIGINT, daemon.request_stop)
     log.info("worker %s starting (kinds=%s)", args.name, args.kinds)
     alerts.send_fire_and_forget("worker.startup",
                                 f"worker {args.name} starting")

@@ -56,6 +56,22 @@ def disk_check(path, *, label: str = "scratch") -> ReadyFn:
     return ready
 
 
+def drain_check(drain) -> ReadyFn:
+    """Degrade readiness while the worker drains (worker/drain.py): it
+    is alive and flushing in-flight work, but the orchestrator must
+    stop routing to it and must not count it toward capacity — the
+    liveness/readiness split again, now for planned eviction."""
+
+    async def ready() -> tuple[bool, str]:
+        snap = drain.snapshot()
+        if snap.get("active"):
+            return False, (f"draining: {snap.get('reason') or 'requested'} "
+                           f"({snap.get('grace_left_s', 0):.0f}s grace left)")
+        return True, "ok"
+
+    return ready
+
+
 def breaker_check(breaker, *, label: str = "coordination plane") -> ReadyFn:
     """Degrade readiness while a brownout breaker (worker/brownout.py)
     is open: the worker is alive and probing on backoff, but routing it

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import re
 import shutil
 import signal
 import threading
@@ -36,6 +37,8 @@ from vlog_tpu.storage import integrity
 from vlog_tpu.utils import failpoints
 from vlog_tpu.worker.breaker import CircuitBreaker
 from vlog_tpu.worker.daemon import DaemonStats
+from vlog_tpu.worker.drain import (DRAIN_CANCEL_REASON, DrainState,
+                                   PreemptionWatcher)
 from vlog_tpu.worker.watchdog import ComputeWatchdogMixin, JobCancelled
 
 log = logging.getLogger("vlog_tpu.remote")
@@ -179,9 +182,11 @@ class WorkerAPIClient:
             delay *= 2
         raise TransientAPIError(f"{method} {path}: retries exhausted")
 
-    async def heartbeat(self, capabilities: dict | None = None) -> None:
+    async def heartbeat(self, capabilities: dict | None = None, *,
+                        draining: bool = False) -> None:
         await self._request("POST", "/api/worker/heartbeat",
-                            json={"capabilities": capabilities or {}})
+                            json={"capabilities": capabilities or {},
+                                  "draining": draining})
 
     async def claim(self, kinds: list[str], accelerator: str) -> dict | None:
         failpoints.hit("remote.claim")
@@ -203,11 +208,16 @@ class WorkerAPIClient:
 
     async def progress(self, job_id: int, *, progress: float | None = None,
                        current_step: str | None = None,
-                       qualities: dict | None = None) -> None:
+                       qualities: dict | None = None,
+                       checkpoint: dict | None = None) -> None:
+        """Progress post; extends the lease. ``checkpoint`` lands in the
+        job row's ``last_checkpoint`` — the incremental upload inventory
+        a successor reads after a preemption. Epoch-fenced like every
+        claim-gated write: a stale incarnation's checkpoint gets 409."""
         await self._fenced_request(
             "POST", f"/api/worker/jobs/{job_id}/progress", job_id=job_id,
             json={"progress": progress, "current_step": current_step,
-                  "qualities": qualities})
+                  "qualities": qualities, "checkpoint": checkpoint})
 
     async def complete(self, job_id: int, result: dict) -> None:
         await self._fenced_request(
@@ -237,12 +247,37 @@ class WorkerAPIClient:
             r.raise_for_status()
             name = r.headers.get("X-Source-Name", f"source_{video_id}")
             out = dest / name
-            tmp = out.with_suffix(out.suffix + ".part")
-            with open(tmp, "wb") as fp:
-                async for chunk in r.aiter_bytes(1 << 20):
-                    fp.write(chunk)
-            tmp.rename(out)
+            await self._stream_to(r, out)
             return out
+
+    async def download_output(self, video_id: int, rel: str,
+                              dest: Path) -> Path:
+        """Fetch one server-held output file (the cross-worker resume
+        prefetch: a successor pulls the preempted attempt's verified
+        partial segments before starting compute)."""
+        async with self._client.stream(
+                "GET", f"/api/worker/output/{video_id}/{rel}") as r:
+            if r.status_code == 409:
+                raise ClaimLost((await r.aread())[:300].decode("utf-8",
+                                                               "replace"))
+            r.raise_for_status()
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            await self._stream_to(r, dest)
+            return dest
+
+    @staticmethod
+    async def _stream_to(r, out: Path) -> None:
+        """Drain a streaming response into ``out`` via tmp+rename; file
+        I/O hops to threads (asyncblock: a slow volume must not stall
+        the event loop that is also posting lease heartbeats)."""
+        tmp = out.with_suffix(out.suffix + ".part")
+        fp = await asyncio.to_thread(open, tmp, "wb")
+        try:
+            async for chunk in r.aiter_bytes(1 << 20):
+                await asyncio.to_thread(fp.write, chunk)
+        finally:
+            await asyncio.to_thread(fp.close)
+        await asyncio.to_thread(tmp.rename, out)
 
     async def upload_file(self, video_id: int, rel: str, path: Path) -> str:
         """Stream a file up without buffering it in memory; retries reopen
@@ -265,7 +300,8 @@ class WorkerAPIClient:
             except failpoints.FailpointError:
                 corrupt = True
             first = True
-            with open(path, "rb") as fp:
+            fp = await asyncio.to_thread(open, path, "rb")
+            try:
                 while True:
                     chunk = await asyncio.to_thread(fp.read, _UP_CHUNK)
                     if not chunk:
@@ -276,6 +312,8 @@ class WorkerAPIClient:
                         chunk = bytes([chunk[0] ^ 0xFF]) + chunk[1:]
                     first = False
                     yield chunk
+            finally:
+                await asyncio.to_thread(fp.close)
 
         delay = 0.5
         url = f"/api/worker/upload/{video_id}/{rel}"
@@ -340,7 +378,11 @@ class WorkerAPIClient:
 
 # Manifests/playlists are written last by the backend but must also be
 # uploaded last so the server-side validation pass sees segments first.
-_DEFER = ("master.m3u8", "manifest.mpd")
+# The rate-control journal defers too, for the opposite reason: it is
+# APPEND-ONLY during the run, and the run-loop uploads each path once —
+# shipping it early would freeze a stale prefix on the server. flush()
+# (preemption) and drain() (completion) send it fresh.
+_DEFER = ("master.m3u8", "manifest.mpd", "rc_journal.jsonl")
 
 
 class StreamingUploader:
@@ -351,7 +393,8 @@ class StreamingUploader:
     stability."""
 
     def __init__(self, client: WorkerAPIClient, video_id: int, root: Path,
-                 *, poll_s: float = 1.0, skip_prefixes: tuple[str, ...] = ()):
+                 *, poll_s: float = 1.0, skip_prefixes: tuple[str, ...] = (),
+                 on_checkpoint=None):
         self.client = client
         self.video_id = video_id
         self.root = root
@@ -360,6 +403,17 @@ class StreamingUploader:
         self.uploaded: set[str] = set()
         self.bytes_sent = 0
         self.errors: list[str] = []
+        # async ({files, bytes}) -> None, called after every poll cycle
+        # that shipped at least one file — the incremental-checkpoint
+        # hook (RemoteWorker posts it as the job's last_checkpoint, so
+        # the server knows what it holds the moment this host dies)
+        self.on_checkpoint = on_checkpoint
+        # (size, mtime_ns) of each file resume_state accepted as already
+        # uploaded — if the backend later invalidates and rewrites one
+        # (resumed run under a changed encoder config), the stat changes
+        # and the final sweeps must re-ship it, or the published tree
+        # would silently mix predecessor- and successor-config bytes
+        self._resumed_stat: dict[str, tuple[int, int]] = {}
         self._stop = asyncio.Event()
 
     async def resume_state(self) -> None:
@@ -369,9 +423,15 @@ class StreamingUploader:
         integrity plane) digest-mismatches and gets re-uploaded."""
         have = await self.client.upload_status(self.video_id)
         for rel, meta in have.items():
-            if rel == integrity.MANIFEST_NAME:
-                # never resume the manifest: the tree it must describe
-                # is still changing; drain() rewrites and re-uploads it
+            if rel == integrity.MANIFEST_NAME \
+                    or Path(rel).name == "rc_journal.jsonl":
+                # never resume the integrity manifest (the tree it must
+                # describe is still changing; drain() rewrites it) nor
+                # the rate-control journal (append-only during the run —
+                # a t0 digest match would freeze the stale prefix on the
+                # server). Master/DASH playlists MAY resume: the run
+                # rewrites them at the end, so a changed tree simply
+                # digest-mismatches and re-uploads.
                 continue
             local = self.root / rel
             if not local.exists() \
@@ -381,6 +441,8 @@ class StreamingUploader:
                 integrity.sha256_file, local)
             if local_digest == meta.get("sha256"):
                 self.uploaded.add(rel)
+                st = local.stat()
+                self._resumed_stat[rel] = (st.st_size, st.st_mtime_ns)
 
     def _pending(self, include_deferred: bool) -> list[str]:
         out = []
@@ -406,19 +468,89 @@ class StreamingUploader:
         self.bytes_sent += (self.root / rel).stat().st_size
 
     async def run(self) -> None:
-        """Poll-and-upload until stopped; manifests deferred to drain()."""
+        """Poll-and-upload until stopped; manifests deferred to drain().
+
+        Per-cycle error containment: a transient API outage longer than
+        the client's retry budget must pause streaming for one poll, not
+        silently kill this task for the rest of a multi-hour run (the
+        final drain/flush would then have to ship the whole tree inside
+        the eviction window — the loss this plane exists to bound)."""
         while not self._stop.is_set():
-            for rel in self._pending(include_deferred=False):
-                if self._stop.is_set():
-                    return
-                await self._upload_one(rel)
+            try:
+                shipped = 0
+                for rel in self._pending(include_deferred=False):
+                    if self._stop.is_set():
+                        return
+                    await self._upload_one(rel)
+                    shipped += 1
+                if shipped:
+                    await self._checkpoint()
+            except ClaimLost as exc:
+                # the claim is gone; the compute thread gets the same
+                # verdict from its next progress post — stop streaming
+                log.warning("streaming upload stopped, claim lost: %s", exc)
+                return
+            except Exception as exc:  # noqa: BLE001 — contain, log,
+                # retry next cycle (incl. failpoint-injected checkpoint
+                # faults: segments keep streaming even when checkpoint
+                # posts fail)
+                self.errors.append(str(exc))
+                log.warning("streaming upload cycle failed (retrying "
+                            "next poll): %s", exc)
             try:
                 await asyncio.wait_for(self._stop.wait(), self.poll_s)
             except asyncio.TimeoutError:
                 pass
 
+    async def _checkpoint(self) -> None:
+        """Incremental checkpoint: tell the job plane what the server
+        now verifiably holds (``checkpoint.upload`` is the chaos hook)."""
+        if self.on_checkpoint is None:
+            return
+        failpoints.hit("checkpoint.upload")
+        await self.on_checkpoint({"files": len(self.uploaded),
+                                  "bytes": self.bytes_sent})
+
     def stop(self) -> None:
         self._stop.set()
+
+    def _unmark_rewritten_resumes(self) -> None:
+        """Drop the 'already uploaded' mark from any resumed file the
+        backend rewrote since resume_state (stat changed): a resumed run
+        under a changed encoder config invalidates and re-encodes the
+        prefetched prefix, and those fresh bytes must ship."""
+        for rel, (size, mtime_ns) in list(self._resumed_stat.items()):
+            p = self.root / rel
+            try:
+                st = p.stat()
+                unchanged = (st.st_size, st.st_mtime_ns) == (size, mtime_ns)
+            except OSError:
+                unchanged = False      # deleted: nothing to re-upload,
+                # but it must not linger as "uploaded" either
+            if not unchanged:
+                self.uploaded.discard(rel)
+                self._resumed_stat.pop(rel, None)
+
+    async def flush(self) -> tuple[int, int]:
+        """Preemption flush: stop polling and push every remaining
+        stable file — completed segments, the thumbnail, and the
+        deferred rate-control journal — so the server-side partial tree
+        is as complete as the eviction window allows. Mid-run there are
+        no master/DASH manifests yet, so unlike drain() this publishes
+        nothing a player could follow. Best effort per file: one failed
+        transfer must not forfeit the rest of the eviction window.
+        Returns (files, bytes) shipped."""
+        self.stop()
+        self._unmark_rewritten_resumes()
+        n0, b0 = len(self.uploaded), self.bytes_sent
+        for rel in self._pending(include_deferred=True):
+            try:
+                await self._upload_one(rel)
+            except Exception as exc:  # noqa: BLE001 — keep flushing the
+                # rest; whatever misses, the successor re-encodes
+                self.errors.append(f"{rel}: {exc}")
+                log.warning("preemption flush of %s failed: %s", rel, exc)
+        return len(self.uploaded) - n0, self.bytes_sent - b0
 
     async def drain(self) -> None:
         """Final sweep: remaining files, then the deferred playlists,
@@ -433,6 +565,7 @@ class StreamingUploader:
         earlier job) stays on the server — a digests-only manifest
         would silently shrink verify coverage with every reencode."""
         self.stop()
+        self._unmark_rewritten_resumes()
         for rel in self._pending(include_deferred=False):
             await self._upload_one(rel)
         for rel in self._pending(include_deferred=True):
@@ -483,6 +616,10 @@ class RemoteWorker(ComputeWatchdogMixin):
     # claim loop through an unreachable Worker API instead of fixed-pace
     # hammering; None builds one from config.
     db_breaker: Any = None
+    # Grace-budgeted drain (worker/drain.py), WorkerDaemon parity.
+    drain_grace_s: float = field(
+        default_factory=lambda: config.DRAIN_GRACE_S)
+    drain_tick_s: float = 0.2
 
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
@@ -493,6 +630,9 @@ class RemoteWorker(ComputeWatchdogMixin):
         self._stop = asyncio.Event()
         self._cancel = threading.Event()
         self._cancel_reason = ""
+        self.drain = DrainState()
+        self._drain_task: asyncio.Task | None = None
+        self._current_job_id: int | None = None
         if self.breaker is None:
             self.breaker = CircuitBreaker()
         if self.db_breaker is None:
@@ -509,9 +649,100 @@ class RemoteWorker(ComputeWatchdogMixin):
         self._cancel_reason = self._cancel_reason or "shutdown"
         self._cancel.set()
 
+    def handle_termination(self) -> None:
+        """First SIGTERM: grace-budgeted drain. Second: force-stop now
+        (claims released) — WorkerDaemon parity."""
+        if self._stop.is_set():
+            return
+        if self.drain.active:
+            log.warning("second termination signal during drain: skipping "
+                        "the grace window, force-cancelling now")
+            self.request_stop()
+        else:
+            self.begin_drain("SIGTERM")
+
+    def begin_drain(self, reason: str) -> bool:
+        """Enter DRAINING: no new claims; the in-flight job keeps
+        encoding and streaming segments up, its lease heartbeat-extended,
+        until it finishes or the grace deadline force-cancels it (the
+        cancel path then flushes a final checkpoint and requeues the job
+        as a refunded ``preempted`` failure)."""
+        if not self.drain.begin(reason, self.drain_grace_s):
+            return False
+        obs_runtime().worker_draining.set(1)
+        log.warning("entering drain (%s): claiming stopped, job %s in "
+                    "flight, grace %.0fs", reason, self._current_job_id,
+                    self.drain_grace_s)
+        self._drain_task = asyncio.create_task(self._drain_loop())
+        return True
+
+    async def _drain_loop(self) -> None:
+        forced = False
+        last_extend = 0.0
+        try:
+            try:
+                await self.client.heartbeat(draining=True)
+            except Exception:  # noqa: BLE001 — an API flap must not
+                # skip the drain itself
+                log.warning("drain heartbeat failed; draining anyway",
+                            exc_info=True)
+            while not self._stop.is_set():
+                job_id = self._current_job_id
+                if job_id is None:
+                    break
+                if forced or self.drain.expired():
+                    if not forced:
+                        forced = True
+                        log.warning("drain grace exhausted; "
+                                    "force-cancelling job %s", job_id)
+                    # re-set every tick (idempotent): a claim that raced
+                    # begin_drain clears _cancel at claim time and must
+                    # still see the deadline cancel
+                    self._cancel_reason = (self._cancel_reason
+                                           or DRAIN_CANCEL_REASON)
+                    self._cancel.set()
+                now = time.monotonic()
+                if not forced and now - last_extend >= min(
+                        self.heartbeat_interval_s, 10.0):
+                    last_extend = now
+                    try:
+                        await self.client.progress(job_id)
+                    except ClaimLost as exc:
+                        # the job is no longer ours (sweep/admin requeue
+                        # raced the drain): cancel NOW instead of burning
+                        # the rest of the grace window computing for a
+                        # claim every write will 409
+                        log.warning("claim lost during drain (job %s): "
+                                    "cancelling: %s", job_id, exc)
+                        self._cancel_reason = (self._cancel_reason
+                                               or "claim lost during drain")
+                        self._cancel.set()
+                    except TransientAPIError:
+                        pass    # next tick retries; the lease has slack
+                try:
+                    await asyncio.wait_for(self._stop.wait(),
+                                           self.drain_tick_s)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            obs_runtime().worker_draining.set(0)
+            obs_runtime().drain_seconds.observe(self.drain.elapsed_s())
+            log.info("drain complete in %.1fs (%s); stopping worker",
+                     self.drain.elapsed_s(),
+                     "deadline forced" if forced else "clean")
+            self.request_stop()
+
+    async def _on_preemption_notice(self, reason: str) -> None:
+        self.begin_drain(reason)
+
     async def run(self) -> None:
         await self._sweep_workspaces("startup")
         hb = asyncio.create_task(self._heartbeat_loop())
+        watcher = None
+        pw = PreemptionWatcher.from_config()
+        if pw is not None:
+            watcher = asyncio.create_task(
+                pw.watch(self._stop, self._on_preemption_notice))
         try:
             while not self._stop.is_set():
                 try:
@@ -546,8 +777,13 @@ class RemoteWorker(ComputeWatchdogMixin):
                     pass
         finally:
             self._stop.set()
-            hb.cancel()
-            await asyncio.gather(hb, return_exceptions=True)
+            if self._drain_task is not None:
+                await asyncio.gather(self._drain_task,
+                                     return_exceptions=True)
+            tasks = [t for t in (hb, watcher) if t is not None]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
 
     async def _heartbeat_loop(self) -> None:
         caps = {}
@@ -558,7 +794,8 @@ class RemoteWorker(ComputeWatchdogMixin):
                 caps = {}
         while not self._stop.is_set():
             try:
-                await self.client.heartbeat(caps)
+                await self.client.heartbeat(caps,
+                                            draining=self.drain.active)
                 for cmd in await self.client.poll_commands():
                     resp = await self.handle_command(cmd["command"],
                                                      cmd.get("args") or {})
@@ -581,7 +818,15 @@ class RemoteWorker(ComputeWatchdogMixin):
                     "breaker": self.breaker.snapshot(),
                     "db_breaker": self.db_breaker.snapshot(),
                     "disk_paused": self.disk_paused,
+                    "draining": {**self.drain.snapshot(),
+                                 "jobs_remaining":
+                                 int(self._current_job_id is not None)},
                     "kinds": [k.value for k in self.kinds]}
+        if command == "drain":
+            started = self.begin_drain("admin drain command")
+            return {"draining": True, "started": started,
+                    "grace_s": self.drain_grace_s,
+                    "jobs_remaining": int(self._current_job_id is not None)}
         if command == "stop":
             log.info("remote stop command received")
             # Defer: the response must be written before shutdown starts
@@ -609,6 +854,9 @@ class RemoteWorker(ComputeWatchdogMixin):
         return {"error": f"unknown command {command!r}"}
 
     async def poll_once(self) -> bool:
+        if self.drain.active:
+            # draining: no new work on a host that is being evicted
+            return False
         # Disk admission BEFORE the breaker: claiming a job we cannot
         # stage the source or outputs for would only burn an attempt
         # (and, in HALF_OPEN, the probe slot) on a guaranteed ENOSPC.
@@ -654,6 +902,12 @@ class RemoteWorker(ComputeWatchdogMixin):
         self._cancel_reason = ""
         self._reset_watchdog()
         job, video = claimed["job"], claimed["video"]
+        self._current_job_id = job["id"]
+        if self.drain.active:
+            # the drain raced the claim: deliver the cancel ourselves so
+            # the drain loop's broadcast cannot have missed this job
+            self._cancel_reason = self._cancel_reason or DRAIN_CANCEL_REASON
+            self._cancel.set()
         if video is None:
             # The video row vanished under a still-queued job — a data
             # problem, not compute health: resolve any probe.
@@ -681,7 +935,18 @@ class RemoteWorker(ComputeWatchdogMixin):
                 if self.stats.failed == failed_before:
                     self.breaker.record_success()
             except JobCancelled as exc:
-                if self._stop.is_set():
+                if exc.reason.startswith("preempted"):
+                    # drain deadline: the host is being evicted. The
+                    # handler already flushed completed segments + the
+                    # checkpoint; requeue refunded (PREEMPTED), no
+                    # breaker event — compute was healthy.
+                    obs_trace.event("worker.preempted", status="error",
+                                    error=exc.reason,
+                                    grace_s=self.drain_grace_s)
+                    await self._safe_fail(
+                        job["id"], exc.reason,
+                        failure_class=FailureClass.PREEMPTED)
+                elif self._stop.is_set():
                     try:
                         await self.client.release(job["id"])
                         self.stats.bump("released")
@@ -724,11 +989,19 @@ class RemoteWorker(ComputeWatchdogMixin):
                 # wedged HALF_OPEN would never claim again.
                 self.breaker.release_probe()
                 self._span_buffer = None
+                self._current_job_id = None
                 # attempt over, whatever the outcome: drop its fencing
                 # state so lost claims don't accumulate epoch entries
                 self.client._forget_claim(job["id"])
                 if not self.keep_work_dirs:
-                    shutil.rmtree(self._job_dir(video), ignore_errors=True)
+                    # a preempted scratch tree is deliberately kept: if
+                    # the requeued job lands back on THIS worker (the
+                    # drain was cancelled / the host survived), local
+                    # resume beats re-downloading the partials
+                    keep = self.drain.active
+                    if not keep:
+                        shutil.rmtree(self._job_dir(video),
+                                      ignore_errors=True)
         return True
 
     async def _sweep_workspaces(self, why: str) -> None:
@@ -781,6 +1054,87 @@ class RemoteWorker(ComputeWatchdogMixin):
 
     def _job_dir(self, video: dict) -> Path:
         return self.work_dir / video["slug"]
+
+    # files worth prefetching for resume: per-rung init + encoder config
+    # tag + media segments (what the backend's resume scan validates —
+    # init without its encoder.tag reads as a config mismatch and the
+    # segments would be discarded) and the thumbnail (first-batch
+    # artifact a resumed run cannot regenerate). The rate-control
+    # journal fetches separately below: it is deliberately absent from
+    # the manifest/inventory (run state, not a published artifact).
+    _RESUME_RE = re.compile(
+        r"^(?:[^/]+/(?:init\.mp4|encoder\.tag|segment_\d+\.(?:m4s|ts))"
+        r"|thumbnail\.jpg)$")
+
+    async def _prefetch_partials(self, video: dict, out_dir: Path) -> int:
+        """Download the server's digest-verified partial outputs into the
+        scratch tree (cross-worker resume). Best effort: any failure
+        just means more re-encoding, never a failed attempt. Returns the
+        number of files fetched or already present and verified."""
+        try:
+            have = await self.client.upload_status(video["id"])
+        except (ClaimLost, TransientAPIError, httpx.HTTPError) as exc:
+            log.debug("partial inventory unavailable: %s", exc)
+            return 0
+        ok = 0
+        try:
+            # the journal is what makes the continuation byte-identical;
+            # no inventory digest to check — a torn/corrupt journal is
+            # detected by its own line parsing and just means a cold
+            # (still deterministic) restart
+            await self.client.download_output(
+                video["id"], integrity.RC_JOURNAL_NAME,
+                out_dir / integrity.RC_JOURNAL_NAME)
+            ok += 1
+        except (ClaimLost, TransientAPIError, httpx.HTTPError):
+            pass                # predecessor never flushed one
+        for rel, meta in sorted(have.items()):
+            if not self._RESUME_RE.match(rel):
+                continue
+            local = out_dir / rel
+            want = meta.get("sha256")
+            if local.is_file() \
+                    and local.stat().st_size == meta.get("size") \
+                    and await asyncio.to_thread(
+                        integrity.sha256_file, local) == want:
+                ok += 1         # crashed-here-before case: already good
+                continue
+            try:
+                await self.client.download_output(video["id"], rel, local)
+            except (ClaimLost, TransientAPIError, httpx.HTTPError) as exc:
+                log.warning("partial prefetch of %s failed: %s", rel, exc)
+                local.unlink(missing_ok=True)
+                continue
+            digest = await asyncio.to_thread(integrity.sha256_file, local)
+            if digest != want:
+                # corrupted hop: re-encoding beats resuming corruption
+                log.warning("partial %s digest mismatch; dropped", rel)
+                local.unlink(missing_ok=True)
+                continue
+            ok += 1
+        if ok:
+            log.info("cross-worker resume: %d verified partial file(s) "
+                     "prefetched for %s", ok, video["slug"])
+        return ok
+
+    async def _checkpoint_flush(self, uploader: StreamingUploader,
+                                job: dict) -> None:
+        """Best-effort final checkpoint before eviction (drain deadline
+        already fired — whatever this misses, the successor re-encodes)."""
+        try:
+            files, nbytes = await uploader.flush()
+            obs_trace.event("worker.drain", files=len(uploader.uploaded),
+                            flushed_files=files, flushed_bytes=nbytes)
+            await uploader._checkpoint()
+            log.info("preemption flush for job %s: %d file(s), %d bytes",
+                     job["id"], files, nbytes)
+        except failpoints.FailpointError as exc:
+            log.warning("drain checkpoint for job %s injected-failed: %s",
+                        job["id"], exc)
+        except Exception as exc:  # noqa: BLE001 — the host is dying; an
+            # incomplete flush only costs the successor re-encoding
+            log.warning("drain checkpoint flush for job %s incomplete: %s",
+                        job["id"], exc)
 
     # -- compute-thread plumbing (HTTP flavor of the daemon's) -------------
 
@@ -859,8 +1213,20 @@ class RemoteWorker(ComputeWatchdogMixin):
         timeout = config.transcode_timeout_s(info.duration_s, rungs[0].name)
         cb = self._make_progress_cb(job["id"], [r.name for r in rungs])
 
+        # Cross-worker resume: pull the digest-verified partial tree a
+        # preempted (or crashed) predecessor streamed to the server, so
+        # the backend's resume scan continues the ladder instead of
+        # starting over on this machine.
+        with obs_trace.span("worker.resume") as rsp:
+            prefetched = await self._prefetch_partials(video, out_dir)
+            rsp.attrs["prefetched_files"] = prefetched
+
+        async def post_checkpoint(summary: dict) -> None:
+            await self.client.progress(job["id"], checkpoint=summary)
+
         uploader = StreamingUploader(self.client, video["id"], out_dir,
-                                     skip_prefixes=("original",))
+                                     skip_prefixes=("original",),
+                                     on_checkpoint=post_checkpoint)
         await uploader.resume_state()
         up_task = asyncio.create_task(uploader.run())
 
@@ -872,16 +1238,29 @@ class RemoteWorker(ComputeWatchdogMixin):
                                  progress_cb=cb, rungs=rungs,
                                  keep_original=False, write_manifest=False)
 
+        preempted = False
         try:
             with obs_trace.span("worker.transcode",
                                 rungs=[r.name for r in rungs]) as tsp:
                 result = await self._run_with_timeout(work, timeout,
                                                       "transcode")
+        except JobCancelled as exc:
+            preempted = exc.reason.startswith("preempted")
+            raise
         finally:
             uploader.stop()
             await asyncio.gather(up_task, return_exceptions=True)
+            if preempted:
+                # eviction imminent: push every completed segment + the
+                # rc journal and stamp the final checkpoint, so the
+                # successor resumes a maximal verified partial tree
+                await self._checkpoint_flush(uploader, job)
         obs_trace.record_run_stages(tsp, result.run.stage_s)
         obs_runtime().observe_run(result.run.stage_s)
+        if result.run.resumed_segments:
+            tsp.attrs["resumed_segments"] = result.run.resumed_segments
+            obs_runtime().resume_segments_skipped.inc(
+                result.run.resumed_segments)
         with obs_trace.span("worker.upload") as usp:
             await uploader.drain()
             usp.attrs.update(files=len(uploader.uploaded),
@@ -1042,7 +1421,7 @@ async def _amain(args: argparse.Namespace) -> None:
         backend=backend, transcription_model_dir=args.whisper_dir)
 
     from vlog_tpu.worker.health import (WorkerHealthServer, breaker_check,
-                                        combine, disk_check)
+                                        combine, disk_check, drain_check)
 
     async def api_ready() -> tuple[bool, str]:
         if not await client.healthz():
@@ -1053,11 +1432,14 @@ async def _amain(args: argparse.Namespace) -> None:
     # scales) without killing liveness — the worker is healthy, just full.
     health = WorkerHealthServer(
         combine(api_ready, disk_check(worker.work_dir, label="scratch"),
-                breaker_check(worker.db_breaker, label="worker API")))
+                breaker_check(worker.db_breaker, label="worker API"),
+                drain_check(worker.drain)))
     await health.start()
     loop = asyncio.get_running_loop()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        loop.add_signal_handler(sig, worker.request_stop)
+    # SIGTERM = eviction notice: grace-budgeted drain (twice = now);
+    # SIGINT stays immediate (operator ^C).
+    loop.add_signal_handler(signal.SIGTERM, worker.handle_termination)
+    loop.add_signal_handler(signal.SIGINT, worker.request_stop)
     try:
         await worker.run()
     finally:
