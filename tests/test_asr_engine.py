@@ -1,0 +1,613 @@
+"""Continuous-batching ASR plane: one shared Whisper engine serving
+every transcription job on the mesh.
+
+The contract under test (asr/engine.py + asr/queue.py):
+
+- windows from many concurrent jobs pack into fixed-shape bucketed
+  batches with freed rows backfilled per tick (continuous batching);
+- round-robin fairness — a long video's queued tail cannot starve a
+  short clip that arrives mid-stream;
+- per-job output is a pure function of the job's own windows:
+  ``captions.vtt`` is byte-identical solo vs. packed with other jobs,
+  and identical again under slot-lease mesh sharding;
+- preemption mid-transcription drains the in-flight batch into an
+  epoch-fenced checkpoint, and the successor re-submits only the
+  untranscribed windows (strictly fewer decodes, counter-asserted);
+- the engine coexists with a concurrent transcode holding a mesh slot,
+  and work-conservingly takes / gives back the full mesh when alone.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+pytest.importorskip("torch")
+pytest.importorskip("transformers")
+
+from vlog_tpu import config
+from vlog_tpu.asr.engine import (AsrEngine, AsrJobError, get_engine,
+                                 peek_engine, reset_engine)
+from vlog_tpu.asr.queue import (BatchKey, QueueCancelled, QueueClosed,
+                                WindowQueue, WorkItem)
+from vlog_tpu.asr.vtt import format_vtt
+from vlog_tpu.enums import FailureClass, JobKind
+from vlog_tpu.jobs import claims, videos as vids
+from vlog_tpu.media.audio import AudioData, write_wav
+from vlog_tpu.utils import failpoints
+from vlog_tpu.worker.daemon import WorkerDaemon
+from vlog_tpu.worker.transcribe import (transcribe_audio,
+                                        transcribe_audio_engine,
+                                        transcribe_video)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    failpoints.reset()
+    reset_engine()
+    yield
+    failpoints.reset()
+    reset_engine()
+
+
+@pytest.fixture(scope="session")
+def assets(tiny_model_dir):
+    from vlog_tpu.asr.load import load_whisper
+
+    return load_whisper(tiny_model_dir)
+
+
+def _tone(duration_s: float, freq: float = 220.0,
+          sr: int = 16000) -> np.ndarray:
+    t = np.arange(int(duration_s * sr)) / sr
+    return (0.25 * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+
+
+KEY = BatchKey(language="en", task="transcribe", max_new=8, beam=1)
+
+
+def _item(job: str, index: int = 0, **kw) -> WorkItem:
+    return WorkItem(job=job, index=index, start_s=25.0 * index,
+                    samples=np.zeros(16000, np.float32), **kw)
+
+
+def metric_value(name: str) -> float:
+    """Current value of one (possibly labeled) metric line."""
+    from vlog_tpu.obs.metrics import runtime
+
+    m = re.search(rf"^{re.escape(name)} ([0-9.e+-]+)$",
+                  runtime().render_text(), re.M)
+    return float(m.group(1)) if m else 0.0
+
+
+# --------------------------------------------------------------------------
+# WindowQueue units: grouping, fairness, backpressure
+# --------------------------------------------------------------------------
+
+def test_queue_round_robin_one_per_job_per_pass():
+    q = WindowQueue(max_items=64)
+    for i in range(3):
+        q.put(KEY, _item("A", i))
+    q.put(KEY, _item("B", 0))
+    for i in range(2):
+        q.put(KEY, _item("C", i))
+    taken = q.take(KEY, 8)
+    assert [it.job for it in taken] == ["A", "B", "C", "A", "C", "A"]
+    assert q.pending() == 0
+
+
+def test_queue_rotates_serving_order_between_takes():
+    q = WindowQueue(max_items=64)
+    for i in range(3):
+        q.put(KEY, _item("A", i))
+    for i in range(3):
+        q.put(KEY, _item("B", i))
+    first = q.take(KEY, 3)
+    assert [it.job for it in first] == ["A", "B", "A"]
+    # rotation: the next take starts AFTER the last-served job, so B is
+    # not perpetually second behind the bigger job
+    second = q.take(KEY, 2)
+    assert [it.job for it in second] == ["B", "A"]
+
+
+def test_queue_groups_by_batch_key_and_picks_oldest():
+    q = WindowQueue(max_items=64)
+    es = BatchKey(language="es", task="transcribe", max_new=8, beam=1)
+    q.put(es, _item("B", 0, enqueued_at=time.monotonic() - 60.0))
+    q.put(KEY, _item("A", 0))
+    assert q.pick_key() == es          # most-starved parameter group
+    assert [it.job for it in q.take(es, 8)] == ["B"]
+    # keys never mix in one take
+    assert q.take(es, 8) == []
+    assert [it.job for it in q.take(KEY, 8)] == ["A"]
+
+
+def test_queue_backpressure_cancel_timeout_close():
+    q = WindowQueue(max_items=2)
+    q.put(KEY, _item("A", 0))
+    q.put(KEY, _item("A", 1))
+    with pytest.raises(QueueCancelled, match="timed out"):
+        q.put(KEY, _item("A", 2), timeout=0.05)
+    import threading
+
+    cancel = threading.Event()
+    cancel.set()
+    with pytest.raises(QueueCancelled, match="cancelled"):
+        q.put(KEY, _item("A", 2), cancel=cancel)
+    assert q.cancel_job("A") == 2      # drops both queued windows
+    assert q.pending() == 0
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.put(KEY, _item("A", 3))
+
+
+# --------------------------------------------------------------------------
+# Engine: packing, backfill, fairness, failure isolation
+# --------------------------------------------------------------------------
+
+def _collect(handle) -> dict[int, list]:
+    return {idx: cues for idx, cues, _wait in handle.results()}
+
+
+def test_engine_packs_windows_from_concurrent_jobs(assets):
+    engine = AsrEngine(assets, batch_windows=8, tick_s=0.3)
+    try:
+        ha = engine.begin_job("A", language="en", max_new=8, beam=1)
+        hb = engine.begin_job("B", language="en", max_new=8, beam=1)
+        for i in range(3):
+            ha.submit(i, 25.0 * i, _tone(5.0))
+        for i in range(2):
+            hb.submit(i, 25.0 * i, _tone(5.0, 330.0))
+        got_a, got_b = _collect(ha), _collect(hb)
+        ha.close(), hb.close()
+    finally:
+        engine.close()
+    assert sorted(got_a) == [0, 1, 2] and sorted(got_b) == [0, 1]
+    assert engine.windows_decoded == 5
+    batch = engine.batch_log[0]
+    # one fixed-shape forward, both jobs interleaved in it
+    assert batch["n"] == 5 and batch["rows"] == 8
+    assert batch["jobs"] == ["A", "B", "A", "B", "A"]
+    assert batch["occupancy"] == pytest.approx(5 / 8)
+
+
+def test_engine_backfills_freed_rows_across_ticks(assets):
+    engine = AsrEngine(assets, batch_windows=8, tick_s=0.3)
+    try:
+        h = engine.begin_job("long", language="en", max_new=8, beam=1)
+        for i in range(10):
+            h.submit(i, 25.0 * i, _tone(4.0))
+        got = _collect(h)
+        h.close()
+    finally:
+        engine.close()
+    assert sorted(got) == list(range(10))
+    ns = [b["n"] for b in engine.batch_log]
+    assert ns == [8, 2]                       # tail backfills a new tick
+    # recompile-free: every forward ran at a bucketed power-of-two shape
+    for b in engine.batch_log:
+        assert b["rows"] in (1, 2, 4, 8) or b["rows"] % 8 == 0
+
+
+def test_short_clip_rides_the_next_batch_not_the_tail(assets):
+    """A 10-window job is already queued; a 2-window clip arriving
+    on the same tick is served one-per-pass, not after the backlog."""
+    engine = AsrEngine(assets, batch_windows=4, tick_s=0.3)
+    try:
+        hl = engine.begin_job("long", language="en", max_new=8, beam=1)
+        hs = engine.begin_job("short", language="en", max_new=8, beam=1)
+        for i in range(10):
+            hl.submit(i, 25.0 * i, _tone(4.0))
+        for i in range(2):
+            hs.submit(i, 25.0 * i, _tone(4.0, 330.0))
+        got_s = _collect(hs)
+        hs.close()
+        got_l = _collect(hl)
+        hl.close()
+    finally:
+        engine.close()
+    assert sorted(got_s) == [0, 1] and len(got_l) == 10
+    first_two = engine.batch_log[:2]
+    served_early = [j for b in first_two for j in b["jobs"]]
+    assert served_early.count("short") == 2   # all clip windows in the
+    assert served_early.count("long") >= 2    # first two ticks
+
+
+def test_engine_survives_a_failed_batch(assets):
+    failpoints.arm("asr.batch", count=1)
+    errors_before = metric_value('vlog_asr_batches_total{result="error"}')
+    engine = AsrEngine(assets, batch_windows=8, tick_s=0.05)
+    try:
+        ha = engine.begin_job("doomed", language="en", max_new=8, beam=1)
+        ha.submit(0, 0.0, _tone(4.0))
+        with pytest.raises(AsrJobError):
+            list(ha.results())
+        ha.close()
+        # the engine itself survives: the next job decodes normally
+        hb = engine.begin_job("fine", language="en", max_new=8, beam=1)
+        hb.submit(0, 0.0, _tone(4.0))
+        assert sorted(_collect(hb)) == [0]
+        hb.close()
+    finally:
+        engine.close()
+    assert metric_value(
+        'vlog_asr_batches_total{result="error"}') == errors_before + 1
+
+
+def test_get_engine_memoized_per_model_dir(tiny_model_dir):
+    e1 = get_engine(str(tiny_model_dir))
+    assert get_engine(str(tiny_model_dir)) is e1
+    assert peek_engine() is e1
+    reset_engine()
+    assert peek_engine() is None
+
+
+def test_load_whisper_memoized_on_dir_and_mtime(tiny_model_dir):
+    from vlog_tpu.asr import load as load_mod
+
+    a1 = load_mod.load_whisper(tiny_model_dir)
+    assert load_mod.load_whisper(tiny_model_dir) is a1   # one params tree
+    load_mod.invalidate()
+    assert load_mod.load_whisper(tiny_model_dir) is not a1
+
+
+# --------------------------------------------------------------------------
+# Determinism: byte-identical captions solo vs. packed
+# --------------------------------------------------------------------------
+
+def _run_jobs(assets, jobs: list[tuple[str, np.ndarray]],
+              tick_s: float = 0.3):
+    engine = AsrEngine(assets, batch_windows=8, tick_s=tick_s)
+    try:
+        with ThreadPoolExecutor(max_workers=len(jobs)) as ex:
+            futs = {
+                name: ex.submit(
+                    transcribe_audio_engine, sam, engine, job_key=name,
+                    language="en", max_new=8, beam=1,
+                    window_s=30.0, overlap_s=5.0)
+                for name, sam in jobs
+            }
+            out = {name: f.result(timeout=300) for name, f in futs.items()}
+    finally:
+        engine.close()
+    return out, engine.batch_log
+
+
+def test_vtt_byte_identical_solo_vs_packed(assets):
+    """The packing-invariance acceptance test: job A's captions.vtt is
+    byte-for-byte the same whether it had the engine to itself or was
+    co-batched with another job the whole way."""
+    sam_a = _tone(65.0, 220.0)                  # 3 windows at 25 s stride
+    sam_b = _tone(40.0, 330.0)                  # 2 windows
+    solo, _ = _run_jobs(assets, [("A", sam_a)])
+    packed, log = _run_jobs(assets, [("A", sam_a), ("B", sam_b)])
+    # prove the runs actually shared a forward, not just a process
+    assert any(len(set(b["jobs"])) > 1 for b in log)
+    vtt_solo = format_vtt(solo["A"][0])
+    vtt_packed = format_vtt(packed["A"][0])
+    assert vtt_packed == vtt_solo
+    assert solo["A"][2] == packed["A"][2] == 3  # window count agrees
+
+
+def test_resume_restores_windows_and_decodes_strictly_fewer(assets):
+    """Checkpoint/resume without a daemon: a JSON-round-tripped partial
+    state feeds a second attempt that re-submits only the missing
+    windows and still emits identical bytes."""
+    sam = _tone(90.0)                           # 4 windows
+    states: list[tuple[dict, int]] = []
+    engine = AsrEngine(assets, batch_windows=1, tick_s=0.0)
+    try:
+        cues_full, lang, n = transcribe_audio_engine(
+            sam, engine, job_key="full", language="en", max_new=8, beam=1,
+            window_s=30.0, overlap_s=5.0,
+            checkpoint_cb=lambda st, d, t, f:
+                states.append((json.loads(json.dumps(st)), d)))
+        decoded_full = engine.windows_decoded
+    finally:
+        engine.close()
+    assert n == 4 and decoded_full == 4
+    partial = next(st for st, d in states if d == 2)
+
+    resumed_before = metric_value(
+        'vlog_asr_windows_total{result="resumed"}')
+    engine2 = AsrEngine(assets, batch_windows=1, tick_s=0.0)
+    stats: dict = {}
+    try:
+        cues_res, lang2, n2 = transcribe_audio_engine(
+            sam, engine2, job_key="resumed", language=None, max_new=8,
+            beam=1, window_s=30.0, overlap_s=5.0, resume=partial,
+            stats_out=stats)
+        decoded_res = engine2.windows_decoded
+    finally:
+        engine2.close()
+    assert stats["windows_resumed"] == 2
+    assert decoded_res == decoded_full - 2      # strictly fewer decodes
+    assert lang2 == lang == "en"                # language from checkpoint
+    assert format_vtt(cues_res) == format_vtt(cues_full)
+    assert metric_value(
+        'vlog_asr_windows_total{result="resumed"}') == resumed_before + 2
+
+
+# --------------------------------------------------------------------------
+# Mesh scheduler: slot-lease coexistence + work-conserving full mesh
+# --------------------------------------------------------------------------
+
+def test_engine_coexists_with_transcode_slot_then_takes_full_mesh(assets):
+    from vlog_tpu.parallel.scheduler import MeshScheduler
+
+    sched = MeshScheduler(slots=2)              # 8 virtual devs -> 2 x 4
+    # a "transcode job" holds one slot; a second admitted ticket keeps
+    # standing demand so neither party grabs the full mesh mid-test
+    t_other = sched.admit()
+    t_transcode = sched.admit()
+    transcode_lease = t_transcode.acquire(timeout=5)
+    assert transcode_lease.width == 4 and not transcode_lease.is_full_mesh
+
+    engine = AsrEngine(assets, scheduler=sched, batch_windows=4,
+                       tick_s=0.05)
+    try:
+        h = engine.begin_job("co", language="en", max_new=8, beam=1)
+        wins = [(25.0 * i, _tone(4.0)) for i in range(2)]
+        for i, (t0, w) in enumerate(wins):
+            h.submit(i, t0, w)
+        got_shared = _collect(h)
+        h.close()
+        assert sorted(got_shared) == [0, 1]
+        # decoded on the OTHER slot: rows padded to the slot width
+        assert engine.batch_log[0]["rows"] % 4 == 0
+        # queue drained -> the engine gave its slot back
+        deadline = time.monotonic() + 5
+        while (sched.snapshot()["active"] > 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert sched.snapshot()["active"] == 1  # just the transcode
+
+        # transcode finishes; the engine alone is work-conserving: the
+        # next serving period gets the full-mesh fallback lease
+        t_transcode.close()
+        t_other.close()
+        h2 = engine.begin_job("alone", language="en", max_new=8, beam=1)
+        for i, (t0, w) in enumerate(wins):
+            h2.submit(i, t0, w)
+        got_alone = _collect(h2)
+        h2.close()
+        assert engine.batch_log[-1]["rows"] % 8 == 0   # all 8 devices
+        # ... and released it once the queue drained again
+        deadline = time.monotonic() + 5
+        while (sched.snapshot()["active"] > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert sched.snapshot()["active"] == 0
+    finally:
+        engine.close()
+        t_transcode.close()
+        t_other.close()
+    # sharded output == unsharded output, row for row
+    engine2 = AsrEngine(assets, batch_windows=4, tick_s=0.05)
+    try:
+        h3 = engine2.begin_job("solo", language="en", max_new=8, beam=1)
+        for i, (t0, w) in enumerate(wins):
+            h3.submit(i, t0, w)
+        got_solo = _collect(h3)
+        h3.close()
+    finally:
+        engine2.close()
+    assert got_shared == got_solo == got_alone
+
+
+# --------------------------------------------------------------------------
+# Drain -> checkpoint -> resume chaos (daemon end-to-end)
+# --------------------------------------------------------------------------
+
+def test_preempted_transcription_resumes_byte_identical(run, db, tmp_path,
+                                                        tiny_model_dir,
+                                                        monkeypatch):
+    """Preempt a daemon mid-transcription: the grace-zero drain force-
+    cancels the compute thread, the in-flight batch flushes into the
+    epoch-fenced checkpoint, the job requeues as a refunded PREEMPTED
+    failure, and a successor daemon re-submits only the untranscribed
+    windows (counter-asserted) yet writes a byte-identical VTT."""
+    monkeypatch.setattr(config, "ASR_BATCH_WINDOWS", 1)  # window-granular
+    monkeypatch.setattr(config, "ASR_TICK_S", 0.0)       # ticks
+
+    wav = tmp_path / "long.wav"
+    sam = _tone(200.0)                     # 8 windows at 25 s stride
+    write_wav(wav, AudioData(pcm=sam[None].astype(np.float64),
+                             sample_rate=16000))
+    video = run(vids.create_video(db, "Preempt me",
+                                  source_path=str(wav)))
+    run(db.execute("UPDATE videos SET duration_s=200.0 WHERE id=:id",
+                   {"id": video["id"]}))
+    job_id = run(claims.enqueue_job(db, video["id"], JobKind.TRANSCRIPTION))
+
+    daemon = WorkerDaemon(db, name="asr-chaos-1",
+                          video_dir=tmp_path / "videos",
+                          progress_min_interval_s=0.0, drain_tick_s=0.01,
+                          drain_grace_s=0.0,
+                          transcription_model_dir=str(tiny_model_dir))
+
+    # Deterministic preemption trigger: the moment the first window's
+    # checkpoint lands, fire the termination notice and park the compute
+    # thread until the drain's force-cancel reaches the supervisor.
+    real_make = daemon._make_checkpoint_cb
+
+    def make_cb(job):
+        inner = real_make(job)
+        loop = asyncio.get_running_loop()
+
+        def cb(state, done, total, final):
+            inner(state, done, total, final)
+            if done >= 1 and not final and not daemon.drain.active:
+                loop.call_soon_threadsafe(daemon.handle_termination)
+                sup = daemon._active_sups.get(job["id"])
+                t0 = time.monotonic()
+                while (sup is not None and not sup._cancel.is_set()
+                       and time.monotonic() - t0 < 10.0):
+                    time.sleep(0.002)
+        return cb
+
+    monkeypatch.setattr(daemon, "_make_checkpoint_cb", make_cb)
+
+    async def preempt():
+        task = asyncio.create_task(daemon.poll_once())
+        await asyncio.wait_for(task, 300.0)
+        if daemon._drain_task is not None:
+            await asyncio.wait_for(daemon._drain_task, 30.0)
+
+    run(preempt())
+
+    job = run(db.fetch_one("SELECT * FROM jobs WHERE id=:id",
+                           {"id": job_id}))
+    assert job["claimed_by"] is None and job["attempt"] == 0   # refunded
+    hist = run(claims.get_failure_history(db, job_id))
+    assert hist[-1]["failure_class"] == FailureClass.PREEMPTED.value
+    ckpt = json.loads(job["last_checkpoint"] or "{}")
+    saved = ckpt.get("asr", {}).get("windows", {})
+    k = len(saved)
+    assert 1 <= k < 8                      # partial, not empty, not all
+    assert ckpt["asr"]["v"] == 1 and ckpt["asr"]["language"] == "en"
+
+    # Tear down the preempted attempt's engine (close() joins the tick
+    # thread, letting any in-flight decode finish) so the successor's
+    # engine counter starts at zero — a clean re-decode count.
+    reset_engine()
+    resumed_before = metric_value(
+        'vlog_asr_windows_total{result="resumed"}')
+
+    successor = WorkerDaemon(db, name="asr-chaos-2",
+                             video_dir=tmp_path / "videos",
+                             progress_min_interval_s=0.0,
+                             transcription_model_dir=str(tiny_model_dir))
+    assert run(successor.poll_once()) is True
+
+    tr = run(db.fetch_one("SELECT * FROM transcriptions WHERE video_id=:v",
+                          {"v": video["id"]}))
+    assert tr is not None and tr["status"] == "completed"
+    # counter-asserted bounded loss: the successor decoded exactly the
+    # windows missing from the checkpoint — strictly fewer than a
+    # from-scratch attempt
+    redecoded = peek_engine().windows_decoded
+    assert redecoded == 8 - k < 8
+    assert metric_value(
+        'vlog_asr_windows_total{result="resumed"}') == resumed_before + k
+
+    # byte-identity across the preemption: compare with a clean solo run
+    resumed_vtt = (tmp_path / "videos" / video["slug"]
+                   / "captions.vtt").read_bytes()
+    ref = transcribe_video(wav, tmp_path / "solo-ref",
+                           model_dir=str(tiny_model_dir))
+    assert resumed_vtt == (tmp_path / "solo-ref"
+                           / "captions.vtt").read_bytes()
+    assert ref.windows == 8
+
+
+# --------------------------------------------------------------------------
+# Registry / docs agreement (delivery-lint pattern, ASR edition)
+# --------------------------------------------------------------------------
+
+class TestAsrAgreement:
+    KNOBS = ("VLOG_ASR_BATCH_WINDOWS", "VLOG_ASR_TICK_S",
+             "VLOG_ASR_QUEUE_MAX")
+    METRICS = ("vlog_asr_batches_total", "vlog_asr_windows_total",
+               "vlog_asr_batch_occupancy", "vlog_asr_pad_waste",
+               "vlog_asr_windows_per_second", "vlog_asr_queue_wait_seconds")
+    SITES = ("asr.submit", "asr.batch")
+    SPANS = ("worker.transcribe",)
+    SPAN_ATTRS = ("asr.windows_total", "asr.windows_live",
+                  "asr.windows_resumed", "asr.windows_submitted",
+                  "asr.queue_wait_mean_s", "asr.queue_wait_max_s")
+
+    def test_knobs_parsed_and_documented(self):
+        from vlog_tpu.analysis import registry as reg
+
+        reg.assert_knobs(self.KNOBS)
+
+    def test_metrics_registered_and_documented(self):
+        from vlog_tpu.analysis import registry as reg
+
+        reg.assert_metric_families(self.METRICS)
+
+    def test_failpoint_sites_registered_and_documented(self):
+        from vlog_tpu.analysis import registry as reg
+
+        reg.assert_failpoint_sites(self.SITES)
+        for site in self.SITES:
+            assert site in failpoints.SITES, site
+
+    def test_span_and_attrs_documented(self):
+        from vlog_tpu.analysis import registry as reg
+
+        reg.assert_span_names(self.SPANS)
+        reg.assert_documented(self.SPAN_ATTRS)
+
+
+# --------------------------------------------------------------------------
+# Packing microbench (slow): engine-batched vs per-job sequential
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_asr_packing_microbench(assets):
+    """Windows/sec through the shared engine (many small jobs packed
+    into full buckets) vs. the pre-engine sequential path (one padded
+    partial batch per job). Eight 3-window jobs: sequential burns eight
+    forwards at 3/8 occupancy; the engine packs the same 24 windows
+    into three full forwards."""
+    jobs = [(f"j{k}", _tone(65.0, 200.0 + 15.0 * k)) for k in range(8)]
+
+    # warm the single bucket shape both paths run at, outside the clock
+    warm_engine = AsrEngine(assets, batch_windows=8, tick_s=0.05)
+    try:
+        transcribe_audio_engine(_tone(190.0), warm_engine, job_key="warm",
+                                language="en", max_new=8, beam=1,
+                                window_s=30.0, overlap_s=5.0)
+    finally:
+        warm_engine.close()
+
+    engine = AsrEngine(assets, batch_windows=8, tick_s=0.02)
+    t0 = time.perf_counter()
+    try:
+        with ThreadPoolExecutor(max_workers=len(jobs)) as ex:
+            futs = [ex.submit(transcribe_audio_engine, sam, engine,
+                              job_key=name, language="en", max_new=8,
+                              beam=1, window_s=30.0, overlap_s=5.0)
+                    for name, sam in jobs]
+            results = [f.result(timeout=600) for f in futs]
+        wall_engine = time.perf_counter() - t0
+        stats = engine.stats()
+    finally:
+        engine.close()
+    windows = sum(r[2] for r in results)
+    assert windows == 24 and stats["windows"] == 24
+
+    t0 = time.perf_counter()
+    for _name, sam in jobs:
+        transcribe_audio(sam, assets, language="en", max_new=8,
+                         window_s=30.0, overlap_s=5.0, batch_windows=8)
+    wall_seq = time.perf_counter() - t0
+
+    engine_wps = windows / wall_engine
+    seq_wps = windows / wall_seq
+    speedup = engine_wps / seq_wps
+    record = {
+        "metric": "asr_engine_windows_per_second",
+        "value": round(engine_wps, 2),
+        "unit": "windows/s",
+        "vs_baseline": round(speedup, 2),
+        "sequential_windows_per_second": round(seq_wps, 2),
+        "jobs": len(jobs),
+        "windows": windows,
+        "batches": stats["batches"],
+        "mean_occupancy": round(stats["mean_occupancy"], 3),
+    }
+    from pathlib import Path
+
+    out = Path(__file__).parent.parent / "BENCH_asr.json"
+    out.write_text(json.dumps(record, indent=1) + "\n")
+    print(json.dumps(record))
+    assert speedup > 1.5

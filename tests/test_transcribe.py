@@ -67,6 +67,10 @@ def test_cut_windows_cover_and_overlap():
     # short track: one window
     wins = _cut_windows(np.zeros(sr, np.float32), window_s=30.0, overlap_s=5.0)
     assert len(wins) == 1
+    # zero-length input: no windows at all (the old loop emitted one
+    # empty window that wasted a batch row downstream)
+    assert _cut_windows(np.zeros(0, np.float32),
+                        window_s=30.0, overlap_s=5.0) == []
 
 
 # --------------------------------------------------------------------------
@@ -115,6 +119,29 @@ def test_transcribe_video_writes_vtt(tmp_path, tiny_model_dir, assets):
     vtt = (tmp_path / "out" / "captions.vtt").read_text()
     assert vtt.startswith("WEBVTT")
     assert not list((tmp_path / "out").glob("*.tmp"))
+
+
+def test_transcribe_video_reuses_process_engine(tmp_path, tiny_model_dir):
+    """Two transcriptions in one process share one engine (weights load
+    once through the memoized load_whisper)."""
+    from vlog_tpu.asr.engine import peek_engine, reset_engine
+
+    reset_engine()
+    try:
+        for name in ("a", "b"):
+            wav = tmp_path / f"{name}.wav"
+            write_wav(wav, AudioData(pcm=_tone(4.0)[None].astype(np.float64),
+                                     sample_rate=16000))
+            transcribe_video(wav, tmp_path / f"out-{name}",
+                             model_dir=str(tiny_model_dir), language="en",
+                             max_new=8)
+            if name == "a":
+                first = peek_engine()
+                assert first is not None
+        assert peek_engine() is first
+        assert peek_engine().windows_decoded == 2
+    finally:
+        reset_engine()
 
 
 def test_missing_model_dir_raises_actionable_error(tmp_path):

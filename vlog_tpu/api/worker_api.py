@@ -75,6 +75,10 @@ def _claim_epoch(request: web.Request) -> int | None:
 
 
 def _job_payload(row: Row) -> dict:
+    # last_checkpoint is decoded opaquely: the wire shape is whatever the
+    # job kind wrote (e.g. transcription's {"asr": {...}} resume state from
+    # jobs.claims.update_progress), so remote workers resume byte-identically
+    # without this API layer knowing any kind-specific schema.
     out = dict(row)
     out["payload"] = json.loads(out.get("payload") or "{}")
     out["last_checkpoint"] = json.loads(out.get("last_checkpoint") or "{}")

@@ -2,9 +2,15 @@
 
 The transcription compute substrate replacing the reference's
 faster-whisper/CTranslate2 dependency (worker/transcription.py:78-133):
-log-mel frontend, encoder-decoder forward, and batched greedy decoding
-with Whisper's timestamp rules — all JAX, sharded over the device mesh
-for long audio (SURVEY.md §5 long-audio data parallelism).
+log-mel frontend, encoder-decoder forward, and batched greedy/beam
+decoding with Whisper's timestamp rules — all JAX, sharded over the
+device mesh for long audio (SURVEY.md §5 long-audio data parallelism).
+
+Serving goes through the continuous-batching engine (engine.py +
+queue.py): one shared Whisper per worker process packs 30 s windows
+from every concurrent transcription job into fixed-shape bucketed
+batches on a mesh-scheduler slot lease, with per-job byte-identical
+output regardless of co-tenants (the packing-invariance contract).
 """
 
 from vlog_tpu.asr.mel import log_mel_spectrogram  # noqa: F401

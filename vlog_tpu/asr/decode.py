@@ -260,7 +260,17 @@ def generate_batch(assets: WhisperAssets, mel: jnp.ndarray, *,
 
     ``beam=1`` is the greedy scan; ``beam>1`` runs batched beam search
     with length-normalized selection (config.WHISPER_BEAM wires the
-    production default; the reference runs beam-5)."""
+    production default; the reference runs beam-5).
+
+    Row independence is a load-bearing contract: no op here crosses
+    batch rows (per-row conv/attention/argmax, one shared prompt), so
+    row i's tokens never depend on rows j != i — zero-padded rows and
+    co-batched jobs cannot perturb a window's output. The continuous-
+    batching engine (asr/engine.py) builds its byte-identical
+    solo-vs-packed guarantee on this; tests/test_asr_engine.py breaks
+    if it regresses. One shared prompt per call also means callers may
+    only co-batch windows agreeing on (language, task, max_new, beam)
+    — the engine's BatchKey."""
     st = assets.tokens
     cfg = assets.cfg
     if max_new is None:

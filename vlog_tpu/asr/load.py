@@ -11,6 +11,7 @@ fleet has no egress by design.
 from __future__ import annotations
 
 import json
+import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
@@ -120,11 +121,40 @@ def derive_special_tokens(tokenizer, hf_cfg: dict,
     )
 
 
+# Process-wide asset cache. Whisper weights are hundreds of MB of
+# safetensors; every caller (engine, CLI, quality_bench) used to re-read
+# them per invocation. Keyed on (resolved dir, config.json mtime_ns) so a
+# swapped-in checkpoint at the same path is picked up without a restart.
+_cache: dict[tuple[str, int], WhisperAssets] = {}  # under _cache_lock
+_cache_lock = threading.Lock()
+
+
+def invalidate() -> None:
+    """Drop every cached checkpoint (tests swap model dirs in place)."""
+    with _cache_lock:
+        _cache.clear()
+
+
 def load_whisper(model_dir: str | Path) -> WhisperAssets:
     model_dir = Path(model_dir)
     cfg_path = model_dir / "config.json"
     if not cfg_path.exists():
         raise ModelLoadError(f"{model_dir}: missing config.json")
+    key = (str(model_dir.resolve()), cfg_path.stat().st_mtime_ns)
+    with _cache_lock:
+        cached = _cache.get(key)
+    if cached is not None:
+        return cached
+    assets = _load_whisper_uncached(model_dir)
+    with _cache_lock:
+        # A concurrent loader may have won the race; keep the first entry
+        # so every caller shares one params tree (device memory matters).
+        assets = _cache.setdefault(key, assets)
+    return assets
+
+
+def _load_whisper_uncached(model_dir: Path) -> WhisperAssets:
+    cfg_path = model_dir / "config.json"
     hf_cfg = json.loads(cfg_path.read_text())
     cfg = WhisperConfig.from_hf(hf_cfg)
 

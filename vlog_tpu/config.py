@@ -311,6 +311,18 @@ WHISPER_OVERLAP_S: float = 5.0      # chunk overlap for stitching
 WHISPER_BEAM: int = _env_int("VLOG_WHISPER_BEAM", 5, lo=1, hi=16)
 TRANSCRIPTION_ENABLED: bool = _env_bool("VLOG_TRANSCRIPTION_ENABLED", True)
 
+# Continuous-batching ASR engine (asr/engine.py): one shared Whisper
+# serving every transcription job on the worker.
+# Widest batch the engine packs per tick; batches run at power-of-two
+# bucket shapes up to this, so decode stays recompile-free.
+ASR_BATCH_WINDOWS: int = _env_int("VLOG_ASR_BATCH_WINDOWS", 8, lo=1, hi=64)
+# Coalescing delay per tick: how long the engine lets windows from
+# concurrent jobs accumulate before packing a batch. 0 disables.
+ASR_TICK_S: float = _env_float("VLOG_ASR_TICK_S", 0.05, lo=0.0, hi=5.0)
+# Window-queue bound; submits block (backpressure) once this many
+# windows are queued across all jobs.
+ASR_QUEUE_MAX: int = _env_int("VLOG_ASR_QUEUE_MAX", 256, lo=8, hi=8192)
+
 # --------------------------------------------------------------------------
 # Sprites (reference: config.py:572-593)
 # --------------------------------------------------------------------------

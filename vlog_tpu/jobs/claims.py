@@ -397,6 +397,13 @@ async def update_progress(
     Raises :class:`JobStateError` if the caller no longer holds the claim
     (the 409-abort signal remote workers act on) or ``epoch`` (the
     claim's attempt number, the fencing token) is stale.
+
+    ``checkpoint`` is stored verbatim as JSON under ``jobs.last_checkpoint``;
+    its shape is owned by the job kind. Transcription stores
+    ``{"asr": {"windows": {index: 1}, "language": ...}}`` — the set of
+    decoded window indices plus the detected language — which the ASR
+    engine (asr/engine.py) reads on resume to re-submit only the windows
+    the preempted attempt never finished.
     """
     t = db_now()
     async with db.transaction() as tx:

@@ -9,6 +9,9 @@ module here so the two planes can never drift.
 
 from __future__ import annotations
 
+import asyncio
+import logging
+from pathlib import Path
 from types import SimpleNamespace
 from typing import Any
 
@@ -16,6 +19,8 @@ from vlog_tpu import config
 from vlog_tpu.db.core import Database, Row, now as db_now
 from vlog_tpu.enums import JobKind
 from vlog_tpu.jobs import claims, videos as vids
+
+log = logging.getLogger("vlog.finalize")
 
 
 async def finalize_transcode(
@@ -80,6 +85,39 @@ async def finalize_transcription(
     await db.execute(
         "UPDATE videos SET transcription_status='completed', updated_at=:t "
         "WHERE id=:id", {"t": t, "id": video_id})
+    # Publish captions.vtt through the manifest-verified path: fold its
+    # size+sha256 into the slug tree's outputs.json so the verify
+    # endpoint (POST /api/videos/{id}/verify) covers captions instead of
+    # silently skipping them. Covers local daemon finalizes and remote
+    # completes alike — both pass a vtt_path inside the published tree.
+    if vtt_path:
+        await asyncio.to_thread(_publish_caption_manifest, vtt_path)
     # captions.vtt just changed under the slug: evict any cached copy
     # (transcode publish invalidates via vids.finalize_ready already)
     await vids.invalidate_delivery(db, video_id)
+
+
+def _publish_caption_manifest(vtt_path: str) -> None:
+    """Update ``outputs.json`` next to ``captions.vtt`` with the caption
+    file's size+sha256. A tree without a manifest (pre-integrity upload,
+    or a transcription that outran its transcode) is left alone — the
+    next full manifest write will sweep the vtt in via build_manifest."""
+    from vlog_tpu.storage import integrity
+
+    p = Path(vtt_path)
+    root = p.parent
+    if not p.exists():
+        return
+    try:
+        files = integrity.load_manifest(root)
+        if files is None:
+            return
+        rel = p.name
+        files[rel] = {"size": p.stat().st_size,
+                      "sha256": integrity.sha256_file(p)}
+        integrity.write_manifest(root, files)
+    except (integrity.ManifestError, OSError) as exc:
+        # Manifest refresh is a publication nicety, not a gate: the vtt
+        # itself is already on disk and served.
+        log.warning("caption manifest update failed for %s: %s",
+                    vtt_path, exc)

@@ -237,6 +237,36 @@ class RuntimeMetrics:
             "Ladder segments accepted from a verified partial tree by "
             "resume instead of re-encoded (summed across rungs)",
             registry=self.registry)
+        # Continuous-batching ASR plane (asr/engine.py): one shared
+        # Whisper engine serving every transcription job on the worker.
+        self.asr_batches = Counter(
+            "vlog_asr_batches_total",
+            "Batched decode forwards run by the ASR engine",
+            ["result"], registry=self.registry)
+        self.asr_windows = Counter(
+            "vlog_asr_windows_total",
+            "Windows through the ASR plane (decoded = engine forward; "
+            "resumed = restored from a checkpoint without re-decoding; "
+            "failed = lost to a batch failure)",
+            ["result"], registry=self.registry)
+        self.asr_batch_occupancy = Gauge(
+            "vlog_asr_batch_occupancy",
+            "Real windows / batch rows in the last engine batch (1.0 = "
+            "perfectly packed)", registry=self.registry)
+        self.asr_pad_waste = Gauge(
+            "vlog_asr_pad_waste",
+            "Zero-padded fraction of the last engine batch's rows",
+            registry=self.registry)
+        self.asr_windows_per_second = Gauge(
+            "vlog_asr_windows_per_second",
+            "Decode throughput of the last engine batch",
+            registry=self.registry)
+        self.asr_queue_wait = Histogram(
+            "vlog_asr_queue_wait_seconds",
+            "Seconds a window waited in the cross-job queue before its "
+            "batch completed",
+            buckets=(0.01, 0.05, 0.2, 1.0, 5.0, 20.0, 60.0, 300.0),
+            registry=self.registry)
         # the fires counter must see every fire in the process, wherever
         # the site lives — failpoints stays dependency-free, we observe
         failpoints.add_observer(

@@ -231,6 +231,14 @@ class MeshScheduler:
     loop, leases acquired/released from per-job compute threads.
     ``devices`` may be any opaque objects (tests drive the grant logic
     with strings); JAX enters only when a lease builds its mesh.
+
+    Demand granularity is per CONSUMER, not strictly per job: transcode
+    jobs hold one ticket each, while the ASR engine (asr/engine.py)
+    holds one ticket for every transcription job it is serving,
+    acquired while its window queue has work and released at tick
+    boundaries — which is why the daemon's claim loop admits tickets
+    only for device-exclusive kinds and gates transcription claims on
+    the engine's own activity rather than on slot capacity.
     """
 
     def __init__(self, devices: Sequence | None = None,

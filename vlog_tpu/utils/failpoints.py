@@ -54,6 +54,12 @@ site                where it fires
 ``checkpoint.upload``  the remote uploader's incremental checkpoint
                     post and drain-time flush — the armed write fails,
                     so the server keeps only what already streamed
+``asr.submit``      JobHandle.submit (asr/engine.py), before a window
+                    enters the cross-job queue — the submitting job's
+                    attempt fails, the engine keeps serving others
+``asr.batch``       engine tick, before the batched decode forward —
+                    every job with a window in the batch gets the
+                    failure; the engine survives and keeps ticking
 ==================  =====================================================
 
 Every legitimate site name is listed in :data:`SITES`;
@@ -125,6 +131,11 @@ SITES: dict[str, str] = {
     "checkpoint.upload": "remote uploader's incremental checkpoint post and "
                          "the drain-time flush; the armed checkpoint write "
                          "fails",
+    "asr.submit": "JobHandle.submit, before a window enters the cross-job "
+                  "queue; the submitting job's attempt fails",
+    "asr.batch": "ASR engine tick, before the batched decode forward; "
+                 "every job in the batch gets the failure, the engine "
+                 "keeps ticking",
 }
 
 

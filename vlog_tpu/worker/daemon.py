@@ -540,17 +540,25 @@ class WorkerDaemon(ComputeWatchdogMixin):
                        and (len(self._tasks) + len(batch)
                             < self.scheduler.slots)):
                     # Device jobs need slot capacity; CPU-only kinds
-                    # (sprites, transcription) ride the same
-                    # concurrency bound but never register device
-                    # demand — a transcode claimed alongside one still
-                    # work-conservingly gets the full mesh. With zero
-                    # capacity (a full-width lease running) only CPU
-                    # kinds are claimable; device jobs stay in the
-                    # queue for other workers.
+                    # (sprites) ride the same concurrency bound but
+                    # never register device demand — a transcode
+                    # claimed alongside one still work-conservingly
+                    # gets the full mesh. Transcription is device
+                    # demand too, but the shared ASR engine owns it:
+                    # ONE scheduler ticket serves every transcription
+                    # job, so transcription stays claimable with zero
+                    # capacity as long as the engine is already
+                    # serving (new jobs pile onto the running batch
+                    # instead of queueing behind a slot). With zero
+                    # capacity and an idle engine, device jobs and
+                    # transcription both stay in the queue.
                     kinds = self.kinds
                     if self.scheduler.capacity() <= 0:
                         kinds = tuple(k for k in self.kinds
                                       if k not in device_kinds)
+                        if not self._asr_engine_active():
+                            kinds = tuple(k for k in kinds
+                                          if k != JobKind.TRANSCRIPTION)
                         if not kinds:
                             break
                     job = await self._admit_and_claim(kinds=kinds)
@@ -567,6 +575,15 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 self._tasks.add(task)
                 task.add_done_callback(self._tasks.discard)
         return bool(batch)
+
+    def _asr_engine_active(self) -> bool:
+        """Is the shared ASR engine already serving (lease held or
+        windows queued)? Never builds the engine — an idle worker must
+        not page in Whisper weights from the claim loop."""
+        from vlog_tpu.asr.engine import peek_engine
+
+        eng = peek_engine()
+        return eng is not None and eng.active()
 
     async def _run_slot_job(self, job: Row, ticket: Any) -> None:
         """One slot job's task body: _process_claimed with the same
@@ -951,6 +968,45 @@ class WorkerDaemon(ComputeWatchdogMixin):
 
         return cb
 
+    def _make_checkpoint_cb(self, job: Row):
+        """ASR checkpoint callback run on the COMPUTE THREAD.
+
+        Persists the cumulative resume state through the epoch-fenced
+        ``jobs.last_checkpoint`` write (claims.update_progress carries
+        the claim's attempt number as the fencing token, so a swept-and-
+        reclaimed predecessor can never stomp the successor's state).
+        Rate-limited like progress writes; the ``final`` flush — the
+        drain path, after the in-flight batch drained — blocks until the
+        row is written so a preempted attempt's completed windows survive
+        the process."""
+        loop = asyncio.get_running_loop()
+        last_write = 0.0
+        epoch = job["attempt"]
+
+        async def write(state: dict) -> None:
+            try:
+                await claims.update_progress(
+                    self.db, job["id"], self.name,
+                    checkpoint={"asr": state}, epoch=epoch)
+            except js.JobStateError:
+                pass   # claim lost; the progress cb aborts the thread
+
+        def cb(state: dict, done: int, total: int, final: bool) -> None:
+            nonlocal last_write
+            now = time.monotonic()
+            if (not final and done < total
+                    and now - last_write < self.progress_min_interval_s):
+                return
+            last_write = now
+            fut = asyncio.run_coroutine_threadsafe(write(state), loop)
+            if final:
+                try:
+                    fut.result(timeout=10.0)
+                except Exception:  # noqa: BLE001 — drain deadline wins
+                    pass
+
+        return cb
+
     # Grace period for a cancelled compute thread to reach its next
     # progress-callback boundary before the daemon abandons it.
     cancel_grace_s: float = 120.0
@@ -1174,16 +1230,48 @@ class WorkerDaemon(ComputeWatchdogMixin):
             {"t": db_now(), "id": video["id"]})
         out_dir = self.video_dir / video["slug"]
         cb = self._make_progress_cb(job["id"], 0, [])
+        ckpt_cb = self._make_checkpoint_cb(job)
         timeout = config.transcode_timeout_s(
             float(video["duration_s"] or 0.0), "720p")
+        # A preempted/swept predecessor left its decoded windows in the
+        # job row; this attempt re-submits only what is missing and
+        # still produces a byte-identical VTT.
+        try:
+            prior = json.loads(job["last_checkpoint"] or "{}")
+        except (TypeError, ValueError):
+            prior = {}
+        resume = prior.get("asr") if isinstance(prior, dict) else None
+        model_dir = (self.transcription_model_dir or config.WHISPER_DIR
+                     or None)
+        asr_stats: dict[str, Any] = {}
 
         def work():
-            return transcribe_video(source, out_dir, progress_cb=cb,
-                                    model_dir=self.transcription_model_dir)
+            engine = None
+            if model_dir and Path(model_dir).exists() \
+                    and self.scheduler is not None:
+                # The shared engine owns the slot demand (one ticket for
+                # every transcription job on this worker); without a
+                # scheduler, transcribe_video builds the scheduler-less
+                # engine itself (classic full-mesh behavior).
+                from vlog_tpu.asr.engine import get_engine
+
+                engine = get_engine(model_dir, scheduler=self.scheduler)
+            return transcribe_video(
+                source, out_dir, progress_cb=cb,
+                model_dir=self.transcription_model_dir,
+                engine=engine, job_key=f"job-{job['id']}",
+                checkpoint_cb=ckpt_cb, resume=resume,
+                stats_out=asr_stats)
+
+        from vlog_tpu.obs import trace as obs_trace
 
         try:
-            result = await self._sup()._run_with_timeout(
-                work, timeout, "transcription")
+            with obs_trace.span("worker.transcribe",
+                                video_id=video["id"]) as tsp:
+                result = await self._sup()._run_with_timeout(
+                    work, timeout, "transcription")
+                for k, v in asr_stats.items():
+                    tsp.attrs[f"asr.{k}"] = v
         except js.JobStateError:
             # Claim lost: another worker owns this job now — do not stomp
             # whatever status it is writing.

@@ -1370,23 +1370,68 @@ class RemoteWorker(ComputeWatchdogMixin):
             "sheets": result.sheet_count, "tiles": result.tile_count})
         self.stats.bump("completed")
 
+    def _make_asr_checkpoint_cb(self, job_id: int):
+        """ASR resume-state posts (compute thread) through the epoch-
+        fenced progress endpoint: completed windows land in the job row's
+        ``last_checkpoint`` so a successor on ANY worker re-submits only
+        what is missing. Rate-limited; the ``final`` (drain) flush blocks
+        so the state lands before the requeue."""
+        loop = asyncio.get_running_loop()
+        last = 0.0
+
+        async def post(state: dict) -> None:
+            try:
+                await self.client.progress(job_id,
+                                           checkpoint={"asr": state})
+            except ClaimLost:
+                pass   # the progress cb aborts the thread
+            except TransientAPIError:
+                pass   # a missed checkpoint only costs re-decode
+
+        def cb(state: dict, done: int, total: int, final: bool) -> None:
+            nonlocal last
+            now = time.monotonic()
+            if (not final and done < total
+                    and now - last < self.progress_min_interval_s):
+                return
+            last = now
+            fut = asyncio.run_coroutine_threadsafe(post(state), loop)
+            if final:
+                try:
+                    fut.result(timeout=10.0)
+                except Exception:  # noqa: BLE001 — drain deadline wins
+                    pass
+
+        return cb
+
     async def _run_transcription(self, job: dict, video: dict) -> None:
         from vlog_tpu.worker.transcribe import transcribe_video
 
         src = await self._fetch_source(video)
         out_dir = self._job_dir(video) / "out"
         cb = self._make_progress_cb(job["id"], [])
+        ckpt_cb = self._make_asr_checkpoint_cb(job["id"])
         timeout = config.transcode_timeout_s(
             float(video.get("duration_s") or 0.0), "720p")
+        # Cross-worker resume: the predecessor's decoded windows are in
+        # the job row; decode only the rest, byte-identical output.
+        prior = job.get("last_checkpoint") or {}
+        resume = prior.get("asr") if isinstance(prior, dict) else None
+        asr_stats: dict = {}
 
         def work():
             return transcribe_video(src, out_dir, progress_cb=cb,
-                                    model_dir=self.transcription_model_dir)
+                                    model_dir=self.transcription_model_dir,
+                                    job_key=f"job-{job['id']}",
+                                    checkpoint_cb=ckpt_cb, resume=resume,
+                                    stats_out=asr_stats)
 
-        with obs_trace.span("worker.transcription") as sp:
+        with obs_trace.span("worker.transcribe") as sp:
             result = await self._run_with_timeout(work, timeout,
                                                   "transcription")
             sp.attrs.update(language=result.language, model=result.model)
+            for k, v in asr_stats.items():
+                sp.attrs[f"asr.{k}"] = v
         with obs_trace.span("worker.upload"):
             await self.client.upload_file(video["id"], "captions.vtt",
                                           Path(result.vtt_path))
