@@ -446,9 +446,15 @@ def test_sse_stream_emits_progress_blocks(run, db, tmp_path):
                                              progress=55.0,
                                              current_step="mid")
                 buf = b""
-                async with asyncio.timeout(10):
-                    while b'"progress": 55.0' not in buf:
-                        buf += await resp.content.read(1024)
+
+                async def read_until_progress() -> bytes:
+                    got = b""
+                    while b'"progress": 55.0' not in got:
+                        got += await resp.content.read(1024)
+                    return got
+
+                # asyncio.timeout is 3.11+; wait_for covers 3.10
+                buf = await asyncio.wait_for(read_until_progress(), 10)
                 assert b"event: progress" in buf
         await srv.close()
 

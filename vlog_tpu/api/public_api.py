@@ -399,8 +399,12 @@ async def serve_media(request: web.Request) -> web.StreamResponse:
         # downloads of the source are gated (reference config.py:602-616)
         if not config.DOWNLOADS_ENABLED:
             return _media_error(403, "downloads disabled")
+    # a request already carrying the peer-fill header IS a peer fill
+    # from another origin: answer from local tiers only, never re-enter
+    # the ring (a misconfigured ring must not chase ownership in a loop)
+    allow_peer = delivery.PEER_FILL_HEADER not in request.headers
     try:
-        got = await plane.fetch(slug, tail)
+        got = await plane.fetch(slug, tail, allow_peer=allow_peer)
     except delivery.LoadShedError as exc:
         resp = _media_error(503, "origin overloaded, retry shortly")
         resp.headers["Retry-After"] = str(exc.retry_after_s)
@@ -409,16 +413,9 @@ async def serve_media(request: web.Request) -> web.StreamResponse:
         # a symlink escape reports like any missing file: revealing
         # "exists but refused" would leak tree shape
         return _media_error(404, "not found")
-    if isinstance(got, delivery.BypassFile):
-        # too large for the buffer cache: stream, FileResponse handles
-        # its own Range/conditional semantics
-        return web.FileResponse(got.path, headers={
-            "Content-Type": got.mime,
-            "Cache-Control": (
-                delivery_http.CACHE_MUTABLE
-                if got.path.suffix.lower() in delivery_http.MUTABLE_SUFFIXES
-                else delivery_http.CACHE_IMMUTABLE),
-            **delivery_http.CORS_HEADERS})
+    # CacheEntry buffers from RAM; FileEntry (large-object bypass, big
+    # L2 hits) streams zero-copy — one state machine for both, so all
+    # four serve paths emit identical validators and bytes
     return delivery_http.entry_response(request, got)
 
 
@@ -466,6 +463,11 @@ def build_public_app(db: Database, *, video_dir: Path | None = None
     app[VIDEO_DIR] = Path(video_dir or config.VIDEO_DIR)
     app[DELIVERY] = DeliveryPlane(db, app[VIDEO_DIR])
     app[SETTINGS_SVC] = SettingsService(db)
+
+    async def _close_delivery(app: web.Application) -> None:
+        await app[DELIVERY].close()
+
+    app.on_cleanup.append(_close_delivery)
     r = app.router
     r.add_get("/api/videos", list_videos)
     r.add_get("/api/videos/{slug}", video_detail)

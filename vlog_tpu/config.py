@@ -297,6 +297,46 @@ DELIVERY_STATE_TTL_S: float = _env_float(
 DELIVERY_MAX_ENTRY_BYTES: int = _env_int(
     "VLOG_DELIVERY_MAX_ENTRY_BYTES", 32 * 1024**2, lo=1)
 
+# ---- distributed tier (L2 + peer-fill + prewarm + sendfile) --------------
+
+# Byte budget of the disk-backed L2 below the RAM LRU (0 disables the
+# disk tier entirely). Entries spill here on L1 eviction and on fill;
+# every read back is sha256-verified against the publish manifest before
+# it can serve, so a corrupt or truncated spill refills instead of
+# serving.
+DELIVERY_L2_BYTES: int = _env_int("VLOG_DELIVERY_L2_BYTES", 0, lo=0)
+# Directory holding the digest-named L2 store (content-addressed:
+# <sha256[:2]>/<sha256>). Safe to wipe at any time — it is purely a
+# warm-set cache rebuilt from the origin tree.
+DELIVERY_L2_DIR: Path = _env_path(
+    "VLOG_DELIVERY_L2_DIR", str(BASE_DIR / "delivery-l2"))
+# Comma-separated base URLs of every origin process in the delivery
+# ring (including this one). Empty = no ring: every miss fills from
+# local disk. With a ring, a miss on a non-owner origin fetches the
+# object from its rendezvous-hash owner over the public /videos route
+# (digest-checked) before falling back to local disk.
+DELIVERY_PEERS: tuple[str, ...] = tuple(
+    u.strip().rstrip("/") for u in
+    _env_str("VLOG_DELIVERY_PEERS", "").split(",") if u.strip())
+# This process's own base URL as it appears in VLOG_DELIVERY_PEERS, so
+# the ring can tell "I am the owner" from "fetch from the owner". Empty
+# with a non-empty ring means this process owns nothing (pure edge).
+DELIVERY_SELF_URL: str = _env_str(
+    "VLOG_DELIVERY_SELF_URL", "").rstrip("/")
+# Per-object peer-fetch budget; a slow or down owner past this falls
+# back to local fill and starts a short cooldown for that peer.
+DELIVERY_PEER_TIMEOUT_S: float = _env_float(
+    "VLOG_DELIVERY_PEER_TIMEOUT", 2.0, lo=0.1)
+# How many leading media segments of each rung finalize_ready warms
+# into the cache (plus every init segment). 0 disables prewarm.
+DELIVERY_PREWARM_SEGMENTS: int = _env_int(
+    "VLOG_DELIVERY_PREWARM_SEGMENTS", 2, lo=0)
+# L2 hits at or above this size serve zero-copy (os.sendfile via a
+# file response) instead of buffering into the RAM LRU; smaller hits
+# promote to L1 as usual.
+DELIVERY_SENDFILE_BYTES: int = _env_int(
+    "VLOG_DELIVERY_SENDFILE_BYTES", 8 * 1024**2, lo=1)
+
 # --------------------------------------------------------------------------
 # Transcription (reference: config.py:263-267)
 # --------------------------------------------------------------------------

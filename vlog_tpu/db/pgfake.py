@@ -54,6 +54,10 @@ _DDL_REWRITES = [
 _INFO_SCHEMA_RE = re.compile(r"\binformation_schema\.columns\b", re.I)
 _PG_NOTIFY_RE = re.compile(
     r"^\s*SELECT\s+pg_notify\s*\(\s*\$1\s*,\s*\$2\s*\)\s*$", re.I)
+# The driver's INSERT-id contract appends "RETURNING id"; sqlite only
+# grew RETURNING in 3.35, so older runtimes strip it and synthesize the
+# rows from rowid arithmetic instead (see _execute).
+_RETURNING_ID_RE = re.compile(r"\s+RETURNING\s+id\s*;?\s*$", re.I)
 _LISTEN_RE = re.compile(r'^\s*LISTEN\s+"?([A-Za-z_][\w]*)"?\s*$', re.I)
 _PARAM_RE = re.compile(r"\$(\d+)")
 
@@ -304,7 +308,21 @@ class _Handler(socketserver.BaseRequestHandler):
         if verb0 == "ROLLBACK" and not conn.in_transaction:
             return [], None, "ROLLBACK"   # PG tolerates; sqlite errors
         params = [None if a is None else a.decode() for a in args]
+        synth_returning = False
+        if verb0 == "INSERT" and sqlite3.sqlite_version_info < (3, 35, 0):
+            stripped = _RETURNING_ID_RE.sub("", ssql)
+            if stripped != ssql:
+                ssql = stripped
+                synth_returning = True
         cur = conn.execute(ssql, params)
+        if synth_returning:
+            # one statement's rowids are allocated in order, so the new
+            # ids are the last n: [lastrowid-n+1 .. lastrowid]
+            n = max(cur.rowcount, 0)
+            last = cur.lastrowid or 0
+            rows = ([[last - n + 1 + i] for i in range(n)]
+                    if n and last else [])
+            return rows, ["id"], f"INSERT 0 {len(rows)}"
         verb = (ssql.lstrip().split(None, 1) or ["?"])[0].upper()
         if cur.description is not None:
             cols = [d[0] for d in cur.description]

@@ -1,5 +1,7 @@
 """Delivery plane: origin segment cache, single-flight, admission,
-publish-keyed invalidation (see delivery/plane.py for the design note).
+publish-keyed invalidation, plus the distributed tier — disk-backed L2,
+consistent-hash peer fill, publish-time prewarm, zero-copy large-object
+serving (see delivery/plane.py for the design note).
 
 Import surface for the rest of the codebase:
 
@@ -7,35 +9,50 @@ Import surface for the rest of the codebase:
 - :func:`invalidate_slug` / :func:`invalidate_all` — called by the
   publish/re-encode/delete/verify paths and the admin endpoint; fan out
   to every plane registered in this process.
+- :func:`prewarm_slug` — publish-time prewarm fan-out (finalize_ready).
 - :func:`stats_snapshot` — the admin stats panel's data source.
 """
 
-from vlog_tpu.delivery.cache import CacheEntry, SegmentCache, SingleFlight
+from vlog_tpu.delivery.cache import (
+    CacheEntry,
+    FileEntry,
+    SegmentCache,
+    SingleFlight,
+)
+from vlog_tpu.delivery.l2 import DiskL2
 from vlog_tpu.delivery.plane import (
-    BypassFile,
+    PEER_FILL_HEADER,
     DeliveryPlane,
     LoadShedError,
     MediaEscapeError,
+    PeerFillError,
     ServingState,
     has_planes,
     invalidate_all,
     invalidate_slug,
+    prewarm_slug,
     register,
     stats_snapshot,
 )
+from vlog_tpu.delivery.ring import Ring
 
 __all__ = [
-    "BypassFile",
     "CacheEntry",
     "DeliveryPlane",
+    "DiskL2",
+    "FileEntry",
     "LoadShedError",
     "MediaEscapeError",
+    "PEER_FILL_HEADER",
+    "PeerFillError",
+    "Ring",
     "SegmentCache",
     "ServingState",
     "SingleFlight",
     "has_planes",
     "invalidate_all",
     "invalidate_slug",
+    "prewarm_slug",
     "register",
     "stats_snapshot",
 ]

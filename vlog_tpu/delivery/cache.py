@@ -36,11 +36,12 @@ class CacheEntry:
     """One cached media object: body bytes + the response metadata."""
 
     __slots__ = ("slug", "rel", "version", "body", "etag", "mime",
-                 "mtime", "immutable", "expires_at")
+                 "mtime", "immutable", "expires_at", "digest")
 
     def __init__(self, *, slug: str, rel: str, version: str, body: bytes,
                  etag: str, mime: str, mtime: float, immutable: bool,
-                 expires_at: float | None = None):
+                 expires_at: float | None = None,
+                 digest: str | None = None):
         self.slug = slug
         self.rel = rel
         self.version = version      # manifest sha256 or mtime-ns tag
@@ -50,6 +51,8 @@ class CacheEntry:
         self.mtime = mtime          # seconds; Last-Modified / If-Range
         self.immutable = immutable  # segments: yes; .m3u8/.mpd: no
         self.expires_at = expires_at  # monotonic deadline; None = pinned
+        self.digest = digest        # manifest sha256 when covered: the
+        #                             L2 spill key; None = L2-ineligible
 
     @property
     def size(self) -> int:
@@ -59,11 +62,36 @@ class CacheEntry:
         return self.expires_at is None or now < self.expires_at
 
 
+class FileEntry:
+    """A file-backed media object served zero-copy via ``os.sendfile``:
+    the ``> VLOG_DELIVERY_MAX_ENTRY_BYTES`` bypass and L2 hits at or
+    above ``VLOG_DELIVERY_SENDFILE_BYTES``. Carries the same response
+    metadata (validators included) as :class:`CacheEntry` but no body —
+    it is never retained in the RAM LRU, and ``delivery/http.py`` builds
+    its 200/206 from the file instead of a buffer."""
+
+    __slots__ = ("slug", "rel", "path", "size", "etag", "mime", "mtime",
+                 "immutable", "digest")
+
+    def __init__(self, *, slug: str, rel: str, path, size: int, etag: str,
+                 mime: str, mtime: float, immutable: bool,
+                 digest: str | None = None):
+        self.slug = slug
+        self.rel = rel
+        self.path = path
+        self.size = size
+        self.etag = etag
+        self.mime = mime
+        self.mtime = mtime
+        self.immutable = immutable
+        self.digest = digest
+
+
 class SegmentCache:
     """LRU over ``(slug, rel)`` bounded by total body bytes."""
 
     def __init__(self, max_bytes: int, *,
-                 on_evict: Callable[[int], None] | None = None):
+                 on_evict: Callable[[CacheEntry], None] | None = None):
         self.max_bytes = max_bytes
         self._entries: OrderedDict[Key, CacheEntry] = OrderedDict()
         self._bytes = 0
@@ -109,7 +137,9 @@ class SegmentCache:
             self._bytes -= victim.size
             self.evictions += 1
             if self._on_evict is not None:
-                self._on_evict(victim.size)
+                # the whole entry, not just its size: the delivery
+                # plane's hook spills digest-covered victims to the L2
+                self._on_evict(victim)
         return True
 
     def invalidate_slug(self, slug: str) -> int:

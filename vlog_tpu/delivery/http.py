@@ -26,11 +26,16 @@ Implemented subset (what MSE/hls players actually send):
 
 from __future__ import annotations
 
+import asyncio
+import os
 from email.utils import formatdate, parsedate_to_datetime
+from stat import S_ISREG
 
 from aiohttp import web
 
-from vlog_tpu.delivery.cache import CacheEntry
+from vlog_tpu.delivery.cache import CacheEntry, FileEntry
+
+Entry = CacheEntry | FileEntry
 
 # The reference subclasses StaticFiles for exactly this table
 # (HLSStaticFiles, docs/ARCHITECTURE.md:59-62).
@@ -76,7 +81,7 @@ def preflight_response() -> web.Response:
     return web.Response(status=204, headers=PREFLIGHT_HEADERS)
 
 
-def cache_control(entry: CacheEntry) -> str:
+def cache_control(entry: Entry) -> str:
     return CACHE_IMMUTABLE if entry.immutable else CACHE_MUTABLE
 
 
@@ -135,7 +140,7 @@ def parse_range(header: str, size: int) -> tuple[int, int] | None:
     return start, min(end, size - 1)
 
 
-def _unmodified_since(header: str | None, entry: CacheEntry) -> bool:
+def _unmodified_since(header: str | None, entry: Entry) -> bool:
     """If-Modified-Since -> 304 eligibility (ETag-less revalidators —
     the header the preflight invites clients to send)."""
     if header is None:
@@ -147,7 +152,7 @@ def _unmodified_since(header: str | None, entry: CacheEntry) -> bool:
     return int(entry.mtime) <= cut
 
 
-def _if_range_allows(header: str | None, entry: CacheEntry) -> bool:
+def _if_range_allows(header: str | None, entry: Entry) -> bool:
     """True when a Range header may be honored under this If-Range."""
     if header is None:
         return True
@@ -166,9 +171,18 @@ def _if_range_allows(header: str | None, entry: CacheEntry) -> bool:
     return int(entry.mtime) == int(cut)
 
 
-def entry_response(request: web.Request, entry: CacheEntry,
-                   ) -> web.Response:
-    """The full conditional/range state machine over a cached buffer."""
+def entry_response(request: web.Request, entry: Entry,
+                   ) -> web.StreamResponse:
+    """The full conditional/range state machine over a delivery entry.
+
+    Buffered entries (:class:`CacheEntry`) answer from RAM; file-backed
+    entries (:class:`FileEntry` — the large-object bypass and big L2
+    hits) answer 200/206 zero-copy via :class:`SendfileResponse`. Both
+    kinds flow through the SAME decision tree with the SAME validators
+    (the entry's digest ETag and origin mtime, never a fresh ``stat``),
+    so the four serve paths — L1, L2, peer, bypass — are byte- and
+    header-identical by construction.
+    """
     base = {
         "Content-Type": entry.mime,
         "ETag": entry.etag,
@@ -188,7 +202,7 @@ def entry_response(request: web.Request, entry: CacheEntry,
         not_modified.pop("Content-Type")
         return web.Response(status=304, headers=not_modified)
 
-    size = len(entry.body)
+    size = entry.size
     rng = None
     # RFC 9110 §13.1.5: a non-matching If-Range means IGNORE the Range
     # header outright — including its 416 path, or a resume against a
@@ -202,14 +216,83 @@ def entry_response(request: web.Request, entry: CacheEntry,
                 headers={**base, "Content-Range": f"bytes */{size}"})
 
     if rng is None:
-        status, body = 200, entry.body
+        status, start, length = 200, 0, size
     else:
         start, end = rng
-        status, body = 206, entry.body[start:end + 1]
+        status, length = 206, end - start + 1
         base["Content-Range"] = f"bytes {start}-{end}/{size}"
 
     if request.method == "HEAD":
-        # mirror the GET headers (Content-Length included) sans body
-        base["Content-Length"] = str(len(body))
+        # mirror the GET headers (Content-Length included) sans body —
+        # answered from metadata for both kinds (no file open for HEAD)
+        base["Content-Length"] = str(length)
         return web.Response(status=status, headers=base)
+    if isinstance(entry, FileEntry):
+        return SendfileResponse(entry.path, status=status, offset=start,
+                                count=length, headers=base)
+    body = entry.body if rng is None else entry.body[start:start + length]
     return web.Response(status=status, body=body, headers=base)
+
+
+class SendfileResponse(web.FileResponse):
+    """Zero-copy body transport, nothing else.
+
+    Every conditional/range decision — 304, 416, If-Range, the byte
+    window — was already made by :func:`entry_response` against the
+    delivery entry's validators, so this class must NOT re-run
+    ``FileResponse``'s stat-based machinery: aiohttp computes an
+    ``mtime-size`` ETag and date-only If-Range, which would diverge from
+    the digest ETags the buffered paths emit. ``prepare`` is overridden
+    to open + fstat the file off-loop and hand straight to
+    ``FileResponse._sendfile`` (``loop.sendfile`` → ``os.sendfile``,
+    with aiohttp's own chunked fallback where unavailable) using the
+    precomputed offset/count and the caller's headers verbatim.
+    """
+
+    def __init__(self, path, *, status: int, offset: int, count: int,
+                 headers: dict[str, str]):
+        super().__init__(path, status=status, headers=headers)
+        self._offset = offset
+        self._count = count
+
+    def _open_stat(self):
+        fobj = open(self._path, "rb")
+        try:
+            st = os.fstat(fobj.fileno())
+        except OSError:
+            fobj.close()
+            raise
+        if not S_ISREG(st.st_mode):
+            fobj.close()
+            raise FileNotFoundError(str(self._path))
+        return fobj
+
+    async def prepare(self, request: web.BaseRequest):
+        loop = asyncio.get_running_loop()
+        try:
+            fobj = await loop.run_in_executor(None, self._open_stat)
+        except OSError:
+            # the file vanished between fill and serve (republish race):
+            # degrade to a clean 404 rather than a torn stream
+            self.set_status(404)
+            self.content_length = 0
+            for name in ("ETag", "Last-Modified", "Content-Range",
+                         "Cache-Control", "Content-Type"):
+                self.headers.pop(name, None)
+            return await web.StreamResponse.prepare(self, request)
+        try:
+            self.content_length = self._count
+            if self._count == 0:
+                return await web.StreamResponse.prepare(self, request)
+            # FileResponse._sendfile: loop.sendfile over the transport,
+            # falling back to chunked executor reads when unsupported
+            return await self._sendfile(request, fobj, self._offset,
+                                        self._count)
+        finally:
+            fut = loop.run_in_executor(None, fobj.close)
+            _CLOSE_FUTURES.add(fut)
+            fut.add_done_callback(_CLOSE_FUTURES.discard)
+
+
+# strong refs to in-flight close futures (mirrors aiohttp's own pattern)
+_CLOSE_FUTURES: set = set()

@@ -1,0 +1,234 @@
+"""Disk-backed L2 below the delivery plane's RAM LRU.
+
+A content-addressed spill store: entries land here on L1 eviction and on
+fill, named by their publish-manifest sha256 (``<digest[:2]>/<digest>``),
+and byte-bounded with LRU eviction of its own. Because the name *is* the
+digest, lookups are exact-content by construction — a republished
+segment gets a new digest and simply stops being looked up, so slug
+invalidation never has to touch the L2 at all; stale objects age out.
+
+Trust model: the store is a cache on local disk, not a source of truth.
+Every read hashes the bytes and compares against the digest name before
+anything can serve or promote to L1 — a corrupt or truncated entry is
+deleted and reported so the caller refills from the origin tree (or a
+peer), never served.
+
+Thread model: fills and spills run on ``asyncio.to_thread`` workers
+while stats are read from the event loop, so the index is lock-guarded.
+File reads/writes happen OUTSIDE the lock (only index bookkeeping is
+serialized); the worst interleaving is two threads verifying the same
+digest twice, which is idempotent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+from collections import OrderedDict
+from pathlib import Path
+from typing import Callable
+
+log = logging.getLogger("vlog.delivery.l2")
+
+__all__ = ["DiskL2"]
+
+# sha256 hex: the only filenames the store creates or trusts on rescan.
+_DIGEST_LEN = 64
+_TMP_PREFIX = "tmp-"
+
+
+def _is_digest(name: str) -> bool:
+    if len(name) != _DIGEST_LEN:
+        return False
+    try:
+        int(name, 16)
+    except ValueError:
+        return False
+    return True
+
+
+class DiskL2:
+    """Byte-bounded digest-named disk store with LRU eviction."""
+
+    def __init__(self, root: str | Path, max_bytes: int, *,
+                 on_evict: Callable[[int], None] | None = None) -> None:
+        self.root = Path(root)
+        self.max_bytes = int(max_bytes)
+        self._on_evict = on_evict
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._index: OrderedDict[str, int] = OrderedDict()  # digest -> size
+        # guarded-by: _lock
+        self._bytes = 0
+        # guarded-by: _lock
+        self.counters = {
+            "hits": 0, "misses": 0, "corrupt": 0,
+            "stores": 0, "evictions": 0,
+        }
+        if self.enabled:
+            self.root.mkdir(parents=True, exist_ok=True)
+            self._rescan()
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_bytes > 0
+
+    # -- init-time rescan --------------------------------------------------
+
+    def _rescan(self) -> None:
+        """Rebuild the index from disk so the warm set survives process
+        restarts. Ordered oldest-mtime-first (approximate recency: mtimes
+        mirror the origin segment, not last access), then trimmed to
+        budget. Stray temp files from a crashed writer are swept."""
+        found: list[tuple[float, str, int]] = []
+        for shard in sorted(self.root.iterdir()) if self.root.is_dir() else []:
+            if not shard.is_dir():
+                if shard.name.startswith(_TMP_PREFIX):
+                    shard.unlink(missing_ok=True)
+                continue
+            for f in shard.iterdir():
+                if f.name.startswith(_TMP_PREFIX):
+                    f.unlink(missing_ok=True)
+                    continue
+                if not _is_digest(f.name):
+                    continue
+                try:
+                    st = f.stat()
+                except OSError:
+                    continue
+                found.append((st.st_mtime, f.name, st.st_size))
+        found.sort()
+        with self._lock:
+            for _, digest, size in found:
+                self._index[digest] = size
+                self._bytes += size
+            victims = self._evict_over_budget_locked()
+        self._unlink_all(victims)
+
+    # -- core --------------------------------------------------------------
+
+    def path_for(self, digest: str) -> Path:
+        return self.root / digest[:2] / digest
+
+    def read(self, digest: str) -> tuple[str, bytes | None, float]:
+        """``(outcome, body, mtime)`` — outcome one of hit/miss/corrupt.
+
+        A hit returns the verified bytes plus the stored mtime (the
+        origin segment's, preserved at store time so Last-Modified is
+        identical whichever tier serves). corrupt means the bytes were
+        there but failed the digest check; the entry has already been
+        deleted and the caller must refill from origin.
+        """
+        if not self.enabled:
+            return "miss", None, 0.0
+        with self._lock:
+            if digest in self._index:
+                self._index.move_to_end(digest)
+                known = True
+            else:
+                known = False
+        path = self.path_for(digest)
+        if not known:
+            self._bump("misses")
+            return "miss", None, 0.0
+        try:
+            st = path.stat()
+            body = path.read_bytes()
+        except OSError:
+            # indexed but unreadable (crash residue, external wipe)
+            self._drop(digest)
+            self._bump("misses")
+            return "miss", None, 0.0
+        if hashlib.sha256(body).hexdigest() != digest:
+            log.warning("l2 entry %s failed digest check (%d bytes); "
+                        "deleting", digest[:12], len(body))
+            self._drop(digest)
+            path.unlink(missing_ok=True)
+            self._bump("corrupt")
+            return "corrupt", None, 0.0
+        self._bump("hits")
+        return "hit", body, st.st_mtime
+
+    def put(self, digest: str, body: bytes, mtime: float) -> bool:
+        """Store verified bytes under their digest; no-op when already
+        present or when the object alone exceeds the byte budget.
+        Atomic: temp write + rename, so readers never see a torn file."""
+        if not self.enabled or len(body) > self.max_bytes:
+            return False
+        with self._lock:
+            if digest in self._index:
+                self._index.move_to_end(digest)
+                return False
+        path = self.path_for(digest)
+        tmp = path.parent / f"{_TMP_PREFIX}{digest[:16]}-{os.getpid()}"
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp.write_bytes(body)
+            # carry the origin segment's mtime so Last-Modified (and the
+            # If-Range date match) is identical across L1/L2/sendfile
+            os.utime(tmp, (mtime, mtime))
+            os.replace(tmp, path)
+        except OSError as exc:
+            log.warning("l2 store failed for %s: %s", digest[:12], exc)
+            tmp.unlink(missing_ok=True)
+            return False
+        with self._lock:
+            if digest in self._index:       # racing writer beat us
+                self._index.move_to_end(digest)
+                return False
+            self._index[digest] = len(body)
+            self._bytes += len(body)
+            self.counters["stores"] += 1
+            victims = self._evict_over_budget_locked()
+        self._unlink_all(victims)
+        return True
+
+    def _evict_over_budget_locked(self) -> list[str]:
+        """LRU-evict index entries until under budget; returns the digests
+        whose files the caller must unlink (outside the lock)."""
+        victims: list[str] = []
+        while self._bytes > self.max_bytes and self._index:
+            digest, size = self._index.popitem(last=False)
+            self._bytes -= size
+            self.counters["evictions"] += 1
+            victims.append(digest)
+        return victims
+
+    def _unlink_all(self, digests: list[str]) -> None:
+        for digest in digests:
+            self.path_for(digest).unlink(missing_ok=True)
+            if self._on_evict is not None:
+                self._on_evict(1)
+
+    def _drop(self, digest: str) -> None:
+        with self._lock:
+            size = self._index.pop(digest, None)
+            if size is not None:
+                self._bytes -= size
+
+    def _bump(self, key: str) -> None:
+        with self._lock:
+            self.counters[key] += 1
+
+    def clear(self) -> int:
+        """Drop every entry (admin invalidate-all); returns count.
+        Not counted as evictions — a clear is an operator action, not
+        budget pressure."""
+        with self._lock:
+            victims = list(self._index)
+            self._index.clear()
+            self._bytes = 0
+        for digest in victims:
+            self.path_for(digest).unlink(missing_ok=True)
+        return len(victims)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "bytes": self._bytes,
+                "budget_bytes": self.max_bytes,
+                "entries": len(self._index),
+                **self.counters,
+            }

@@ -19,34 +19,64 @@ viewer — touches neither Postgres nor ``open()``:
 - **invalidation** — publish/re-encode/delete/restore/verify paths call
   :func:`invalidate_slug`, which fans out to every plane registered in
   this process (plus ``POST /api/delivery/invalidate`` for operators).
-  Cross-process staleness of publish state and manifests is bounded by
-  ``VLOG_DELIVERY_STATE_TTL`` / ``VLOG_DELIVERY_MANIFEST_TTL``; segment
-  BODIES are pinned by default, so a split deployment (admin/worker
-  mutating trees in another process) must set
-  ``VLOG_DELIVERY_SEGMENT_TTL`` for republished segments to converge.
 
-Counters go two places on purpose: plain ints on the plane (the admin
-stats panel and tests read exact deltas) and the process-wide
-``obs.metrics.runtime()`` registry (Prometheus families
+Below and beside the RAM LRU sits the **distributed tier**:
+
+- a **disk-backed L2** (delivery/l2.py): digest-covered entries spill
+  there on fill and on L1 eviction; an L1 miss probes it before any
+  origin read, and every L2 read is sha256-verified against the
+  manifest digest before it can serve — corrupt spills are deleted and
+  refilled, never served. Content addressing makes slug invalidation a
+  no-op for the L2: a republished file gets a new digest and the old
+  object simply stops being looked up.
+- a **rendezvous-hash ring** (delivery/ring.py) over
+  ``VLOG_DELIVERY_PEERS``: a miss on a non-owner origin fetches the
+  object from its owner over the public media route (digest-verified,
+  loop-guarded by the ``X-Vlog-Peer-Fill`` header) before falling back
+  to local disk, so the fleet converges on one hot set instead of N.
+  A failing peer gets a short cooldown and fills degrade to local.
+- **publish-time prewarm**: ``finalize_ready`` schedules
+  :meth:`DeliveryPlane.prewarm_slug`, pulling every init segment plus
+  the first ``VLOG_DELIVERY_PREWARM_SEGMENTS`` media segments of each
+  rung through the normal fetch path so a fresh publish's first viewer
+  hits RAM.
+- a **zero-copy path**: the ``> VLOG_DELIVERY_MAX_ENTRY_BYTES`` bypass
+  and L2 hits at or above ``VLOG_DELIVERY_SENDFILE_BYTES`` return
+  :class:`~vlog_tpu.delivery.cache.FileEntry`, which
+  ``delivery/http.py`` serves via ``os.sendfile`` instead of buffering.
+
+Counters go two places on purpose: the lock-guarded dict on the plane
+(the admin stats panel and tests read exact deltas) and the
+process-wide ``obs.metrics.runtime()`` registry (Prometheus families
 ``vlog_delivery_*`` — scraped via the public API's ``/metrics``).
 """
 
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import logging
 import os
 import stat as stat_mod
 import threading
 import time
 import weakref
 from dataclasses import dataclass
+from email.utils import parsedate_to_datetime
 from pathlib import Path
 
+import aiohttp
+
 from vlog_tpu import config
-from vlog_tpu.delivery.cache import CacheEntry, SegmentCache, SingleFlight
+from vlog_tpu.delivery.cache import CacheEntry, FileEntry, SegmentCache, \
+    SingleFlight
 from vlog_tpu.delivery.http import MEDIA_MIME, MUTABLE_SUFFIXES
+from vlog_tpu.delivery.l2 import DiskL2
+from vlog_tpu.delivery.ring import Ring
 from vlog_tpu.obs.metrics import runtime
 from vlog_tpu.utils import failpoints
+
+log = logging.getLogger("vlog.delivery")
 
 # Publish-state entries (including negative "missing" ones) are tiny;
 # this bound only matters under a random-slug 404 storm.
@@ -55,6 +85,14 @@ _STATE_CACHE_MAX = 16384
 # published file); bound them so a long-lived process serving a huge
 # catalog doesn't accumulate one map per slug ever touched.
 _DIGEST_CACHE_MAX = 2048
+# How long a failed peer sits out before the next fill retries it.
+_PEER_COOLDOWN_S = 5.0
+# Requests carrying this header are peer fills from another origin:
+# they must answer from local tiers only (never re-enter the ring), or
+# a misconfigured ring could chase ownership in a cycle.
+PEER_FILL_HEADER = "X-Vlog-Peer-Fill"
+# Media-segment suffixes the prewarm pass considers (CMAF + TS).
+_SEGMENT_SUFFIXES = (".m4s", ".ts")
 
 
 class LoadShedError(RuntimeError):
@@ -69,21 +107,16 @@ class MediaEscapeError(PermissionError):
     """A resolved path escaped the slug's tree (symlink traversal)."""
 
 
+class PeerFillError(RuntimeError):
+    """A peer fetch came back unusable (status, digest, transport)."""
+
+
 @dataclass(frozen=True)
 class ServingState:
     """What the media route needs to gate a request — nothing more."""
 
     video_id: int | None
     status: str                 # 'ready' | 'deleted' | 'missing' | other
-
-
-@dataclass(frozen=True)
-class BypassFile:
-    """An object too large to buffer: stream it from disk instead."""
-
-    path: Path
-    mime: str
-    size: int
 
 
 class DeliveryPlane:
@@ -95,7 +128,14 @@ class DeliveryPlane:
                  manifest_ttl_s: float | None = None,
                  segment_ttl_s: float | None = None,
                  state_ttl_s: float | None = None,
-                 max_entry_bytes: int | None = None):
+                 max_entry_bytes: int | None = None,
+                 l2_bytes: int | None = None,
+                 l2_dir: str | Path | None = None,
+                 peers: tuple[str, ...] | list[str] | None = None,
+                 self_url: str | None = None,
+                 peer_timeout_s: float | None = None,
+                 prewarm_segments: int | None = None,
+                 sendfile_bytes: int | None = None):
         self.db = db
         self.video_dir = Path(video_dir)
         self.max_inflight_reads = (config.DELIVERY_MAX_INFLIGHT_READS
@@ -110,20 +150,38 @@ class DeliveryPlane:
         self.max_entry_bytes = (config.DELIVERY_MAX_ENTRY_BYTES
                                 if max_entry_bytes is None
                                 else max_entry_bytes)
+        self.peer_timeout_s = (config.DELIVERY_PEER_TIMEOUT_S
+                               if peer_timeout_s is None else peer_timeout_s)
+        self.prewarm_segments = (config.DELIVERY_PREWARM_SEGMENTS
+                                 if prewarm_segments is None
+                                 else prewarm_segments)
+        self.sendfile_bytes = (config.DELIVERY_SENDFILE_BYTES
+                               if sendfile_bytes is None else sendfile_bytes)
         m = runtime()
         self.cache = SegmentCache(
             config.DELIVERY_CACHE_BYTES if cache_bytes is None
             else cache_bytes,
-            on_evict=lambda _size: m.delivery_evictions.inc())
+            on_evict=self._on_l1_evict)
         self.flight = SingleFlight(
             on_collapse=lambda: m.delivery_collapses.inc())
-        # loop-confined: _states/_fill_gen/counters are only touched
-        # from event-loop coroutines, never from fill threads
+        self.l2 = DiskL2(
+            config.DELIVERY_L2_DIR if l2_dir is None else l2_dir,
+            config.DELIVERY_L2_BYTES if l2_bytes is None else l2_bytes,
+            on_evict=lambda _n: runtime().delivery_l2_evictions.inc())
+        self.ring = Ring(
+            config.DELIVERY_PEERS if peers is None else peers,
+            config.DELIVERY_SELF_URL if self_url is None else self_url)
+        # loop-confined: _states/_fill_gen/_inflight_reads/_peer_down/
+        # _tasks/_http are only touched from event-loop coroutines,
+        # never from fill threads
         self._states: dict[str, tuple[ServingState, float]] = {}
+        self._peer_down: dict[str, float] = {}      # peer -> retry-at
+        self._tasks: set[asyncio.Task] = set()      # spills + prewarms
+        self._http: aiohttp.ClientSession | None = None
         # slug -> (outputs.json mtime_ns | None, {rel: (size, sha256)})
-        # — read AND refreshed inside _read_entry, which runs in
-        # asyncio.to_thread fill workers: concurrent fills for two
-        # slugs would otherwise race the dict (and the bound/clear)
+        # — read AND refreshed inside fill workers running in
+        # asyncio.to_thread: concurrent fills for two slugs would
+        # otherwise race the dict (and the bound/clear)
         self._digest_lock = threading.Lock()
         # guarded-by: _digest_lock
         self._digests: dict[str, tuple[int | None,
@@ -134,12 +192,23 @@ class DeliveryPlane:
         # not cache what it read (the tree may have been rewritten
         # between its read and its put)
         self._fill_gen = 0
+        # hot counters are bumped from event-loop coroutines AND from
+        # to_thread fill workers (spills, prewarm bookkeeping), so they
+        # live behind a lock; _bump is the one write path
+        self._counter_lock = threading.Lock()
+        # guarded-by: _counter_lock
         self.counters = {
             "hits": 0, "misses": 0, "bypass": 0, "shed": 0,
             "disk_reads": 0, "state_hits": 0, "state_misses": 0,
             "state_stale": 0, "invalidations": 0,
+            "peer_fills": 0, "peer_errors": 0, "sendfile": 0,
+            "prewarm_runs": 0, "prewarm_segments": 0, "prewarm_errors": 0,
         }
         register(self)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._counter_lock:
+            self.counters[key] += n
 
     # -- publish-state gate ------------------------------------------------
 
@@ -148,9 +217,9 @@ class DeliveryPlane:
         now = time.monotonic()
         cached = self._states.get(slug)
         if cached is not None and now < cached[1]:
-            self.counters["state_hits"] += 1
+            self._bump("state_hits")
             return cached[0]
-        self.counters["state_misses"] += 1
+        self._bump("state_misses")
         from vlog_tpu.jobs import videos as vids   # lazy: no import cycle
 
         try:
@@ -165,7 +234,7 @@ class DeliveryPlane:
             # state is in hand — keep playback alive on it rather than
             # 500 every viewer. Re-extend by one TTL so a flap costs one
             # probe per slug per TTL, not one per request.
-            self.counters["state_stale"] += 1
+            self._bump("state_stale")
             runtime().delivery_stale_state.inc()
             st = cached[0]
             self._states[slug] = (st, now + self.state_ttl_s)
@@ -183,10 +252,12 @@ class DeliveryPlane:
 
     # -- segment fetch -----------------------------------------------------
 
-    async def fetch(self, slug: str, rel: str
-                    ) -> CacheEntry | BypassFile:
-        """The media body for ``slug/rel`` — cached, or read via
-        single-flight under the admission bound.
+    async def fetch(self, slug: str, rel: str, *, allow_peer: bool = True
+                    ) -> CacheEntry | FileEntry:
+        """The media body for ``slug/rel`` — L1, then L2, then the ring
+        owner, then local disk, via single-flight under the admission
+        bound. ``allow_peer=False`` (requests already carrying the
+        peer-fill header) answers from local tiers only.
 
         Raises FileNotFoundError (404), :class:`MediaEscapeError`
         (symlink traversal, also a 404 — don't leak tree shape),
@@ -196,19 +267,20 @@ class DeliveryPlane:
         """
         entry = self.cache.get((slug, rel))
         if entry is not None:
-            self.counters["hits"] += 1
+            self._bump("hits")
             m = runtime()
             m.delivery_requests.labels("hit").inc()
             m.delivery_bytes.labels("cache").inc(entry.size)
             return entry
-        return await self.flight.run((slug, rel),
-                                     lambda: self._fill(slug, rel))
+        return await self.flight.run(
+            (slug, rel), lambda: self._fill(slug, rel, allow_peer))
 
-    async def _fill(self, slug: str, rel: str) -> CacheEntry | BypassFile:
+    async def _fill(self, slug: str, rel: str, allow_peer: bool
+                    ) -> CacheEntry | FileEntry:
         # a just-finished leader may have filled it while we queued
         entry = self.cache.get((slug, rel))
         if entry is not None:
-            self.counters["hits"] += 1
+            self._bump("hits")
             runtime().delivery_requests.labels("hit").inc()
             runtime().delivery_bytes.labels("cache").inc(entry.size)
             return entry
@@ -216,44 +288,262 @@ class DeliveryPlane:
         try:
             failpoints.hit("delivery.shed")
         except failpoints.FailpointError:
-            self.counters["shed"] += 1
+            self._bump("shed")
             m.delivery_requests.labels("shed").inc()
             raise LoadShedError() from None
         if self._inflight_reads >= self.max_inflight_reads:
-            self.counters["shed"] += 1
+            self._bump("shed")
             m.delivery_requests.labels("shed").inc()
             raise LoadShedError()
         self._inflight_reads += 1
         m.delivery_inflight_reads.set(self._inflight_reads)
         gen = self._fill_gen
+        source = "disk"
         try:
-            got = await asyncio.to_thread(self._read_entry, slug, rel)
+            got: CacheEntry | FileEntry | None = None
+            kind, meta = await asyncio.to_thread(self._pre_fill, slug, rel)
+            if kind == "l2":
+                digest, size, body, mtime = meta
+                m.delivery_l2_requests.labels("hit").inc()
+                m.delivery_bytes.labels("l2").inc(size)
+                source = "l2"
+                if size >= self.sendfile_bytes:
+                    got = FileEntry(
+                        slug=slug, rel=rel, path=self.l2.path_for(digest),
+                        size=size, etag=f'"{digest}"', mime=_mime_for(rel),
+                        mtime=mtime, immutable=True, digest=digest)
+                else:
+                    got = self._entry_from_bytes(slug, rel, digest, body,
+                                                 mtime)
+            else:
+                if kind in ("miss", "corrupt") and self.l2.enabled:
+                    m.delivery_l2_requests.labels(kind).inc()
+                if meta is not None and allow_peer:
+                    digest, _size = meta
+                    got = await self._peer_fetch(slug, rel, digest)
+                    if got is not None:
+                        source = "peer"
+                        m.delivery_bytes.labels("peer").inc(got.size)
+                        self._store_l2_soon(got)
+            if got is None:
+                got = await asyncio.to_thread(self._read_entry, slug, rel)
+                self._bump("disk_reads")
+                if isinstance(got, CacheEntry):
+                    m.delivery_bytes.labels("disk").inc(got.size)
+                    self._store_l2_soon(got)
         finally:
             self._inflight_reads -= 1
             m.delivery_inflight_reads.set(self._inflight_reads)
-        self.counters["disk_reads"] += 1
-        if isinstance(got, BypassFile):
-            self.counters["bypass"] += 1
+        if source == "l2":
+            m.delivery_requests.labels("l2_hit").inc()
+        elif source == "peer":
+            self._bump("peer_fills")
+            m.delivery_requests.labels("peer_fill").inc()
+        elif isinstance(got, FileEntry):
+            self._bump("bypass")
             m.delivery_requests.labels("bypass").inc()
-            return got
-        self.counters["misses"] += 1
-        m.delivery_requests.labels("miss").inc()
-        m.delivery_bytes.labels("disk").inc(got.size)
-        if gen == self._fill_gen:
-            # an invalidation mid-read means these bytes may predate a
+        else:
+            self._bump("misses")
+            m.delivery_requests.labels("miss").inc()
+        if isinstance(got, FileEntry):
+            self._bump("sendfile")
+        elif gen == self._fill_gen:
+            # an invalidation mid-fill means these bytes may predate a
             # tree rewrite: serve them to the waiters, cache nothing
             self.cache.put(got)
-        m.delivery_cache_bytes.set(self.cache.bytes_cached)
+            m.delivery_cache_bytes.set(self.cache.bytes_cached)
         return got
 
+    # -- peer fill (event loop: aiohttp client) ----------------------------
+
+    async def _peer_fetch(self, slug: str, rel: str, digest: str
+                          ) -> CacheEntry | None:
+        """Fetch one digest-known object from its ring owner; None means
+        'fall back to local fill' (not-owner-here, cooldown, transport
+        error, bad status, digest mismatch)."""
+        key = f"{slug}/{rel}"
+        if self.ring.is_local(key):
+            return None
+        owner = self.ring.owner(key)
+        assert owner is not None
+        now = time.monotonic()
+        if self._peer_down.get(owner, 0.0) > now:
+            return None
+        try:
+            failpoints.hit("delivery.peer")
+            sess = self._http_session()
+            async with sess.get(
+                    f"{owner}/videos/{slug}/{rel}",
+                    headers={PEER_FILL_HEADER: "1"},
+                    timeout=aiohttp.ClientTimeout(total=self.peer_timeout_s),
+            ) as resp:
+                if resp.status != 200:
+                    raise PeerFillError(f"{owner} answered {resp.status}")
+                body = await resp.read()
+                last_modified = resp.headers.get("Last-Modified")
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — any failure degrades
+            self._peer_failed(owner, exc)
+            return None
+        if hashlib.sha256(body).hexdigest() != digest:
+            # the owner served bytes that don't match the manifest this
+            # origin published against — treat the peer as unhealthy
+            self._peer_failed(owner, PeerFillError(
+                f"{owner} body does not match digest {digest[:12]}…"))
+            return None
+        mtime = _parse_http_date(last_modified)
+        runtime().delivery_peer_fills.labels("hit").inc()
+        return self._entry_from_bytes(slug, rel, digest, body, mtime)
+
+    def _peer_failed(self, owner: str, exc: BaseException) -> None:
+        self._peer_down[owner] = time.monotonic() + _PEER_COOLDOWN_S
+        self._bump("peer_errors")
+        runtime().delivery_peer_fills.labels("error").inc()
+        log.warning("peer-fill from %s failed (%.1fs cooldown): %s",
+                    owner, _PEER_COOLDOWN_S, exc)
+
+    def _http_session(self) -> aiohttp.ClientSession:
+        if self._http is None or self._http.closed:
+            self._http = aiohttp.ClientSession()
+        return self._http
+
+    async def close(self) -> None:
+        """Release loop-bound resources (peer HTTP session, background
+        spill/prewarm tasks). Called from the app's cleanup hook."""
+        for t in list(self._tasks):
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        if self._http is not None and not self._http.closed:
+            await self._http.close()
+
+    # -- L2 spill ----------------------------------------------------------
+
+    def _on_l1_evict(self, victim: CacheEntry) -> None:
+        runtime().delivery_evictions.inc()
+        self._store_l2_soon(victim)
+
+    def _store_l2_soon(self, entry: CacheEntry | FileEntry) -> None:
+        """Write-through/spill one digest-covered immutable entry to the
+        L2 off the serve path. On the event loop this schedules a
+        thread; in loop-less (unit-test) contexts it writes inline."""
+        if not self.l2.enabled or not isinstance(entry, CacheEntry):
+            return
+        if entry.digest is None or not entry.immutable:
+            return
+
+        digest, body, mtime = entry.digest, entry.body, entry.mtime
+
+        def work() -> None:
+            if self.l2.put(digest, body, mtime):
+                runtime().delivery_l2_bytes.set(self.l2.stats()["bytes"])
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            work()
+            return
+        t = loop.create_task(asyncio.to_thread(work))
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    # -- publish-time prewarm ----------------------------------------------
+
+    def schedule_prewarm(self, slug: str) -> bool:
+        """Fire-and-forget prewarm of a freshly published slug; False
+        when prewarm is disabled or no loop is running here."""
+        if self.prewarm_segments <= 0:
+            return False
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return False
+        t = loop.create_task(self.prewarm_slug(slug))
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+        return True
+
+    async def prewarm_slug(self, slug: str) -> dict:
+        """Pull every init segment + the first ``prewarm_segments``
+        media segments of each rung through the normal fetch path (so
+        single-flight, L2 write-through, and the ring all apply)."""
+        self._bump("prewarm_runs")
+        m = runtime()
+        rels = await asyncio.to_thread(self._prewarm_targets, slug)
+        warmed = errors = 0
+        for rel in rels:
+            try:
+                await self.fetch(slug, rel)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — prewarm is best-effort
+                errors += 1
+                m.delivery_prewarm.labels("error").inc()
+            else:
+                warmed += 1
+                m.delivery_prewarm.labels("warmed").inc()
+        self._bump("prewarm_segments", warmed)
+        self._bump("prewarm_errors", errors)
+        return {"slug": slug, "targets": len(rels), "warmed": warmed,
+                "errors": errors}
+
+    def _prewarm_targets(self, slug: str) -> list[str]:
+        """Init segments + first-N media segments per rung directory,
+        straight from the publish manifest (no playlist parsing)."""
+        from vlog_tpu.storage import integrity
+
+        _, files = integrity.manifest_digests(self.video_dir / slug)
+        inits: list[str] = []
+        by_dir: dict[str, list[str]] = {}
+        for rel in files:
+            name = rel.rsplit("/", 1)[-1]
+            if name.startswith("init"):
+                inits.append(rel)
+            elif Path(name).suffix.lower() in _SEGMENT_SUFFIXES:
+                d = rel.rsplit("/", 1)[0] if "/" in rel else ""
+                by_dir.setdefault(d, []).append(rel)
+        targets = sorted(inits)
+        for _, segs in sorted(by_dir.items()):
+            targets.extend(sorted(segs)[:self.prewarm_segments])
+        return targets
+
     # -- blocking internals (run in a thread) ------------------------------
+
+    def _pre_fill(self, slug: str, rel: str):
+        """L2/ring eligibility + the L2 probe, off-loop.
+
+        Returns one of::
+
+            ("l2",     (digest, size, body, mtime))   # verified L2 hit
+            ("miss",   (digest, size))   # digest known, not in L2
+            ("corrupt", (digest, size))  # was in L2, failed verify
+            ("origin", None)             # mutable / uncovered / bypass
+        """
+        if not self.l2.enabled and not self.ring.enabled:
+            return "origin", None       # single-origin: no extra stat
+        if Path(rel).suffix.lower() in MUTABLE_SUFFIXES:
+            return "origin", None       # playlists mutate: local + TTL
+        want = self._manifest_meta(slug, rel)
+        if want is None:
+            return "origin", None       # no manifest coverage: local
+        size, digest = want
+        if size > self.max_entry_bytes:
+            return "origin", None       # bypass objects stream locally
+        if not self.l2.enabled:
+            return "miss", (digest, size)
+        outcome, body, mtime = self.l2.read(digest)
+        if outcome == "hit":
+            return "l2", (digest, size, body, mtime)
+        return outcome, (digest, size)
 
     def _video_root(self) -> Path:
         if self._root_resolved is None:
             self._root_resolved = self.video_dir.resolve()
         return self._root_resolved
 
-    def _read_entry(self, slug: str, rel: str) -> CacheEntry | BypassFile:
+    def _read_entry(self, slug: str, rel: str) -> CacheEntry | FileEntry:
         failpoints.hit("delivery.read")
         raw = self.video_dir / slug / rel
         # ONE resolve per fill (not per hit): the lexical ".." check in
@@ -273,7 +563,16 @@ class DeliveryPlane:
         suffix = resolved.suffix.lower()
         mime = MEDIA_MIME.get(suffix, "application/octet-stream")
         if st.st_size > self.max_entry_bytes:
-            return BypassFile(path=resolved, mime=mime, size=st.st_size)
+            # the bypass still carries the manifest digest when one
+            # covers the file, so its validators match the buffered
+            # paths (mtime-size fallback otherwise — same as below)
+            digest = self._digest_for(slug, rel, st.st_size)
+            etag = (f'"{digest}"' if digest is not None
+                    else f'"{st.st_mtime_ns:x}-{st.st_size:x}"')
+            return FileEntry(
+                slug=slug, rel=rel, path=resolved, size=st.st_size,
+                etag=etag, mime=mime, mtime=st.st_mtime,
+                immutable=suffix not in MUTABLE_SUFFIXES, digest=digest)
         body = resolved.read_bytes()
         digest = self._digest_for(slug, rel, len(body))
         mutable = suffix in MUTABLE_SUFFIXES
@@ -291,16 +590,27 @@ class DeliveryPlane:
         return CacheEntry(
             slug=slug, rel=rel, version=version, body=body, etag=etag,
             mime=mime, mtime=st.st_mtime, immutable=not mutable,
-            expires_at=expires)
+            expires_at=expires, digest=digest)
 
-    def _digest_for(self, slug: str, rel: str, size: int) -> str | None:
-        """The manifest sha256 for one published file, or None.
+    def _entry_from_bytes(self, slug: str, rel: str, digest: str,
+                          body: bytes, mtime: float) -> CacheEntry:
+        """A cacheable entry for digest-verified bytes that did NOT come
+        from the local origin tree (L2 promotion, peer fill)."""
+        expires = None
+        if self.segment_ttl_s > 0:
+            expires = time.monotonic() + self.segment_ttl_s
+        return CacheEntry(
+            slug=slug, rel=rel, version=digest, body=body,
+            etag=f'"{digest}"', mime=_mime_for(rel), mtime=mtime,
+            immutable=True, expires_at=expires, digest=digest)
+
+    def _manifest_meta(self, slug: str, rel: str
+                       ) -> tuple[int, str] | None:
+        """``(size, sha256)`` from the publish manifest, or None.
 
         The per-slug digest map loads from ``outputs.json`` on first
         use and revalidates by the manifest's mtime_ns per fill (a stat,
-        not a re-read — fills are misses, already off the hot path). A
-        size mismatch means the manifest is stale for this rel: fall
-        back to the mtime ETag rather than lie about content.
+        not a re-read — fills are misses, already off the hot path).
         """
         from vlog_tpu.storage import integrity
 
@@ -320,7 +630,13 @@ class DeliveryPlane:
                 if len(self._digests) >= _DIGEST_CACHE_MAX:
                     self._digests.clear()   # coarse but bounded; re-warms
                 self._digests[slug] = cached
-        want = cached[1].get(rel)
+        return cached[1].get(rel)
+
+    def _digest_for(self, slug: str, rel: str, size: int) -> str | None:
+        """The manifest sha256 for one published file, or None. A size
+        mismatch means the manifest is stale for this rel: fall back to
+        the mtime ETag rather than lie about content."""
+        want = self._manifest_meta(slug, rel)
         if want is None or want[0] != size:
             return None
         return want[1]
@@ -328,13 +644,16 @@ class DeliveryPlane:
     # -- invalidation + stats ---------------------------------------------
 
     def invalidate_slug(self, slug: str) -> int:
-        """Evict everything known about one slug; returns entries dropped."""
+        """Evict everything known about one slug; returns entries
+        dropped. The L2 is intentionally untouched: it is addressed by
+        content digest, so a republished tree's new manifest simply
+        stops resolving to the old objects and they age out by LRU."""
         n = self.cache.invalidate_slug(slug)
         self._states.pop(slug, None)
         with self._digest_lock:
             self._digests.pop(slug, None)
         self._fill_gen += 1
-        self.counters["invalidations"] += 1
+        self._bump("invalidations")
         runtime().delivery_cache_bytes.set(self.cache.bytes_cached)
         return n
 
@@ -343,14 +662,19 @@ class DeliveryPlane:
         self._states.clear()
         with self._digest_lock:
             self._digests.clear()
+        n += self.l2.clear()            # operator nuke clears disk too
         self._fill_gen += 1
-        self.counters["invalidations"] += 1
+        self._bump("invalidations")
         runtime().delivery_cache_bytes.set(self.cache.bytes_cached)
+        runtime().delivery_l2_bytes.set(0)
         return n
 
     def stats(self) -> dict:
+        with self._counter_lock:
+            counters = dict(self.counters)
+        l2 = self.l2.stats()
         return {
-            **self.counters,
+            **counters,
             "single_flight_collapses": self.flight.collapses,
             "evictions": self.cache.evictions,
             "expirations": self.cache.expirations,
@@ -360,7 +684,32 @@ class DeliveryPlane:
             "state_entries": len(self._states),
             "inflight_reads": self._inflight_reads,
             "max_inflight_reads": self.max_inflight_reads,
+            "l2_hits": l2["hits"],
+            "l2_misses": l2["misses"],
+            "l2_corrupt": l2["corrupt"],
+            "l2_stores": l2["stores"],
+            "l2_evictions": l2["evictions"],
+            "l2_bytes": l2["bytes"],
+            "l2_budget_bytes": l2["budget_bytes"],
+            "l2_entries": l2["entries"],
+            "ring": self.ring.membership(),
         }
+
+
+def _mime_for(rel: str) -> str:
+    return MEDIA_MIME.get(Path(rel).suffix.lower(),
+                          "application/octet-stream")
+
+
+def _parse_http_date(value: str | None) -> float:
+    """Last-Modified from a peer response -> epoch seconds; the fetch
+    time when absent/garbled (a fresh strong-ETag validator either way)."""
+    if value:
+        try:
+            return parsedate_to_datetime(value).timestamp()
+        except (TypeError, ValueError):
+            pass
+    return time.time()
 
 
 # --------------------------------------------------------------------------
@@ -395,6 +744,13 @@ def invalidate_all() -> int:
     return sum(p.invalidate_all() for p in list(_PLANES))
 
 
+def prewarm_slug(slug: str) -> int:
+    """Schedule publish-time prewarm on every plane in this process;
+    returns how many planes scheduled one (0 with prewarm disabled, no
+    planes, or no running loop — all fine: prewarm is best-effort)."""
+    return sum(1 for p in list(_PLANES) if p.schedule_prewarm(slug))
+
+
 def stats_snapshot() -> dict:
     """Aggregated + per-plane stats for the admin panel."""
     per_plane = [p.stats() for p in list(_PLANES)]
@@ -404,4 +760,5 @@ def stats_snapshot() -> dict:
             if isinstance(v, int):
                 totals[k] = totals.get(k, 0) + v
     return {"planes": per_plane, "totals": totals,
-            "plane_count": len(per_plane)}
+            "plane_count": len(per_plane),
+            "ring": per_plane[0]["ring"] if per_plane else None}

@@ -85,11 +85,17 @@ async def get_video_serving_state(db: Database, slug: str) -> Row | None:
         {"s": slug})
 
 
-async def invalidate_delivery(db: Database, video_id: int) -> None:
+async def invalidate_delivery(db: Database, video_id: int, *,
+                              prewarm: bool = False) -> None:
     """Evict a video from any in-process delivery-plane caches after a
     publish-visible mutation (status flip, publish, re-encode). A no-op
     in processes that serve no media; lazy import keeps the job plane
-    free of a delivery dependency at import time."""
+    free of a delivery dependency at import time.
+
+    ``prewarm=True`` (the publish path, finalize_ready) additionally
+    schedules a best-effort warm of the fresh tree's init segments +
+    leading media segments, so the first viewer hits RAM instead of
+    paying cold reads — the eviction always lands first."""
     from vlog_tpu import delivery
 
     if not delivery.has_planes():
@@ -98,6 +104,8 @@ async def invalidate_delivery(db: Database, video_id: int) -> None:
                              {"id": video_id})
     if row is not None:
         delivery.invalidate_slug(row["slug"])
+        if prewarm:
+            delivery.prewarm_slug(row["slug"])
 
 
 async def set_status(
@@ -163,5 +171,6 @@ async def finalize_ready(
                 },
             )
     # publish-keyed invalidation: a (re)published tree must be visible
-    # to in-process delivery caches immediately, not after the TTL
-    await invalidate_delivery(db, video_id)
+    # to in-process delivery caches immediately, not after the TTL —
+    # and the fresh tree's leading segments are prewarmed right behind
+    await invalidate_delivery(db, video_id, prewarm=True)

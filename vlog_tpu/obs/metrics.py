@@ -148,12 +148,12 @@ class RuntimeMetrics:
         self.delivery_requests = Counter(
             "vlog_delivery_requests_total",
             "Delivery-plane media request outcomes "
-            "(hit, miss, bypass, shed)",
+            "(hit, l2_hit, peer_fill, miss, bypass, shed)",
             ["outcome"], registry=self.registry)
         self.delivery_bytes = Counter(
             "vlog_delivery_bytes_total",
             "Payload bytes produced by the delivery plane, by source "
-            "(cache buffer vs origin disk read)",
+            "(cache, l2, peer, disk)",
             ["source"], registry=self.registry)
         self.delivery_evictions = Counter(
             "vlog_delivery_evictions_total",
@@ -171,6 +171,32 @@ class RuntimeMetrics:
             "vlog_delivery_inflight_reads",
             "Cache-fill disk reads currently in flight",
             registry=self.registry)
+        # Distributed delivery tier: disk-backed L2, consistent-hash
+        # peer fill, publish-time prewarm (delivery/{l2,ring,plane}.py).
+        self.delivery_l2_requests = Counter(
+            "vlog_delivery_l2_requests_total",
+            "Disk L2 probe outcomes on L1 miss "
+            "(hit, miss, corrupt — corrupt entries are deleted and "
+            "refilled, never served)",
+            ["outcome"], registry=self.registry)
+        self.delivery_l2_bytes = Gauge(
+            "vlog_delivery_l2_bytes",
+            "Bytes currently held by the disk-backed delivery L2",
+            registry=self.registry)
+        self.delivery_l2_evictions = Counter(
+            "vlog_delivery_l2_evictions_total",
+            "Disk L2 entries evicted to stay under the byte budget",
+            registry=self.registry)
+        self.delivery_peer_fills = Counter(
+            "vlog_delivery_peer_fills_total",
+            "Consistent-hash peer fill outcomes (hit = digest-verified "
+            "body from the ring owner; error = any failure, which "
+            "degrades to a local fill)",
+            ["outcome"], registry=self.registry)
+        self.delivery_prewarm = Counter(
+            "vlog_delivery_prewarm_total",
+            "Publish-time prewarm segment outcomes (warmed, error)",
+            ["outcome"], registry=self.registry)
         # Mesh job scheduler (parallel/scheduler.py): slot arbitration
         # over the process's device mesh.
         self.mesh_slots = Gauge(

@@ -117,6 +117,8 @@ SITES: dict[str, str] = {
     "storage.gc": "storage.gc.run_gc entry",
     "delivery.read": "delivery plane cache-fill, before the disk read",
     "delivery.shed": "delivery plane admission check; forces load-shed",
+    "delivery.peer": "delivery plane peer fill, before the owner fetch; "
+                     "an armed hit degrades the fill to local disk",
     "device.fault": "compute thread, start of the backend ladder run; "
                     "re-raised as a synthetic XLA-like device error",
     "claim.fence": "WorkerAPIClient epoch header; the armed write sends "

@@ -914,7 +914,12 @@ async function loadDeliveryStats() {
   tb.textContent = "";
   $("dl-empty").hidden = d.plane_count > 0;
   $("dl-stats").hidden = d.plane_count === 0;
-  if (d.plane_count === 0) { $("dl-summary").textContent = ""; return; }
+  $("dl-tier").hidden = d.plane_count === 0;
+  if (d.plane_count === 0) {
+    $("dl-summary").textContent = "";
+    $("dl-ring").textContent = "";
+    return;
+  }
   const s = d.totals;
   const served = s.hits + s.misses;
   const rate = served ? ((100 * s.hits) / served).toFixed(1) + "%" : "—";
@@ -925,6 +930,21 @@ async function loadDeliveryStats() {
     String(s.evictions), String(s.shed), String(s.state_hits),
     String(s.state_misses)]);
   tb.appendChild(tr);
+  const tt = $("dl-tier").tBodies[0];
+  tt.textContent = "";
+  const t2 = document.createElement("tr");
+  cells(t2, [String(s.l2_hits), String(s.l2_misses), String(s.l2_corrupt),
+    String(s.l2_stores), String(s.l2_evictions),
+    `${fmtBytes(s.l2_bytes)} / ${fmtBytes(s.l2_budget_bytes)}`,
+    String(s.peer_fills), String(s.peer_errors), String(s.sendfile),
+    String(s.prewarm_runs), String(s.prewarm_segments),
+    String(s.prewarm_errors)]);
+  tt.appendChild(t2);
+  const ring = d.ring;
+  $("dl-ring").textContent = ring && ring.enabled
+    ? `ring: ${ring.peers.length} peers [${ring.peers.join(", ")}]` +
+      (ring.self ? `, self=${ring.self}` : ", self not in ring")
+    : "ring: disabled (single-origin; set VLOG_DELIVERY_PEERS to enable peer fill)";
   $("dl-summary").textContent =
     `${d.plane_count} plane(s), ${s.invalidations} invalidations, ` +
     `${s.inflight_reads}/${s.max_inflight_reads} reads in flight`;
