@@ -1,0 +1,287 @@
+"""Coordination-plane load bench: claims/sec and enqueue->claim latency.
+
+K simulated workers run against an in-process Worker API (aiohttp
+AppRunner on an ephemeral port, sqlite database in a scratch dir — the
+same stack the integration tests drive), so the numbers measure the
+coordination plane itself: auth middleware (verify cache warmed before
+the timed window, so argon2 is out of the picture), the claim
+transaction, the long-poll park, and the event-bus wakeup — not
+accelerator compute.
+
+Three throughput steps over a pre-enqueued backlog of M jobs, drained
+claim-by-claim by K concurrent workers (claims/sec = M / wall):
+
+- ``poll_only``   one job per request, no server-side wait (the classic
+                  claim loop every worker ran before the long-poll
+                  claim; on an empty queue it would sleep a poll
+                  interval — with a backlog the cost is one HTTP
+                  round-trip + one claim transaction per job)
+- ``long_poll``   one job per request, ``wait_s`` set (identical cost on
+                  a backlog; the step exists to show the park adds
+                  nothing when work is plentiful)
+- ``batched``     up to ``--batch`` jobs per request in ONE claim
+                  transaction (amortizes the HTTP hop, the sweep
+                  fast-path probe, and the transaction overhead)
+
+Then a latency step: K workers park in long-poll claim loops while a
+feeder enqueues jobs one at a time; enqueue->claim latency is read back
+from the server-side ``queue.wait`` spans (jobs/claims.py writes one per
+claim, duration = claim time - enqueue/release time), p50/p99 over the
+run. The acceptance bar is p99 under half the classic poll interval
+(VLOG_WORKER_POLL_INTERVAL, default 5 s): a parked claimant must learn
+of new work in wakeup latency, not poll latency.
+
+Records append to BENCH_coord.json in the repo's labeled-record format
+(same shape as BENCH_delivery.json): ``{"step", "metric", "rps",
+"timestamp", "config"}`` — ``rps`` holds the headline value for the
+step's metric (claims/sec, or seconds for the latency records).
+
+Run it: ``python bench_coord.py --workers 32 --jobs 512``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import statistics
+import tempfile
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+
+
+def _utcnow() -> str:
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _quantile(vals: list[float], q: float) -> float:
+    if not vals:
+        return float("nan")
+    s = sorted(vals)
+    idx = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+    return s[idx]
+
+
+class _Stack:
+    """In-process Worker API + K registered clients."""
+
+    def __init__(self, workers: int, tmp: Path):
+        self.workers = workers
+        self.tmp = tmp
+        self.db = None
+        self.runner = None
+        self.base = ""
+        self.clients = []
+
+    async def start(self) -> None:
+        from aiohttp import web
+
+        from vlog_tpu.api.worker_api import build_worker_app
+        from vlog_tpu.db import Database, create_all
+        from vlog_tpu.worker.remote import WorkerAPIClient
+
+        self.db = Database(f"sqlite:///{self.tmp / 'bench_coord.db'}")
+        await self.db.connect()
+        await create_all(self.db)
+        app = build_worker_app(self.db, video_dir=self.tmp / "videos")
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.base = f"http://127.0.0.1:{port}"
+        for i in range(self.workers):
+            name = f"bench-w{i}"
+            key = await WorkerAPIClient.register(self.base, name,
+                                                 accelerator="tpu")
+            self.clients.append(WorkerAPIClient(self.base, key,
+                                                timeout=30.0, retries=1))
+        # warm the auth verify cache so argon2 (deliberately ~100 ms of
+        # CPU per cold key) never lands inside a timed window
+        await asyncio.gather(*(c.claim(["transcode"], "tpu")
+                               for c in self.clients))
+
+    async def close(self) -> None:
+        for c in self.clients:
+            await c.aclose()
+        if self.runner is not None:
+            await self.runner.cleanup()
+        if self.db is not None:
+            await self.db.disconnect()
+
+    async def enqueue(self, n: int, *, prefix: str) -> list[int]:
+        from vlog_tpu.jobs import claims, videos
+
+        ids = []
+        for i in range(n):
+            v = await videos.create_video(self.db, f"{prefix}-{i}",
+                                          source_path="/dev/null")
+            ids.append(await claims.enqueue_job(self.db, v["id"]))
+        return ids
+
+
+async def _drain(stack: _Stack, total: int, *, max_jobs: int,
+                 wait_s: float) -> float:
+    """K workers claim until the backlog is gone; returns the wall
+    seconds from start to the claim that emptied it. (The harness'
+    still-parked stragglers after that point are a drain artifact — a
+    real fleet keeps running — so they are awaited but not timed.)"""
+    remaining = total
+    lock = asyncio.Lock()
+    t0 = time.perf_counter()
+    t_done = t0
+
+    async def worker(client) -> None:
+        nonlocal remaining, t_done
+        while True:
+            async with lock:
+                if remaining <= 0:
+                    return
+            if max_jobs > 1:
+                got = len(await client.claim_batch(
+                    ["transcode"], "tpu", max_jobs=max_jobs, wait_s=wait_s))
+            else:
+                got = int(await client.claim(
+                    ["transcode"], "tpu", wait_s=wait_s) is not None)
+            async with lock:
+                emptied = remaining > 0 and remaining - got <= 0
+                remaining -= got
+                if emptied:
+                    # only the claim that EMPTIED the backlog stamps the
+                    # finish — stragglers returning from a 0-job park
+                    # must not move it
+                    t_done = time.perf_counter()
+                if remaining <= 0:
+                    return
+            if got == 0:
+                # backlog raced empty under a concurrent claimer; the
+                # remaining counter ends the loop next pass
+                await asyncio.sleep(0.01)
+
+    await asyncio.gather(*(worker(c) for c in stack.clients))
+    return t_done - t0
+
+
+async def _latency_run(stack: _Stack, jobs: int, *, gap_s: float,
+                       wait_s: float) -> list[float]:
+    """Workers park in long-poll loops; a feeder trickles jobs in.
+    Returns the server-side ``queue.wait`` durations (enqueue->claim)."""
+    done = asyncio.Event()
+    claimed = 0
+    lock = asyncio.Lock()
+
+    async def worker(client) -> None:
+        nonlocal claimed
+        while not done.is_set():
+            got = await client.claim(["transcode"], "tpu", wait_s=wait_s)
+            if got is None:
+                continue
+            async with lock:
+                claimed += 1
+                if claimed >= jobs:
+                    done.set()
+
+    tasks = [asyncio.create_task(worker(c)) for c in stack.clients]
+    ids = []
+    for i in range(jobs):
+        ids.extend(await stack.enqueue(1, prefix=f"lat-{i}"))
+        await asyncio.sleep(gap_s)
+    await asyncio.wait_for(done.wait(), timeout=60.0)
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    marks = ",".join(f":j{i}" for i in range(len(ids)))
+    rows = await stack.db.fetch_all(
+        f"SELECT duration_s FROM job_spans WHERE name='queue.wait' "
+        f"AND job_id IN ({marks})",
+        {f"j{i}": jid for i, jid in enumerate(ids)})
+    return [float(r["duration_s"]) for r in rows
+            if r["duration_s"] is not None]
+
+
+async def run_bench(args: argparse.Namespace) -> list[dict]:
+    records: list[dict] = []
+    with tempfile.TemporaryDirectory(prefix="bench-coord-") as td:
+        stack = _Stack(args.workers, Path(td))
+        await stack.start()
+        try:
+            steps = [
+                ("poll_only", 1, 0.0),
+                ("long_poll", 1, args.wait_s),
+                ("batched", args.batch, args.wait_s),
+            ]
+            rates: dict[str, float] = {}
+            for step, max_jobs, wait_s in steps:
+                await stack.enqueue(args.jobs, prefix=step)
+                wall = await _drain(stack, args.jobs, max_jobs=max_jobs,
+                                    wait_s=wait_s)
+                rates[step] = args.jobs / wall
+                records.append({
+                    "step": step, "metric": "coord_claims_per_s",
+                    "rps": round(rates[step], 1), "timestamp": _utcnow(),
+                    "config": {"workers": args.workers, "jobs": args.jobs,
+                               "max_jobs": max_jobs, "wait_s": wait_s,
+                               "db": "sqlite"},
+                })
+            lat = await _latency_run(stack, args.latency_jobs,
+                                     gap_s=args.latency_gap_s,
+                                     wait_s=args.wait_s)
+            p50, p99 = _quantile(lat, 0.5), _quantile(lat, 0.99)
+            records.append({
+                "step": "long_poll_latency",
+                "metric": "enqueue_to_claim_p99_s",
+                "rps": round(p99, 4), "timestamp": _utcnow(),
+                "config": {"workers": args.workers,
+                           "jobs": args.latency_jobs,
+                           "gap_s": args.latency_gap_s,
+                           "wait_s": args.wait_s,
+                           "p50_s": round(p50, 4),
+                           "mean_s": round(statistics.fmean(lat), 4)
+                           if lat else None,
+                           "samples": len(lat),
+                           "poll_interval_ref_s": 5.0},
+            })
+            records.append({
+                "step": "speedup", "metric": "batched_vs_poll_only_x",
+                "rps": round(rates["batched"] / rates["poll_only"], 2),
+                "timestamp": _utcnow(),
+                "config": {"workers": args.workers, "jobs": args.jobs,
+                           "batch": args.batch},
+            })
+        finally:
+            await stack.close()
+    return records
+
+
+def append_records(out: Path, records: list[dict]) -> None:
+    existing = []
+    if out.exists():
+        existing = json.loads(out.read_text() or "[]")
+    existing.extend(records)
+    out.write_text(json.dumps(existing, indent=1) + "\n")
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="coordination-plane claims/sec + latency bench")
+    parser.add_argument("--workers", type=int, default=8)
+    parser.add_argument("--jobs", type=int, default=200,
+                        help="backlog size per throughput step")
+    parser.add_argument("--batch", type=int, default=8,
+                        help="max_jobs per request in the batched step")
+    parser.add_argument("--wait-s", type=float, default=2.0,
+                        help="long-poll wait per claim request")
+    parser.add_argument("--latency-jobs", type=int, default=24)
+    parser.add_argument("--latency-gap-s", type=float, default=0.1)
+    parser.add_argument("--out", default="BENCH_coord.json")
+    args = parser.parse_args(argv)
+    records = asyncio.run(run_bench(args))
+    for r in records:
+        print(json.dumps(r))
+    append_records(Path(args.out), records)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,529 @@
+"""Fleet-scale coordination plane: long-poll push claims, batched
+claim/heartbeat/span writes, and the decoupled lease sweep.
+
+The invariants under test are the ones the refactor must not move:
+
+- batch-claim ordering is identical to issuing the same number of
+  single claims (priority DESC, FIFO within a priority band);
+- the X-Claim-Epoch fence holds across batched and long-polled claims;
+- ``_sweep_expired``'s release + dead-letter semantics still fire, now
+  from the periodic sweeper and the in-claim oldest-expiry fast path;
+- a killed notify path (the ``events.publish`` failpoint, a stopped
+  LISTEN thread) degrades parked claimants to re-check/poll latency
+  with zero jobs lost or double-claimed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp.test_utils import TestServer
+
+from vlog_tpu import config
+from vlog_tpu.api.worker_api import COORD, build_worker_app
+from vlog_tpu.db.core import now as db_now
+from vlog_tpu.enums import JobKind
+from vlog_tpu.jobs import claims, videos as vids
+from vlog_tpu.utils import failpoints
+from vlog_tpu.worker.remote import ClaimLost, WorkerAPIClient
+
+
+async def make_video(db, slug="vid"):
+    t = db_now()
+    return await db.execute(
+        "INSERT INTO videos (slug, title, created_at, updated_at)"
+        " VALUES (:s, :s, :t, :t)",
+        {"s": slug, "t": t},
+    )
+
+
+# --------------------------------------------------------------------------
+# Batched claims (claim layer)
+# --------------------------------------------------------------------------
+
+class TestBatchClaims:
+    def test_batch_order_matches_single_claim_semantics(self, db, run):
+        """One claim_jobs(max_jobs=N) hands out exactly the jobs N
+        sequential single claims would, in the same order."""
+        async def body():
+            expect = []
+            for i, prio in enumerate((0, 10, 10, 5, 0)):
+                v = await make_video(db, f"v{i}")
+                jid = await claims.enqueue_job(db, v, priority=prio)
+                expect.append((prio, jid))
+            # priority DESC, then FIFO (enqueue order == created_at order)
+            expect_ids = [jid for _, jid in
+                          sorted(expect, key=lambda e: (-e[0],
+                                                        expect.index(e)))]
+            got = await claims.claim_jobs(db, "w1", max_jobs=3)
+            assert [r["id"] for r in got] == expect_ids[:3]
+            # the remaining backlog continues in the same global order
+            # under plain single claims
+            one = await claims.claim_job(db, "w2")
+            two = await claims.claim_job(db, "w2")
+            assert [one["id"], two["id"]] == expect_ids[3:]
+
+        run(body())
+
+    def test_batch_capped_by_config(self, db, run, monkeypatch):
+        async def body():
+            monkeypatch.setattr(config, "CLAIM_BATCH_MAX", 2)
+            for i in range(4):
+                v = await make_video(db, f"v{i}")
+                await claims.enqueue_job(db, v)
+            got = await claims.claim_jobs(db, "w1", max_jobs=99)
+            assert len(got) == 2
+
+        run(body())
+
+    def test_batch_rows_carry_distinct_epochs_and_leases(self, db, run):
+        """Every batched row is a full claim: its own attempt (= fencing
+        epoch), lease, and ownership — progress under the right worker
+        works, the wrong epoch is rejected."""
+        from vlog_tpu.jobs.state import JobStateError
+
+        async def body():
+            for i in range(3):
+                v = await make_video(db, f"v{i}")
+                await claims.enqueue_job(db, v)
+            got = await claims.claim_jobs(db, "w1", max_jobs=3)
+            assert len(got) == 3
+            for row in got:
+                assert row["claimed_by"] == "w1"
+                assert row["attempt"] == 1
+                assert row["claim_expires_at"] > db_now()
+                await claims.update_progress(db, row["id"], "w1",
+                                             progress=10.0, epoch=1)
+            with pytest.raises(JobStateError):
+                await claims.update_progress(db, got[0]["id"], "w1",
+                                             progress=20.0, epoch=0)
+
+        run(body())
+
+    def test_batch_writes_per_job_trace_anchors(self, db, run):
+        async def body():
+            for i in range(2):
+                v = await make_video(db, f"v{i}")
+                await claims.enqueue_job(db, v)
+            got = await claims.claim_jobs(db, "w1", max_jobs=2)
+            for row in got:
+                assert row["_trace"]["trace_id"]
+                names = {r["name"] for r in await db.fetch_all(
+                    "SELECT name FROM job_spans WHERE job_id=:j",
+                    {"j": row["id"]})}
+                assert {"queue.wait", "server.claim"} <= names
+
+        run(body())
+
+
+# --------------------------------------------------------------------------
+# Decoupled lease sweep
+# --------------------------------------------------------------------------
+
+class TestDecoupledSweep:
+    def test_expired_lease_still_reclaimable_by_next_claim(self, db, run):
+        """The in-claim oldest-expiry fast path keeps the long-standing
+        guarantee: an expired lease is claimable by the very next
+        claim, no sweeper needed."""
+        async def body():
+            v = await make_video(db)
+            jid = await claims.enqueue_job(db, v)
+            await claims.claim_job(db, "w1", lease_s=0.0)
+            await asyncio.sleep(0.01)
+            got = await claims.claim_job(db, "w2")
+            assert got is not None and got["id"] == jid
+            assert got["attempt"] == 2
+            fail = await db.fetch_one(
+                "SELECT * FROM job_failures WHERE job_id=:j", {"j": jid})
+            assert fail["failure_class"] == "worker_crash"
+
+        run(body())
+
+    def test_live_leases_skip_the_sweep_entirely(self, db, run):
+        """With no lapsed lease the claim transaction pays one aggregate
+        probe, never the full sweep: a claim alongside a LIVE lease must
+        not write any failure attribution."""
+        async def body():
+            v1 = await make_video(db, "a")
+            v2 = await make_video(db, "b")
+            await claims.enqueue_job(db, v1)
+            await claims.enqueue_job(db, v2)
+            held = await claims.claim_job(db, "w1", lease_s=3600.0)
+            await claims.claim_job(db, "w2")
+            rows = await db.fetch_all("SELECT * FROM job_failures")
+            assert rows == []
+            row = await db.fetch_one("SELECT * FROM jobs WHERE id=:i",
+                                     {"i": held["id"]})
+            assert row["claimed_by"] == "w1"
+
+        run(body())
+
+    def test_sweep_loop_releases_and_dead_letters(self, db, run):
+        """Invariant (c): the periodic sweeper performs the full
+        _sweep_expired contract — release with worker_crash attribution,
+        dead-letter at exhausted budget — without any claim traffic."""
+        async def body():
+            v1 = await make_video(db, "retryable")
+            v2 = await make_video(db, "exhausted")
+            j1 = await claims.enqueue_job(db, v1)
+            j2 = await claims.enqueue_job(db, v2, max_attempts=1)
+            await claims.claim_jobs(db, "w1", max_jobs=2, lease_s=0.0)
+            await asyncio.sleep(0.01)
+            stop = asyncio.Event()
+            task = asyncio.create_task(
+                claims.sweep_loop(db, stop, interval_s=0.02))
+            for _ in range(100):
+                row = await db.fetch_one(
+                    "SELECT * FROM jobs WHERE id=:i", {"i": j2})
+                if row["failed_at"] is not None:
+                    break
+                await asyncio.sleep(0.05)
+            stop.set()
+            await task
+            j1_row = await db.fetch_one("SELECT * FROM jobs WHERE id=:i",
+                                        {"i": j1})
+            j2_row = await db.fetch_one("SELECT * FROM jobs WHERE id=:i",
+                                        {"i": j2})
+            # budget left: released back to claimable
+            assert j1_row["claimed_by"] is None
+            assert j1_row["failed_at"] is None
+            # budget spent: dead-lettered, not stranded
+            assert j2_row["failed_at"] is not None
+            assert j2_row["claimed_by"] is None
+
+        run(body())
+
+    def test_sweep_loop_zero_interval_is_disabled(self, db, run):
+        async def body():
+            stop = asyncio.Event()
+            await asyncio.wait_for(
+                claims.sweep_loop(db, stop, interval_s=0.0), timeout=1.0)
+
+        run(body())
+
+
+# --------------------------------------------------------------------------
+# HTTP: long-poll + batched claim endpoint
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def api(run, db, tmp_path):
+    """Live worker API on an ephemeral port + a registered client."""
+    video_dir = tmp_path / "srv-videos"
+    app = build_worker_app(db, video_dir=video_dir)
+    server = TestServer(app)
+    run(server.start_server())
+    base = str(server.make_url(""))
+    key = run(WorkerAPIClient.register(base, "cw1", accelerator="tpu"))
+    client = WorkerAPIClient(base, key, timeout=30.0, retries=1)
+    yield {"base": base, "client": client, "db": db, "app": app}
+    run(client.aclose())
+    run(server.close())
+
+
+async def _enqueue_one(db, slug="lp-vid"):
+    v = await vids.create_video(db, slug, source_path="/dev/null")
+    return await claims.enqueue_job(db, v["id"])
+
+
+class TestLongPollClaim:
+    def test_parked_claim_wakes_on_enqueue(self, run, db, api):
+        """A claim parked on an empty queue returns the job the moment
+        one is enqueued — wakeup latency, not poll latency."""
+        async def body():
+            async def park():
+                t0 = time.monotonic()
+                got = await api["client"].claim(["transcode"], "tpu",
+                                                wait_s=10.0)
+                return got, time.monotonic() - t0
+
+            task = asyncio.create_task(park())
+            await asyncio.sleep(0.15)        # let the request park
+            jid = await _enqueue_one(db)
+            got, elapsed = await asyncio.wait_for(task, timeout=5.0)
+            assert got is not None and got["job"]["id"] == jid
+            assert elapsed < 5.0, "woken claim must beat the wait budget"
+
+        run(body())
+
+    def test_parked_claim_times_out_to_204(self, run, api):
+        async def body():
+            t0 = time.monotonic()
+            got = await api["client"].claim(["transcode"], "tpu",
+                                            wait_s=0.4)
+            assert got is None
+            assert time.monotonic() - t0 >= 0.3
+
+        run(body())
+
+    def test_park_shed_past_max_waiters(self, run, db, api, monkeypatch):
+        """Past VLOG_CLAIM_MAX_WAITERS concurrent parks the request is
+        shed to an immediate empty answer (client falls back to its
+        poll interval) instead of growing unbounded server state."""
+        async def body():
+            monkeypatch.setattr(config, "CLAIM_MAX_WAITERS", 1)
+            parked = asyncio.create_task(
+                api["client"].claim(["transcode"], "tpu", wait_s=2.0))
+            await asyncio.sleep(0.15)
+            coord = api["app"][COORD]
+            assert coord.waiters == 1
+            t0 = time.monotonic()
+            got = await api["client"].claim(["transcode"], "tpu",
+                                            wait_s=5.0)
+            assert got is None
+            assert time.monotonic() - t0 < 1.0, "shed, not parked"
+            assert coord.shed == 1
+            await asyncio.gather(parked, return_exceptions=True)
+
+        run(body())
+
+    def test_batched_endpoint_shape_and_legacy_compat(self, run, db, api):
+        async def body():
+            for i in range(3):
+                await _enqueue_one(db, f"b{i}")
+            got = await api["client"].claim_batch(["transcode"], "tpu",
+                                                  max_jobs=2)
+            assert len(got) == 2
+            for entry in got:
+                assert entry["job"]["claimed_by"] == "cw1"
+                assert entry["video"]["slug"].startswith("b")
+                assert entry["trace"]["trace_id"]
+            # a client that never asked for a batch gets the legacy
+            # single shape from the same endpoint
+            one = await api["client"].claim(["transcode"], "tpu")
+            assert one is not None and "job" in one and "jobs" not in one
+
+        run(body())
+
+    def test_epoch_fence_holds_for_batched_claims(self, run, db, api):
+        """Invariant (b): each batched claim registers its own epoch and
+        a stale epoch (claim.fence failpoint) still bounces 409."""
+        async def body():
+            for i in range(2):
+                await _enqueue_one(db, f"f{i}")
+            got = await api["client"].claim_batch(["transcode"], "tpu",
+                                                  max_jobs=2)
+            a, b = (e["job"] for e in got)
+            # the right epoch proceeds
+            await api["client"].progress(a["id"], progress=5.0)
+            failpoints.arm("claim.fence", count=1)
+            try:
+                with pytest.raises(ClaimLost):
+                    await api["client"].progress(b["id"], progress=5.0)
+            finally:
+                failpoints.reset()
+
+        run(body())
+
+    def test_killed_notify_degrades_to_recheck(self, run, db, api,
+                                               monkeypatch):
+        """Invariant (d): with every wakeup hint dropped at the publish
+        site, a parked claimant still gets the job via its jittered
+        re-check — degraded latency, zero lost jobs."""
+        async def body():
+            monkeypatch.setattr(config, "CLAIM_RECHECK_S", 0.2)
+            failpoints.arm("events.publish")   # every hint dropped
+            try:
+                task = asyncio.create_task(
+                    api["client"].claim(["transcode"], "tpu", wait_s=10.0))
+                await asyncio.sleep(0.15)
+                jid = await _enqueue_one(db)
+                got = await asyncio.wait_for(task, timeout=5.0)
+                assert got is not None and got["job"]["id"] == jid
+            finally:
+                failpoints.reset()
+            row = await db.fetch_one("SELECT * FROM jobs WHERE id=:i",
+                                     {"i": jid})
+            assert row["claimed_by"] == "cw1"
+            assert row["attempt"] == 1, "claimed exactly once"
+
+        run(body())
+
+
+# --------------------------------------------------------------------------
+# Write-behind heartbeats
+# --------------------------------------------------------------------------
+
+class TestHeartbeatCoalescing:
+    def test_coalesced_fold_flushes_one_statement(self, run, db, tmp_path,
+                                                  monkeypatch):
+        """N workers' heartbeats inside one flush window land as ONE
+        executemany; drain transitions bypass the buffer entirely."""
+        monkeypatch.setattr(config, "HEARTBEAT_FLUSH_S", 30.0)
+        app = build_worker_app(db, video_dir=tmp_path / "v")
+        server = TestServer(app)
+        run(server.start_server())
+        base = str(server.make_url(""))
+        clients = []
+        try:
+            async def body():
+                for i in range(3):
+                    key = await WorkerAPIClient.register(
+                        base, f"hb{i}", accelerator="tpu")
+                    clients.append(WorkerAPIClient(base, key, timeout=10.0,
+                                                   retries=1))
+                coord = app[COORD]
+                for c in clients:
+                    await c.heartbeat({"chips": 1})
+                    await c.heartbeat({"chips": 2})   # latest wins
+                # buffered, not yet written
+                rows = await db.fetch_all(
+                    "SELECT name, last_heartbeat_at FROM workers")
+                assert all(r["last_heartbeat_at"] is None for r in rows)
+                q0 = db.query_count
+                n = await coord.hb.flush()
+                assert n == 3
+                assert coord.hb.flushes == 1
+                assert db.query_count - q0 == 1, \
+                    "one executemany for the whole window"
+                rows = await db.fetch_all("SELECT * FROM workers")
+                for r in rows:
+                    assert r["last_heartbeat_at"] is not None
+                    assert json.loads(r["capabilities"])["chips"] == 2
+                # draining writes through synchronously
+                await clients[0].heartbeat(draining=True)
+                row = await db.fetch_one(
+                    "SELECT status FROM workers WHERE name='hb0'")
+                assert row["status"] == "draining"
+
+            run(body())
+        finally:
+            for c in clients:
+                run(c.aclose())
+            run(server.close())
+
+
+# --------------------------------------------------------------------------
+# Batched span ingest
+# --------------------------------------------------------------------------
+
+class TestSpanBatchIngest:
+    def test_record_spans_costs_two_statements(self, db, run):
+        from vlog_tpu.obs import store as obs_store, trace as obs_trace
+
+        async def body():
+            v = await make_video(db)
+            jid = await claims.enqueue_job(db, v)
+            _, root, _ = await obs_store.ensure_root(db, jid)
+            buf = obs_trace.TraceBuffer()
+            for i in range(25):
+                buf.add(obs_trace.Span(trace_id="t1", span_id=f"s{i}",
+                                       parent_id=root, name=f"stage.{i}",
+                                       started_at=float(i), duration_s=0.5))
+            q0 = db.query_count
+            inserted = await obs_store.record_spans(db, jid, buf.drain())
+            assert len(inserted) == 25
+            assert db.query_count - q0 == 2, \
+                "one dedupe read + one executemany, regardless of count"
+
+        run(body())
+
+    def test_retried_report_is_dup_accounted(self, db, run):
+        from vlog_tpu.obs import store as obs_store, trace as obs_trace
+
+        async def body():
+            v = await make_video(db)
+            jid = await claims.enqueue_job(db, v)
+            _, root, _ = await obs_store.ensure_root(db, jid)
+            spans = [obs_trace.Span(trace_id="t1", span_id=f"s{i}",
+                                    parent_id=root, name="stage.x",
+                                    started_at=float(i), duration_s=0.1)
+                     for i in range(5)]
+            first = await obs_store.record_spans(db, jid, spans)
+            assert len(first) == 5
+            again = await obs_store.record_spans(db, jid, spans)
+            assert again == [], "a retried report inserts nothing new"
+            n = await db.fetch_val(
+                "SELECT COUNT(*) FROM job_spans WHERE job_id=:j "
+                "AND parent_id IS NOT NULL", {"j": jid})
+            assert n == 5
+
+        run(body())
+
+
+# --------------------------------------------------------------------------
+# Notify-path loss over the Postgres wire (FakePg)
+# --------------------------------------------------------------------------
+
+class TestPgNotifyLoss:
+    def test_listen_loss_degrades_to_poll_no_job_lost(self):
+        """A dead LISTEN thread loses hints, never jobs: subscribers go
+        quiet, the DB queue still hands out every job exactly once, and
+        a restarted bus hears wakeups again."""
+        from vlog_tpu.db import pg
+        from vlog_tpu.db.pgfake import FakePg
+        from vlog_tpu.db.schema import create_all
+        from vlog_tpu.jobs.events import CH_JOBS, bus_for
+
+        srv = FakePg().start()
+        try:
+            async def go():
+                db = pg.PgDatabase(srv.dsn)
+                await db.connect()
+                await create_all(db)
+                bus = bus_for(db)
+                await bus.start()
+                sub = bus.subscribe(CH_JOBS)
+                # sanity: the wire path works before the loss
+                bus.publish(CH_JOBS, {"probe": 1})
+                assert await sub.get(timeout=5.0) == {"probe": 1}
+                # kill the listener: hints now vanish on the floor
+                await asyncio.to_thread(bus._listener.stop)
+                v = await vids.create_video(db, "lost-notify")
+                jid = await claims.enqueue_job(db, v["id"])  # hint lost
+                assert await sub.get(timeout=0.3) is None
+                # ...but the queue of record never depended on it
+                got = await claims.claim_job(db, "w1")
+                assert got is not None and got["id"] == jid
+                assert await claims.claim_job(db, "w2") is None
+                # a bus restart re-establishes LISTEN
+                await bus.close()
+                await bus.start()
+                sub2 = bus.subscribe(CH_JOBS)
+                bus.publish(CH_JOBS, {"probe": 2})
+                assert await sub2.get(timeout=5.0) == {"probe": 2}
+                await bus.close()
+                await db.disconnect()
+
+            asyncio.run(go())
+        finally:
+            srv.stop()
+
+
+# --------------------------------------------------------------------------
+# Bench smoke (slow): the claims/sec harness end to end at small K
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_bench_coord_smoke(tmp_path):
+    """bench_coord at small K: long-poll p99 enqueue->claim latency beats
+    the classic poll interval by a wide margin, batched claims/sec is at
+    least poll-only's, and a labeled record lands in BENCH_coord.json."""
+    import argparse
+    from pathlib import Path
+
+    import bench_coord
+
+    args = argparse.Namespace(workers=4, jobs=40, batch=8, wait_s=2.0,
+                              latency_jobs=8, latency_gap_s=0.05)
+    records = asyncio.run(bench_coord.run_bench(args))
+    by_step = {r["step"]: r for r in records}
+    poll = by_step["poll_only"]["rps"]
+    batched = by_step["batched"]["rps"]
+    assert batched >= poll, (poll, batched)
+    p99 = by_step["long_poll_latency"]["rps"]
+    assert p99 < 0.5 * config.WORKER_POLL_INTERVAL_S, p99
+    out = Path(bench_coord.__file__).with_name("BENCH_coord.json")
+    bench_coord.append_records(out, [{
+        "step": "smoke", "metric": "coord_claims_per_s",
+        "rps": round(batched, 1),
+        "timestamp": records[0]["timestamp"],
+        "config": {"workers": args.workers, "jobs": args.jobs,
+                   "max_jobs": args.batch, "source": "pytest smoke",
+                   "poll_only_rps": round(poll, 1),
+                   "long_poll_p99_s": p99},
+    }])
+    assert json.loads(out.read_text())[-1]["step"] == "smoke"

@@ -108,15 +108,15 @@ def test_lease_extends_during_transcode(run, db, tmp_path, video_job,
     monkeypatch.setattr(claims, "update_progress", spy)
     daemon = make_daemon(db, tmp_path)
     initial_expiry = {}
-    orig_claim = claims.claim_job
+    orig_claim = claims.claim_jobs
 
     async def claim_spy(*a, **kw):
-        row = await orig_claim(*a, **kw)
-        if row is not None:
+        rows = await orig_claim(*a, **kw)
+        for row in rows:
             initial_expiry[row["id"]] = row["claim_expires_at"]
-        return row
+        return rows
 
-    monkeypatch.setattr(claims, "claim_job", claim_spy)
+    monkeypatch.setattr(claims, "claim_jobs", claim_spy)
     run(daemon.poll_once())
     assert observed, "no progress writes happened during the transcode"
     assert max(observed) > initial_expiry[job_id]

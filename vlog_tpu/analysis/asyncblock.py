@@ -32,13 +32,17 @@ by hand). If a plane grows a metadata call on a network filesystem's
 critical path, offload it anyway; the lint is a floor, not the
 ceiling.
 
-Scope: modules under ``api/``, ``delivery/``, ``web/``, and — since the
-preemption-tolerant drain plane — ``worker/``. Worker processes are
-event-loop servers too: the same loop runs lease heartbeats, the drain
-supervisor, the incremental-checkpoint uploader, and the health server's
-readiness answers, so a blocking call there stalls exactly the writes
-that keep a draining job from being swept (compute is fine — it runs on
-threads via ``_run_with_timeout``, outside any ``async def``).
+Scope: modules under ``api/``, ``delivery/``, ``web/``, ``worker/``
+(since the preemption-tolerant drain plane), and — since the fleet-scale
+coordination plane — ``jobs/``. Worker processes are event-loop servers
+too: the same loop runs lease heartbeats, the drain supervisor, the
+incremental-checkpoint uploader, and the health server's readiness
+answers, so a blocking call there stalls exactly the writes that keep a
+draining job from being swept (compute is fine — it runs on threads via
+``_run_with_timeout``, outside any ``async def``). ``jobs/`` is in scope
+for the same reason: claim transactions, the lease sweeper, and the
+event-bus publish paths all run on the serving loops, and a blocking
+call inside one stalls every parked long-poll claimant at once.
 """
 
 from __future__ import annotations
@@ -49,7 +53,7 @@ from vlog_tpu.analysis.core import Finding, Module, dotted_name
 
 RULE = "asyncblock"
 
-SCOPED_DIRS = frozenset({"api", "delivery", "web", "worker"})
+SCOPED_DIRS = frozenset({"api", "delivery", "web", "worker", "jobs"})
 
 # fully-dotted blocking calls (module attribute form)
 _BLOCKING_DOTTED = {

@@ -18,6 +18,8 @@ import errno
 import hashlib
 import json
 import logging
+import random
+import time
 from pathlib import Path
 
 from aiohttp import web
@@ -28,6 +30,7 @@ from vlog_tpu.db.core import Database, Row, now as db_now, open_database
 from vlog_tpu.db.retry import with_retries
 from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
 from vlog_tpu.jobs import claims, state as js, videos as vids
+from vlog_tpu.jobs.events import CH_JOBS, bus_for
 from vlog_tpu.jobs.finalize import finalize_transcode, finalize_transcription
 from vlog_tpu.obs import store as obs_store
 # Metrics moved to the shared obs plane (obs/metrics.py) so every
@@ -48,6 +51,117 @@ VIDEO_DIR = web.AppKey("video_dir", Path)
 METRICS = web.AppKey("metrics", object)
 # optional async (event_name, payload) hook — wired to webhook delivery
 EVENTS = web.AppKey("events", object)
+# per-app coordination-plane state (parked waiters, sweeper, coalescer)
+COORD = web.AppKey("coord", object)
+
+
+class _HeartbeatCoalescer:
+    """Write-behind heartbeat buffer for the worker API.
+
+    At fleet scale every worker's heartbeat is one UPDATE on the shared
+    DB every ``VLOG_HEARTBEAT_INTERVAL``; this folds them: non-drain
+    heartbeats land in a per-worker dict (latest wins) and flush as ONE
+    ``executemany`` per ``VLOG_HEARTBEAT_FLUSH_S`` window. Heartbeats
+    are liveness hints with an offline threshold orders of magnitude
+    above the flush window, so a window of staleness is invisible —
+    but drain transitions write through synchronously (the caller skips
+    ``offer``): a draining worker must stop receiving work NOW.
+    Disabled (``offer`` refuses, callers write through) at flush 0.
+    """
+
+    def __init__(self, db: Database, flush_s: float):
+        self._db = db
+        self.flush_s = flush_s
+        self._pending: dict[str, dict] = {}
+        self._stop = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self.flushes = 0               # observability for tests/admin
+
+    def offer(self, name: str, *, caps_json: str | None,
+              code_version: str | None) -> bool:
+        """Buffer one heartbeat; False means "write through yourself"."""
+        if self.flush_s <= 0:
+            return False
+        self._pending[name] = {"t": db_now(), "n": name, "st": "active",
+                               "c": caps_json, "v": code_version}
+        return True
+
+    async def flush(self) -> int:
+        batch = list(self._pending.values())
+        self._pending = {}
+        if not batch:
+            return 0
+        try:
+            await self._db.execute_many(
+                """
+                UPDATE workers SET last_heartbeat_at=:t, status=:st,
+                       capabilities=COALESCE(:c, capabilities),
+                       code_version=COALESCE(:v, code_version)
+                WHERE name=:n
+                """, batch)
+        except Exception:
+            # put the batch back (without clobbering anything newer) so
+            # a DB brownout delays heartbeats instead of losing them
+            for row in batch:
+                self._pending.setdefault(row["n"], row)
+            raise
+        self.flushes += 1
+        return len(batch)
+
+    async def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.flush_s)
+                return
+            except asyncio.TimeoutError:
+                pass
+            try:
+                await self.flush()
+            except Exception:  # noqa: BLE001 — retried next window
+                log.warning("heartbeat flush failed; retrying next window",
+                            exc_info=True)
+
+    def start(self) -> None:
+        if self.flush_s > 0 and self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def close(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await asyncio.gather(self._task, return_exceptions=True)
+            self._task = None
+        try:
+            await self.flush()          # nothing buffered stays lost
+        except Exception:  # noqa: BLE001 — shutdown best-effort
+            log.warning("final heartbeat flush failed", exc_info=True)
+
+
+class CoordState:
+    """Per-app coordination-plane state: parked-waiter accounting for
+    long-poll claims, the periodic lease sweeper, and the heartbeat
+    coalescer. Wired through ``build_worker_app``'s startup/cleanup so
+    embedders and tests get the lifecycle for free."""
+
+    def __init__(self, db: Database):
+        self.db = db
+        self.waiters = 0               # parked long-poll claim handlers
+        self.shed = 0                  # parks refused at CLAIM_MAX_WAITERS
+        self.hb = _HeartbeatCoalescer(db, config.HEARTBEAT_FLUSH_S)
+        self._stop = asyncio.Event()
+        self._sweeper: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self.hb.start()
+        if config.SWEEP_INTERVAL_S > 0 and self._sweeper is None:
+            self._sweeper = asyncio.create_task(
+                claims.sweep_loop(self.db, self._stop))
+
+    async def close(self) -> None:
+        self._stop.set()
+        if self._sweeper is not None:
+            await asyncio.gather(self._sweeper, return_exceptions=True)
+            self._sweeper = None
+        await self.hb.close()
 
 
 def _json_error(status: int, message: str) -> web.Response:
@@ -160,6 +274,17 @@ async def heartbeat(request: web.Request) -> web.Response:
     body = await request.json() if request.can_read_body else {}
     db = request.app[DB]
     ident = request[IDENTITY]
+    caps_json = (json.dumps(body["capabilities"])
+                 if body.get("capabilities") else None)
+    draining = bool(body.get("draining"))
+    coord = request.app.get(COORD)
+    # Write-behind coalescing for plain liveness beats; drain transitions
+    # always write through — a draining worker must become visibly
+    # non-claimable immediately, not a flush window later.
+    if not draining and coord is not None and coord.hb.offer(
+            ident.worker_name, caps_json=caps_json,
+            code_version=body.get("code_version")):
+        return web.json_response({"ok": True, "coalesced": True})
     await db.execute(
         """
         UPDATE workers SET last_heartbeat_at=:t, status=:st,
@@ -170,11 +295,49 @@ async def heartbeat(request: web.Request) -> web.Response:
         {"t": db_now(), "n": ident.worker_name,
          # a draining worker is online-but-not-claimable: a distinct
          # fleet state the workers table / admin UI must show
-         "st": "draining" if body.get("draining") else "active",
-         "c": json.dumps(body["capabilities"]) if body.get("capabilities")
-              else None,
+         "st": "draining" if draining else "active",
+         "c": caps_json,
          "v": body.get("code_version")})
     return web.json_response({"ok": True})
+
+
+async def _parked_claim(request: web.Request, wait_s: float,
+                        claim_once) -> list[Row]:
+    """Park this claim request on the CH_JOBS wakeup channel until a job
+    becomes claimable or the wait budget lapses.
+
+    Bounded: past ``VLOG_CLAIM_MAX_WAITERS`` concurrent parks the
+    request is shed to an immediate empty answer (the client falls back
+    to its poll interval). Wakeups are advisory — a woken waiter re-runs
+    the real claim query, and losing a claim race just parks it again —
+    and a jittered re-check (``VLOG_CLAIM_RECHECK_S``) re-runs the query
+    even with every notify lost, so a dead listener degrades dispatch
+    latency to the re-check period, never to a hung request or a lost
+    job."""
+    coord = request.app.get(COORD)
+    if coord is None:
+        return []
+    if coord.waiters >= config.CLAIM_MAX_WAITERS:
+        coord.shed += 1
+        return []
+    bus = bus_for(request.app[DB])
+    await bus.start()                  # idempotent; adopts this loop
+    coord.waiters += 1
+    sub = bus.subscribe(CH_JOBS)
+    try:
+        deadline = time.monotonic() + wait_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return []
+            await sub.get(timeout=min(
+                remaining, config.CLAIM_RECHECK_S * (0.5 + random.random())))
+            rows = await claim_once()
+            if rows:
+                return rows
+    finally:
+        sub.close()
+        coord.waiters -= 1
 
 
 async def claim(request: web.Request) -> web.Response:
@@ -183,43 +346,65 @@ async def claim(request: web.Request) -> web.Response:
                   or [k.value for k in JobKind])
     accel = AcceleratorKind(body.get("accelerator", "cpu"))
     db = request.app[DB]
+    worker = request[IDENTITY].worker_name
+    code_version = body.get("code_version", config.CODE_VERSION)
+    try:
+        max_jobs = max(1, min(int(body.get("max_jobs") or 1),
+                              config.CLAIM_BATCH_MAX))
+        wait_s = min(float(body.get("wait_s") or 0.0), config.CLAIM_WAIT_MAX_S)
+    except (TypeError, ValueError):
+        return _json_error(400, "bad max_jobs/wait_s")
+    batched = "max_jobs" in body       # response shape follows the ask
+
     # the claim transaction is the fleet's contention point: on Postgres
     # two claimants can deadlock on row-lock order (resolved by killing
     # one), on sqlite a busy writer surfaces as "database is locked" —
-    # both are retry-then-succeed, and claim_job re-reads its inputs
-    row = await with_retries(
-        lambda: claims.claim_job(
-            db, request[IDENTITY].worker_name, kinds=kinds,
-            accelerator=accel,
-            code_version=body.get("code_version", config.CODE_VERSION)),
-        label="claim")
-    if row is None:
+    # both are retry-then-succeed, and claim_jobs re-reads its inputs
+    async def claim_once() -> list[Row]:
+        return await with_retries(
+            lambda: claims.claim_jobs(
+                db, worker, kinds=kinds, accelerator=accel,
+                code_version=code_version, max_jobs=max_jobs),
+            label="claim")
+
+    rows = await claim_once()
+    if not rows and wait_s > 0:
+        rows = await _parked_claim(request, wait_s, claim_once)
+    if not rows:
         return web.Response(status=204)
-    video = await vids.get_video(db, row["video_id"])
-    request.app[METRICS].jobs_claimed.labels(row["kind"]).inc()
-    # hand the worker the trace to join: its spans (shipped back via
-    # POST .../spans) parent under the job's root span. claim_job
-    # stashed the context on the row when it wrote the claim markers;
-    # re-derive only if that write failed. Best effort: the claim is
-    # already committed — a failing trace read must not turn this
-    # response into a 500 (the worker would re-claim a second job
-    # while this one idles to lease expiry).
-    trace_ctx = row.pop("_trace", None)
-    if trace_ctx is None and config.TRACE_ENABLED:
-        try:
-            trace_id, root, _ = await obs_store.ensure_root(
-                db, row["id"], created_at=row["created_at"])
-            trace_ctx = {"trace_id": trace_id, "parent_span_id": root}
-        except Exception:  # noqa: BLE001 — telemetry must not fail claims
-            log.warning("trace context for job %s unavailable", row["id"],
-                        exc_info=True)
-    return web.json_response({
-        "job": _job_payload(row),
-        "video": {k: video[k] for k in
-                  ("id", "slug", "title", "duration_s", "width", "height")}
-        if video else None,
-        "trace": trace_ctx,
-    })
+    entries = []
+    for row in rows:
+        request.app[METRICS].jobs_claimed.labels(row["kind"]).inc()
+        video = await vids.get_video(db, row["video_id"])
+        # hand the worker the trace to join: its spans (shipped back via
+        # POST .../spans) parent under the job's root span. claim_jobs
+        # stashed the context on the row when it wrote the claim markers;
+        # re-derive only if that write failed. Best effort: the claim is
+        # already committed — a failing trace read must not turn this
+        # response into a 500 (the worker would re-claim a second job
+        # while this one idles to lease expiry).
+        trace_ctx = row.pop("_trace", None)
+        if trace_ctx is None and config.TRACE_ENABLED:
+            try:
+                trace_id, root, _ = await obs_store.ensure_root(
+                    db, row["id"], created_at=row["created_at"])
+                trace_ctx = {"trace_id": trace_id, "parent_span_id": root}
+            except Exception:  # noqa: BLE001 — telemetry never fails claims
+                log.warning("trace context for job %s unavailable",
+                            row["id"], exc_info=True)
+        entries.append({
+            "job": _job_payload(row),
+            "video": {k: video[k] for k in
+                      ("id", "slug", "title", "duration_s", "width",
+                       "height")}
+            if video else None,
+            "trace": trace_ctx,
+        })
+    if not batched:
+        # legacy single-claim shape for clients that never asked for a
+        # batch (pre-batch workers keep working against a new server)
+        return web.json_response(entries[0])
+    return web.json_response({"jobs": entries})
 
 
 async def progress(request: web.Request) -> web.Response:
@@ -796,6 +981,16 @@ def build_worker_app(db: Database, video_dir: Path | None = None) -> web.Applica
     app[DB] = db
     app[VIDEO_DIR] = Path(video_dir or config.VIDEO_DIR)
     app[METRICS] = Metrics()
+    app[COORD] = CoordState(db)
+
+    async def _coord_startup(app: web.Application) -> None:
+        app[COORD].start()
+
+    async def _coord_cleanup(app: web.Application) -> None:
+        await app[COORD].close()
+
+    app.on_startup.append(_coord_startup)
+    app.on_cleanup.append(_coord_cleanup)
     app.router.add_post("/api/worker/register", register)
     app.router.add_post("/api/worker/heartbeat", heartbeat)
     app.router.add_post("/api/worker/claim", claim)

@@ -174,6 +174,44 @@ MAX_JOB_ATTEMPTS: int = _env_int("VLOG_MAX_JOB_ATTEMPTS", 3, lo=1, hi=20)
 WORKER_POLL_INTERVAL_S: float = _env_float("VLOG_WORKER_POLL_INTERVAL", 5.0, lo=0.1)
 
 # --------------------------------------------------------------------------
+# Coordination plane at fleet scale: long-poll push claims, batched
+# claim/heartbeat writes, decoupled lease sweep (jobs/claims.py,
+# api/worker_api.py). Wakeups stay ADVISORY: every cap here bounds a
+# latency/throughput optimization, never correctness — a shed waiter or
+# lost notify degrades to plain poll latency.
+# --------------------------------------------------------------------------
+
+# Upper bound the claim endpoint enforces on a request's ``wait_s``
+# long-poll park. 0 disables parking entirely (every claim answers
+# immediately — the pre-long-poll behavior).
+CLAIM_WAIT_MAX_S: float = _env_float("VLOG_CLAIM_WAIT_MAX_S", 30.0, lo=0.0)
+# Parked-waiter bound per API process: claim requests beyond this many
+# concurrent parks are shed to an immediate 204 (the client falls back
+# to its poll interval) instead of pinning more handler tasks/sockets.
+CLAIM_MAX_WAITERS: int = _env_int("VLOG_CLAIM_MAX_WAITERS", 256, lo=1)
+# Jittered re-check cadence while parked: even with every notify lost
+# (dead listener connection, cross-process sqlite) a parked claimant
+# re-runs the claim query at roughly this period, so dispatch latency
+# degrades to ~this — never to a hung request.
+CLAIM_RECHECK_S: float = _env_float("VLOG_CLAIM_RECHECK_S", 2.0, lo=0.1)
+# Hard cap on ``max_jobs`` per claim call (jobs per claim transaction).
+# Bounds both the transaction's lock footprint and how much work one
+# greedy worker can take in a single grab.
+CLAIM_BATCH_MAX: int = _env_int("VLOG_CLAIM_BATCH_MAX", 16, lo=1)
+# Per-process expired-lease sweeper cadence (jittered ±50% so a fleet of
+# processes desynchronizes). The claim path no longer sweeps on every
+# claim — it keeps a cheap oldest-expiry probe — so this loop is what
+# guarantees lapsed leases are reclaimed and dead-lettered even when
+# nobody is claiming. 0 disables the loop (tests that drive sweeps
+# explicitly).
+SWEEP_INTERVAL_S: float = _env_float("VLOG_SWEEP_INTERVAL_S", 10.0, lo=0.0)
+# Write-behind heartbeat coalescing window for the worker API: non-drain
+# heartbeats buffer in process and flush as ONE multi-row write per
+# window. 0 (default) writes through synchronously. Draining heartbeats
+# always write through — a drain transition must be visible immediately.
+HEARTBEAT_FLUSH_S: float = _env_float("VLOG_HEARTBEAT_FLUSH_S", 0.0, lo=0.0)
+
+# --------------------------------------------------------------------------
 # Preemption-tolerant drain (worker/drain.py): on SIGTERM or a
 # preemption notice the worker stops claiming, lets in-flight compute
 # finish and flush (leases heartbeat-extended), then force-cancels and

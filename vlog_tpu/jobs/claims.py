@@ -23,6 +23,7 @@ reference's local transcoder bypassed the HTTP plane.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import random
@@ -281,7 +282,31 @@ async def sweep_expired_claims(db: Database) -> int:
     return released
 
 
-async def claim_job(
+async def _sweep_if_due(tx: Any, db: Database, t: float) -> list[int]:
+    """Oldest-expiry fast-path gating the in-claim sweep.
+
+    The full sweep (row locks, failure rows, dead-lettering) used to run
+    inside EVERY claim transaction, so a fleet of claimants serialized
+    on redundant sweeps. Now one cheap lock-free aggregate decides: only
+    when the oldest live lease has actually lapsed does this claim pay
+    for the sweep (keeping the long-standing guarantee that an expired
+    lease is reclaimable by the very next claim); otherwise reclamation
+    belongs to the periodic :func:`sweep_loop`. Returns the dead-lettered
+    job ids (the caller announces them post-commit).
+    """
+    probe = await tx.fetch_one(
+        """
+        SELECT MIN(claim_expires_at) AS exp FROM jobs
+        WHERE completed_at IS NULL AND failed_at IS NULL
+          AND claimed_by IS NOT NULL AND claim_expires_at IS NOT NULL
+        """)
+    if probe is None or probe["exp"] is None or probe["exp"] > t:
+        return []
+    _, dead = await _sweep_expired(tx, t, db.row_lock_suffix)
+    return dead
+
+
+async def claim_jobs(
     db: Database,
     worker_name: str,
     *,
@@ -289,13 +314,20 @@ async def claim_job(
     accelerator: AcceleratorKind = AcceleratorKind.CPU,
     code_version: str = config.CODE_VERSION,
     lease_s: float | None = None,
-) -> Row | None:
-    """Atomically claim the best eligible job, or return None.
+    max_jobs: int = 1,
+) -> list[Row]:
+    """Atomically claim up to ``max_jobs`` eligible jobs in ONE transaction.
 
     Ordering: priority DESC, then oldest first — matching the reference's
-    priority streams + FIFO recovery. Jobs demanding a specific accelerator
-    (``required_accelerator``) are only handed to matching workers; jobs
-    demanding a newer code version are skipped (worker_api.py:1398-1434).
+    priority streams + FIFO recovery — and identical to issuing
+    ``max_jobs`` single claims back to back (the batch walks the same
+    ordered candidate list the single-claim loop would). Jobs demanding a
+    specific accelerator (``required_accelerator``) are only handed to
+    matching workers; jobs demanding a newer code version are skipped
+    (worker_api.py:1398-1434). ``max_jobs`` is capped at
+    ``VLOG_CLAIM_BATCH_MAX``; each returned row carries its own attempt
+    number (the epoch fencing token) and its own post-commit trace
+    anchors, exactly as single claims do.
     """
     try:
         # chaos hook for the coordination-plane brownout: an armed
@@ -308,29 +340,32 @@ async def claim_job(
             "claim query unavailable (injected db.claim)") from exc
     t = db_now()
     lease = lease_s if lease_s is not None else config.CLAIM_LEASE_S
-    kind_list = ",".join(f"'{k.value}'" for k in kinds)
+    n = max(1, min(int(max_jobs), config.CLAIM_BATCH_MAX))
+    kind_marks = ",".join(f":k{i}" for i in range(len(kinds)))
+    kind_params = {f"k{i}": k.value for i, k in enumerate(kinds)}
+    pairs: list[tuple[Row, Row]] = []   # (pre-claim row, claimed row)
     async with db.transaction() as tx:
-        # sweep expired leases first so they are claimable below
-        _, dead = await _sweep_expired(tx, t, db.row_lock_suffix)
+        # expired leases only swept when the oldest one has lapsed
+        dead = await _sweep_if_due(tx, db, t)
         # On Postgres the suffix is FOR UPDATE SKIP LOCKED: concurrent
         # claimants contend on row locks and skip each other's picks —
         # the reference's exact mechanism (worker_api.py:1494-1556). On
         # sqlite it is empty (BEGIN IMMEDIATE already serializes).
-        row = await tx.fetch_one(
+        rows = await tx.fetch_all(
             f"""
             SELECT * FROM jobs
             WHERE {js.SQL_CLAIMABLE}
-              AND kind IN ({kind_list})
+              AND kind IN ({kind_marks})
               AND attempt < max_attempts
               AND (required_accelerator IS NULL OR required_accelerator = :accel)
               AND (min_code_version IS NULL OR min_code_version <= :cv)
             ORDER BY priority DESC, created_at ASC
-            LIMIT 1{db.row_lock_suffix}
+            LIMIT :lim{db.row_lock_suffix}
             """,
-            {"now": t, "accel": accelerator.value, "cv": code_version},
+            {"now": t, "accel": accelerator.value, "cv": code_version,
+             "lim": n, **kind_params},
         )
-        claimed = None
-        if row is not None:
+        for row in rows:
             js.guard_claim(row, now=t)
             failpoints.hit("claims.claim")
             await tx.execute(
@@ -345,38 +380,89 @@ async def claim_job(
             claimed = await tx.fetch_one("SELECT * FROM jobs WHERE id=:id",
                                          {"id": row["id"]})
             assert claimed is not None
+            pairs.append((row, claimed))
     # terminal transitions the sweep performed, announced post-commit
     for jid in dead:
         _wake(db, CH_PROGRESS, {"job_id": jid, "event": "failed"})
-    if claimed is not None and config.TRACE_ENABLED:
+    if pairs and config.TRACE_ENABLED:
         # Trace anchors, post-commit (span writes must never grow the
         # fleet's contention-point transaction, nor fail it — the
-        # claim is already committed, and a raising write here would
-        # make with_retries claim a SECOND job): the queue wait since
-        # the last state change, and the claim event itself.
+        # claims are already committed, and a raising write here would
+        # make with_retries claim a SECOND batch): per job, the queue
+        # wait since the last state change and the claim event itself.
         async def _claim_spans() -> None:
-            trace_id, root, _ = await obs_store.ensure_root(
-                db, claimed["id"], created_at=claimed["created_at"])
-            # stash for the HTTP claim handler so it can hand the worker
-            # the trace context without re-reading the root row (rows
-            # are plain dicts; serializing callers pop the key)
-            claimed["_trace"] = {"trace_id": trace_id,
-                                 "parent_span_id": root}
-            wait_start = row["updated_at"] or row["created_at"] or t
-            await obs_store.record(
-                db, claimed["id"], trace_id=trace_id, parent_id=root,
-                name="queue.wait", started_at=wait_start,
-                duration_s=max(0.0, t - wait_start),
-                attrs={"attempt": claimed["attempt"]})
-            await obs_store.record(
-                db, claimed["id"], trace_id=trace_id, parent_id=root,
-                name="server.claim", started_at=t,
-                duration_s=max(0.0, db_now() - t),
-                attrs={"worker": worker_name, "kind": claimed["kind"],
-                       "attempt": claimed["attempt"]})
+            for row, claimed in pairs:
+                trace_id, root, _ = await obs_store.ensure_root(
+                    db, claimed["id"], created_at=claimed["created_at"])
+                # stash for the HTTP claim handler so it can hand the
+                # worker the trace context without re-reading the root
+                # row (rows are plain dicts; serializing callers pop it)
+                claimed["_trace"] = {"trace_id": trace_id,
+                                     "parent_span_id": root}
+                wait_start = row["updated_at"] or row["created_at"] or t
+                await obs_store.record(
+                    db, claimed["id"], trace_id=trace_id, parent_id=root,
+                    name="queue.wait", started_at=wait_start,
+                    duration_s=max(0.0, t - wait_start),
+                    attrs={"attempt": claimed["attempt"]})
+                await obs_store.record(
+                    db, claimed["id"], trace_id=trace_id, parent_id=root,
+                    name="server.claim", started_at=t,
+                    duration_s=max(0.0, db_now() - t),
+                    attrs={"worker": worker_name, "kind": claimed["kind"],
+                           "attempt": claimed["attempt"]})
 
         await _trace_write("claim", _claim_spans)
-    return claimed
+    return [claimed for _, claimed in pairs]
+
+
+async def claim_job(
+    db: Database,
+    worker_name: str,
+    *,
+    kinds: tuple[JobKind, ...] = (JobKind.TRANSCODE,),
+    accelerator: AcceleratorKind = AcceleratorKind.CPU,
+    code_version: str = config.CODE_VERSION,
+    lease_s: float | None = None,
+) -> Row | None:
+    """Atomically claim the best eligible job, or return None.
+
+    Single-job façade over :func:`claim_jobs` — same ordering, fencing,
+    and trace anchors with ``max_jobs=1``.
+    """
+    rows = await claim_jobs(
+        db, worker_name, kinds=kinds, accelerator=accelerator,
+        code_version=code_version, lease_s=lease_s, max_jobs=1)
+    return rows[0] if rows else None
+
+
+async def sweep_loop(db: Database, stop: asyncio.Event, *,
+                     interval_s: float | None = None) -> None:
+    """Jittered per-process periodic expired-lease sweeper.
+
+    With the per-claim sweep reduced to an oldest-expiry probe
+    (:func:`_sweep_if_due`), this loop is what guarantees lapsed leases
+    are released and dead-lettered even when nobody is claiming. The
+    interval is jittered ±50% (the retry_backoff_s idiom) so a fleet of
+    API/daemon processes desynchronizes instead of sweeping in lockstep.
+    Exits when ``stop`` is set; a failing sweep (DB brownout) is logged
+    and retried next tick — the sweeper must outlive transient faults.
+    """
+    base = config.SWEEP_INTERVAL_S if interval_s is None else interval_s
+    if base <= 0:
+        return
+    while not stop.is_set():
+        delay = base * (0.5 + random.random())
+        try:
+            await asyncio.wait_for(stop.wait(), delay)
+            return
+        except asyncio.TimeoutError:
+            pass
+        try:
+            await sweep_expired_claims(db)
+        except Exception:  # noqa: BLE001 — the sweeper outlives brownouts
+            log.warning("periodic lease sweep failed; retrying next tick",
+                        exc_info=True)
 
 
 async def update_progress(

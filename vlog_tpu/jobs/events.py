@@ -32,6 +32,8 @@ import threading
 from collections import defaultdict
 from typing import Any
 
+from vlog_tpu.utils import failpoints
+
 log = logging.getLogger("vlog.events")
 
 # Wakeup channels (PG NOTIFY identifiers must be plain identifiers).
@@ -228,8 +230,12 @@ class PgNotifyBus(LocalEventBus):
 def wake(db: Any, channel: str, payload: dict | None = None) -> None:
     """Post-commit wakeup hint. Never load-bearing: a lost hint
     degrades to poll latency, so failures are swallowed — every
-    publisher (claims, webhooks) shares this one rule."""
+    publisher (claims, webhooks) shares this one rule. The
+    ``events.publish`` failpoint drops the hint here (the killed-notify
+    chaos path: parked claimants must fall back to their jittered
+    re-check / poll with zero jobs lost)."""
     try:
+        failpoints.hit("events.publish")
         bus_for(db).publish(channel, payload or {})
     except Exception:   # noqa: BLE001
         log.debug("wakeup publish failed", exc_info=True)

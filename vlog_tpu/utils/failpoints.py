@@ -43,9 +43,13 @@ site                where it fires
 ``claim.fence``     WorkerAPIClient's epoch header — the armed write
                     sends a STALE ``X-Claim-Epoch``, so the server's
                     409 fence is what must catch it
-``db.claim``        jobs.claims.claim_job entry — the claim query fails
+``db.claim``        jobs.claims.claim_jobs entry — the claim query fails
                     with a synthetic connection error (the
                     coordination-plane brownout path)
+``events.publish``  jobs.events.wake, before the bus publish — the armed
+                    hit drops the wakeup hint, so parked long-poll
+                    claimants must degrade to their jittered re-check /
+                    poll latency with zero jobs lost
 ``preempt.notice``  preemption watcher poll (worker/drain.py) — an
                     armed hit IS the eviction notice: the worker
                     begins a grace-budgeted drain
@@ -123,8 +127,11 @@ SITES: dict[str, str] = {
                     "re-raised as a synthetic XLA-like device error",
     "claim.fence": "WorkerAPIClient epoch header; the armed write sends "
                    "a stale X-Claim-Epoch",
-    "db.claim": "claim_job entry; the claim query fails with a synthetic "
+    "db.claim": "claim_jobs entry; the claim query fails with a synthetic "
                 "connection error",
+    "events.publish": "jobs.events.wake, before the bus publish; an armed "
+                      "hit drops the wakeup hint (parked claimants degrade "
+                      "to re-check/poll latency)",
     "preempt.notice": "preemption watcher poll (worker/drain.py); an armed "
                       "hit IS the eviction notice — the worker begins "
                       "draining",

@@ -442,8 +442,9 @@ class WorkerDaemon(ComputeWatchdogMixin):
         try:
             await self.startup()
         except Exception:  # noqa: BLE001 — a failed recovery sweep must
-            # not keep the worker down; lapsed leases are also swept
-            # inside every claim transaction
+            # not keep the worker down; the periodic sweep_loop below
+            # (and the claim path's oldest-expiry probe) reclaims
+            # lapsed leases anyway
             log.exception("startup recovery failed; polling anyway")
         if (self.scheduler is None and config.MESH_SLOTS > 1
                 and self.backend is not None):
@@ -455,6 +456,10 @@ class WorkerDaemon(ComputeWatchdogMixin):
         await bus.start()
         jobs_sub = bus.subscribe(CH_JOBS)
         hb = asyncio.create_task(self._heartbeat_loop())
+        # periodic expired-lease sweeper: with the per-claim sweep
+        # reduced to an oldest-expiry probe, this loop is what reclaims
+        # and dead-letters lapsed leases on an idle queue
+        sweeper = asyncio.create_task(claims.sweep_loop(self.db, self._stop))
         probe = None
         if self.scheduler is not None and config.DEVICE_PROBE_INTERVAL_S > 0:
             probe = asyncio.create_task(self._device_probe_loop())
@@ -510,7 +515,8 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 # give it a moment to notice the stop and wind down
                 await asyncio.gather(self._drain_task,
                                      return_exceptions=True)
-            tasks = [t for t in (hb, probe, watcher) if t is not None]
+            tasks = [t for t in (hb, sweeper, probe, watcher)
+                     if t is not None]
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
@@ -553,7 +559,8 @@ class WorkerDaemon(ComputeWatchdogMixin):
                     # capacity and an idle engine, device jobs and
                     # transcription both stay in the queue.
                     kinds = self.kinds
-                    if self.scheduler.capacity() <= 0:
+                    capacity = self.scheduler.capacity()
+                    if capacity <= 0:
                         kinds = tuple(k for k in self.kinds
                                       if k not in device_kinds)
                         if not self._asr_engine_active():
@@ -561,13 +568,32 @@ class WorkerDaemon(ComputeWatchdogMixin):
                                           if k != JobKind.TRANSCRIPTION)
                         if not kinds:
                             break
-                    job = await self._admit_and_claim(kinds=kinds)
-                    if job is None:
+                    # Batched claim: one transaction fills as many free
+                    # slots as the queue can satisfy, instead of one
+                    # claim transaction per slot. Bounded by remaining
+                    # device capacity whenever the claim could return
+                    # device kinds — the batch must never admit past
+                    # what the (held) scheduler can grant.
+                    want = (self.scheduler.slots - len(self._tasks)
+                            - len(batch))
+                    if capacity > 0 and any(k in device_kinds
+                                            for k in kinds):
+                        want = min(want, capacity)
+                    # clamp to the claim layer's own cap so a short
+                    # batch below really means the queue ran dry (and
+                    # not that claim_jobs silently truncated the ask)
+                    want = min(want, config.CLAIM_BATCH_MAX)
+                    jobs = await self._admit_and_claim(kinds=kinds,
+                                                       max_jobs=want)
+                    if not jobs:
                         break
-                    ticket = (self.scheduler.admit()
-                              if JobKind(job["kind"]) in device_kinds
-                              else None)
-                    batch.append((job, ticket))
+                    for job in jobs:
+                        ticket = (self.scheduler.admit()
+                                  if JobKind(job["kind"]) in device_kinds
+                                  else None)
+                        batch.append((job, ticket))
+                    if len(jobs) < want:
+                        break   # queue has no more eligible work now
         finally:
             for job, ticket in batch:
                 task = asyncio.create_task(
@@ -646,16 +672,17 @@ class WorkerDaemon(ComputeWatchdogMixin):
 
     async def poll_once(self) -> bool:
         """Claim and process at most one job. Returns True if one ran."""
-        job = await self._admit_and_claim()
-        if job is None:
+        jobs = await self._admit_and_claim()
+        if not jobs:
             return False
-        await self._process_claimed(job)
+        await self._process_claimed(jobs[0])
         return True
 
-    async def _admit_and_claim(self, kinds: tuple[JobKind, ...] | None = None
-                               ) -> Row | None:
-        """Admission gates (disk, breaker) + one claim attempt. Returns
-        the claimed job row, or None when nothing should run now.
+    async def _admit_and_claim(self, kinds: tuple[JobKind, ...] | None = None,
+                               max_jobs: int = 1) -> list[Row]:
+        """Admission gates (disk, breaker) + one claim attempt (up to
+        ``max_jobs`` jobs in one transaction — _poll_fill's batch fill).
+        Returns the claimed job rows, empty when nothing should run now.
         ``kinds`` narrows the claim (slot mode claims CPU-only kinds
         while a full-width lease saturates the mesh)."""
         from vlog_tpu.db.retry import with_retries
@@ -664,7 +691,7 @@ class WorkerDaemon(ComputeWatchdogMixin):
         if self.drain.active:
             # draining: the scheduler grants no new slots — the whole
             # point is to empty this host before it disappears
-            return None
+            return []
         # Disk admission BEFORE the breaker: claiming with a full output
         # volume guarantees ENOSPC mid-write — burning an attempt (and,
         # in HALF_OPEN, the probe slot) to learn what a statvfs already
@@ -675,39 +702,41 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 log.warning("output volume under disk pressure; pausing "
                             "claiming (%s)", self.video_dir)
             self.disk_paused = True
-            return None
+            return []
         self.disk_paused = False
         if not self.breaker.allow():
             # breaker open: leave the queue alone until the cooldown
             # lapses and a half-open probe is due
-            return None
+            return []
         # From here on, every exit that does not end in record_success /
         # record_failure must call release_probe() (a no-op unless this
         # poll holds the half-open probe) — otherwise the breaker wedges
         # in HALF_OPEN waiting for an outcome that can never arrive.
         try:
-            job = await with_retries(
-                lambda: claims.claim_job(
+            jobs = await with_retries(
+                lambda: claims.claim_jobs(
                     self.db, self.name,
                     kinds=self.kinds if kinds is None else kinds,
-                    accelerator=self.accelerator),
+                    accelerator=self.accelerator, max_jobs=max_jobs),
                 label="daemon-claim")
         except BaseException:
             self.breaker.release_probe()
             raise
-        if job is None:
+        if not jobs:
             self.breaker.release_probe()
-            return None
+            return []
         if self._stop.is_set():
-            # Shutdown arrived while the claim was in flight: hand it
-            # straight back instead of starting (and then abandoning) work.
+            # Shutdown arrived while the claim was in flight: hand every
+            # job straight back instead of starting (and then
+            # abandoning) work.
             self.breaker.release_probe()
-            try:
-                await claims.release_job(self.db, job["id"], self.name)
-            except js.JobStateError:
-                pass
-            return None
-        return job
+            for job in jobs:
+                try:
+                    await claims.release_job(self.db, job["id"], self.name)
+                except js.JobStateError:
+                    pass
+            return []
+        return jobs
 
     async def _process_claimed(self, job: Row, ticket: Any = None) -> None:
         """Run one claimed job to its outcome under its own supervisor.

@@ -76,6 +76,7 @@ class WorkerAPIClient:
         self.base_url = base_url.rstrip("/")
         self.retries = retries
         self.api_key = api_key
+        self._timeout = timeout
         # Fencing tokens: job id -> the claim's attempt number, sent as
         # X-Claim-Epoch on every claim-gated write so a swept-and-
         # reclaimed job's stale incarnation gets 409 instead of
@@ -188,15 +189,7 @@ class WorkerAPIClient:
                             json={"capabilities": capabilities or {},
                                   "draining": draining})
 
-    async def claim(self, kinds: list[str], accelerator: str) -> dict | None:
-        failpoints.hit("remote.claim")
-        r = await self._request("POST", "/api/worker/claim",
-                                json={"kinds": kinds,
-                                      "accelerator": accelerator,
-                                      "code_version": config.CODE_VERSION})
-        if r.status_code == 204:
-            return None
-        data = r.json()
+    def _register_claim(self, data: dict) -> dict:
         job = data.get("job") or {}
         if job.get("id") is not None:
             # the claim's attempt number IS the fencing epoch for every
@@ -205,6 +198,44 @@ class WorkerAPIClient:
             if job.get("video_id") is not None:
                 self._video_jobs[job["video_id"]] = job["id"]
         return data
+
+    def _claim_body_kw(self, kinds: list[str], accelerator: str,
+                       wait_s: float) -> tuple[dict, dict]:
+        body = {"kinds": kinds, "accelerator": accelerator,
+                "code_version": config.CODE_VERSION}
+        kw: dict = {}
+        if wait_s > 0:
+            body["wait_s"] = wait_s
+            # the HTTP request must outlive the server-side park
+            kw["timeout"] = self._timeout + wait_s
+        return body, kw
+
+    async def claim(self, kinds: list[str], accelerator: str, *,
+                    wait_s: float = 0.0) -> dict | None:
+        """Claim one job. ``wait_s`` > 0 long-polls: the server parks
+        the request until a job becomes claimable (or the wait lapses),
+        so an idle fleet learns of new work in wakeup latency instead
+        of a poll interval."""
+        failpoints.hit("remote.claim")
+        body, kw = self._claim_body_kw(kinds, accelerator, wait_s)
+        r = await self._request("POST", "/api/worker/claim", json=body, **kw)
+        if r.status_code == 204:
+            return None
+        return self._register_claim(r.json())
+
+    async def claim_batch(self, kinds: list[str], accelerator: str, *,
+                          max_jobs: int, wait_s: float = 0.0) -> list[dict]:
+        """Claim up to ``max_jobs`` jobs in ONE request (one server-side
+        transaction); returns the claim entries (``{job, video, trace}``
+        each), empty when nothing is eligible after any long-poll wait."""
+        failpoints.hit("remote.claim")
+        body, kw = self._claim_body_kw(kinds, accelerator, wait_s)
+        body["max_jobs"] = max_jobs
+        r = await self._request("POST", "/api/worker/claim", json=body, **kw)
+        if r.status_code == 204:
+            return []
+        return [self._register_claim(e)
+                for e in (r.json().get("jobs") or [])]
 
     async def progress(self, job_id: int, *, progress: float | None = None,
                        current_step: str | None = None,
@@ -620,9 +651,14 @@ class RemoteWorker(ComputeWatchdogMixin):
     drain_grace_s: float = field(
         default_factory=lambda: config.DRAIN_GRACE_S)
     drain_tick_s: float = 0.2
+    # Long-poll claim wait. None = auto: park on the server for up to
+    # min(poll_interval_s, VLOG_CLAIM_WAIT_MAX_S); 0 = classic poll-only
+    # (tests, bench baselines, servers predating the long-poll claim).
+    claim_wait_s: float | None = None
 
     def __post_init__(self) -> None:
         self.stats = DaemonStats()
+        self._idle_delay = self.poll_interval_s
         self.restart_requested = False
         self.disk_paused = False
         self._span_buffer = None      # the active attempt's TraceBuffer
@@ -770,11 +806,16 @@ class RemoteWorker(ComputeWatchdogMixin):
                     await asyncio.sleep(min(self.poll_interval_s, 1.0))
                 if worked or self._stop.is_set():
                     continue
-                try:
-                    await asyncio.wait_for(self._stop.wait(),
-                                           self.poll_interval_s)
-                except asyncio.TimeoutError:
-                    pass
+                # poll_once already parked on the server for (part of)
+                # the idle window when long-polling; only sleep the
+                # remainder, so a shed/legacy server degrades to exactly
+                # the classic poll latency instead of doubling it
+                if self._idle_delay > 0:
+                    try:
+                        await asyncio.wait_for(self._stop.wait(),
+                                               self._idle_delay)
+                    except asyncio.TimeoutError:
+                        pass
         finally:
             self._stop.set()
             if self._drain_task is not None:
@@ -854,6 +895,8 @@ class RemoteWorker(ComputeWatchdogMixin):
         return {"error": f"unknown command {command!r}"}
 
     async def poll_once(self) -> bool:
+        # non-claim exits (drain, disk, breaker) idle the full interval
+        self._idle_delay = self.poll_interval_s
         if self.drain.active:
             # draining: no new work on a host that is being evicted
             return False
@@ -881,14 +924,21 @@ class RemoteWorker(ComputeWatchdogMixin):
         # Exits that run no compute must hand a half-open probe slot back
         # (release_probe is a no-op unless this poll holds the probe —
         # same wedge-avoidance contract as WorkerDaemon.poll_once).
+        wait_s = (min(self.poll_interval_s, config.CLAIM_WAIT_MAX_S)
+                  if self.claim_wait_s is None else self.claim_wait_s)
+        t0 = time.monotonic()
         try:
             claimed = await self.client.claim(
-                [k.value for k in self.kinds], self.accelerator.value)
+                [k.value for k in self.kinds], self.accelerator.value,
+                wait_s=wait_s)
         except BaseException:
             self.breaker.release_probe()
             raise
         if claimed is None:
             self.breaker.release_probe()
+            # the server park already paid (part of) the idle window
+            self._idle_delay = max(
+                0.0, self.poll_interval_s - (time.monotonic() - t0))
             return False
         if self._stop.is_set():
             self.breaker.release_probe()
