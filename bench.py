@@ -227,11 +227,23 @@ def run_body(platform: str) -> None:
 
     vs = realtime_x / NVENC_FULL_LADDER_REALTIME if platform != "cpu" else 0.0
     unit = f"x_realtime_30fps_single_chip_{jax.devices()[0].platform}"
+    # Stamp the mesh shape the backend would resolve for this ladder on
+    # the visible devices (the 2-D data x rung layout), so BENCH records
+    # from different rounds say what grid their numbers ran on.
+    from vlog_tpu.parallel.mesh import resolve_mesh_shape
+    n_dev = len(jax.devices())
+    try:
+        mesh_shape = (resolve_mesh_shape(None, n_dev, rungs).label
+                      if n_dev > 1 else "1x1")
+    except ValueError:
+        mesh_shape = "1x1"
     record = {
         "metric": metric,
         "value": round(realtime_x, 3),
         "unit": unit,
         "vs_baseline": round(vs, 3),
+        "mesh_shape": mesh_shape,
+        "mesh_spec": config.TPU_MESH_SPEC,
         "chain_fps": round(chain_fps, 2),
         "chain_gop_len": clen,
         "chain_deblock": bool(config.H264_DEBLOCK),

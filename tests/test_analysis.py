@@ -1,4 +1,4 @@
-"""Static-analysis plane (vlog_tpu/analysis/): pass framework, the five
+"""Static-analysis plane (vlog_tpu/analysis/): pass framework, the
 passes against seeded fixture packages, baseline suppression, the CLI,
 and the tier-1 gate over the real repo.
 
@@ -413,6 +413,66 @@ class TestRegistry:
 
 
 # --------------------------------------------------------------------------
+# meshshim
+# --------------------------------------------------------------------------
+
+class TestMeshShim:
+    def test_every_raw_spelling_fires(self, tmp_path):
+        pkg = _pkg(tmp_path, {"worker/rogue.py": """\
+            import jax
+            import jax.experimental.shard_map
+            from jax import shard_map
+            from jax.experimental import shard_map
+            from jax.experimental.shard_map import shard_map
+
+            def sharded(mesh, fn):
+                return jax.shard_map(fn, mesh=mesh)
+
+            def sharded_exp(mesh, fn):
+                return jax.experimental.shard_map(fn, mesh=mesh)
+        """})
+        found = _messages(run_passes(pkg, rules=["meshshim"]))
+        assert len(found) == 6
+        assert all("parallel/mesh.py" in m for m in found)
+        assert any("import jax.experimental.shard_map" in m.replace(
+            "raw import", "import") for m in found)
+        assert any("from jax import shard_map" in m.replace(
+            "raw from", "from") for m in found)
+        assert any("jax.shard_map attribute" in m.replace("raw ", "")
+                   for m in found)
+
+    def test_shim_module_and_shim_users_are_clean(self, tmp_path):
+        pkg = _pkg(tmp_path, {
+            # the shim itself may touch the raw API — that is its job
+            "parallel/mesh.py": """\
+                from jax.experimental.shard_map import shard_map as _raw
+
+                def shard_map(fn, mesh, in_specs, out_specs):
+                    return _raw(fn, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs)
+            """,
+            # sanctioned call sites import the shim, not jax
+            "parallel/ladder.py": """\
+                from pkg.parallel.mesh import shard_map
+
+                def program(mesh, fn):
+                    return shard_map(fn, mesh, None, None)
+            """,
+            # a local attribute called shard_map on a non-jax object is
+            # not the raw API
+            "worker/ok.py": """\
+                def run(backend):
+                    return backend.shard_map(lambda x: x)
+            """})
+        assert run_passes(pkg, rules=["meshshim"]) == []
+
+    def test_real_repo_is_clean(self):
+        findings = [f for f in run_passes(default_pkg_dir())
+                    if f.rule == "meshshim"]
+        assert findings == []
+
+
+# --------------------------------------------------------------------------
 # Baseline + CLI
 # --------------------------------------------------------------------------
 
@@ -497,4 +557,4 @@ def test_every_pass_ran_over_a_parsed_repo():
     assert "vlog_tpu/delivery/plane.py" in rels
     assert "vlog_tpu/worker/brownout.py" in rels
     assert set(PASSES) == {"asyncblock", "lockdiscipline", "epochfence",
-                           "tracehop", "registry"}
+                           "tracehop", "registry", "meshshim"}

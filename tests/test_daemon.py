@@ -286,6 +286,9 @@ def test_daemon_concurrent_slot_claims(run, db, tmp_path):
         assert attrs.get("mesh.width") == 4, attrs
         assert attrs.get("mesh.slot") in (0, 1)
         assert "mesh.wait_s" in attrs
+        # grid_for_run stamped the resolved (data x rung) label on the
+        # lease; default spec data:-1 -> all 4 slot devices on the data axis
+        assert attrs.get("mesh.shape") == "4x1", attrs
         widths.append(attrs["mesh.slot"])
     assert sorted(widths) == [0, 1]       # one job per slot
 
@@ -317,4 +320,5 @@ def test_daemon_single_job_under_scheduler_gets_full_mesh(run, db, tmp_path,
     attrs = json.loads(span["attributes"] or "{}")
     assert attrs.get("mesh.width") == 8
     assert attrs.get("mesh.slot") == "full"
+    assert attrs.get("mesh.shape") == "8x1", attrs
     assert sched.capacity() == 2

@@ -130,6 +130,155 @@ def test_hevc_backend_run_on_mesh_matches_single_device(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# 2-D (data × rung) grid: byte identity across every mesh shape ×
+# pipeline depth, h264 intra + chain and hevc, plus the small-batch
+# workload the rung axis exists for (n_chains < data width).
+# --------------------------------------------------------------------------
+
+# Four constant-QP rungs (bitrate 0 -> no closed-loop rate feedback):
+# chain batching legitimately varies with the data-axis width, so the
+# shape-invariance contract needs a QP schedule that cannot depend on
+# how many chains share a dispatch.
+_RUNGS_2D = (("96p", 96, 30), ("64p", 64, 31),
+             ("48p", 48, 32), ("32p", 32, 33))
+
+# data:1,rung:8 exercises the clamp (4 rungs -> 1x4); the others are
+# the full 8-device shapes. "auto" rides along in the chain test.
+_SPECS_2D = ("data:1,rung:8", "data:2,rung:4",
+             "data:4,rung:2", "data:8,rung:1")
+
+_SINGLE_DEV_SCRIPT_2D = """
+import sys
+import jax
+assert len(jax.devices()) == 1, jax.devices()
+from vlog_tpu import config
+from vlog_tpu.worker.pipeline import process_video
+mode = sys.argv[3]
+kw = {"rungs": tuple(
+    config.QualityRung(n, h, 0, 0, base_qp=q)
+    for n, h, q in (("96p", 96, 30), ("64p", 64, 31),
+                    ("48p", 48, 32), ("32p", 32, 33)))}
+if mode.endswith("+h265"):
+    mode = mode[:-5]
+    kw["codec"] = "h265"
+process_video(sys.argv[1], sys.argv[2], audio=False, segment_duration_s=1.0,
+              gop_mode=mode, **kw)
+"""
+
+
+def _rungs_2d(config):
+    return tuple(config.QualityRung(n, h, 0, 0, base_qp=q)
+                 for n, h, q in _RUNGS_2D)
+
+
+def _single_device_tree_2d(tmp_path, src, gop_mode: str):
+    single_out = tmp_path / "single"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, "-c", _SINGLE_DEV_SCRIPT_2D, str(src),
+         str(single_out), gop_mode],
+        env=env, cwd="/root/repo", timeout=600,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    ref = _tree_files(single_out)
+    assert any(k.endswith(".m4s") for k in ref)
+    return ref
+
+
+def _run_2d_matrix(tmp_path, monkeypatch, gop_mode: str,
+                   extra_specs: tuple[str, ...] = ()):
+    """Every mesh shape × pipeline depth must publish the byte tree the
+    single-chip run publishes (identity to the baseline implies identity
+    across all shapes/depths)."""
+    import jax
+
+    from vlog_tpu import config
+    from vlog_tpu.worker.pipeline import process_video
+
+    assert len(jax.devices()) == 8, "conftest must pin the 8-device mesh"
+    src = make_y4m(tmp_path / "src.y4m", n_frames=24, width=128, height=96,
+                   fps=10)
+    ref = _single_device_tree_2d(tmp_path, src, gop_mode)
+
+    kw: dict = {"rungs": _rungs_2d(config)}
+    mode = gop_mode
+    if mode.endswith("+h265"):
+        mode = mode[:-5]
+        kw["codec"] = "h265"
+    for depth in (1, 2, 3):
+        monkeypatch.setattr(config, "PIPELINE_DEPTH", depth)
+        specs = _SPECS_2D + extra_specs if depth == 2 else _SPECS_2D
+        for spec in specs:
+            monkeypatch.setattr(config, "TPU_MESH_SPEC", spec)
+            out = tmp_path / f"d{depth}_{spec.replace(':', '').replace(',', '-')}"
+            process_video(src, out, audio=False, segment_duration_s=1.0,
+                          gop_mode=mode, **kw)
+            files = _tree_files(out)
+            assert set(files) == set(ref), (depth, spec,
+                                            set(files) ^ set(ref))
+            for rel, data in ref.items():
+                assert files[rel] == data, (
+                    f"depth {depth} shape {spec}: {rel} differs "
+                    f"({len(files[rel])} vs {len(data)} bytes)")
+
+
+@pytest.mark.slow
+def test_2d_shape_matrix_intra(tmp_path, monkeypatch):
+    """All-intra over the full shape × depth matrix: the intra batch
+    width (max(frame_batch, data) rounded to data) is 8 for every
+    shape, so identity holds including the closed-loop batch plumbing."""
+    _run_2d_matrix(tmp_path, monkeypatch, "intra")
+
+
+@pytest.mark.slow
+def test_2d_shape_matrix_chains(tmp_path, monkeypatch):
+    """I+P chains at constant QP over the matrix, plus auto shape
+    selection: chains-per-dispatch varies with the data width, but each
+    chain's compute must not care which shape dispatched it."""
+    _run_2d_matrix(tmp_path, monkeypatch, "p", extra_specs=("auto",))
+
+
+@pytest.mark.slow
+def test_2d_shape_matrix_hevc(tmp_path, monkeypatch):
+    """Fused HEVC chain ladder over the matrix."""
+    _run_2d_matrix(tmp_path, monkeypatch, "p+h265")
+
+
+@pytest.mark.slow
+def test_2d_small_batch_byte_identical(tmp_path, monkeypatch):
+    """n_chains < data width — the workload the rung axis exists for
+    (r04: device_pull_s at 96% of wall on padded data-only dispatches).
+    12 frames at 6-frame chains = 2 chains: 8x1 pads 2 -> 8 chains,
+    2x4 runs them unpadded with rungs split 4 ways. Both must publish
+    the single-chip byte tree."""
+    import jax
+
+    from vlog_tpu import config
+    from vlog_tpu.worker.pipeline import process_video
+
+    assert len(jax.devices()) == 8
+    src = make_y4m(tmp_path / "src.y4m", n_frames=12, width=128, height=96,
+                   fps=10)
+    rungs = _rungs_2d(config)
+
+    trees = {}
+    for spec in ("data:8,rung:1", "data:2,rung:4"):
+        monkeypatch.setattr(config, "TPU_MESH_SPEC", spec)
+        out = tmp_path / spec.replace(":", "").replace(",", "-")
+        process_video(src, out, audio=False, segment_duration_s=0.6,
+                      gop_mode="p", rungs=rungs)
+        trees[spec] = _tree_files(out)
+        assert any(k.endswith(".m4s") for k in trees[spec])
+    a, b = trees.values()
+    assert set(a) == set(b)
+    for rel, data in a.items():
+        assert b[rel] == data, f"{rel}: 2x4 differs from 8x1"
+
+
+# --------------------------------------------------------------------------
 # Mesh job scheduler (parallel/scheduler.py): slot-width byte identity,
 # concurrent-vs-serialized equivalence, and chaos drain.
 # --------------------------------------------------------------------------

@@ -56,6 +56,92 @@ def test_pad_batch():
     assert n == 5 and yq.shape[0] == 5 and yq is y
 
 
+# --------------------------------------------------------------------------
+# 2-D (data × rung) grid: shape resolution + column layout
+# --------------------------------------------------------------------------
+
+_LADDER_6 = (("2160p", 2160, 3840, 30), ("1440p", 1440, 2560, 30),
+             ("1080p", 1080, 1920, 30), ("720p", 720, 1280, 30),
+             ("480p", 480, 854, 30), ("360p", 360, 640, 30))
+
+
+def test_balanced_rung_columns_lpt_by_pixel_rate():
+    from vlog_tpu.parallel.mesh import balanced_rung_columns
+
+    cols = balanced_rung_columns(_LADDER_6, 2)
+    # 2160p (8.3 MP) outweighs the other five rungs combined (~7 MP):
+    # LPT parks it alone and stacks everything else in the other column
+    assert cols == ((0,), (1, 2, 3, 4, 5))
+    # every rung appears exactly once, no column empty
+    cols4 = balanced_rung_columns(_LADDER_6, 4)
+    assert sorted(i for c in cols4 for i in c) == list(range(6))
+    assert all(c for c in cols4)
+    # deterministic on ties
+    same = (("a", 100, 100, 30), ("b", 100, 100, 30))
+    assert balanced_rung_columns(same, 2) == ((0,), (1,))
+    with pytest.raises(ValueError):
+        balanced_rung_columns(_LADDER_6, 7)   # more columns than rungs
+    with pytest.raises(ValueError):
+        balanced_rung_columns(_LADDER_6, 0)
+
+
+def test_auto_mesh_shape_small_batch_prefers_rung_axis():
+    from vlog_tpu.parallel.mesh import MeshShape, auto_mesh_shape
+
+    # big batch: pure data parallelism wins (ties prefer wider data)
+    assert auto_mesh_shape(8, _LADDER_6, batch_hint=64) == MeshShape(8, 1)
+    # 1-chain batch: padding 1 -> 8 buys nothing; splitting rungs does
+    small = auto_mesh_shape(8, _LADDER_6, batch_hint=1)
+    assert small.rung > 1 and small.n_devices == 8
+    # single device: only one shape exists
+    assert auto_mesh_shape(1, _LADDER_6, batch_hint=4) == MeshShape(1, 1)
+
+
+def test_resolve_mesh_shape_specs_and_clamps():
+    from vlog_tpu.parallel.mesh import MeshShape, resolve_mesh_shape
+
+    r = resolve_mesh_shape("data:2,rung:4", 8, _LADDER_6)
+    assert r == MeshShape(2, 4)
+    # rung clamps to the rung count
+    r = resolve_mesh_shape("data:1,rung:8", 8, _LADDER_6[:4])
+    assert r == MeshShape(1, 4)
+    # wildcard data absorbs what the rung axis leaves
+    assert resolve_mesh_shape("data:-1,rung:2", 8, _LADDER_6) \
+        == MeshShape(4, 2)
+    # wildcard rung fills up to the rung count
+    assert resolve_mesh_shape("data:2,rung:-1", 8, _LADDER_6) \
+        == MeshShape(2, 4)
+    # legacy 1-D specs stay 1-D
+    assert resolve_mesh_shape("data:-1", 8, _LADDER_6) == MeshShape(8, 1)
+    # auto defers to the model
+    assert resolve_mesh_shape("auto", 8, _LADDER_6, batch_hint=64) \
+        == MeshShape(8, 1)
+    with pytest.raises(ValueError):
+        resolve_mesh_shape("data:8,rung:2", 8, _LADDER_6)   # 16 > 8
+
+
+def test_rung_grid_columns_contiguous_blocks():
+    from vlog_tpu.parallel.mesh import MeshShape, rung_grid
+
+    devs = list(jax.devices())
+    grid = rung_grid(_LADDER_6, MeshShape(2, 4), devs)
+    assert grid.label == "2x4" and grid.data == 2
+    assert len(grid.columns) == 4
+    seen = []
+    for j, col in enumerate(grid.columns):
+        assert list(col.mesh.devices.flat) == devs[2 * j:2 * j + 2]
+        assert col.mesh.axis_names == ("data",)
+        seen.extend(col.names)
+    assert sorted(seen) == sorted(r[0] for r in _LADDER_6)
+    assert grid.column_of("2160p").names == ("2160p",)
+    with pytest.raises(KeyError):
+        grid.column_of("nope")
+    # width-1 columns still get a real mesh (placement must commit to
+    # the owning device, not the process default)
+    g18 = rung_grid(_LADDER_6, MeshShape(1, 6), devs)
+    assert all(c.mesh.devices.size == 1 for c in g18.columns)
+
+
 def test_sharded_ladder_levels_match_single_device():
     """The sharded step must produce bit-identical levels to the
     single-device encoder (exact integer DSP — no tolerance)."""
@@ -268,6 +354,35 @@ def test_mesh_for_run_uses_lease_devices():
     assert host_pool_for_run() is None
 
 
+def test_grid_for_run_uses_lease_and_stamps_shape(monkeypatch):
+    import jax
+
+    from vlog_tpu import config
+    from vlog_tpu.parallel.scheduler import grid_for_run
+
+    rungs = _LADDER_6[:4]
+    devs = list(jax.devices())
+    monkeypatch.setattr(config, "TPU_MESH_SPEC", "data:2,rung:4")
+    s = MeshScheduler(devices=devs, slots=2)
+    t1, t2 = s.admit(), s.admit()
+    with t1.acquire() as lease:
+        # the spec needs 8 devices but the slot has 4: degrade to auto
+        grid = grid_for_run(rungs, batch_hint=1)
+        assert grid is not None
+        assert grid.shape.n_devices <= 4
+        assert {d for c in grid.columns for d in c.mesh.devices.flat} \
+            <= set(devs[:4])
+        assert lease.shape == grid.label
+    t1.close()
+    t2.close()
+    # without a lease the spec resolves against all devices
+    grid = grid_for_run(rungs, batch_hint=1)
+    assert grid.label == "2x4"
+    # explicit 1-D spec keeps the legacy shape
+    monkeypatch.setattr(config, "TPU_MESH_SPEC", "data:-1")
+    assert grid_for_run(rungs).label == "8x1"
+
+
 def test_single_slot_scheduler_serializes():
     s = _sched(slots=1)
     t1 = s.admit()
@@ -300,10 +415,11 @@ def test_scheduler_gauges_and_wait_histogram():
 # --------------------------------------------------------------------------
 
 class TestMeshSchedulerAgreement:
-    KNOBS = ("VLOG_MESH_SLOTS",)
+    KNOBS = ("VLOG_MESH_SLOTS", "VLOG_TPU_MESH")
     METRICS = ("vlog_mesh_slots", "vlog_mesh_slot_occupancy",
-               "vlog_mesh_slot_width", "vlog_mesh_slot_wait_seconds")
-    SPAN_ATTRS = ("mesh.slot", "mesh.width")
+               "vlog_mesh_slot_width", "vlog_mesh_slot_wait_seconds",
+               "vlog_ladder_pad_waste")
+    SPAN_ATTRS = ("mesh.slot", "mesh.width", "mesh.shape")
 
     def test_knobs_parsed_and_documented(self):
         from vlog_tpu import config
@@ -311,6 +427,7 @@ class TestMeshSchedulerAgreement:
 
         reg.assert_knobs(self.KNOBS)
         assert isinstance(config.MESH_SLOTS, int)
+        assert isinstance(config.TPU_MESH_SPEC, str)
 
     def test_metrics_registered_and_documented(self):
         from vlog_tpu.analysis import registry as reg

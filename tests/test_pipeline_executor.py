@@ -84,6 +84,26 @@ class TestExecutorUnit:
         assert g["pipeline_depth"] == 3
         assert g["host_wall_s"] >= 0.0
 
+    def test_note_pad_waste_accumulates_and_gauges(self):
+        """Pad-waste observability: padded frames accumulate into the
+        run's prof (surfaces in stage_s) and the last dispatch's padded
+        fraction lands on the vlog_ladder_pad_waste gauge."""
+        from vlog_tpu.obs.metrics import runtime
+
+        prof: dict = {}
+        pipe = PipelineExecutor(["r"], pull=lambda n, b: None,
+                                process=lambda n, b, h: None,
+                                depth=1, host_threads=1, prof=prof)
+        try:
+            pipe.note_pad_waste(2, 8)       # 6 padded frames, 75% waste
+            assert prof["pad_frames"] == 6
+            assert runtime().ladder_pad_waste._value.get() == 0.75
+            pipe.note_pad_waste(8, 8)       # full batch: no waste
+            assert prof["pad_frames"] == 6
+            assert runtime().ladder_pad_waste._value.get() == 0.0
+        finally:
+            pipe.close()
+
     def test_depth_one_is_serial(self):
         """At depth 1 a submit never overlaps the previous batch."""
         active = []

@@ -8,6 +8,7 @@ it); ``python -m vlog_tpu.analysis`` is the CLI. Pass registry:
 - ``epochfence``      claim-gated Worker-API writes reach the epoch fence
 - ``tracehop``        thread hand-offs in traced modules carry context
 - ``registry``        knob/metric/failpoint/span registries vs docs
+- ``meshshim``        shard_map call sites go through parallel/mesh
 """
 
 from __future__ import annotations
@@ -15,7 +16,7 @@ from __future__ import annotations
 from pathlib import Path
 
 from vlog_tpu.analysis import (asyncblock, epochfence, lockdiscipline,
-                               registry, tracehop)
+                               meshshim, registry, tracehop)
 from vlog_tpu.analysis.core import (Finding, Module, load_baseline,
                                     load_package, render_baseline)
 
@@ -25,7 +26,7 @@ __all__ = [
 ]
 
 PASSES = {m.RULE: m for m in (asyncblock, lockdiscipline, epochfence,
-                              tracehop, registry)}
+                              tracehop, registry, meshshim)}
 
 
 def default_pkg_dir() -> Path:

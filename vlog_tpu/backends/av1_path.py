@@ -159,17 +159,20 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
         from vlog_tpu.ops.resize import resize_yuv420
         from vlog_tpu.parallel.executor import PipelineExecutor
         from vlog_tpu.parallel.mesh import pad_batch, shard_frames
-        from vlog_tpu.parallel.scheduler import (host_pool_for_run,
-                                                 mesh_for_run)
+        from vlog_tpu.parallel.scheduler import (grid_for_run,
+                                                 host_pool_for_run)
 
-        # Mesh parity with the first-party paths: the device resize
-        # shards the frame axis over the mesh when >1 device is visible
-        # (slot submesh under the scheduler, all devices otherwise), so
-        # AV1 jobs can be placed on narrow slots too. Frames are
-        # independent, so sharded and unsharded resizes are identical;
-        # pad_batch rounds the batch up to the mesh and the pull trims.
-        mesh = mesh_for_run()
-        n_mesh = int(mesh.devices.size) if mesh is not None else 1
+        # Mesh parity with the first-party paths: rungs are partitioned
+        # into cost-balanced columns of the 2-D (data x rung) grid and
+        # each rung's device resize runs on its owning column (slot
+        # submesh under the scheduler, all devices otherwise), so AV1
+        # jobs can be placed on narrow slots too and per-rung resizes
+        # land on distinct devices. Frames are independent, so sharded
+        # and unsharded resizes are identical; pad_batch rounds the
+        # batch up to the column's data width and the pull trims.
+        rungs_spec = tuple((r.name, r.height, r.width, 0)
+                           for r in plan.rungs)
+        grid = grid_for_run(rungs_spec, batch_hint=plan.frame_batch)
 
         fifo: queue_mod.Queue = queue_mod.Queue(maxsize=1)
         eof = object()
@@ -269,9 +272,11 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
             if (rung.height, rung.width) == (by.shape[1], by.shape[2]):
                 return by, bu, bv
             n = by.shape[0]
-            if mesh is not None and n_mesh > 1:
-                (by, bu, bv), _ = pad_batch(n_mesh, by, bu, bv)
-                by, bu, bv = shard_frames(mesh, by, bu, bv)
+            if grid is not None:
+                col = grid.column_of(name)
+                (by, bu, bv), _ = pad_batch(grid.data, by, bu, bv)
+                pipe.note_pad_waste(n, by.shape[0])
+                by, bu, bv = shard_frames(col.mesh, by, bu, bv)
             ry, ru, rv = resize_yuv420(by, bu, bv, rung.height,
                                        rung.width)
             return (np.asarray(ry)[:n], np.asarray(ru)[:n],

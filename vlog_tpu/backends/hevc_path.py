@@ -94,10 +94,9 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
 
         from vlog_tpu.parallel.executor import (LaggedRateControl,
                                                 PipelineExecutor)
-        from vlog_tpu.parallel.hevc_ladder import hevc_chain_ladder_program
-        from vlog_tpu.parallel.mesh import shard_frames
-        from vlog_tpu.parallel.scheduler import (host_pool_for_run,
-                                                 mesh_for_run)
+        from vlog_tpu.parallel.hevc_ladder import hevc_chain_ladder_grid
+        from vlog_tpu.parallel.scheduler import (grid_for_run,
+                                                 host_pool_for_run)
 
         # closed-loop VBR toward each rung's ladder bitrate, same
         # controller the H.264 path uses (per-frame QP is traced, so
@@ -121,24 +120,25 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
         stop = threading.Event()
 
         # --- fused all-rungs chain ladder (parallel/hevc_ladder.py): one
-        # dispatch per batch emits every hvc1 rung; chains shard over the
-        # mesh when >1 device (SURVEY §2d.2/§2d.5 applied to HEVC). The
-        # mesh is the job's slot submesh under the scheduler, the
-        # all-devices mesh otherwise (parallel/scheduler.py).
+        # dispatch per batch emits every hvc1 rung; over >1 device the
+        # ladder lays out as a 2-D (data × rung) grid — chains shard the
+        # data axis, rung columns split the ladder (SURVEY §2d.2/§2d.5
+        # applied to HEVC). grid_for_run() resolves the shape over the
+        # job's slot devices (all devices without a lease); batch math
+        # keys off the DATA-axis width only, keeping batches (and trees)
+        # identical across grid shapes.
         src_h, src_w = plan.source.height, plan.source.width
         rungs_spec = tuple((r.name, r.height, r.width, r.qp)
                            for r in plan.rungs)
-        mesh = mesh_for_run()
-        n_dev = int(mesh.devices.size) if mesh is not None else 1
         clen = max(1, plan.gop_len)
-        chains_per = max(1, -(-plan.frame_batch // clen))
-        dev = max(n_dev, 1)
-        chains_per = max(dev, chains_per + (-chains_per) % dev)
-        batch_n = clen * chains_per
-        fn, mats = hevc_chain_ladder_program(
+        hint = max(1, -(-plan.frame_batch // clen))
+        grid = grid_for_run(rungs_spec, batch_hint=hint)
+        prog = hevc_chain_ladder_grid(
             rungs_spec, src_h, src_w,
-            search=config.MOTION_SEARCH_RADIUS, mesh=mesh,
+            search=config.MOTION_SEARCH_RADIUS, grid=grid,
             deblock=config.HEVC_DEBLOCK)
+        chains_per = max(prog.data, hint + (-hint) % prog.data)
+        batch_n = clen * chains_per
         npix = {r.name: r.height * r.width for r in plan.rungs}
         rows_cols = {r.name: ((r.height + 31) // 32, (r.width + 31) // 32)
                      for r in plan.rungs}
@@ -168,6 +168,7 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                 by = np.concatenate([by, np.repeat(by[-1:], reps, axis=0)])
                 bu = np.concatenate([bu, np.repeat(bu[-1:], reps, axis=0)])
                 bv = np.concatenate([bv, np.repeat(bv[-1:], reps, axis=0)])
+            pipe.note_pad_waste(n_real, batch_n)
             chain = lambda p: p.reshape((chains_per, clen) + p.shape[1:])
             by, bu, bv = chain(by), chain(bu), chain(bv)
             qps = {}
@@ -177,10 +178,9 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
                 qps[r.name] = q       # the program applies the I -2 anchor
             rc = {r.name: controllers[r.name].device_rc_params()
                   for r in plan.rungs}
-            if mesh is not None:
-                by, bu, bv = shard_frames(mesh, by, bu, bv)
-                qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
-            return fn(by, bu, bv, mats, qps, rc), n_real, qps
+            # per-column staging: frames replicate along the rung axis,
+            # each rung's outputs stay on its owning column for the pull
+            return prog.dispatch(by, bu, bv, qps, rc), n_real, qps
 
         # --- stage-decoupled consume side: the same PipelineExecutor
         # the H.264 path uses (per-rung ordered threads, shared host

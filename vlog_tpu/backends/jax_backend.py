@@ -338,44 +338,47 @@ class JaxBackend:
             bytes_written[name] += len(data)
 
         # --- the one-pass ladder program: ONE dispatch per GOP batch
-        # emits quantized levels for EVERY rung (SURVEY §2d.2); frames
-        # shard over the device mesh when >1 chip (§2d.5). Under the
-        # mesh job scheduler the mesh is this job's SLOT submesh
-        # (parallel/scheduler.py) so concurrent jobs split the chips;
-        # without a lease it is the classic all-devices mesh.
+        # emits quantized levels for EVERY rung (SURVEY §2d.2); over >1
+        # chip the ladder lays out as a 2-D (data × rung) grid — frames
+        # shard the data axis, rung columns split the ladder — resolved
+        # by grid_for_run() (slot submesh devices under the scheduler,
+        # every visible device otherwise; VLOG_TPU_MESH picks the
+        # shape). All batch math keys off the grid's DATA-axis width
+        # only, so every shape whose data width divides the frame batch
+        # stages identical batches — the cross-shape byte-identity
+        # contract tests/test_mesh_equivalence.py asserts.
         import jax
 
-        from vlog_tpu.parallel.ladder import ladder_encode_program
-        from vlog_tpu.parallel.mesh import shard_frames
-        from vlog_tpu.parallel.scheduler import (host_pool_for_run,
-                                                 mesh_for_run)
+        from vlog_tpu.parallel.ladder import (ladder_chain_grid,
+                                              ladder_encode_grid)
+        from vlog_tpu.parallel.scheduler import (grid_for_run,
+                                                 host_pool_for_run)
 
         src_h, src_w = plan.source.height, plan.source.width
         rungs_spec = tuple((r.name, r.height, r.width, r.qp)
                            for r in plan.rungs)
-        mesh = mesh_for_run()
-        n_dev = int(mesh.devices.size) if mesh is not None else 1
         chain_mode = plan.gop_len > 1
         if chain_mode:
-            from vlog_tpu.parallel.ladder import ladder_chain_program
-
-            # Chains are independent mini-GOPs, so the mesh shards the
+            # Chains are independent mini-GOPs, so the grid shards the
             # chain axis; enough chains per dispatch to honor frame_batch
-            # (amortizing host overhead), rounded to the mesh size.
+            # (amortizing host overhead), rounded to the data-axis width
+            # (NOT the device count: a 2x4 grid pads a small batch to 2
+            # chains where the 1-D mesh padded it to 8).
             clen = plan.gop_len
-            chains_per = max(1, -(-plan.frame_batch // clen))
-            dev = max(n_dev, 1)
-            chains_per = max(dev, chains_per + (-chains_per) % dev)
-            batch_n = clen * chains_per
-            fn, mats = ladder_chain_program(
+            hint = max(1, -(-plan.frame_batch // clen))
+            grid = grid_for_run(rungs_spec, batch_hint=hint)
+            prog = ladder_chain_grid(
                 rungs_spec, src_h, src_w,
-                search=config.MOTION_SEARCH_RADIUS, mesh=mesh,
+                search=config.MOTION_SEARCH_RADIUS, grid=grid,
                 deblock=config.H264_DEBLOCK)
+            chains_per = max(prog.data, hint + (-hint) % prog.data)
+            batch_n = clen * chains_per
         else:
-            fn, mats = ladder_encode_program(rungs_spec, src_h, src_w, mesh)
-            # Fixed staged batch size (single compile; mesh-divisible).
-            batch_n = max(plan.frame_batch, n_dev)
-            batch_n += (-batch_n) % max(n_dev, 1)
+            grid = grid_for_run(rungs_spec, batch_hint=plan.frame_batch)
+            prog = ladder_encode_grid(rungs_spec, src_h, src_w, grid)
+            # Fixed staged batch size (single compile; data-divisible).
+            batch_n = max(plan.frame_batch, prog.data)
+            batch_n += (-batch_n) % prog.data
 
         # Closed-loop VBR toward each rung's ladder bitrate.
         controllers = {
@@ -405,6 +408,7 @@ class JaxBackend:
                 by = np.concatenate([by, np.repeat(by[-1:], reps, axis=0)])
                 bu = np.concatenate([bu, np.repeat(bu[-1:], reps, axis=0)])
                 bv = np.concatenate([bv, np.repeat(bv[-1:], reps, axis=0)])
+            pipe.note_pad_waste(n_real, batch_n)
             if chain_mode:
                 chain = lambda p: p.reshape((chains_per, clen) + p.shape[1:])
                 by, bu, bv = chain(by), chain(bu), chain(bv)
@@ -423,15 +427,13 @@ class JaxBackend:
                 # alpha 0 (calibrate_proxy no-ops), disabling adjustment
                 rc = {r.name: controllers[r.name].device_rc_params()
                       for r in plan.rungs}
-            else:
-                qps = {r.name: controllers[r.name].frame_qps(batch_n)
-                       for r in plan.rungs}
-            if mesh is not None:
-                by, bu, bv = shard_frames(mesh, by, bu, bv)
-                qps = {k: shard_frames(mesh, q)[0] for k, q in qps.items()}
-            if chain_mode:
-                return fn(by, bu, bv, mats, qps, rc), n_real, qps
-            return fn(by, bu, bv, mats, qps), n_real, qps
+                # the grid stages per column (frames replicated along
+                # the rung axis, each rung's QP/RC routed to its owning
+                # column) and leaves each rung's outputs on that column
+                return prog.dispatch(by, bu, bv, qps, rc), n_real, qps
+            qps = {r.name: controllers[r.name].frame_qps(batch_n)
+                   for r in plan.rungs}
+            return prog.dispatch(by, bu, bv, qps), n_real, qps
 
         # --- stage-decoupled consume side (parallel/executor.py): rungs
         # pull + entropy-code concurrently on per-rung ordered threads,

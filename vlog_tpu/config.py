@@ -485,7 +485,14 @@ PIPELINE_DEPTH: int = _env_int("VLOG_PIPELINE_DEPTH", 2, lo=1, hi=16)
 ENTROPY_THREADS: int = _env_int(
     "VLOG_ENTROPY_THREADS", max(2, min(32, os.cpu_count() or 8)),
     lo=1, hi=256)
-# Mesh axis layout, e.g. "data:8" or "data:4,chunk:2". Parsed by parallel.mesh.
+# Mesh axis layout for the ladder's 2-D (data × rung) grid, parsed by
+# parallel.mesh.resolve_mesh_shape: "data:2,rung:4" splits 8 devices
+# into 4 rung columns of 2-wide data submeshes; "auto" picks the shape
+# from batch size and rung count; legacy 1-D specs ("data:-1", "data:8")
+# keep the pure data-parallel layout (rung defaults to 1). One axis may
+# be -1 (fill from the device count); the rung axis clamps to the
+# ladder's rung count. Non-ladder programs (make_mesh callers) read the
+# same spec and ignore axes they don't use.
 TPU_MESH_SPEC: str = _env_str("VLOG_TPU_MESH", "data:-1")
 # Mesh job slots (parallel/scheduler.py): the process's devices partition
 # into this many equal-width slots so the scheduler can admit that many

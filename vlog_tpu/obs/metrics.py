@@ -219,6 +219,12 @@ class RuntimeMetrics:
             "(queue-wait-for-slot)",
             buckets=(0.001, 0.01, 0.1, 0.5, 2.0, 10.0, 60.0, 300.0),
             registry=self.registry)
+        self.ladder_pad_waste = Gauge(
+            "vlog_ladder_pad_waste",
+            "Padded fraction of the last ladder dispatch's staged frames "
+            "(pad_batch rounds batches to the grid's data-axis width; the "
+            "2-D (data x rung) layout narrows that width on small batches)",
+            registry=self.registry)
         # Fault-domain isolation plane: device quarantine + claim-loop
         # brownout (parallel/scheduler.py, worker/brownout.py).
         self.slot_quarantined = Counter(

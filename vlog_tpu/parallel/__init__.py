@@ -12,9 +12,14 @@ from vlog_tpu.parallel.executor import (  # noqa: F401
     StagedBatch,
 )
 from vlog_tpu.parallel.mesh import (  # noqa: F401
+    MeshShape,
     MeshSpec,
+    RungGrid,
+    balanced_rung_columns,
     make_mesh,
     parse_mesh_spec,
+    resolve_mesh_shape,
+    rung_grid,
     shard_frames,
 )
 from vlog_tpu.parallel.scheduler import (  # noqa: F401
@@ -23,6 +28,7 @@ from vlog_tpu.parallel.scheduler import (  # noqa: F401
     SlotTicket,
     current_lease,
     get_scheduler,
+    grid_for_run,
     host_pool_for_run,
     mesh_for_run,
 )

@@ -13,10 +13,16 @@ H.264 DSP for every rung, cross-device ``psum`` PSNR reduction over ICI
 (SURVEY.md §2d.5).
 
 After the correctness asserts, the harness measures and prints (as the
-final JSON line the MULTICHIP_r*.json record captures):
+final JSON line the MULTICHIP_r*.json record captures; the same numbers
+are appended as labeled records to ``MULTICHIP.json`` in the
+BENCH_delivery/BENCH_coord format so shape_fps trajectories compare
+across rounds instead of each round overwriting the last):
 
-- per-mesh-shape chain-ladder throughput at 1/2/4/8 devices
-  (``shape_fps``), and
+- per-mesh-shape chain-ladder throughput over the 2-D (data × rung)
+  grid — data-only shapes (1x1/2x1/4x1/8x1) plus the full-device 2-D
+  shapes (4x2/2x4) — on two workloads: "full" (one chain per data
+  slot) and "small_batch" (2 chains regardless of shape, the workload
+  where data-only padding wastes most of the mesh) (``shape_fps``), and
 - the mesh job scheduler's 2-slots-vs-1 comparison: two queued jobs
   whose batches underfill the full mesh, run serialized on full-mesh
   leases vs concurrently on 2 narrow slots through the REAL
@@ -121,11 +127,71 @@ def run(n_devices: int) -> None:
           f"{[(r[0], round(float(stats[r[0]]), 2)) for r in rungs]}, "
           f"chain clen={clen} ok, hevc chain ok")
 
+    # The shape sweep wants enough rungs for a real rung axis (r up to
+    # 4 columns); all sweep rungs fit the 96x128 source.
+    sweep_rungs = (("96p", 96, 128, 26), ("64p", 64, 96, 28),
+                   ("48p", 48, 64, 29), ("32p", 32, 48, 30))
+    shape_fps = measure_mesh_shapes(devices, sweep_rungs, h, w, clen)
+    sched = measure_scheduler_packing(devices, rungs, h, w, clen)
     record = {"multichip": "ok", "devices": n_devices,
-              "shape_fps": measure_mesh_shapes(devices, rungs, h, w, clen),
-              "sched": measure_scheduler_packing(devices, rungs, h, w,
-                                                 clen)}
+              "shape_fps": shape_fps, "sched": sched}
+    try:
+        _append_records("MULTICHIP.json",
+                        _multichip_records(n_devices, shape_fps, sched))
+    except OSError:
+        pass   # record trail is best-effort; the JSON line below is not
     print(json.dumps(record), flush=True)
+
+
+def _append_records(path: str, records: list[dict]) -> None:
+    """Labeled-record trail (the BENCH_delivery/BENCH_coord idiom):
+    read the existing list, extend, rewrite — rounds accumulate."""
+    import os
+
+    existing: list = []
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, list):
+                existing = loaded
+        except (OSError, ValueError):
+            existing = []
+    existing.extend(records)
+    with open(path, "w") as f:
+        json.dump(existing, f, indent=1)
+        f.write("\n")
+
+
+def _multichip_records(n_devices: int, shape_fps: dict,
+                       sched: dict) -> list[dict]:
+    ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    recs = []
+    for workload in ("full", "small_batch"):
+        for label, fps in (shape_fps.get(workload) or {}).items():
+            recs.append({
+                "step": f"{workload}:{label}",
+                "metric": "ladder_chain_fps",
+                "fps": fps,
+                "timestamp": ts,
+                "config": {"devices": n_devices, "mesh_shape": label,
+                           "workload": workload}})
+    summary = shape_fps.get("small_batch_summary")
+    if summary:
+        recs.append({"step": "small_batch_summary",
+                     "metric": "ladder_shape_win_x",
+                     "win_x": summary.get("win_x"),
+                     "timestamp": ts,
+                     "config": {"devices": n_devices, **summary}})
+    if sched and "speedup" in sched:
+        recs.append({"step": "sched_packing",
+                     "metric": "sched_speedup_x",
+                     "speedup_x": sched["speedup"],
+                     "timestamp": ts,
+                     "config": {"devices": n_devices,
+                                "jobs": sched.get("jobs"),
+                                "slot_widths": sched.get("slot_widths")}})
+    return recs
 
 
 def _chain_batch(rng_seed: int, n_chains: int, clen: int, h: int, w: int):
@@ -162,29 +228,83 @@ def _dispatch_chains(fn, mats, mesh, rungs, y, u, v, clen):
     np.asarray(outs[rungs[0][0]]["sse_y"])
 
 
-def measure_mesh_shapes(devices, rungs, h: int, w: int, clen: int,
-                        shapes=(1, 2, 4, 8), iters: int = 3) -> dict:
-    """Chain-ladder throughput (frames/s) per mesh shape: one chain per
-    device, so each shape measures its own scale-out, not padding."""
-    from vlog_tpu import config
-    from vlog_tpu.parallel.ladder import ladder_chain_program
-    from vlog_tpu.parallel.mesh import make_mesh
+def _dispatch_grid(prog, rungs, y, u, v, clen):
+    """One 2-D grid chain-ladder dispatch: pad the chain axis to the
+    grid's DATA width (not the device count — the 2-D win), stage per
+    column, block, and pull one output per rung — the dispatch+pull
+    shape the production consume loop pays."""
+    import jax
+    import numpy as np
 
-    out = {}
-    for k in shapes:
-        if k > len(devices):
+    from vlog_tpu.parallel.mesh import pad_batch
+
+    (y, u, v), _ = pad_batch(prog.data, y, u, v)
+    n = y.shape[0]
+    qps = {name: np.full((n, clen), qp, np.int32)
+           for name, _, _, qp in rungs}
+    rc = {name: {"budget": np.float32(2000.0), "alpha": np.float32(0.0)}
+          for name, _, _, _ in rungs}
+    outs = prog.dispatch(y, u, v, qps, rc)
+    jax.block_until_ready(outs)
+    for name, _, _, _ in rungs:
+        np.asarray(outs[name]["sse_y"])
+
+
+def measure_mesh_shapes(devices, rungs, h: int, w: int, clen: int,
+                        shapes=None, iters: int = 3) -> dict:
+    """Chain-ladder throughput (frames/s) per 2-D (data × rung) mesh
+    shape, on two workloads:
+
+    - ``full``: one chain per data slot — each shape at its natural
+      batch, measuring pure scale-out; and
+    - ``small_batch``: 2 chains regardless of shape (n_chains <
+      devices) — the workload where a data-only shape pads 2 chains up
+      to its full width (every padded chain is discarded encode work)
+      while a 2-D shape spends the same devices splitting rungs across
+      columns instead.
+
+    fps counts REAL frames only, so data-only padding waste shows up
+    directly in the small_batch numbers. The default sweep is every
+    data-only divisor shape (1x1/2x1/.../Nx1) plus the full-device 2-D
+    shapes (N/r x r for each divisor r <= n_rungs)."""
+    from vlog_tpu import config
+    from vlog_tpu.parallel.ladder import ladder_chain_grid
+    from vlog_tpu.parallel.mesh import MeshShape, rung_grid
+
+    n_dev = len(devices)
+    if shapes is None:
+        divs = [d for d in range(1, n_dev + 1) if n_dev % d == 0]
+        shapes = [(d, 1) for d in divs]
+        shapes += [(n_dev // r, r) for r in divs if 1 < r <= len(rungs)]
+
+    out: dict = {}
+    for d, r in shapes:
+        if d * r > n_dev or r > len(rungs):
             continue
-        mesh = make_mesh("data:-1", devices=list(devices[:k])) \
-            if k > 1 else None
-        fn, mats = ladder_chain_program(rungs, h, w, search=4, mesh=mesh,
-                                        deblock=config.H264_DEBLOCK)
-        y, u, v = _chain_batch(7, k, clen, h, w)
-        _dispatch_chains(fn, mats, mesh, rungs, y, u, v, clen)   # compile
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            _dispatch_chains(fn, mats, mesh, rungs, y, u, v, clen)
-        dt = (time.perf_counter() - t0) / iters
-        out[str(k)] = round(k * clen / dt, 2)
+        shape = MeshShape(d, r)
+        grid = (rung_grid(rungs, shape, list(devices[:d * r]))
+                if d * r > 1 else None)
+        prog = ladder_chain_grid(rungs, h, w, search=4, grid=grid,
+                                 deblock=config.H264_DEBLOCK)
+        for workload, chains in (("full", d), ("small_batch", 2)):
+            y, u, v = _chain_batch(7, chains, clen, h, w)
+            _dispatch_grid(prog, rungs, y, u, v, clen)   # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                _dispatch_grid(prog, rungs, y, u, v, clen)
+            dt = (time.perf_counter() - t0) / iters
+            out.setdefault(workload, {})[shape.label] = round(
+                chains * clen / dt, 2)
+
+    small = out.get("small_batch", {})
+    data_only = small.get(f"{n_dev}x1")
+    two_d = {k: v for k, v in small.items() if not k.endswith("x1")}
+    if data_only and two_d:
+        best = max(two_d, key=lambda k: two_d[k])
+        out["small_batch_summary"] = {
+            "data_only_shape": f"{n_dev}x1", "data_only": data_only,
+            "best_2d_shape": best, "best_2d": two_d[best],
+            "win_x": round(two_d[best] / data_only, 2)}
     return out
 
 

@@ -191,6 +191,24 @@ class PipelineExecutor:
             if key in _BUSY_KEYS:
                 self._busy_s += seconds
 
+    def note_pad_waste(self, n_real: int, n_staged: int) -> None:
+        """Record one dispatch's batch padding: the
+        ``vlog_ladder_pad_waste`` gauge gets the padded fraction of the
+        staged frames (mirroring ``vlog_asr_pad_waste``), and the run
+        profile accumulates the thrown-away frames as ``pad_frames`` —
+        the number the (data × rung) grid's narrower data axis exists
+        to shrink on small/tail batches."""
+        waste = ((n_staged - n_real) / n_staged) if n_staged > 0 else 0.0
+        with self._prof_lock:
+            self.prof["pad_frames"] = (self.prof.get("pad_frames", 0.0)
+                                       + max(0, n_staged - n_real))
+        try:
+            from vlog_tpu.obs.metrics import runtime
+
+            runtime().ladder_pad_waste.set(waste)
+        except Exception:   # metrics are best-effort observability
+            pass
+
     def gauges(self) -> dict:
         """Overlap/occupancy gauges for ``RunResult.stage_s``: the
         configured window, the deepest the window actually got, and

@@ -29,8 +29,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from vlog_tpu.codecs.hevc.jax_core import encode_chain_dsp
 from vlog_tpu.codecs.hevc.syntax import CTB
 from vlog_tpu.ops.resize import resize_yuv420_with
-from vlog_tpu.parallel.ladder import RungSpec, ladder_matrices
-from vlog_tpu.parallel.mesh import shard_map
+from vlog_tpu.parallel.ladder import GridProgram, RungSpec, ladder_matrices
+from vlog_tpu.parallel.mesh import RungGrid, shard_map
 
 
 def _pad_ctb(y, u, v):
@@ -142,3 +142,38 @@ def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
         check_vma=False,
     )
     return jax.jit(fn), jax.device_put(mats, NamedSharding(mesh, P()))
+
+
+def hevc_chain_ladder_grid(rungs: tuple[RungSpec, ...], src_h: int,
+                           src_w: int, search: int = 16,
+                           grid: RungGrid | None = None,
+                           deblock: bool | None = None) -> GridProgram:
+    """Grid-wide HEVC chain ladder: per-column programs over a
+    (data × rung) grid, same dispatch surface as the H.264 grids.
+
+    ``deblock`` resolves (None -> config.HEVC_DEBLOCK) here, outside
+    the caches, for the same reason as :func:`hevc_chain_ladder_program`.
+    """
+    if deblock is None:
+        from vlog_tpu import config
+
+        deblock = config.HEVC_DEBLOCK
+    return _hevc_grid_cached(rungs, src_h, src_w, search, grid,
+                             bool(deblock))
+
+
+@functools.lru_cache(maxsize=8)
+def _hevc_grid_cached(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
+                      search: int, grid: RungGrid | None,
+                      deblock: bool) -> GridProgram:
+    if grid is None:
+        fn, mats = _hevc_chain_ladder_cached(rungs, src_h, src_w, search,
+                                             None, deblock)
+        names = tuple(r[0] for r in rungs)
+        return GridProgram(((names, None, fn, mats),), 1, "1x1", True)
+    cols = []
+    for col in grid.columns:
+        fn, mats = _hevc_chain_ladder_cached(col.rungs, src_h, src_w,
+                                             search, col.mesh, deblock)
+        cols.append((col.names, col.mesh, fn, mats))
+    return GridProgram(tuple(cols), grid.data, grid.label, True)

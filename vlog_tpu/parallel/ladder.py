@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from vlog_tpu.parallel.mesh import shard_map
+from vlog_tpu.parallel.mesh import RungGrid, shard_frames, shard_map
 
 from vlog_tpu.codecs.h264.encoder import encode_frame
 from vlog_tpu.ops.resize import plan_ladder_matrices, resize_yuv420_with
@@ -319,6 +319,88 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
         check_vma=False,
     )
     return jax.jit(fn), jax.device_put(mats, NamedSharding(mesh, P()))
+
+
+class GridProgram:
+    """One-call dispatch of a ladder over a (data × rung) grid.
+
+    Owns one compiled program per rung column (each built over the
+    column's 1-D data submesh with only that column's resize matrices
+    staged) and performs the input staging itself: the source frames
+    replicate into every column (the rung-axis replication), per-rung
+    QP/RC state routes to the owning column, and the merged output dict
+    leaves each rung's arrays resident on its owning column — so the
+    executor's per-rung async d2h pulls come off different devices.
+
+    Degenerate shapes collapse to the classic paths: ``grid=None`` is
+    the single-chip jit program (host numpy in, default device), and a
+    ``Nx1`` grid is the 1-D data mesh — one column, all rungs, same
+    program the pre-grid backends built. Byte identity across shapes
+    follows from rung independence: a column computes exactly the
+    restriction of the full program to its rung subset.
+    """
+
+    def __init__(self, columns: tuple, data: int, label: str, chain: bool):
+        # columns: ((names, mesh_or_None, fn, mats), ...)
+        self.columns = columns
+        self.data = data          # data-axis width (pad_batch target)
+        self.label = label        # e.g. "2x4"; "1x1" single-chip
+        self._chain = chain
+
+    def dispatch(self, y, u, v, qps: dict, rc: dict | None = None):
+        """Stage + run every column; returns {rung_name: outputs}."""
+        outs = {}
+        for names, mesh, fn, mats in self.columns:
+            if mesh is None:
+                cy, cu, cv = y, u, v
+                cq = {n: qps[n] for n in names}
+            else:
+                cy, cu, cv = shard_frames(mesh, y, u, v)
+                cq = {n: shard_frames(mesh, qps[n])[0] for n in names}
+            if self._chain:
+                crc = None if rc is None else {n: rc[n] for n in names}
+                outs.update(fn(cy, cu, cv, mats, cq, crc))
+            else:
+                outs.update(fn(cy, cu, cv, mats, cq))
+        return outs
+
+
+@functools.lru_cache(maxsize=8)
+def ladder_encode_grid(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
+                       grid: RungGrid | None = None) -> GridProgram:
+    """Grid-wide intra ladder: per-column ``ladder_encode_program``s.
+
+    Cached per (rungs, geometry, grid) on top of the per-column program
+    cache, so regenerating the same grid reuses every compiled column.
+    """
+    if grid is None:
+        fn, mats = ladder_encode_program(rungs, src_h, src_w, None)
+        names = tuple(r[0] for r in rungs)
+        return GridProgram(((names, None, fn, mats),), 1, "1x1", False)
+    cols = []
+    for col in grid.columns:
+        fn, mats = ladder_encode_program(col.rungs, src_h, src_w, col.mesh)
+        cols.append((col.names, col.mesh, fn, mats))
+    return GridProgram(tuple(cols), grid.data, grid.label, False)
+
+
+@functools.lru_cache(maxsize=8)
+def ladder_chain_grid(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
+                      search: int = 8, grid: RungGrid | None = None,
+                      deblock: bool = False) -> GridProgram:
+    """Grid-wide I+P chain ladder: per-column ``ladder_chain_program``s."""
+    if grid is None:
+        fn, mats = ladder_chain_program(rungs, src_h, src_w, search=search,
+                                        mesh=None, deblock=deblock)
+        names = tuple(r[0] for r in rungs)
+        return GridProgram(((names, None, fn, mats),), 1, "1x1", True)
+    cols = []
+    for col in grid.columns:
+        fn, mats = ladder_chain_program(col.rungs, src_h, src_w,
+                                        search=search, mesh=col.mesh,
+                                        deblock=deblock)
+        cols.append((col.names, col.mesh, fn, mats))
+    return GridProgram(tuple(cols), grid.data, grid.label, True)
 
 
 def single_chip_ladder(rungs: tuple[RungSpec, ...], src_h: int, src_w: int

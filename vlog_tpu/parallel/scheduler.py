@@ -67,7 +67,8 @@ from vlog_tpu import config
 
 __all__ = [
     "MeshScheduler", "SlotCancelled", "SlotLease", "SlotTicket",
-    "current_lease", "get_scheduler", "host_pool_for_run", "mesh_for_run",
+    "current_lease", "get_scheduler", "grid_for_run", "host_pool_for_run",
+    "mesh_for_run",
 ]
 
 
@@ -113,6 +114,49 @@ def mesh_for_run():
     return make_mesh() if len(jax.devices()) > 1 else None
 
 
+def grid_for_run(rungs, batch_hint: int | None = None):
+    """The (data × rung) dispatch grid the current run should use.
+
+    The 2-D sibling of :func:`mesh_for_run`: resolves the run's device
+    set (slot lease devices under the scheduler, every visible device
+    otherwise) and the VLOG_TPU_MESH shape against THIS ladder's rung
+    list and batch hint, then lays the rungs out as a
+    :class:`~vlog_tpu.parallel.mesh.RungGrid`. A slot lease can itself
+    be 2-D: a 4-wide slot with ``VLOG_TPU_MESH=auto`` (or a fitting
+    explicit spec) splits into e.g. 2x2. An explicit spec that does not
+    fit the lease's width degrades to ``auto`` over the lease devices —
+    specs are sized for the full device count, slots are narrower.
+
+    Returns None on a single device (the backends' plain-jit fast
+    path). The resolved shape label is stamped on the lease for the
+    worker's ``mesh.shape`` span attr.
+    """
+    from vlog_tpu.parallel.mesh import resolve_mesh_shape, rung_grid
+
+    lease = current_lease()
+    if lease is not None:
+        devices = list(lease.devices)
+    else:
+        import jax
+
+        devices = list(jax.devices())
+    if len(devices) <= 1:
+        if lease is not None:
+            lease.shape = "1x1"
+        return None
+    rungs = tuple(rungs)
+    try:
+        shape = resolve_mesh_shape(None, len(devices), rungs, batch_hint)
+    except ValueError:
+        if lease is None:
+            raise
+        shape = resolve_mesh_shape("auto", len(devices), rungs, batch_hint)
+    grid = rung_grid(rungs, shape, devices)
+    if lease is not None:
+        lease.shape = grid.label
+    return grid
+
+
 def host_pool_for_run() -> ThreadPoolExecutor | None:
     """The scheduler's shared host entropy pool when running under a
     slot lease; None otherwise (the executor then owns its own pool,
@@ -133,7 +177,7 @@ class SlotLease:
     """
 
     __slots__ = ("slot", "devices", "width", "wait_s", "scheduler",
-                 "_released", "_token")
+                 "shape", "_released", "_token")
 
     def __init__(self, scheduler: "MeshScheduler", slot: int,
                  devices: tuple):
@@ -142,6 +186,10 @@ class SlotLease:
         self.devices = tuple(devices)
         self.width = len(self.devices)
         self.wait_s = 0.0
+        # resolved (data x rung) grid label, stamped by grid_for_run()
+        # when a backend lays its ladder out over this lease — the
+        # worker attaches it to the transcode span as ``mesh.shape``
+        self.shape = None
         self._released = False
         self._token = None
 

@@ -1078,6 +1078,10 @@ class WorkerDaemon(ComputeWatchdogMixin):
                                        else lease.slot)
             span.attrs["mesh.width"] = lease.width
             span.attrs["mesh.wait_s"] = round(lease.wait_s, 3)
+            # the (data x rung) grid label the backend resolved for
+            # this lease (grid_for_run stamps it during the run)
+            if getattr(lease, "shape", None):
+                span.attrs["mesh.shape"] = lease.shape
 
     # -- handlers ----------------------------------------------------------
 
