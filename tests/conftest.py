@@ -29,6 +29,64 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
+# Sanitized build: VLOG_LOCK_SANITIZER=1 swaps every annotated
+# instance lock in the package for the locktrace witness BEFORE any
+# test constructs a scheduler/engine/executor, so the whole tier-1 run
+# doubles as a lock-order + deadlock chaos harness. The autouse gate
+# below fails any test that grew the report list.
+if os.environ.get("VLOG_LOCK_SANITIZER") == "1":
+    from vlog_tpu.utils import locktrace as _locktrace
+
+    _locktrace.install()
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness_gate():
+    """Zero-tolerance witness gate on sanitized builds: a test that
+    provokes a violation ON PURPOSE must drain it with
+    ``locktrace.reset_reports()`` before returning."""
+    from vlog_tpu.utils import locktrace
+
+    if not locktrace.installed():
+        yield
+        return
+    before = len(locktrace.reports())
+    yield
+    fresh = locktrace.reports()[before:]
+    assert not fresh, "lock witness reports:\n" + "\n\n".join(
+        r.render() for r in fresh)
+
+
+@pytest.fixture(autouse=True)
+def _vlog_thread_leak_gate():
+    """Fail any test that leaves a non-daemon ``vlog-*`` thread alive.
+
+    Named threads make sanitizer traces and leak reports actionable;
+    this gate is what keeps the names honest. The scheduler's
+    ``vlog-mesh-host`` pool is exempt — its workers park idle for the
+    process lifetime by design (ThreadPoolExecutor workers are
+    non-daemon and the pool is reused across jobs)."""
+    import threading
+    import time as _time
+
+    before = set(threading.enumerate())
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t not in before and t.is_alive() and not t.daemon
+                and t.name.startswith("vlog-")
+                and not t.name.startswith("vlog-mesh-host")]
+
+    yield
+    left = leaked()
+    deadline = _time.monotonic() + 2.0
+    while left and _time.monotonic() < deadline:
+        for t in left:
+            t.join(timeout=0.1)
+        left = leaked()
+    assert not left, ("test leaked non-daemon vlog-* threads: "
+                      + ", ".join(sorted(t.name for t in left)))
+
 
 @pytest.fixture
 def anyio_backend():

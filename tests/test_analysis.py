@@ -228,6 +228,261 @@ class TestLockDiscipline:
 
 
 # --------------------------------------------------------------------------
+# lockorder
+# --------------------------------------------------------------------------
+
+class TestLockOrder:
+    def test_rank_inversion_fires(self, tmp_path):
+        """The seeded inversion: a high-rank lock held while a lower-rank
+        one is acquired (the classic AB/BA half)."""
+        pkg = _pkg(tmp_path, {"parallel/inv.py": """\
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._lo = threading.Lock()    # lock-order: 10
+                    self._hi = threading.Lock()    # lock-order: 20
+
+                def forward(self):
+                    with self._lo:
+                        with self._hi:
+                            pass                   # 10 -> 20: fine
+
+                def backward(self):
+                    with self._hi:
+                        with self._lo:             # VIOLATION: 20 -> 10
+                            pass
+        """})
+        found = _messages(run_passes(pkg, rules=["lockorder"]))
+        # the inversion itself, plus the AB/BA cycle the two paths form
+        inversions = [m for m in found if "rank inversion" in m]
+        assert len(inversions) == 1
+        assert "_lo" in inversions[0] and "_hi" in inversions[0]
+        assert "backward" in inversions[0]
+        assert any("lock-acquisition cycle" in m for m in found)
+
+    def test_cycle_between_unranked_guarded_by_locks_fires(self, tmp_path):
+        """Two modules each nest the other's lock: a true AB/BA cycle is
+        reported even when no ranks are declared (cycle detection works
+        on the acquisition graph alone)."""
+        pkg = _pkg(tmp_path, {
+            "worker/a.py": """\
+                import threading
+
+                class A:
+                    def __init__(self, b):
+                        self._a_lock = threading.Lock()
+                        self._n = 0          # guarded-by: _a_lock
+                        self.b = b
+
+                    def poke(self):
+                        with self._a_lock:
+                            with self.b._b_lock:
+                                pass
+            """,
+            "worker/b.py": """\
+                import threading
+
+                class B:
+                    def __init__(self, a):
+                        self._b_lock = threading.Lock()
+                        self._m = 0          # guarded-by: _b_lock
+                        self.a = a
+
+                    def poke(self):
+                        with self._b_lock:
+                            with self.a._a_lock:
+                                pass
+            """,
+        })
+        found = _messages(run_passes(pkg, rules=["lockorder"]))
+        cycles = [m for m in found if "lock-acquisition cycle" in m]
+        assert len(cycles) == 1
+        assert "_a_lock" in cycles[0] and "_b_lock" in cycles[0]
+
+    def test_agreement_lint_missing_rank_in_annotated_module(self, tmp_path):
+        """A lock init inside a lockdiscipline-annotated module must carry
+        a rank — the two sides of the plane stay in agreement."""
+        pkg = _pkg(tmp_path, {"parallel/mixed.py": """\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0              # guarded-by: _lock
+        """})
+        found = _messages(run_passes(pkg, rules=["lockorder"]))
+        assert len(found) == 1
+        assert "no '# lock-order:' rank" in found[0]
+
+    def test_agreement_lint_dangling_and_duplicate_ranks(self, tmp_path):
+        pkg = _pkg(tmp_path, {
+            "parallel/dup1.py": """\
+                import threading
+
+                # lock-order: 7
+                DANGLING = object()
+
+                class C:
+                    def __init__(self):
+                        self._x = threading.Lock()    # lock-order: 30
+            """,
+            "parallel/dup2.py": """\
+                import threading
+
+                class D:
+                    def __init__(self):
+                        self._y = threading.Lock()    # lock-order: 30
+            """,
+        })
+        found = _messages(run_passes(pkg, rules=["lockorder"]))
+        assert any("dangling" in m for m in found)
+        assert any("duplicate lock-order rank" in m for m in found)
+
+    def test_guarded_by_naming_missing_lock_fires(self, tmp_path):
+        """guarded-by pointing at a field that is never initialised as a
+        lock is a lint finding here (lockdiscipline trusts the name)."""
+        pkg = _pkg(tmp_path, {"parallel/ghost.py": """\
+            class Box:
+                def __init__(self):
+                    self._n = 0              # guarded-by: _phantom
+        """})
+        found = _messages(run_passes(pkg, rules=["lockorder"]))
+        assert len(found) == 1
+        assert "_phantom" in found[0]
+
+    def test_disciplined_module_is_clean(self, tmp_path):
+        pkg = _pkg(tmp_path, {"parallel/ok.py": """\
+            import threading
+
+            class Clean:
+                def __init__(self):
+                    self._cond = threading.Condition()    # lock-order: 10
+                    # lock-order: 20
+                    self._side = threading.Lock()
+                    self._jobs = []          # guarded-by: _cond
+
+                def move(self):
+                    with self._cond:
+                        with self._side:
+                            pass
+        """})
+        assert run_passes(pkg, rules=["lockorder"]) == []
+
+    def test_real_repo_lock_order_is_clean(self):
+        assert run_passes(default_pkg_dir(), rules=["lockorder"]) == []
+
+
+# --------------------------------------------------------------------------
+# holdblock
+# --------------------------------------------------------------------------
+
+class TestHoldBlock:
+    def test_blocking_ops_under_lock_fire(self, tmp_path):
+        pkg = _pkg(tmp_path, {"worker/busy.py": """\
+            import subprocess
+            import time
+            import threading
+
+            class Busy:
+                def __init__(self):
+                    self._lock = threading.Lock()    # lock-order: 10
+
+                def nap(self):
+                    with self._lock:
+                        time.sleep(1)
+
+                def shell(self):
+                    with self._lock:
+                        subprocess.run(["ls"])
+
+                def harvest(self, fut):
+                    with self._lock:
+                        return fut.result()
+
+                async def persist(self, db):
+                    with self._lock:
+                        await db.execute_many("INSERT", [])
+        """})
+        found = _messages(run_passes(pkg, rules=["holdblock"]))
+        assert len(found) == 4
+        assert any("time.sleep" in m for m in found)
+        assert any("subprocess.run" in m for m in found)
+        assert any(".result()" in m for m in found)
+        assert any("execute_many" in m for m in found)
+        assert all("_lock" in m for m in found)
+
+    def test_holds_ok_escape_needs_a_reason(self, tmp_path):
+        pkg = _pkg(tmp_path, {"worker/escape.py": """\
+            import time
+            import threading
+
+            class E:
+                def __init__(self):
+                    self._lock = threading.Lock()    # lock-order: 10
+
+                def justified(self):
+                    with self._lock:
+                        time.sleep(0)    # holds-ok: serialized flush order
+
+                def lazy(self):
+                    with self._lock:
+                        time.sleep(0)    # holds-ok:
+        """})
+        found = _messages(run_passes(pkg, rules=["holdblock"]))
+        assert len(found) == 1
+        assert "without a justification" in found[0]
+        assert "lazy" in found[0]
+
+    def test_wait_on_own_condition_clean_foreign_wait_fires(self, tmp_path):
+        pkg = _pkg(tmp_path, {"parallel/waits.py": """\
+            import threading
+
+            class W:
+                def __init__(self, other):
+                    self._cond = threading.Condition()    # lock-order: 10
+                    self.other = other
+
+                def good(self):
+                    with self._cond:
+                        self._cond.wait(timeout=1)
+
+                def bad(self):
+                    with self._cond:
+                        self.other._peer.wait()
+
+            class Peer:
+                def __init__(self):
+                    self._peer = threading.Condition()    # lock-order: 20
+        """})
+        found = _messages(run_passes(pkg, rules=["holdblock"]))
+        assert len(found) == 1
+        assert "bad" in found[0] and "wait" in found[0]
+
+    def test_blocking_outside_lock_is_clean(self, tmp_path):
+        pkg = _pkg(tmp_path, {"worker/clean.py": """\
+            import time
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()    # lock-order: 10
+                    self._pending = []    # guarded-by: _lock
+
+                def flush(self, db, run):
+                    with self._lock:
+                        batch = list(self._pending)
+                        self._pending.clear()
+                    run(db.execute_many("INSERT", batch))
+                    time.sleep(0)
+        """})
+        assert run_passes(pkg, rules=["holdblock"]) == []
+
+    def test_real_repo_holdblock_is_clean(self):
+        assert run_passes(default_pkg_dir(), rules=["holdblock"]) == []
+
+
+# --------------------------------------------------------------------------
 # epochfence
 # --------------------------------------------------------------------------
 
@@ -557,4 +812,5 @@ def test_every_pass_ran_over_a_parsed_repo():
     assert "vlog_tpu/delivery/plane.py" in rels
     assert "vlog_tpu/worker/brownout.py" in rels
     assert set(PASSES) == {"asyncblock", "lockdiscipline", "epochfence",
-                           "tracehop", "registry", "meshshim"}
+                           "tracehop", "registry", "meshshim", "lockorder",
+                           "holdblock"}

@@ -395,6 +395,55 @@ class TestHeartbeatCoalescing:
                 run(c.aclose())
             run(server.close())
 
+    def test_flush_detaches_buffer_before_io(self, run):
+        """Hold-discipline regression (concurrency plane): ``flush``
+        must snapshot AND clear the pending buffer before the DB await
+        starts, so heartbeats offered while the write is in flight
+        land in the NEXT window instead of being lost or re-sent."""
+        from vlog_tpu.api.worker_api import _HeartbeatCoalescer
+
+        pending_at_io: list[dict] = []
+
+        class StubDB:
+            async def execute_many(self, sql, rows):
+                pending_at_io.append(dict(hb._pending))
+                # a heartbeat arriving mid-write
+                hb.offer("late", caps_json=None, code_version=None)
+
+        hb = _HeartbeatCoalescer(StubDB(), flush_s=30.0)
+        assert hb.offer("w1", caps_json="{}", code_version="v1")
+        assert hb.offer("w2", caps_json="{}", code_version="v1")
+
+        n = run(hb.flush())
+        assert n == 2 and hb.flushes == 1
+        # the buffer was already detached when I/O began …
+        assert pending_at_io == [{}]
+        # … and the mid-write offer survived into the next window
+        assert set(hb._pending) == {"late"}
+
+    def test_failed_flush_restores_without_clobbering_newer(self, run):
+        """A DB brownout puts the batch back for the next window — but
+        ``setdefault`` only, so a NEWER heartbeat offered during the
+        failed write wins over the stale row being restored."""
+        from vlog_tpu.api.worker_api import _HeartbeatCoalescer
+
+        class FlakyDB:
+            async def execute_many(self, sql, rows):
+                hb.offer("w1", caps_json='{"chips": 2}', code_version="v2")
+                raise RuntimeError("db brownout")
+
+        hb = _HeartbeatCoalescer(FlakyDB(), flush_s=30.0)
+        hb.offer("w1", caps_json='{"chips": 1}', code_version="v1")
+        hb.offer("w2", caps_json="{}", code_version="v1")
+
+        with pytest.raises(RuntimeError, match="brownout"):
+            run(hb.flush())
+        assert hb.flushes == 0
+        # w2's dropped row came back; w1 kept the newer mid-flight beat
+        assert set(hb._pending) == {"w1", "w2"}
+        assert hb._pending["w1"]["c"] == '{"chips": 2}'
+        assert hb._pending["w1"]["v"] == "v2"
+
 
 # --------------------------------------------------------------------------
 # Batched span ingest

@@ -21,8 +21,9 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m vlog_tpu.analysis",
         description="Project-invariant static analysis over vlog_tpu/.")
-    ap.add_argument("--rule", action="append", choices=sorted(PASSES),
-                    help="run only this pass (repeatable)")
+    ap.add_argument("--rule", action="append", metavar="RULE[,RULE...]",
+                    help="run only these passes (repeatable and/or "
+                         f"comma-separated; known: {', '.join(sorted(PASSES))})")
     ap.add_argument("--root", type=Path, default=None,
                     help="package dir to scan (default: this vlog_tpu)")
     ap.add_argument("--baseline", type=Path, default=None,
@@ -30,6 +31,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--baseline-update", action="store_true",
                     help="rewrite the baseline from this run and exit 0")
     args = ap.parse_args(argv)
+    if args.rule:
+        args.rule = [r for spec in args.rule
+                     for r in spec.split(",") if r]
 
     pkg_dir = (args.root or default_pkg_dir()).resolve()
     baseline_path = args.baseline or default_baseline(pkg_dir)

@@ -123,7 +123,8 @@ class _HeartbeatCoalescer:
 
     def start(self) -> None:
         if self.flush_s > 0 and self._task is None:
-            self._task = asyncio.create_task(self._run())
+            self._task = asyncio.create_task(self._run(),
+                                             name="vlog-hb-coalescer")
 
     async def close(self) -> None:
         self._stop.set()
@@ -154,7 +155,8 @@ class CoordState:
         self.hb.start()
         if config.SWEEP_INTERVAL_S > 0 and self._sweeper is None:
             self._sweeper = asyncio.create_task(
-                claims.sweep_loop(self.db, self._stop))
+                claims.sweep_loop(self.db, self._stop),
+                name="vlog-lease-sweep")
 
     async def close(self) -> None:
         self._stop.set()

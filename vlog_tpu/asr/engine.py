@@ -145,7 +145,7 @@ class AsrEngine:
         self.tick_s = config.ASR_TICK_S if tick_s is None else tick_s
         self.window_s = window_s or config.WHISPER_CHUNK_S
         self._queue = WindowQueue(queue_max or config.ASR_QUEUE_MAX)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()             # lock-order: 20
         self._jobs: dict[str, JobHandle] = {}   # guarded-by: _lock
         self._started = False                   # guarded-by: _lock
         self._stop = threading.Event()
@@ -172,7 +172,7 @@ class AsrEngine:
             if not self._started:
                 self._started = True
                 self._thread = threading.Thread(
-                    target=self._run, name="asr-engine", daemon=True)
+                    target=self._run, name="vlog-asr-engine", daemon=True)
                 self._thread.start()
         return handle
 

@@ -66,7 +66,7 @@ class WindowQueue:
 
     def __init__(self, max_items: int = 256):
         self.max_items = max_items
-        self._cond = threading.Condition()
+        self._cond = threading.Condition()        # lock-order: 22
         # One FIFO per (batch key, job); job order per key is the
         # round-robin rotation. Counts are derived, kept inline so the
         # backpressure check is O(1).

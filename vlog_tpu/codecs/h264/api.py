@@ -165,7 +165,8 @@ class H264Encoder:
             return list(pool.map(pack, range(n)))
         if n == 1 or self.entropy_threads <= 1:
             return [pack(i) for i in range(n)]
-        with ThreadPoolExecutor(self.entropy_threads) as own:
+        with ThreadPoolExecutor(self.entropy_threads,
+                                thread_name_prefix="vlog-entropy") as own:
             return list(own.map(pack, range(n)))
 
     def encode_levels(self, levels: dict, qps: np.ndarray,
@@ -199,7 +200,8 @@ class H264Encoder:
             return list(pool.map(pack, range(n)))
         if n == 1 or self.entropy_threads <= 1:
             return [pack(i) for i in range(n)]
-        with ThreadPoolExecutor(self.entropy_threads) as own:
+        with ThreadPoolExecutor(self.entropy_threads,
+                                thread_name_prefix="vlog-entropy") as own:
             return list(own.map(pack, range(n)))
 
     def encode(self, y: np.ndarray, u: np.ndarray, v: np.ndarray

@@ -336,7 +336,8 @@ class HevcEncoder:
                 is_idr=(i == 0), psnr_y=float(psnrs[i]))
 
         if pool is None:
-            with ThreadPoolExecutor(self.entropy_threads) as p:
+            with ThreadPoolExecutor(self.entropy_threads,
+                                    thread_name_prefix="vlog-entropy") as p:
                 return list(p.map(pack, range(t_real)))
         return list(pool.map(pack, range(t_real)))
 
@@ -384,6 +385,7 @@ class HevcEncoder:
                 is_idr=True, psnr_y=psnr)
 
         if pool is None:
-            with ThreadPoolExecutor(self.entropy_threads) as p:
+            with ThreadPoolExecutor(self.entropy_threads,
+                                    thread_name_prefix="vlog-entropy") as p:
                 return list(p.map(pack, range(b)))
         return list(pool.map(pack, range(b)))

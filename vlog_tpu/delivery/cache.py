@@ -183,7 +183,8 @@ class SingleFlight:
             # handler: a leader whose client disconnects gets cancelled
             # by aiohttp, and an inline fill would propagate that
             # CancelledError to every follower still connected.
-            task = asyncio.get_running_loop().create_task(factory())
+            task = asyncio.get_running_loop().create_task(
+                factory(), name="vlog-cache-fill")
             task.add_done_callback(self._retire(key))
             self._inflight[key] = task
         # shield: cancelling one waiter must not cancel the shared fill

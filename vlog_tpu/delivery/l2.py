@@ -57,7 +57,7 @@ class DiskL2:
         self.root = Path(root)
         self.max_bytes = int(max_bytes)
         self._on_evict = on_evict
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()             # lock-order: 54
         # guarded-by: _lock
         self._index: OrderedDict[str, int] = OrderedDict()  # digest -> size
         # guarded-by: _lock

@@ -182,7 +182,7 @@ class DeliveryPlane:
         # — read AND refreshed inside fill workers running in
         # asyncio.to_thread: concurrent fills for two slugs would
         # otherwise race the dict (and the bound/clear)
-        self._digest_lock = threading.Lock()
+        self._digest_lock = threading.Lock()      # lock-order: 50
         # guarded-by: _digest_lock
         self._digests: dict[str, tuple[int | None,
                                        dict[str, tuple[int, str]]]] = {}
@@ -195,7 +195,7 @@ class DeliveryPlane:
         # hot counters are bumped from event-loop coroutines AND from
         # to_thread fill workers (spills, prewarm bookkeeping), so they
         # live behind a lock; _bump is the one write path
-        self._counter_lock = threading.Lock()
+        self._counter_lock = threading.Lock()     # lock-order: 52
         # guarded-by: _counter_lock
         self.counters = {
             "hits": 0, "misses": 0, "bypass": 0, "shed": 0,
@@ -445,7 +445,8 @@ class DeliveryPlane:
         except RuntimeError:
             work()
             return
-        t = loop.create_task(asyncio.to_thread(work))
+        t = loop.create_task(asyncio.to_thread(work),
+                             name="vlog-delivery-invalidate")
         self._tasks.add(t)
         t.add_done_callback(self._tasks.discard)
 
@@ -460,7 +461,8 @@ class DeliveryPlane:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             return False
-        t = loop.create_task(self.prewarm_slug(slug))
+        t = loop.create_task(self.prewarm_slug(slug),
+                             name="vlog-delivery-prewarm")
         self._tasks.add(t)
         t.add_done_callback(self._tasks.discard)
         return True

@@ -107,5 +107,6 @@ class AlertSink:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             return
-        task = loop.create_task(self.send(alert, message, details, key=key))
+        task = loop.create_task(self.send(alert, message, details, key=key),
+                                name="vlog-alert-send")
         task.add_done_callback(lambda t: t.exception())

@@ -395,7 +395,8 @@ def measure_scheduler_packing(devices, rungs, h: int, w: int, clen: int,
             errors.append(exc)
             barrier.abort()
 
-    threads = [threading.Thread(target=slot_job, args=(t, 21 + i))
+    threads = [threading.Thread(target=slot_job, args=(t, 21 + i),
+                                name=f"vlog-dryrun-slot-{i}")
                for i, t in enumerate(tickets)]
     for t in threads:
         t.start()

@@ -114,7 +114,7 @@ class StagedBatch:
         self.n_real = n_real
         self.qps = qps
         self.extra = extra
-        self._ready_lock = threading.Lock()
+        self._ready_lock = threading.Lock()       # lock-order: 32
         self._ready = False
         self._remaining = n_rungs
 
@@ -154,9 +154,9 @@ class PipelineExecutor:
         for key in ("compute_wait_s", "device_pull_s", "entropy_s",
                     "package_s"):
             self.prof.setdefault(key, 0.0)
-        self._prof_lock = threading.Lock()
+        self._prof_lock = threading.Lock()        # lock-order: 34
         self._busy_s = 0.0
-        self._cond = threading.Condition()
+        self._cond = threading.Condition()        # lock-order: 30
         self._stop = threading.Event()
         self._in_flight = 0
         self._max_in_flight = 0
@@ -395,7 +395,7 @@ class LaggedRateControl:
     def __init__(self, controllers: dict):
         self._controllers = controllers
         self._pending: dict[str, deque] = {n: deque() for n in controllers}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()             # lock-order: 36
 
     def post(self, name: str, batch_index: int, *, nbytes: int,
              frames: int, frame_qps=None, cost: float | None = None

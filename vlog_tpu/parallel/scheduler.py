@@ -298,7 +298,7 @@ class MeshScheduler:
         self.devices = tuple(devices)
         want = config.MESH_SLOTS if slots is None else int(slots)
         self._want_slots = max(1, want)
-        self._cond = threading.Condition()
+        self._cond = threading.Condition()        # lock-order: 10
         self._active: dict[int, SlotLease] = {}   # guarded-by: _cond
         # admitted, not yet granted
         self._open_tickets = 0                    # guarded-by: _cond
@@ -314,7 +314,7 @@ class MeshScheduler:
         with self._cond:
             self._rebuild_locked()
         self._host_pool: ThreadPoolExecutor | None = None  # guarded-by: _pool_lock
-        self._pool_lock = threading.Lock()
+        self._pool_lock = threading.Lock()        # lock-order: 12
         self._metrics().mesh_slots.set(self.slots)
 
     def _rebuild_locked(self) -> None:

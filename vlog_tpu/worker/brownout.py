@@ -56,7 +56,7 @@ class CoordinationBreaker:
         # The claim loop mutates this state while the health server's
         # readiness thread (worker/health.py breaker_check) and the
         # stats command read it — every access goes through _lock.
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()             # lock-order: 42
         self._consecutive = 0                 # guarded-by: _lock
         self._open = False                    # guarded-by: _lock
         self._opened_at = 0.0                 # guarded-by: _lock

@@ -237,7 +237,8 @@ class WorkerDaemon(ComputeWatchdogMixin):
         log.warning("entering drain (%s): claiming stopped, %d in-flight "
                     "job(s), grace %.0fs", reason, len(self._active_sups),
                     self.drain_grace_s)
-        self._drain_task = asyncio.create_task(self._drain_loop())
+        self._drain_task = asyncio.create_task(self._drain_loop(),
+                                              name="vlog-drain")
         return True
 
     async def _drain_loop(self) -> None:
@@ -455,19 +456,23 @@ class WorkerDaemon(ComputeWatchdogMixin):
         bus = bus_for(self.db)
         await bus.start()
         jobs_sub = bus.subscribe(CH_JOBS)
-        hb = asyncio.create_task(self._heartbeat_loop())
+        hb = asyncio.create_task(self._heartbeat_loop(),
+                                 name="vlog-heartbeat")
         # periodic expired-lease sweeper: with the per-claim sweep
         # reduced to an oldest-expiry probe, this loop is what reclaims
         # and dead-letters lapsed leases on an idle queue
-        sweeper = asyncio.create_task(claims.sweep_loop(self.db, self._stop))
+        sweeper = asyncio.create_task(claims.sweep_loop(self.db, self._stop),
+                                      name="vlog-lease-sweep")
         probe = None
         if self.scheduler is not None and config.DEVICE_PROBE_INTERVAL_S > 0:
-            probe = asyncio.create_task(self._device_probe_loop())
+            probe = asyncio.create_task(self._device_probe_loop(),
+                                        name="vlog-device-probe")
         watcher = None
         pw = PreemptionWatcher.from_config()
         if pw is not None:
             watcher = asyncio.create_task(
-                pw.watch(self._stop, self._on_preemption_notice))
+                pw.watch(self._stop, self._on_preemption_notice),
+                name="vlog-preempt-watch")
         try:
             while not self._stop.is_set():
                 try:
@@ -597,7 +602,8 @@ class WorkerDaemon(ComputeWatchdogMixin):
         finally:
             for job, ticket in batch:
                 task = asyncio.create_task(
-                    self._run_slot_job(job, ticket))
+                    self._run_slot_job(job, ticket),
+                    name=f"vlog-slot-job-{job['id']}")
                 self._tasks.add(task)
                 task.add_done_callback(self._tasks.discard)
         return bool(batch)

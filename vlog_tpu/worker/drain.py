@@ -55,7 +55,7 @@ class DrainState:
     """Thread-safe drain flag + grace-deadline bookkeeping."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()             # lock-order: 40
         self._active = False          # guarded-by: _lock
         self._reason = ""             # guarded-by: _lock
         self._started_mono = 0.0      # guarded-by: _lock

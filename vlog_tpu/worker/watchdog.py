@@ -59,7 +59,8 @@ class ComputeWatchdogMixin:
         """Run blocking compute in a thread; cancel cooperatively on
         timeout or stall. The loop wakes every ``watchdog_tick_s`` to
         check both windows."""
-        task = asyncio.create_task(asyncio.to_thread(fn))
+        task = asyncio.create_task(asyncio.to_thread(fn),
+                                   name="vlog-watchdog-compute")
         # the stall window opens NOW: pre-compute setup (download/probe)
         # already happened, and the thread owes its first batch within
         # stall_window_s
