@@ -1,0 +1,330 @@
+"""Multi-tenant fair-share QoS + overload protection.
+
+The claim-side contract under test: weighted deficit-round-robin across
+tenants with a hard starvation bound and per-tenant in-flight caps
+(jobs/claims.py `_qos_candidates`), admission control at enqueue
+(jobs/qos.py `admit_enqueue` — queue caps, brownout shedding, `qos.flood`
+bypass), the shared fleet snapshot behind ``GET /api/fleet/scale-hint``,
+and the tenant-aware queue-depth alert. Epoch fencing must be untouched
+by any of it.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from vlog_tpu import config
+from vlog_tpu.db.core import now as db_now
+from vlog_tpu.enums import AcceleratorKind, JobKind
+from vlog_tpu.jobs import alerts as alertsmod, claims, qos
+from vlog_tpu.jobs.state import JobStateError
+from vlog_tpu.utils import failpoints
+
+
+async def make_video(db, slug="vid"):
+    t = db_now()
+    return await db.execute(
+        "INSERT INTO videos (slug, title, created_at, updated_at)"
+        " VALUES (:s, :s, :t, :t)",
+        {"s": slug, "t": t},
+    )
+
+
+async def enqueue_n(db, n, *, tenant, prefix, kind=JobKind.TRANSCODE,
+                    priority=0):
+    ids = []
+    for i in range(n):
+        vid = await make_video(db, f"{prefix}{i}")
+        ids.append(await claims.enqueue_job(db, vid, kind, tenant=tenant,
+                                            priority=priority))
+    return ids
+
+
+@pytest.fixture
+def clean_brownout():
+    """Isolate the module-level enqueue breaker singleton."""
+    saved = qos._brownout
+    qos._brownout = None
+    yield
+    qos._brownout = saved
+
+
+def _jain(counts):
+    num = float(sum(counts)) ** 2
+    den = len(counts) * float(sum(c * c for c in counts))
+    return num / den if den else 0.0
+
+
+# --------------------------------------------------------------------------
+# Tenant column + fair-share claiming
+# --------------------------------------------------------------------------
+
+class TestFairShare:
+    def test_default_tenant_on_plain_enqueue(self, db, run):
+        async def body():
+            vid = await make_video(db)
+            jid = await claims.enqueue_job(db, vid)
+            row = await db.fetch_one("SELECT * FROM jobs WHERE id=:i",
+                                     {"i": jid})
+            assert row["tenant"] == qos.DEFAULT_TENANT
+            job = await claims.claim_job(db, "w1")
+            assert job["tenant"] == qos.DEFAULT_TENANT
+
+        run(body())
+
+    def test_mixed_kind_batch_respects_inflight_cap(self, db, run):
+        async def body():
+            await qos.settings_for(db).set("qos.tenant.capped.max_inflight",
+                                           2)
+            # mixed kinds on the capped tenant; an uncapped tenant fills
+            # the rest of the batch
+            await enqueue_n(db, 3, tenant="capped", prefix="ct")
+            await enqueue_n(db, 3, tenant="capped", prefix="cs",
+                            kind=JobKind.SPRITE)
+            await enqueue_n(db, 8, tenant="free", prefix="fr")
+            got = await claims.claim_jobs(
+                db, "w1", kinds=(JobKind.TRANSCODE, JobKind.SPRITE),
+                accelerator=AcceleratorKind.CPU, max_jobs=8)
+            by_tenant: dict[str, int] = {}
+            for row in got:
+                by_tenant[row["tenant"]] = by_tenant.get(row["tenant"],
+                                                         0) + 1
+            assert by_tenant.get("capped", 0) <= 2, by_tenant
+            assert len(got) == 8, "cap must not shrink the batch"
+            # with 2 capped jobs in flight the tenant has zero headroom:
+            # a second batch must take nothing more from it
+            more = await claims.claim_jobs(
+                db, "w2", kinds=(JobKind.TRANSCODE, JobKind.SPRITE),
+                accelerator=AcceleratorKind.CPU, max_jobs=8)
+            assert all(r["tenant"] != "capped" for r in more), [
+                r["tenant"] for r in more]
+
+        run(body())
+
+    def test_starvation_bound_beats_flooding_tenant(self, db, run,
+                                                    monkeypatch):
+        async def body():
+            monkeypatch.setattr(config, "QOS_STARVATION_S", 5.0)
+            svc = qos.settings_for(db)
+            await svc.set("qos.tenant.flood.weight", 10.0)
+            await svc.set("qos.tenant.quiet.weight", 1.0)
+            failpoints.arm("qos.flood")
+            try:
+                # flood outnumbers 10:1, outweighs 10:1 AND outranks on
+                # priority — only the age tier can rescue the quiet job
+                await enqueue_n(db, 10, tenant="flood", prefix="fl",
+                                priority=5)
+                (quiet_id,) = await enqueue_n(db, 1, tenant="quiet",
+                                              prefix="qt")
+            finally:
+                failpoints.disarm("qos.flood")
+            await db.execute(
+                "UPDATE jobs SET created_at = created_at - 10 "
+                "WHERE id=:i", {"i": quiet_id})
+            job = await claims.claim_job(db, "w1")
+            assert job["id"] == quiet_id, (
+                "starved quiet-tenant job must win over every weight "
+                "and priority")
+
+        run(body())
+
+    def test_equal_weight_half_drain_is_fair(self, db, run):
+        async def body():
+            tenants = [f"t{i}" for i in range(4)]
+            for tn in tenants:
+                await enqueue_n(db, 8, tenant=tn, prefix=tn)
+            counts = {tn: 0 for tn in tenants}
+            for i in range(16):  # half drain: full drain is trivially 1.0
+                job = await claims.claim_job(db, f"w{i}")
+                counts[job["tenant"]] += 1
+            jain = _jain(list(counts.values()))
+            assert jain >= 0.9, (jain, counts)
+
+        run(body())
+
+    def test_priority_order_within_tenant_intact(self, db, run):
+        async def body():
+            await enqueue_n(db, 1, tenant="a", prefix="lo", priority=0)
+            (hi,) = await enqueue_n(db, 1, tenant="a", prefix="hi",
+                                    priority=10)
+            job = await claims.claim_job(db, "w1")
+            assert job["id"] == hi
+
+        run(body())
+
+    def test_stale_epoch_409_unchanged(self, db, run):
+        async def body():
+            await enqueue_n(db, 1, tenant="a", prefix="v")
+            job = await claims.claim_job(db, "w1")
+            assert job["attempt"] == 1
+            # correct epoch works; a stale fencing token must still
+            # raise through the QoS claim path exactly as before
+            await claims.update_progress(db, job["id"], "w1", progress=5.0,
+                                         epoch=1)
+            with pytest.raises(JobStateError):
+                await claims.update_progress(db, job["id"], "w1",
+                                             progress=6.0, epoch=0)
+
+        run(body())
+
+
+# --------------------------------------------------------------------------
+# Admission control + brownout shedding
+# --------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_queue_cap_429(self, db, run, clean_brownout):
+        async def body():
+            await qos.settings_for(db).set("qos.tenant.busy.max_queued", 2)
+            await enqueue_n(db, 2, tenant="busy", prefix="b")
+            vid = await make_video(db, "b-over")
+            with pytest.raises(qos.AdmissionError) as ei:
+                await claims.enqueue_job(db, vid, tenant="busy")
+            assert ei.value.tenant == "busy"
+            assert ei.value.retry_after_s > 0
+            # refused loudly, not dropped silently: exactly the two
+            # admitted jobs exist
+            n = await db.fetch_val(
+                "SELECT COUNT(*) FROM jobs WHERE tenant='busy'")
+            assert n == 2
+
+        run(body())
+
+    def test_flood_failpoint_bypasses_admission(self, db, run,
+                                                clean_brownout):
+        async def body():
+            await qos.settings_for(db).set("qos.tenant.busy.max_queued", 1)
+            failpoints.arm("qos.flood")
+            try:
+                await enqueue_n(db, 3, tenant="busy", prefix="fp")
+            finally:
+                failpoints.disarm("qos.flood")
+            n = await db.fetch_val(
+                "SELECT COUNT(*) FROM jobs WHERE tenant='busy'")
+            assert n == 3, "armed qos.flood must bypass the queue cap"
+
+        run(body())
+
+    def test_brownout_sheds_low_weight_tenants_first(self, db, run,
+                                                     clean_brownout):
+        from vlog_tpu.worker.brownout import CoordinationBreaker
+
+        async def body():
+            await qos.settings_for(db).set("qos.tenant.cheap.weight", 0.5)
+            qos._brownout = CoordinationBreaker(
+                source="enqueue", threshold=1, cooldown_s=30.0)
+            qos._brownout.record_error(ConnectionError("probe"))
+            assert qos._brownout.is_open
+            # low-weight tenant is shed...
+            vid = await make_video(db, "shed")
+            with pytest.raises(qos.AdmissionError) as ei:
+                await claims.enqueue_job(db, vid, tenant="cheap")
+            assert ei.value.retry_after_s == 30.0
+            # ...while default-weight traffic still lands
+            ok_ids = await enqueue_n(db, 1, tenant=qos.DEFAULT_TENANT,
+                                     prefix="dflt")
+            # recovery closes the breaker and re-admits the shed tenant
+            qos._brownout.record_success()
+            assert not qos._brownout.is_open
+            cheap_id = await claims.enqueue_job(db, vid, tenant="cheap")
+            # zero jobs lost: every admitted enqueue is a real row
+            for jid in [*ok_ids, cheap_id]:
+                assert await db.fetch_one(
+                    "SELECT 1 FROM jobs WHERE id=:i", {"i": jid})
+
+        run(body())
+
+
+# --------------------------------------------------------------------------
+# Fleet snapshot, scale-hint endpoint, tenant alert
+# --------------------------------------------------------------------------
+
+class TestFleetSignals:
+    def test_scale_hint_math(self, db, run, monkeypatch):
+        async def body():
+            monkeypatch.setattr(config, "QOS_SCALE_TARGET", 8)
+            await enqueue_n(db, 17, tenant="a", prefix="sh")
+            snap = await qos.fleet_snapshot(db)
+            # ceil(17/8) wanted, 0 online
+            assert snap["scale_hint"] == 3
+            assert snap["tenants"]["a"]["queued"] == 17
+            assert snap["queued"] == 17 and snap["inflight"] == 0
+
+        run(body())
+
+    def test_scale_hint_endpoint_serves_snapshot(self, db, run, tmp_path):
+        from aiohttp.test_utils import TestServer
+
+        from vlog_tpu.api.worker_api import build_worker_app
+
+        async def body():
+            await enqueue_n(db, 3, tenant="web", prefix="ep")
+            app = build_worker_app(db, video_dir=tmp_path / "v")
+            server = TestServer(app)
+            await server.start_server()
+            try:
+                import aiohttp
+
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(
+                            server.make_url("/api/fleet/scale-hint")) as r:
+                        assert r.status == 200
+                        body_json = await r.json()
+            finally:
+                await server.close()
+            assert body_json["tenants"]["web"]["queued"] == 3
+            assert "scale_hint" in body_json
+            assert "brownout_open" in body_json
+
+        run(body())
+
+    def test_admin_retranscode_429_maps_admission(self, db, run):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from vlog_tpu.api.admin_api import build_admin_app
+
+        async def body():
+            await qos.settings_for(db).set("qos.tenant.cap1.max_queued", 1)
+            await enqueue_n(db, 1, tenant="cap1", prefix="full")
+            vid = await make_video(db, "wants-in")
+            app = build_admin_app(db)
+            async with TestClient(TestServer(app)) as c:
+                r = await c.post(f"/api/videos/{vid}/retranscode",
+                                 json={"tenant": "cap1"})
+                assert r.status == 429
+                assert r.headers["Retry-After"].isdigit()
+                body_json = await r.json()
+            assert body_json["tenant"] == "cap1"
+            assert body_json["retry_after_s"] > 0
+
+        run(body())
+
+    def test_tenant_queue_depth_alert_names_tenant(self, db, run):
+        async def body():
+            await enqueue_n(db, 3, tenant="noisy", prefix="al")
+            await enqueue_n(db, 1, tenant="calm", prefix="cl")
+            sent = []
+            sink = alertsmod.AlertSink(url=None)
+
+            async def fake_send(alert, message, details=None, *, key=None):
+                sent.append((alert, key, details))
+                return True
+
+            sink.send = fake_send
+            offenders = await alertsmod.check_tenant_queue_depth(
+                db, sink, threshold=2)
+            assert offenders == ["noisy"]
+            (alert, key, details), = sent
+            assert key == "queue_depth:noisy"
+            assert details["tenant"] == "noisy" and details["queued"] == 3
+
+        run(body())
+
+    def test_alert_disabled_at_zero_threshold(self, db, run):
+        async def body():
+            await enqueue_n(db, 5, tenant="noisy", prefix="z")
+            sink = alertsmod.AlertSink(url=None)
+            assert await alertsmod.check_tenant_queue_depth(
+                db, sink, threshold=0) == []
+
+        run(body())

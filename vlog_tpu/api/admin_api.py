@@ -30,7 +30,7 @@ from vlog_tpu.api import auth as authmod
 from vlog_tpu.api.settings import SettingsService, SettingsError
 from vlog_tpu.db.core import Database, now as db_now, open_database
 from vlog_tpu.enums import JobKind, VideoStatus
-from vlog_tpu.jobs import claims, state as js, videos as vids
+from vlog_tpu.jobs import alerts as alertsmod, claims, qos, state as js, videos as vids
 from vlog_tpu.media.probe import ProbeError, get_video_info
 
 log = logging.getLogger("vlog_tpu.admin_api")
@@ -45,6 +45,15 @@ _COPY_CHUNK = 1 << 20
 
 def _json_error(status: int, message: str) -> web.Response:
     return web.json_response({"error": message}, status=status)
+
+
+def _admission_429(exc: qos.AdmissionError) -> web.Response:
+    """Per-tenant admission refusal: 429 + Retry-After, never a drop."""
+    return web.json_response(
+        {"error": str(exc), "tenant": exc.tenant,
+         "retry_after_s": exc.retry_after_s},
+        status=429,
+        headers={"Retry-After": str(max(1, round(exc.retry_after_s)))})
 
 
 def _qnum(query, name: str, default, *, lo=None, hi=None, cast=int):
@@ -358,7 +367,15 @@ async def upload_video(request: web.Request) -> web.Response:
         "height=:h, fps=:f, updated_at=:t WHERE id=:id",
         {"p": str(dest), "d": info.duration_s, "w": info.width,
          "h": info.height, "f": info.fps, "t": db_now(), "id": video["id"]})
-    job_id = await claims.enqueue_job(db, video["id"], JobKind.TRANSCODE)
+    tenant = qos.normalize_tenant(request.headers.get("X-Vlog-Tenant"))
+    try:
+        job_id = await claims.enqueue_job(db, video["id"], JobKind.TRANSCODE,
+                                          tenant=tenant)
+    except qos.AdmissionError as exc:
+        # the video row stays (source is saved and probed); only the
+        # transcode is refused — the caller retries the enqueue via
+        # retranscode after Retry-After
+        return _admission_429(exc)
     video = await vids.get_video(db, video["id"])
     return web.json_response(
         {"video": video, "job_id": job_id}, status=201)
@@ -444,13 +461,17 @@ async def retranscode(request: web.Request) -> web.Response:
     video = await vids.get_video(db, _path_id(request, "video_id"))
     if video is None:
         return _json_error(404, "no such video")
-    force = bool((await request.json() if request.can_read_body else {}
-                  ).get("force"))
+    body = await request.json() if request.can_read_body else {}
+    tenant = qos.normalize_tenant(
+        body.get("tenant") or request.headers.get("X-Vlog-Tenant"))
     try:
         job_id = await claims.enqueue_job(db, video["id"], JobKind.TRANSCODE,
-                                          force=force)
+                                          force=bool(body.get("force")),
+                                          tenant=tenant)
     except js.JobStateError as exc:
         return _json_error(409, str(exc))
+    except qos.AdmissionError as exc:
+        return _admission_429(exc)
     await vids.set_status(db, video["id"], VideoStatus.PENDING)
     return web.json_response({"job_id": job_id})
 
@@ -470,13 +491,17 @@ async def reencode(request: web.Request) -> web.Response:
     cerr = validate_codec_format(codec, fmt)
     if cerr is not None:
         return _json_error(400, cerr)
+    tenant = qos.normalize_tenant(
+        body.get("tenant") or request.headers.get("X-Vlog-Tenant"))
     try:
         job_id = await claims.enqueue_job(
             db, video["id"], JobKind.REENCODE,
             payload={"streaming_format": fmt, "codec": codec},
-            force=bool(body.get("force")))
+            force=bool(body.get("force")), tenant=tenant)
     except js.JobStateError as exc:
         return _json_error(409, str(exc))
+    except qos.AdmissionError as exc:
+        return _admission_429(exc)
     return web.json_response({"job_id": job_id})
 
 
@@ -560,6 +585,7 @@ async def list_jobs(request: web.Request) -> web.Response:
     db = request.app[DB]
     q = request.query
     want = q.get("state", "").strip()
+    want_tenant = q.get("tenant", "").strip()
     limit = _qnum(q, "limit", 100, lo=1, hi=500)
     cursor = _qnum(q, "cursor", None, lo=1)
     t = db_now()
@@ -568,6 +594,9 @@ async def list_jobs(request: web.Request) -> web.Response:
     if want:
         where.append(f"{_STATE_CASE} = :want")
         params["want"] = want
+    if want_tenant:
+        where.append("j.tenant = :tenant")
+        params["tenant"] = want_tenant
     if cursor is not None:
         where.append("j.id < :cursor")
         params["cursor"] = cursor
@@ -580,6 +609,7 @@ async def list_jobs(request: web.Request) -> web.Response:
         ORDER BY j.id DESC LIMIT :limit
         """, params)
     out = [{"id": r["id"], "kind": r["kind"], "state": r["state"],
+            "tenant": r["tenant"],
             "slug": r["slug"], "title": r["title"],
             "attempt": r["attempt"], "progress": r["progress"],
             "current_step": r["current_step"],
@@ -591,9 +621,13 @@ async def list_jobs(request: web.Request) -> web.Response:
     next_cursor = rows[-1]["id"] if len(rows) == limit else None
     resp = {"jobs": out, "next_cursor": next_cursor}
     if cursor is None:
+        # first page only, like the state counts — a tenant filter
+        # scopes them so the queue tab's numbers match the rows shown
+        tenant_sql = "WHERE j.tenant = :tenant" if want_tenant else ""
         count_rows = await db.fetch_all(
             f"SELECT {_STATE_CASE} AS state, COUNT(*) AS n FROM jobs j "
-            "GROUP BY state", {"now": t})
+            f"{tenant_sql} GROUP BY state",
+            {"now": t, **({"tenant": want_tenant} if want_tenant else {})})
         counts = {r["state"]: r["n"] for r in count_rows}
         resp["counts"] = counts
         resp["total"] = (counts.get(want, 0) if want
@@ -1119,6 +1153,13 @@ async def list_workers(request: web.Request) -> web.Response:
     return web.json_response({"workers": rows})
 
 
+async def fleet_scale_hint(request: web.Request) -> web.Response:
+    """Autoscale signal for the admin Queue tab — same
+    :func:`vlog_tpu.jobs.qos.fleet_snapshot` the worker API endpoint
+    and the ``stats`` worker command serve."""
+    return web.json_response(await qos.fleet_snapshot(request.app[DB]))
+
+
 async def send_worker_command(request: web.Request) -> web.Response:
     """Queue a management command; the worker answers on its next
     heartbeat tick (reference admin.py:5164-5290 remote worker RPC)."""
@@ -1411,6 +1452,7 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
               webhook_deliveries)
     r.add_delete("/api/webhooks/{webhook_id:\\d+}", delete_webhook)
     r.add_get("/api/workers", list_workers)
+    r.add_get("/api/fleet/scale-hint", fleet_scale_hint)
     r.add_post("/api/workers/{name}/revoke", revoke_worker)
     r.add_post("/api/workers/{name}/drain", drain_worker)
     r.add_post("/api/workers/{name}/command", send_worker_command)
@@ -1472,6 +1514,10 @@ async def serve(port: int | None = None, db_url: str | None = None,
     maintenance_task = asyncio.create_task(_session_maintenance_loop(db))
     gc_task = asyncio.create_task(_gc_loop(
         db, video_dir=app[VIDEO_DIR], upload_dir=app[UPLOAD_DIR]))
+    # tenant-aware queue-depth alerting (VLOG_QOS_ALERT_QUEUED=0
+    # disables inside the check itself; the loop stays cheap)
+    alert_task = asyncio.create_task(alertsmod.queue_depth_loop(
+        db, alertsmod.AlertSink()))
     try:
         await asyncio.Event().wait()
     finally:
@@ -1479,8 +1525,9 @@ async def serve(port: int | None = None, db_url: str | None = None,
         delivery_task.cancel()
         maintenance_task.cancel()
         gc_task.cancel()
+        alert_task.cancel()
         await asyncio.gather(delivery_task, maintenance_task, gc_task,
-                             return_exceptions=True)
+                             alert_task, return_exceptions=True)
         await runner.cleanup()
         await db.disconnect()
 

@@ -28,7 +28,7 @@ from vlog_tpu.db.core import Database, now as db_now  # noqa: F401
 # imports this module inside build_admin_app, so there is no cycle)
 from vlog_tpu.api.admin_api import DB, VIDEO_DIR, _path_id
 from vlog_tpu.enums import JobKind, VideoStatus
-from vlog_tpu.jobs import claims, state as js, videos as vids
+from vlog_tpu.jobs import claims, qos, state as js, videos as vids
 
 
 def _json_error(status: int, message: str) -> web.Response:
@@ -574,11 +574,18 @@ async def bulk_videos(request: web.Request) -> web.Response:
                 "UPDATE videos SET category=:c, updated_at=:t WHERE id=:v",
                 {"c": body.get("category"), "t": t, "v": vid})
         elif action == "retranscode":
+            tenant = qos.normalize_tenant(body.get("tenant"))
             try:
                 await claims.enqueue_job(db, vid, JobKind.TRANSCODE,
-                                         force=bool(body.get("force")))
+                                         force=bool(body.get("force")),
+                                         tenant=tenant)
             except js.JobStateError:
                 missing.append(vid)   # already queued/claimed: report it
+                continue
+            except qos.AdmissionError:
+                # admission-capped, not lost: reported so the caller
+                # retries these ids after the tenant's backlog drains
+                missing.append(vid)
                 continue
             await vids.set_status(db, vid, VideoStatus.PENDING)
         done.append(vid)

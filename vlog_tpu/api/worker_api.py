@@ -29,7 +29,7 @@ from vlog_tpu.api import auth as authmod
 from vlog_tpu.db.core import Database, Row, now as db_now, open_database
 from vlog_tpu.db.retry import with_retries
 from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
-from vlog_tpu.jobs import claims, state as js, videos as vids
+from vlog_tpu.jobs import claims, qos, state as js, videos as vids
 from vlog_tpu.jobs.events import CH_JOBS, bus_for
 from vlog_tpu.jobs.finalize import finalize_transcode, finalize_transcription
 from vlog_tpu.obs import store as obs_store
@@ -203,7 +203,11 @@ def _job_payload(row: Row) -> dict:
 
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
-    if request.path in ("/healthz", "/metrics", "/api/worker/register"):
+    # scale-hint is exempt like /metrics: autoscalers are fleet infra,
+    # not registered workers, and the payload is the same aggregate
+    # queue-depth data /metrics already exports per tenant
+    if request.path in ("/healthz", "/metrics", "/api/worker/register",
+                        "/api/fleet/scale-hint"):
         return await handler(request)
     hdr = request.headers.get("Authorization", "")
     if not hdr.startswith("Bearer "):
@@ -970,6 +974,17 @@ async def list_workers(request: web.Request) -> web.Response:
     return web.json_response({"workers": rows})
 
 
+async def scale_hint(request: web.Request) -> web.Response:
+    """Autoscale signal: per-tenant queue state + suggested worker delta.
+
+    One call into :func:`vlog_tpu.jobs.qos.fleet_snapshot` — the same
+    helper the worker ``stats`` command renders, so an autoscaler
+    polling this endpoint and an operator reading the CLI see the same
+    numbers.
+    """
+    return web.json_response(await qos.fleet_snapshot(request.app[DB]))
+
+
 # --------------------------------------------------------------------------
 # App assembly
 # --------------------------------------------------------------------------
@@ -1008,6 +1023,7 @@ def build_worker_app(db: Database, video_dir: Path | None = None) -> web.Applica
     app.router.add_get("/api/worker/upload/{video_id:\\d+}/status",
                        upload_status)
     app.router.add_get("/api/worker/workers", list_workers)
+    app.router.add_get("/api/fleet/scale-hint", scale_hint)
     app.router.add_get("/api/worker/commands", poll_commands)
     app.router.add_post("/api/worker/commands/{command_id:\\d+}/response",
                         respond_command)

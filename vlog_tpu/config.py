@@ -212,6 +212,62 @@ SWEEP_INTERVAL_S: float = _env_float("VLOG_SWEEP_INTERVAL_S", 10.0, lo=0.0)
 HEARTBEAT_FLUSH_S: float = _env_float("VLOG_HEARTBEAT_FLUSH_S", 0.0, lo=0.0)
 
 # --------------------------------------------------------------------------
+# Multi-tenant QoS + overload protection (jobs/qos.py, jobs/claims.py).
+# Per-tenant overrides live in SettingsService dot-keys
+# (``qos.tenant.<name>.weight`` / ``.max_queued`` / ``.max_inflight`` /
+# ``.deadline_budget_s``); the knobs here are the fleet-wide defaults a
+# tenant inherits when no override is written.
+# --------------------------------------------------------------------------
+
+# Hard starvation bound for fair-share claiming: any claimable job older
+# than this many seconds jumps the weighted fair-share order entirely
+# (oldest first), so a low-weight tenant's enqueue->claim latency is
+# bounded even under a flood. This is the liveness guarantee the
+# tenant-flood bench (bench_coord.py --tenants) regression-gates.
+QOS_STARVATION_S: float = _env_float("VLOG_QOS_STARVATION_S", 30.0, lo=0.1)
+# Fair-share weight a tenant gets when no per-tenant override is set.
+# Relative: a weight-2 tenant is offered ~2x the claims of a weight-1
+# tenant while both have backlog. Also the brownout shedding threshold:
+# while the enqueue brownout breaker is open, tenants whose weight is
+# BELOW this default are shed first (429) at admission.
+QOS_DEFAULT_WEIGHT: float = _env_float("VLOG_QOS_DEFAULT_WEIGHT", 1.0,
+                                       lo=0.001)
+# Default per-tenant queue-depth cap enforced at enqueue (claimable +
+# backoff jobs, i.e. queued-not-running). Exceeding it is a 429 +
+# Retry-After, never a silent drop. 0 = unlimited.
+QOS_MAX_QUEUED: int = _env_int("VLOG_QOS_MAX_QUEUED", 0, lo=0)
+# Default per-tenant in-flight (actively claimed) cap enforced by the
+# claim query: a tenant at its cap contributes no candidates until a
+# claim completes/fails/expires. 0 = unlimited.
+QOS_MAX_INFLIGHT: int = _env_int("VLOG_QOS_MAX_INFLIGHT", 0, lo=0)
+# Deadline urgency window: a job whose ``deadline_at`` is within this
+# many seconds (tenant-overridable) boosts past the fair-share tier,
+# ordered by deadline. Starved jobs still rank first.
+QOS_DEADLINE_BUDGET_S: float = _env_float("VLOG_QOS_DEADLINE_BUDGET_S",
+                                          120.0, lo=0.0)
+# Retry-After seconds returned with a queue-depth 429. Brownout sheds
+# return the breaker cooldown instead (the queue is not the bottleneck
+# there — the database is).
+QOS_RETRY_AFTER_S: float = _env_float("VLOG_QOS_RETRY_AFTER_S", 5.0, lo=0.1)
+# Tenant-aware queue-depth alert threshold (jobs/alerts.py): any single
+# tenant with at least this many claimable jobs queued fires a
+# rate-limited webhook naming that tenant. 0 disables the check.
+QOS_ALERT_QUEUED: int = _env_int("VLOG_QOS_ALERT_QUEUED", 0, lo=0)
+# Cadence of the admin process's periodic tenant queue-depth alert scan.
+QOS_ALERT_INTERVAL_S: float = _env_float("VLOG_QOS_ALERT_INTERVAL_S", 60.0,
+                                         lo=1.0)
+# Autoscale signal (GET /api/fleet/scale-hint): target claimable-job
+# backlog per online worker. The hint is the extra workers needed to
+# bring backlog/worker down to this target (negative = shrinkable),
+# bumped to at least +1 while queue-wait p99 exceeds the starvation
+# bound or the enqueue brownout breaker is open.
+QOS_SCALE_TARGET: int = _env_int("VLOG_QOS_SCALE_TARGET", 8, lo=1)
+# Sliding window over server-side ``queue.wait`` spans used for the
+# scale hint's p99 (seconds of history considered).
+QOS_WAIT_WINDOW_S: float = _env_float("VLOG_QOS_WAIT_WINDOW_S", 300.0,
+                                      lo=10.0)
+
+# --------------------------------------------------------------------------
 # Preemption-tolerant drain (worker/drain.py): on SIGTERM or a
 # preemption notice the worker stops claiming, lets in-flight compute
 # finish and flush (leases heartbeat-extended), then force-cancels and

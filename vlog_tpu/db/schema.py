@@ -467,6 +467,30 @@ MIGRATIONS: list[tuple[int, list[str]]] = [
             " ON job_failures(job_id, id)",
         ],
     ),
+    (
+        9,
+        [
+            # -- multi-tenant QoS plane --------------------------------------
+            # Tenant identity on every job: admission control (jobs/qos.py)
+            # caps per-tenant queue depth at enqueue, and the claim query
+            # (jobs/claims.py) runs weighted deficit-round-robin ACROSS
+            # tenants while preserving priority-then-FIFO WITHIN one.
+            # Every pre-migration row (and any writer that never names a
+            # tenant) lands in the 'default' tenant, so single-tenant
+            # deployments keep the exact pre-QoS ordering.
+            "ALTER TABLE jobs ADD COLUMN tenant TEXT NOT NULL"
+            " DEFAULT 'default'",
+            # Optional per-job deadline: jobs carrying one get a
+            # deadline-aware boost in the fair-share order once the
+            # tenant's deadline budget window opens. NULL = no deadline.
+            "ALTER TABLE jobs ADD COLUMN deadline_at REAL",
+            # tenant-scoped scans: admission counts, the fair-share
+            # per-tenant ranking, the queue browser's tenant filter, and
+            # the per-tenant /metrics gauges all GROUP/filter by tenant
+            "CREATE INDEX IF NOT EXISTS idx_jobs_tenant"
+            " ON jobs(tenant, completed_at, failed_at)",
+        ],
+    ),
 ]
 
 

@@ -110,3 +110,58 @@ class AlertSink:
         task = loop.create_task(self.send(alert, message, details, key=key),
                                 name="vlog-alert-send")
         task.add_done_callback(lambda t: t.exception())
+
+
+async def check_tenant_queue_depth(db, sink: AlertSink, *,
+                                   threshold: int | None = None) -> list[str]:
+    """Alert per tenant whose claimable backlog crosses the threshold.
+
+    One GROUP BY over tenant — the alert names the offending tenant
+    (and fires independently per tenant, each under its own rate-limit
+    key), so a single flooding tenant reads as THAT tenant's incident,
+    not an anonymous global queue-depth number. Threshold comes from
+    ``VLOG_QOS_ALERT_QUEUED`` (0 = disabled). Returns the tenants that
+    crossed, for tests and the caller's logs.
+    """
+    from vlog_tpu import config
+    from vlog_tpu.db.core import now as db_now
+    from vlog_tpu.jobs import state as js
+
+    limit = config.QOS_ALERT_QUEUED if threshold is None else threshold
+    if limit <= 0:
+        return []
+    rows = await db.fetch_all(
+        f"""
+        SELECT tenant, COUNT(*) AS n FROM jobs
+        WHERE {js.SQL_CLAIMABLE}
+        GROUP BY tenant HAVING COUNT(*) >= :limit
+        ORDER BY n DESC
+        """,
+        {"now": db_now(), "limit": limit})
+    offenders: list[str] = []
+    for r in rows:
+        tenant, n = r["tenant"], int(r["n"] or 0)
+        offenders.append(tenant)
+        await sink.send(
+            "tenant_queue_depth",
+            f"tenant {tenant!r} has {n} claimable jobs queued "
+            f"(threshold {limit})",
+            {"tenant": tenant, "queued": n, "threshold": limit},
+            key=f"queue_depth:{tenant}")
+    return offenders
+
+
+async def queue_depth_loop(db, sink: AlertSink, *,
+                           interval_s: float | None = None) -> None:
+    """Periodic tenant queue-depth check (admin server background task)."""
+    from vlog_tpu import config
+
+    wait = interval_s if interval_s is not None else config.QOS_ALERT_INTERVAL_S
+    while True:
+        await asyncio.sleep(wait)
+        try:
+            await check_tenant_queue_depth(db, sink)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — alerting never kills the server
+            log.warning("tenant queue-depth check failed", exc_info=True)

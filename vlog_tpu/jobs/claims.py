@@ -27,12 +27,13 @@ import asyncio
 import json
 import logging
 import random
+import sqlite3
 from typing import Any, Awaitable, Callable
 
 from vlog_tpu import config
 from vlog_tpu.db.core import Database, Row, now as db_now
 from vlog_tpu.enums import AcceleratorKind, FailureClass, JobKind
-from vlog_tpu.jobs import state as js
+from vlog_tpu.jobs import qos, state as js
 from vlog_tpu.jobs.events import CH_JOBS, CH_PROGRESS, wake as _wake
 from vlog_tpu.obs import store as obs_store
 from vlog_tpu.obs.metrics import runtime as obs_runtime
@@ -137,6 +138,9 @@ async def enqueue_job(
     max_attempts: int | None = None,
     required_accelerator: AcceleratorKind | None = None,
     force: bool = False,
+    tenant: str = qos.DEFAULT_TENANT,
+    deadline_at: float | None = None,
+    admit: bool = True,
 ) -> int:
     """Create (or reset) the job for a video+kind.
 
@@ -145,8 +149,55 @@ async def enqueue_job(
     job another worker is actively transcoding raises :class:`JobStateError`
     unless ``force=True`` (the admin "retranscode anyway" path) — otherwise
     two workers could write the same output tree concurrently.
+
+    Tenancy: the job lands in ``tenant`` (default tenant when unnamed)
+    and, with ``admit=True``, passes per-tenant admission control first
+    (:func:`vlog_tpu.jobs.qos.admit_enqueue` — queue-depth caps and
+    brownout shedding raise :class:`~vlog_tpu.jobs.qos.AdmissionError`,
+    which HTTP layers map to 429 + Retry-After). Internal follow-up
+    enqueues (jobs/finalize.py sprite/transcription) pass
+    ``admit=False`` with the parent job's tenant: the tenant already
+    paid admission for the pipeline when the root job entered.
+    ``deadline_at`` (absolute epoch seconds) opts the job into the
+    claim query's deadline-aware boost. Transient DB faults on this
+    path feed the enqueue-side brownout breaker (jobs/qos.py), whose
+    open state is what triggers shed-low-weight-tenants-first.
     """
+    tenant = qos.normalize_tenant(tenant)
+    if admit:
+        # outside the transaction below: admission counts go through the
+        # database facade, whose lock the transaction holds
+        await qos.admit_enqueue(db, tenant)
+    # pre-transaction: a QoS-relevant enqueue must invalidate the cached
+    # claim plan before any claimant can observe the new row
+    qos.note_enqueue(db, tenant, deadline_at)
     t = db_now()
+    try:
+        jid = await _enqueue_txn(
+            db, video_id, kind, priority=priority, payload=payload,
+            max_attempts=max_attempts,
+            required_accelerator=required_accelerator, force=force,
+            tenant=tenant, deadline_at=deadline_at, t=t)
+    except (ConnectionError, sqlite3.OperationalError) as exc:
+        qos.record_enqueue_error(exc)
+        raise
+    qos.record_enqueue_ok()
+    if config.TRACE_ENABLED:
+        # root span post-commit: the trace id every later hop joins
+        await _trace_write(
+            "enqueue", lambda: obs_store.ensure_root(db, jid, created_at=t))
+    # after commit, so a woken claimant always sees the row
+    _wake(db, CH_JOBS, {"job_id": jid, "kind": kind.value})
+    return jid
+
+
+async def _enqueue_txn(
+    db: Database, video_id: int, kind: JobKind, *, priority: int,
+    payload: dict[str, Any] | None, max_attempts: int | None,
+    required_accelerator: AcceleratorKind | None, force: bool,
+    tenant: str, deadline_at: float | None, t: float,
+) -> int:
+    """The enqueue upsert transaction (see :func:`enqueue_job`)."""
     async with db.transaction() as tx:
         existing = await tx.fetch_one(
             "SELECT * FROM jobs WHERE video_id=:v AND kind=:k",
@@ -157,14 +208,17 @@ async def enqueue_job(
             "pl": json.dumps(payload or {}),
             "ma": max_attempts or config.MAX_JOB_ATTEMPTS,
             "ra": required_accelerator.value if required_accelerator else None,
+            "tn": tenant,
+            "dl": deadline_at,
             "t": t,
         }
         if existing is None:
             jid = await tx.execute(
                 """
                 INSERT INTO jobs (video_id, kind, priority, payload, max_attempts,
-                                  required_accelerator, created_at, updated_at)
-                VALUES (:v, :k, :p, :pl, :ma, :ra, :t, :t)
+                                  required_accelerator, tenant, deadline_at,
+                                  created_at, updated_at)
+                VALUES (:v, :k, :p, :pl, :ma, :ra, :tn, :dl, :t, :t)
                 """,
                 {**params, "v": video_id, "k": kind.value},
             )
@@ -179,7 +233,8 @@ async def enqueue_job(
             await tx.execute(
                 """
                 UPDATE jobs SET priority=:p, payload=:pl, max_attempts=:ma,
-                    required_accelerator=:ra, claimed_by=NULL, claimed_at=NULL,
+                    required_accelerator=:ra, tenant=:tn, deadline_at=:dl,
+                    claimed_by=NULL, claimed_at=NULL,
                     claim_expires_at=NULL, started_at=NULL, completed_at=NULL,
                     failed_at=NULL, error=NULL, attempt=0, current_step=NULL,
                     last_checkpoint='{}', progress=0.0, next_retry_at=NULL,
@@ -204,12 +259,6 @@ async def enqueue_job(
                 {"id": existing["id"]},
             )
             jid = int(existing["id"])
-    if config.TRACE_ENABLED:
-        # root span post-commit: the trace id every later hop joins
-        await _trace_write(
-            "enqueue", lambda: obs_store.ensure_root(db, jid, created_at=t))
-    # after commit, so a woken claimant always sees the row
-    _wake(db, CH_JOBS, {"job_id": jid, "kind": kind.value})
     return jid
 
 
@@ -306,6 +355,102 @@ async def _sweep_if_due(tx: Any, db: Database, t: float) -> list[int]:
     return dead
 
 
+async def _qos_candidates(
+    tx: Any, base_filter: str, base_params: dict[str, Any],
+    policies: dict[str, qos.TenantPolicy], n: int, t: float,
+) -> list[Row]:
+    """Weighted fair-share candidate pick across tenants (one query).
+
+    Three tiers, in order:
+
+    - **tier 0 — starved**: any claimable job older than
+      ``VLOG_QOS_STARVATION_S``, oldest first. The hard liveness bound:
+      past it, age beats every weight and priority in the system.
+    - **tier 1 — deadline-urgent**: jobs whose ``deadline_at`` falls
+      inside the tenant's deadline budget window, earliest deadline
+      first.
+    - **tier 2 — weighted fair share**: per-tenant rank (priority DESC,
+      FIFO — the intact intra-tenant order) plus the tenant's recently
+      served count (claims inside ``VLOG_QOS_WAIT_WINDOW_S``), divided
+      by the tenant's weight — a weighted-fair-queueing virtual finish
+      time whose deficit state lives in the jobs table itself. The
+      served term is what makes SINGLE claims round-robin: without it,
+      equal-weight tenants all tie at rank 1 and the tie-break would
+      drain tenants in global FIFO order. The window keeps the deficit
+      from becoming lifetime bookkeeping — a new tenant is not owed the
+      whole history of an old one. Equal-weight tenants interleave; a
+      weight-2 tenant is offered two jobs per weight-1 job.
+
+    Per-tenant in-flight caps are enforced in the same query: a
+    tenant's candidates past its remaining headroom (cap minus
+    currently-claimed) are excluded outright, which also caps what a
+    single batch can take from that tenant.
+    """
+    names = sorted(policies)
+    inflight: dict[str, int] = {}
+    if any(p.max_inflight > 0 for p in policies.values()):
+        irows = await tx.fetch_all(
+            f"SELECT tenant, COUNT(*) AS n FROM jobs "
+            f"WHERE {js.SQL_ACTIVELY_CLAIMED} GROUP BY tenant",
+            {"now": t})
+        inflight = {r["tenant"]: int(r["n"] or 0) for r in irows}
+    srows = await tx.fetch_all(
+        "SELECT tenant, COUNT(*) AS n FROM jobs "
+        "WHERE claimed_at IS NOT NULL AND claimed_at > :cut "
+        "GROUP BY tenant",
+        {"cut": t - config.QOS_WAIT_WINDOW_S})
+    served = {r["tenant"]: int(r["n"] or 0) for r in srows}
+
+    def _case(col: str, mark: str) -> str:
+        whens = " ".join(f"WHEN :qt{i} THEN :{mark}{i}"
+                         for i in range(len(names)))
+        return f"CASE {col} {whens} ELSE :{mark}d END"
+
+    params = dict(base_params)
+    params["lim"] = n
+    params["starve"] = t - config.QOS_STARVATION_S
+    for i, nm in enumerate(names):
+        pol = policies[nm]
+        params[f"qt{i}"] = nm
+        params[f"qw{i}"] = pol.weight
+        params[f"qb{i}"] = pol.deadline_budget_s
+        params[f"qh{i}"] = (qos.UNLIMITED if pol.max_inflight == 0
+                            else max(0, pol.max_inflight
+                                     - inflight.get(nm, 0)))
+        params[f"qs{i}"] = served.get(nm, 0)
+    # unknown tenants (enqueued after the plan probe) inherit defaults
+    params["qwd"] = config.QOS_DEFAULT_WEIGHT
+    params["qbd"] = config.QOS_DEADLINE_BUDGET_S
+    params["qhd"] = qos.UNLIMITED
+    params["qsd"] = 0
+    return await tx.fetch_all(
+        f"""
+        SELECT q.*, ((q.qos_rank + {_case('q.tenant', 'qs')}) * 1.0)
+                    / {_case('q.tenant', 'qw')} AS qos_vf
+        FROM (
+            SELECT j.*,
+                   CASE WHEN j.created_at <= :starve THEN 0
+                        WHEN j.deadline_at IS NOT NULL
+                             AND j.deadline_at <= :now
+                                 + {_case('j.tenant', 'qb')} THEN 1
+                        ELSE 2 END AS qos_tier,
+                   ROW_NUMBER() OVER (
+                       PARTITION BY j.tenant
+                       ORDER BY j.priority DESC, j.created_at ASC, j.id ASC
+                   ) AS qos_rank
+            FROM jobs j
+            WHERE {base_filter}
+        ) q
+        WHERE q.qos_rank <= {_case('q.tenant', 'qh')}
+        ORDER BY q.qos_tier ASC,
+                 CASE WHEN q.qos_tier = 0 THEN q.created_at END ASC,
+                 CASE WHEN q.qos_tier = 1 THEN q.deadline_at END ASC,
+                 qos_vf ASC, q.priority DESC, q.created_at ASC, q.id ASC
+        LIMIT :lim
+        """,
+        params)
+
+
 async def claim_jobs(
     db: Database,
     worker_name: str,
@@ -318,16 +463,24 @@ async def claim_jobs(
 ) -> list[Row]:
     """Atomically claim up to ``max_jobs`` eligible jobs in ONE transaction.
 
-    Ordering: priority DESC, then oldest first — matching the reference's
-    priority streams + FIFO recovery — and identical to issuing
-    ``max_jobs`` single claims back to back (the batch walks the same
-    ordered candidate list the single-claim loop would). Jobs demanding a
-    specific accelerator (``required_accelerator``) are only handed to
-    matching workers; jobs demanding a newer code version are skipped
+    Ordering WITHIN a tenant: priority DESC, then oldest first —
+    matching the reference's priority streams + FIFO recovery — and
+    identical to issuing ``max_jobs`` single claims back to back (the
+    batch walks the same ordered candidate list the single-claim loop
+    would). ACROSS tenants the candidate pick is weighted
+    deficit-round-robin with a hard starvation bound and a
+    deadline-urgency boost (:func:`_qos_candidates`); when only the
+    default tenant has claimable work (and it carries no deadline jobs
+    or in-flight cap) the pick collapses to the legacy single-ORDER-BY
+    query, so single-tenant deployments keep the pre-QoS plan and
+    cost. Jobs demanding a specific accelerator
+    (``required_accelerator``) are only handed to matching workers;
+    jobs demanding a newer code version are skipped
     (worker_api.py:1398-1434). ``max_jobs`` is capped at
     ``VLOG_CLAIM_BATCH_MAX``; each returned row carries its own attempt
     number (the epoch fencing token) and its own post-commit trace
-    anchors, exactly as single claims do.
+    anchors, exactly as single claims do. The claim request carries no
+    tenant logic — fairness is decided entirely server-side, here.
     """
     try:
         # chaos hook for the coordination-plane brownout: an armed
@@ -343,28 +496,55 @@ async def claim_jobs(
     n = max(1, min(int(max_jobs), config.CLAIM_BATCH_MAX))
     kind_marks = ",".join(f":k{i}" for i in range(len(kinds)))
     kind_params = {f"k{i}": k.value for i, k in enumerate(kinds)}
+    base_filter = f"""{js.SQL_CLAIMABLE}
+              AND kind IN ({kind_marks})
+              AND attempt < max_attempts
+              AND (required_accelerator IS NULL OR required_accelerator = :accel)
+              AND (min_code_version IS NULL OR min_code_version <= :cv)"""
+    base_params = {"now": t, "accel": accelerator.value,
+                   "cv": code_version, **kind_params}
+    # tenant discovery + policy resolution, pre-transaction and cached
+    # per-db with a short TTL (see qos.claim_plan). A tenant that
+    # enqueues between this probe and the claim transaction is picked
+    # up within the cache TTL — fairness is a steady-state property,
+    # not a per-transaction invariant.
+    policies = await qos.claim_plan(db, base_filter, base_params)
     pairs: list[tuple[Row, Row]] = []   # (pre-claim row, claimed row)
     async with db.transaction() as tx:
         # expired leases only swept when the oldest one has lapsed
         dead = await _sweep_if_due(tx, db, t)
-        # On Postgres the suffix is FOR UPDATE SKIP LOCKED: concurrent
-        # claimants contend on row locks and skip each other's picks —
-        # the reference's exact mechanism (worker_api.py:1494-1556). On
-        # sqlite it is empty (BEGIN IMMEDIATE already serializes).
-        rows = await tx.fetch_all(
-            f"""
-            SELECT * FROM jobs
-            WHERE {js.SQL_CLAIMABLE}
-              AND kind IN ({kind_marks})
-              AND attempt < max_attempts
-              AND (required_accelerator IS NULL OR required_accelerator = :accel)
-              AND (min_code_version IS NULL OR min_code_version <= :cv)
-            ORDER BY priority DESC, created_at ASC
-            LIMIT :lim{db.row_lock_suffix}
-            """,
-            {"now": t, "accel": accelerator.value, "cv": code_version,
-             "lim": n, **kind_params},
-        )
+        if policies is None:
+            # Single-tenant fast path. On Postgres the suffix is FOR
+            # UPDATE SKIP LOCKED: concurrent claimants contend on row
+            # locks and skip each other's picks — the reference's exact
+            # mechanism (worker_api.py:1494-1556). On sqlite it is
+            # empty (BEGIN IMMEDIATE already serializes).
+            rows = await tx.fetch_all(
+                f"""
+                SELECT * FROM jobs
+                WHERE {base_filter}
+                ORDER BY priority DESC, created_at ASC
+                LIMIT :lim{db.row_lock_suffix}
+                """,
+                {**base_params, "lim": n},
+            )
+        else:
+            rows = await _qos_candidates(tx, base_filter, base_params,
+                                         policies, n, t)
+            if rows and db.row_lock_suffix:
+                # The ranked pick cannot carry FOR UPDATE (window
+                # functions); lock the picked rows in a second select
+                # and keep only the ones still claimable — SKIP LOCKED
+                # drops rows a concurrent claimant holds.
+                marks = ",".join(f":c{i}" for i in range(len(rows)))
+                locked = await tx.fetch_all(
+                    f"SELECT * FROM jobs WHERE id IN ({marks})"
+                    f"{db.row_lock_suffix}",
+                    {f"c{i}": r["id"] for i, r in enumerate(rows)})
+                by_id = {r["id"]: r for r in locked}
+                rows = [by_id[r["id"]] for r in rows
+                        if r["id"] in by_id
+                        and js.is_claimable(by_id[r["id"]], now=t)]
         for row in rows:
             js.guard_claim(row, now=t)
             failpoints.hit("claims.claim")
@@ -384,6 +564,10 @@ async def claim_jobs(
     # terminal transitions the sweep performed, announced post-commit
     for jid in dead:
         _wake(db, CH_PROGRESS, {"job_id": jid, "event": "failed"})
+    for row, claimed in pairs:
+        wait_start = row["updated_at"] or row["created_at"] or t
+        obs_runtime().tenant_claim_wait.labels(
+            claimed["tenant"]).observe(max(0.0, t - wait_start))
     if pairs and config.TRACE_ENABLED:
         # Trace anchors, post-commit (span writes must never grow the
         # fleet's contention-point transaction, nor fail it — the
@@ -404,13 +588,15 @@ async def claim_jobs(
                     db, claimed["id"], trace_id=trace_id, parent_id=root,
                     name="queue.wait", started_at=wait_start,
                     duration_s=max(0.0, t - wait_start),
-                    attrs={"attempt": claimed["attempt"]})
+                    attrs={"attempt": claimed["attempt"],
+                           "tenant": claimed["tenant"]})
                 await obs_store.record(
                     db, claimed["id"], trace_id=trace_id, parent_id=root,
                     name="server.claim", started_at=t,
                     duration_s=max(0.0, db_now() - t),
                     attrs={"worker": worker_name, "kind": claimed["kind"],
-                           "attempt": claimed["attempt"]})
+                           "attempt": claimed["attempt"],
+                           "tenant": claimed["tenant"]})
 
         await _trace_write("claim", _claim_spans)
     return [claimed for _, claimed in pairs]

@@ -18,7 +18,7 @@ from typing import Any
 from vlog_tpu import config
 from vlog_tpu.db.core import Database, Row, now as db_now
 from vlog_tpu.enums import JobKind
-from vlog_tpu.jobs import claims, videos as vids
+from vlog_tpu.jobs import claims, qos, videos as vids
 
 log = logging.getLogger("vlog.finalize")
 
@@ -59,10 +59,17 @@ async def finalize_transcode(
         await claims.upsert_quality_progress(
             db, job["id"], rn, status="completed", progress=100.0)
     if enqueue_downstream:
-        await claims.enqueue_job(db, video["id"], JobKind.SPRITE)
+        # downstream jobs inherit the parent transcode's tenant and skip
+        # admission: refusing the sprite/transcription tail of an
+        # already-admitted (and fully paid-for) transcode would strand
+        # the video half-published
+        tenant = job.get("tenant") or qos.DEFAULT_TENANT
+        await claims.enqueue_job(db, video["id"], JobKind.SPRITE,
+                                 tenant=tenant, admit=False)
         if config.TRANSCRIPTION_ENABLED and getattr(probe, "audio_codec",
                                                     None):
-            await claims.enqueue_job(db, video["id"], JobKind.TRANSCRIPTION)
+            await claims.enqueue_job(db, video["id"], JobKind.TRANSCRIPTION,
+                                     tenant=tenant, admit=False)
 
 
 async def finalize_transcription(

@@ -313,6 +313,22 @@ class RuntimeMetrics:
             "batch completed",
             buckets=(0.01, 0.05, 0.2, 1.0, 5.0, 20.0, 60.0, 300.0),
             registry=self.registry)
+        # Multi-tenant QoS plane (jobs/qos.py, jobs/claims.py): the
+        # claim-side wait distribution per tenant — this is the
+        # starvation bound's observable (p99 must stay under
+        # VLOG_QOS_STARVATION_S) — and the fleet autoscale hint.
+        self.tenant_claim_wait = Histogram(
+            "vlog_tenant_claim_wait_seconds",
+            "Seconds between a job becoming claimable and its claim, "
+            "by tenant (enqueue-to-claim wait)",
+            ["tenant"],
+            buckets=(0.01, 0.1, 0.5, 2.0, 10.0, 30.0, 120.0, 600.0),
+            registry=self.registry)
+        self.fleet_scale_hint = Gauge(
+            "vlog_fleet_scale_hint",
+            "Suggested worker-count delta from the fleet snapshot "
+            "(positive = scale out; negative = safe to shrink)",
+            registry=self.registry)
         # the fires counter must see every fire in the process, wherever
         # the site lives — failpoints stays dependency-free, we observe
         failpoints.add_observer(
@@ -426,4 +442,27 @@ class Metrics:
         lines.append("# HELP vlog_workers_online Workers with a fresh heartbeat")
         lines.append("# TYPE vlog_workers_online gauge")
         lines.append(f"vlog_workers_online {online or 0}")
+        # per-tenant queue pressure: one GROUP BY over tenant (the QoS
+        # plane's admission + fair-share inputs, made scrapeable)
+        tenant_rows = await db.fetch_all(
+            f"""
+            SELECT tenant,
+                   SUM(CASE WHEN {js.SQL_CLAIMABLE} THEN 1 ELSE 0 END)
+                       AS queued,
+                   SUM(CASE WHEN {js.SQL_ACTIVELY_CLAIMED} THEN 1 ELSE 0 END)
+                       AS inflight
+            FROM jobs WHERE {js.SQL_NOT_TERMINAL}
+            GROUP BY tenant ORDER BY tenant
+            """, {"now": t})
+        lines.append("# HELP vlog_tenant_queued Claimable jobs by tenant")
+        lines.append("# TYPE vlog_tenant_queued gauge")
+        for r in tenant_rows:
+            lines.append(f'vlog_tenant_queued{{tenant="{r["tenant"]}"}} '
+                         f'{int(r["queued"] or 0)}')
+        lines.append("# HELP vlog_tenant_inflight Actively claimed jobs "
+                     "by tenant")
+        lines.append("# TYPE vlog_tenant_inflight gauge")
+        for r in tenant_rows:
+            lines.append(f'vlog_tenant_inflight{{tenant="{r["tenant"]}"}} '
+                         f'{int(r["inflight"] or 0)}')
         return text + "\n".join(lines) + "\n" + runtime().render_text()

@@ -64,6 +64,11 @@ site                where it fires
 ``asr.batch``       engine tick, before the batched decode forward —
                     every job with a window in the batch gets the
                     failure; the engine survives and keeps ticking
+``qos.flood``       qos.admit_enqueue entry (jobs/qos.py) — an armed
+                    hit BYPASSES per-tenant admission control, letting
+                    a chaos flood through so the claim-side fair-share
+                    + starvation machinery is what must protect quiet
+                    tenants
 ==================  =====================================================
 
 Every legitimate site name is listed in :data:`SITES`;
@@ -145,6 +150,9 @@ SITES: dict[str, str] = {
     "asr.batch": "ASR engine tick, before the batched decode forward; "
                  "every job in the batch gets the failure, the engine "
                  "keeps ticking",
+    "qos.flood": "qos.admit_enqueue entry; an armed hit BYPASSES "
+                 "per-tenant admission so a chaos flood lands on the "
+                 "queue and the claim-side starvation bound must hold",
 }
 
 

@@ -1080,9 +1080,14 @@ async function loadQueue(more) {
 
 async function loadQueuePage(more) {
   const st = $("q-state").value;
-  if (!more) qCursor = null;
+  const tenant = $("q-tenant").value.trim();
+  if (!more) {
+    qCursor = null;
+    loadScaleHint();   // fire-and-forget; the hint is advisory
+  }
   const params = new URLSearchParams();
   if (st) params.set("state", st);
+  if (tenant) params.set("tenant", tenant);
   if (qCursor) params.set("cursor", qCursor);
   const qs = params.toString();
   const d = await api(`/api/jobs${qs ? `?${qs}` : ""}`);
@@ -1108,7 +1113,7 @@ async function loadQueuePage(more) {
       state.title = `retry due in ${Math.max(0,
         Math.round(jb.next_retry_at - Date.now() / 1000))}s`;
     }
-    cells(tr, [`#${jb.id}`, jb.title, jb.kind, state,
+    cells(tr, [`#${jb.id}`, jb.title, jb.tenant || "default", jb.kind, state,
       jb.attempt, prog, jb.current_step || "—", jb.claimed_by || "—",
       fmtAgo(jb.updated_at),
       actionBtn("trace", async () => showTrace(jb.id))]);
@@ -1118,9 +1123,23 @@ async function loadQueuePage(more) {
   qCursor = d.next_cursor;
   $("q-more").hidden = !qCursor;
 }
+
+async function loadScaleHint() {
+  try {
+    const s = await api("/api/fleet/scale-hint");
+    const sign = s.scale_hint > 0 ? `+${s.scale_hint}` : `${s.scale_hint}`;
+    $("q-scale-hint").textContent =
+      `scale hint: ${sign} workers (${s.queued} queued / ` +
+      `${s.workers_online} online, wait p99 ${s.queue_wait_p99_s.toFixed(1)}s` +
+      `${s.brownout_open ? ", BROWNOUT" : ""})`;
+  } catch (e) {
+    $("q-scale-hint").textContent = "";
+  }
+}
 $("q-refresh").onclick = () => loadQueue();
 $("q-more").onclick = () => loadQueue(true);
 $("q-state").addEventListener("change", () => loadQueue());
+$("q-tenant").addEventListener("change", () => loadQueue());
 $("trace-close").onclick = () => { $("trace-panel").hidden = true; };
 
 /* -- trace waterfall: GET /api/jobs/{id}/trace -> horizontal timeline -- */

@@ -388,6 +388,15 @@ class WorkerDaemon(ComputeWatchdogMixin):
         if command == "stats":
             from dataclasses import asdict
 
+            from vlog_tpu.jobs import qos
+
+            try:
+                # same snapshot GET /api/fleet/scale-hint serves — one
+                # SQL helper, two surfaces
+                fleet = await qos.fleet_snapshot(self.db)
+            except Exception:  # noqa: BLE001 — stats must answer anyway
+                log.warning("fleet snapshot unavailable", exc_info=True)
+                fleet = None
             return {**asdict(self.stats),
                     "current_job_id": self._current_job_id,
                     "active_job_ids": sorted(self._active_sups),
@@ -398,7 +407,8 @@ class WorkerDaemon(ComputeWatchdogMixin):
                              if self.scheduler is not None else None),
                     "draining": {**self.drain.snapshot(),
                                  "jobs_remaining": len(self._active_sups)},
-                    "kinds": [k.value for k in self.kinds]}
+                    "kinds": [k.value for k in self.kinds],
+                    "fleet": fleet}
         if command == "drain":
             started = self.begin_drain("admin drain command")
             return {"draining": True, "started": started,
