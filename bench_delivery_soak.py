@@ -1,0 +1,335 @@
+"""Delivery-fabric soak: flash crowd across N origins, one killed mid-crowd.
+
+The thundering-herd survival proof for the self-healing delivery fabric
+(delivery/gossip.py + the hedged/coalesced fill path in plane.py). N
+in-process origins (aiohttp AppRunner each, the same public app the
+integration tests drive) form a gossiping rendezvous ring over one
+published ladder; a flash crowd of concurrent clients then hammers one
+slug's whole segment set through random origins. Two runs:
+
+- ``healthy``  all origins stay up for the whole crowd
+- ``killed``   one origin is torn down after the first crowd round
+               (mid-storm); its clients retry on survivors, gossip
+               walks it suspect -> down, ownership rebalances
+
+Gates (asserted by tests/test_delivery_fabric.py::test_fabric_soak_gates
+and checked here when run standalone):
+
+- zero non-503 client errors in both runs (503 is the shed plane doing
+  its job; anything else is a correctness failure);
+- exactly ONE origin disk read per object fleet-wide (the coalescing
+  proof: the owner reads each segment once, every other serve rides
+  peer fill / L1 across the whole fabric — including the killed run,
+  because the herd-warmed L1s survive the dead origin);
+- killed-run p99 bounded relative to the healthy baseline (routing
+  around the corpse, not timing out into it).
+
+Records append to BENCH_delivery.json as labeled ``fabric_soak``
+records (same shape as the serve-tier microbench records).
+
+Run it: ``python bench_delivery_soak.py --origins 3 --clients 32``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import socket
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+
+
+def _utcnow() -> str:
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _quantile(vals: list[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    ordered = sorted(vals)
+    return ordered[min(len(ordered) - 1, int(len(ordered) * q))]
+
+
+class _Fleet:
+    """N public-app origins on pre-bound sockets, ringed together."""
+
+    def __init__(self, db, video_dir: Path, n: int):
+        self.db = db
+        self.video_dir = video_dir
+        self.n = n
+        self.runners: list = []
+        self.planes: list = []
+        self.urls: list[str] = []
+        self._socks: list[socket.socket] = []
+        self._killed: set[int] = set()
+
+    async def start(self) -> None:
+        from aiohttp import web
+
+        from vlog_tpu import config
+        from vlog_tpu.api.public_api import DELIVERY, build_public_app
+
+        # bind first so every origin knows the whole ring before any
+        # app is constructed (the seed list each membership starts from)
+        for _ in range(self.n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            s.listen(128)
+            self._socks.append(s)
+            self.urls.append(f"http://127.0.0.1:{s.getsockname()[1]}")
+
+        saved = {k: getattr(config, k) for k in
+                 ("DELIVERY_PEERS", "DELIVERY_SELF_URL",
+                  "DELIVERY_GOSSIP_INTERVAL_S",
+                  "DELIVERY_GOSSIP_SUSPECT_AFTER",
+                  "DELIVERY_GOSSIP_DOWN_S")}
+        try:
+            # soak-speed gossip: one probe round ~100 ms so the killed
+            # origin is suspected/downed inside the crowd window
+            config.DELIVERY_GOSSIP_INTERVAL_S = 0.1
+            config.DELIVERY_GOSSIP_SUSPECT_AFTER = 1
+            config.DELIVERY_GOSSIP_DOWN_S = 0.3
+            for i, sock in enumerate(self._socks):
+                # the full member list INCLUDING self — that is the
+                # VLOG_DELIVERY_PEERS convention, and what makes every
+                # origin compute the same rendezvous owner per key
+                config.DELIVERY_PEERS = tuple(self.urls)
+                config.DELIVERY_SELF_URL = self.urls[i]
+                app = build_public_app(self.db,
+                                       video_dir=self.video_dir)
+                self.planes.append(app[DELIVERY])
+                runner = web.AppRunner(app)
+                await runner.setup()
+                await web.SockSite(runner, sock,
+                                   shutdown_timeout=0.25).start()
+                self.runners.append(runner)
+        finally:
+            for k, v in saved.items():
+                setattr(config, k, v)
+
+    async def kill(self, i: int) -> None:
+        """Tear one origin down hard: its sockets close, in-flight
+        requests die, probes to it start failing. Its plane object (and
+        counters) survive for the fleet-wide disk-read audit."""
+        self._killed.add(i)
+        await self.runners[i].cleanup()
+
+    async def close(self) -> None:
+        for i, r in enumerate(self.runners):
+            if i not in self._killed:
+                await r.cleanup()
+
+    def disk_reads_total(self) -> int:
+        return sum(p.counters["disk_reads"] for p in self.planes)
+
+    def ring_version_max(self) -> int:
+        return max(p.membership.version for p in self.planes)
+
+
+async def run_soak(db, video_dir: Path, slug: str, *, n_origins: int = 3,
+                   clients: int = 24, rounds: int = 3,
+                   kill_origin: bool = False) -> dict:
+    """One soak run -> one labeled record (see module docstring)."""
+    import aiohttp
+
+    rels = sorted(p.relative_to(video_dir / slug).as_posix()
+                  for p in (video_dir / slug / "360p").glob("segment_*"))
+    assert rels, f"no segments published under {slug}"
+    fleet = _Fleet(db, video_dir, n_origins)
+    await fleet.start()
+
+    latencies: list[float] = []     # post-kill window only, seconds
+    errors_non_503 = 0
+    errors_503 = 0
+    reroutes = 0
+    requests = 0
+    dead: set[str] = set()
+    lock = asyncio.Lock()
+
+    async def crowd_client(cid: int, session, round_no: int) -> None:
+        nonlocal errors_non_503, errors_503, reroutes, requests
+        rng = random.Random(cid * 1000 + round_no)
+        order = list(rels)
+        rng.shuffle(order)
+        for rel in order:
+            url = rng.choice(fleet.urls)
+            for attempt in (0, 1):
+                if url in dead:
+                    # a viewer whose edge died retries another one
+                    url = rng.choice([u for u in fleet.urls
+                                      if u not in dead])
+                t0 = time.monotonic()
+                try:
+                    async with session.get(
+                            f"{url}/videos/{slug}/{rel}") as resp:
+                        await resp.read()
+                        status = resp.status
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    if attempt == 0:
+                        async with lock:
+                            reroutes += 1
+                        dead.add(url)       # learned the hard way
+                        continue
+                    status = -1             # retried and still failed
+                dt = time.monotonic() - t0
+                async with lock:
+                    requests += 1
+                    latencies.append(dt)
+                    if status == 503:
+                        errors_503 += 1
+                    elif status != 200:
+                        errors_non_503 += 1
+                break
+
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=10.0)) as session:
+        # deterministic ramp: walk every object through every origin
+        # once, so each L1 is herd-warm before the storm (and the
+        # owners do the ONLY disk reads the whole soak is allowed)
+        for url in fleet.urls:
+            for rel in rels:
+                async with session.get(
+                        f"{url}/videos/{slug}/{rel}") as resp:
+                    assert resp.status == 200, (url, rel, resp.status)
+                    await resp.read()
+        t0 = time.monotonic()
+        for round_no in range(rounds):
+            tasks = [crowd_client(c, session, round_no)
+                     for c in range(clients)]
+            if kill_origin and round_no == 1:
+                # mid-crowd, mid-ROUND: the storm is in flight when the
+                # origin vanishes — clients learn from the connection
+                # error and retry on a survivor
+                async def killer():
+                    await asyncio.sleep(0.02)
+                    await fleet.kill(0)
+                    dead.add(fleet.urls[0])
+                await asyncio.gather(killer(), *tasks)
+            else:
+                await asyncio.gather(*tasks)
+        wall_s = time.monotonic() - t0
+        # let gossip finish walking the corpse down before the audit
+        if kill_origin:
+            await asyncio.sleep(0.5)
+    ring_version_max = fleet.ring_version_max()
+    disk_reads = fleet.disk_reads_total()
+    await fleet.close()
+
+    return {
+        "step": "fabric_soak",
+        "metric": "delivery_fabric_soak",
+        "rps": round(requests / max(wall_s, 1e-9), 1),
+        "p50_ms": round(_quantile(latencies, 0.50) * 1000.0, 2),
+        "p99_ms": round(_quantile(latencies, 0.99) * 1000.0, 2),
+        "requests": requests,
+        "errors_non_503": errors_non_503,
+        "errors_503": errors_503,
+        "reroutes": reroutes,
+        "objects": len(rels),
+        "disk_reads_total": disk_reads,
+        "ring_version_max": ring_version_max,
+        "killed_origin": kill_origin,
+        "timestamp": _utcnow(),
+        "config": {"n_origins": n_origins, "clients": clients,
+                   "rounds": rounds,
+                   "topology": ("flash crowd, one origin killed after "
+                                "round 1" if kill_origin
+                                else "flash crowd, all origins healthy")},
+    }
+
+
+def append_records(records: list[dict], path: Path | None = None) -> None:
+    """Append labeled records to BENCH_delivery.json (list-shaped; a
+    legacy single-object file is wrapped on first append)."""
+    out = path or Path(__file__).parent / "BENCH_delivery.json"
+    history: list = []
+    if out.exists():
+        try:
+            prior = json.loads(out.read_text())
+        except (ValueError, OSError):
+            prior = []
+        history = prior if isinstance(prior, list) else [prior]
+    history.extend(records)
+    out.write_text(json.dumps(history, indent=1) + "\n")
+
+
+async def _main_async(args: argparse.Namespace) -> list[dict]:
+    import tempfile
+
+    from vlog_tpu.db import Database, create_all
+    from vlog_tpu.jobs import videos as vids
+    from vlog_tpu.storage import integrity
+
+    with tempfile.TemporaryDirectory(prefix="vlog-soak-") as tmp:
+        tmp_path = Path(tmp)
+        db = Database(f"sqlite:///{tmp_path}/soak.db")
+        await db.connect()
+        await create_all(db)
+        try:
+            v = await vids.create_video(db, "Soak Clip")
+            root = tmp_path / "videos" / v["slug"]
+            (root / "360p").mkdir(parents=True)
+            (root / "master.m3u8").write_text("#EXTM3U\n# master\n")
+            rng = random.Random(17)
+            for i in range(1, args.segments + 1):
+                body = bytes(rng.randrange(256)
+                             for _ in range(args.segment_bytes))
+                (root / "360p" / f"segment_{i:05d}.m4s").write_bytes(body)
+            integrity.write_manifest(root, integrity.build_manifest(root))
+            await db.execute(
+                "UPDATE videos SET status='ready' WHERE id=:i",
+                {"i": v["id"]})
+
+            healthy = await run_soak(
+                db, tmp_path / "videos", v["slug"],
+                n_origins=args.origins, clients=args.clients,
+                rounds=args.rounds)
+            killed = await run_soak(
+                db, tmp_path / "videos", v["slug"],
+                n_origins=args.origins, clients=args.clients,
+                rounds=args.rounds, kill_origin=True)
+        finally:
+            await db.disconnect()
+
+    failures = []
+    for rec in (healthy, killed):
+        if rec["errors_non_503"]:
+            failures.append(f"{rec['config']['topology']}: "
+                            f"{rec['errors_non_503']} non-503 errors")
+        if rec["disk_reads_total"] != rec["objects"]:
+            failures.append(f"{rec['config']['topology']}: "
+                            f"{rec['disk_reads_total']} disk reads for "
+                            f"{rec['objects']} objects")
+    if killed["p99_ms"] > max(10.0 * healthy["p99_ms"], 1000.0):
+        failures.append(f"killed-run p99 {killed['p99_ms']}ms vs healthy "
+                        f"{healthy['p99_ms']}ms")
+    for f in failures:
+        print(f"GATE FAILED: {f}")
+    if failures:
+        raise SystemExit(1)
+    return [healthy, killed]
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(
+        description="delivery-fabric flash-crowd soak (one origin killed)")
+    parser.add_argument("--origins", type=int, default=3)
+    parser.add_argument("--clients", type=int, default=32)
+    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--segments", type=int, default=8)
+    parser.add_argument("--segment-bytes", type=int, default=64 * 1024)
+    parser.add_argument("--out", default=None,
+                        help="records file (default BENCH_delivery.json)")
+    args = parser.parse_args(argv)
+    records = asyncio.run(_main_async(args))
+    for r in records:
+        print(json.dumps(r))
+    append_records(records,
+                   path=Path(args.out) if args.out else None)
+
+
+if __name__ == "__main__":
+    main()

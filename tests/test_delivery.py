@@ -781,7 +781,13 @@ class TestDeliveryAgreement:
              "VLOG_DELIVERY_L2_BYTES", "VLOG_DELIVERY_L2_DIR",
              "VLOG_DELIVERY_PEERS", "VLOG_DELIVERY_SELF_URL",
              "VLOG_DELIVERY_PEER_TIMEOUT", "VLOG_DELIVERY_PREWARM_SEGMENTS",
-             "VLOG_DELIVERY_SENDFILE_BYTES")
+             "VLOG_DELIVERY_SENDFILE_BYTES",
+             "VLOG_DELIVERY_PEER_COOLDOWN_S",
+             "VLOG_DELIVERY_GOSSIP_INTERVAL", "VLOG_DELIVERY_GOSSIP_JITTER",
+             "VLOG_DELIVERY_GOSSIP_SUSPECT_AFTER",
+             "VLOG_DELIVERY_GOSSIP_DOWN", "VLOG_DELIVERY_GOSSIP_QUARANTINE",
+             "VLOG_DELIVERY_HEDGE_MS", "VLOG_DELIVERY_HEAT_HALFLIFE",
+             "VLOG_DELIVERY_L2_ADMIT_HEAT", "VLOG_DELIVERY_L2_HOT_HEAT")
     METRICS = ("vlog_delivery_requests_total", "vlog_delivery_bytes_total",
                "vlog_delivery_evictions_total",
                "vlog_delivery_collapses_total", "vlog_delivery_cache_bytes",
@@ -789,8 +795,14 @@ class TestDeliveryAgreement:
                "vlog_delivery_l2_requests_total", "vlog_delivery_l2_bytes",
                "vlog_delivery_l2_evictions_total",
                "vlog_delivery_peer_fills_total",
-               "vlog_delivery_prewarm_total")
-    SITES = ("delivery.read", "delivery.shed", "delivery.peer")
+               "vlog_delivery_prewarm_total",
+               "vlog_delivery_fill_seconds", "vlog_delivery_hedges_total",
+               "vlog_delivery_coalesced_fills_total",
+               "vlog_delivery_gossip_probes_total",
+               "vlog_delivery_ring_version",
+               "vlog_delivery_l2_rescues_total")
+    SITES = ("delivery.read", "delivery.shed", "delivery.peer",
+             "delivery.gossip", "delivery.hedge")
 
     def test_knobs_parsed_and_documented(self):
         from vlog_tpu.analysis import registry as reg

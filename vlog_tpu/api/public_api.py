@@ -403,8 +403,13 @@ async def serve_media(request: web.Request) -> web.StreamResponse:
     # from another origin: answer from local tiers only, never re-enter
     # the ring (a misconfigured ring must not chase ownership in a loop)
     allow_peer = delivery.PEER_FILL_HEADER not in request.headers
+    # the fill-token correlates this request with a fill already in
+    # flight fleet-wide: passing it through lets the plane count the
+    # coalesce (flash crowd -> one origin disk read)
+    fill_token = request.headers.get(delivery.FILL_TOKEN_HEADER)
     try:
-        got = await plane.fetch(slug, tail, allow_peer=allow_peer)
+        got = await plane.fetch(slug, tail, allow_peer=allow_peer,
+                                fill_token=fill_token)
     except delivery.LoadShedError as exc:
         resp = _media_error(503, "origin overloaded, retry shortly")
         resp.headers["Retry-After"] = str(exc.retry_after_s)
@@ -417,6 +422,21 @@ async def serve_media(request: web.Request) -> web.StreamResponse:
     # L2 hits) streams zero-copy — one state machine for both, so all
     # four serve paths emit identical validators and bytes
     return delivery_http.entry_response(request, got)
+
+
+async def delivery_gossip(request: web.Request) -> web.Response:
+    """The gossip heartbeat endpoint: answering 200 from the same app
+    that serves media makes 'the heartbeat answers' and 'the origin can
+    serve' one fact. The response is this origin's membership snapshot
+    (version + per-peer state), which the prober merges; the sender
+    header marks the caller alive here in the same exchange."""
+    from vlog_tpu import delivery
+
+    plane: delivery.DeliveryPlane = request.app[DELIVERY]
+    sender = request.headers.get(delivery.GOSSIP_FROM_HEADER)
+    if sender:
+        plane.membership.heard_from(sender)
+    return web.json_response(plane.membership.snapshot())
 
 
 async def metrics_endpoint(request: web.Request) -> web.Response:
@@ -464,9 +484,13 @@ def build_public_app(db: Database, *, video_dir: Path | None = None
     app[DELIVERY] = DeliveryPlane(db, app[VIDEO_DIR])
     app[SETTINGS_SVC] = SettingsService(db)
 
+    async def _start_gossip(app: web.Application) -> None:
+        app[DELIVERY].start_gossip()
+
     async def _close_delivery(app: web.Application) -> None:
         await app[DELIVERY].close()
 
+    app.on_startup.append(_start_gossip)
     app.on_cleanup.append(_close_delivery)
     r = app.router
     r.add_get("/api/videos", list_videos)
@@ -484,6 +508,7 @@ def build_public_app(db: Database, *, video_dir: Path | None = None
     r.add_post("/api/sessions/end", end_session)
     r.add_get("/videos/{slug}/{tail:.+}", serve_media)   # GET + HEAD
     r.add_route("OPTIONS", "/videos/{slug}/{tail:.+}", media_preflight)
+    r.add_get("/api/delivery/gossip", delivery_gossip)
     r.add_get("/metrics", metrics_endpoint)
     r.add_get("/healthz", healthz)
     from vlog_tpu.web import attach_ui

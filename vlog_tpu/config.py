@@ -430,6 +430,61 @@ DELIVERY_PREWARM_SEGMENTS: int = _env_int(
 # promote to L1 as usual.
 DELIVERY_SENDFILE_BYTES: int = _env_int(
     "VLOG_DELIVERY_SENDFILE_BYTES", 8 * 1024**2, lo=1)
+# How long a peer that failed a fill (transport error or non-503
+# status) sits out before fills route to it again. A 503 shed with a
+# Retry-After header overrides this with the peer's own number.
+DELIVERY_PEER_COOLDOWN_S: float = _env_float(
+    "VLOG_DELIVERY_PEER_COOLDOWN_S", 5.0, lo=0.0)
+
+# ---- self-healing fabric (gossip membership + hedged fills + heat) -------
+
+# Mean seconds between gossip heartbeat rounds (each round probes every
+# known peer over GET /api/delivery/gossip). 0 disables the probe loop:
+# membership then moves only on fill failures/successes.
+DELIVERY_GOSSIP_INTERVAL_S: float = _env_float(
+    "VLOG_DELIVERY_GOSSIP_INTERVAL", 1.0, lo=0.0)
+# Probe-interval jitter as a fraction of the interval (bounded to
+# [interval*(1-j), interval*(1+j)]) so N origins never probe in
+# lockstep and suspect windows desynchronize across the fleet.
+DELIVERY_GOSSIP_JITTER: float = _env_float(
+    "VLOG_DELIVERY_GOSSIP_JITTER", 0.25, lo=0.0, hi=0.9)
+# Consecutive transport/timeout failures (probe or fill) before an
+# alive peer turns suspect. Suspects keep their ring ownership but
+# fills route around them immediately.
+DELIVERY_GOSSIP_SUSPECT_AFTER: int = _env_int(
+    "VLOG_DELIVERY_GOSSIP_SUSPECT_AFTER", 2, lo=1)
+# A suspect silent this long goes down: it leaves the ownership set and
+# the ring version bumps, so rendezvous routing rebalances its keys.
+# One successful heartbeat rejoins it.
+DELIVERY_GOSSIP_DOWN_S: float = _env_float(
+    "VLOG_DELIVERY_GOSSIP_DOWN", 3.0, lo=0.0)
+# How long a digest-liar peer (served bytes failing the manifest sha256
+# check) is quarantined out of the ownership set, regardless of
+# reachability.
+DELIVERY_GOSSIP_QUARANTINE_S: float = _env_float(
+    "VLOG_DELIVERY_GOSSIP_QUARANTINE", 60.0, lo=0.0)
+# Latency budget before a miss routed to the owner launches a hedge
+# fill to the next-ranked peer (first digest-valid response wins, the
+# loser is cancelled). Once enough fill samples accumulate the budget
+# adapts to the observed p95 fill latency, clamped to [this/4, 4*this].
+# 0 disables hedging.
+DELIVERY_HEDGE_MS: float = _env_float(
+    "VLOG_DELIVERY_HEDGE_MS", 250.0, lo=0.0)
+# Half-life (seconds) of the per-slug exponential heat decay behind
+# popularity-aware L2 admission. Heat rises by 1 per request to the
+# slug and halves every this-many seconds.
+DELIVERY_HEAT_HALFLIFE_S: float = _env_float(
+    "VLOG_DELIVERY_HEAT_HALFLIFE", 300.0, lo=1.0)
+# Minimum slug heat for a body to be admitted into the disk L2
+# (one-hit-wonders bypass the spill). 0 admits everything — the
+# pre-fabric behavior.
+DELIVERY_L2_ADMIT_HEAT: float = _env_float(
+    "VLOG_DELIVERY_L2_ADMIT_HEAT", 0.0, lo=0.0)
+# Slugs at or above this heat resist L2 eviction: the sweep gives their
+# entries a second chance (bounded) and evicts colder bytes first.
+# 0 keeps pure LRU eviction.
+DELIVERY_L2_HOT_HEAT: float = _env_float(
+    "VLOG_DELIVERY_L2_HOT_HEAT", 0.0, lo=0.0)
 
 # --------------------------------------------------------------------------
 # Transcription (reference: config.py:263-267)

@@ -19,8 +19,15 @@ from vlog_tpu.delivery.cache import (
     SegmentCache,
     SingleFlight,
 )
+from vlog_tpu.delivery.gossip import (
+    GOSSIP_FROM_HEADER,
+    Membership,
+    probe_loop,
+    probe_once,
+)
 from vlog_tpu.delivery.l2 import DiskL2
 from vlog_tpu.delivery.plane import (
+    FILL_TOKEN_HEADER,
     PEER_FILL_HEADER,
     DeliveryPlane,
     LoadShedError,
@@ -40,9 +47,12 @@ __all__ = [
     "CacheEntry",
     "DeliveryPlane",
     "DiskL2",
+    "FILL_TOKEN_HEADER",
     "FileEntry",
+    "GOSSIP_FROM_HEADER",
     "LoadShedError",
     "MediaEscapeError",
+    "Membership",
     "PEER_FILL_HEADER",
     "PeerFillError",
     "Ring",
@@ -53,6 +63,8 @@ __all__ = [
     "invalidate_all",
     "invalidate_slug",
     "prewarm_slug",
+    "probe_loop",
+    "probe_once",
     "register",
     "stats_snapshot",
 ]

@@ -172,6 +172,11 @@ class SingleFlight:
     def inflight(self) -> int:
         return len(self._inflight)
 
+    def pending(self, key: Key) -> bool:
+        """Whether a fill for ``key`` is in flight right now — a caller
+        about to ``run`` this key would collapse onto it."""
+        return key in self._inflight
+
     async def run(self, key: Key, factory: Callable[[], Awaitable]):
         task = self._inflight.get(key)
         if task is not None:

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import time
 from email.utils import formatdate, parsedate_to_datetime
 from stat import S_ISREG
 
@@ -150,6 +151,28 @@ def _unmodified_since(header: str | None, entry: Entry) -> bool:
     except (TypeError, ValueError):
         return False
     return int(entry.mtime) <= cut
+
+
+def parse_retry_after(value: str | None) -> float | None:
+    """``Retry-After`` -> seconds, or None when absent/garbled.
+
+    Accepts both RFC 9110 forms: delta-seconds and an HTTP-date (the
+    date form converts to a from-now delta, floored at 0). The peer-fill
+    path uses this to honor a shedding owner's own backoff hint as the
+    cooldown instead of the flat configured one.
+    """
+    if not value:
+        return None
+    value = value.strip()
+    try:
+        return max(0.0, float(int(value)))
+    except ValueError:
+        pass
+    try:
+        return max(0.0, parsedate_to_datetime(value).timestamp()
+                   - time.time())
+    except (TypeError, ValueError):
+        return None
 
 
 def _if_range_allows(header: str | None, entry: Entry) -> bool:

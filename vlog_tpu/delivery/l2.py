@@ -50,22 +50,41 @@ def _is_digest(name: str) -> bool:
 
 
 class DiskL2:
-    """Byte-bounded digest-named disk store with LRU eviction."""
+    """Byte-bounded digest-named disk store with LRU eviction.
+
+    Optionally popularity-aware: callers may stamp each ``put`` with the
+    owning slug's *heat* (the plane's exponentially-decayed per-slug
+    request rate). With ``admit_heat`` set, bodies below the threshold
+    bypass the spill entirely (a one-hit-wonder should not push a
+    herd-warmed segment off disk); with ``hot_heat`` set, the eviction
+    sweep gives entries at or above it a bounded second chance — their
+    heat halves and they move to the MRU end, so colder bytes go first.
+    Both default to 0 (off): pure LRU, the pre-fabric behavior.
+    """
 
     def __init__(self, root: str | Path, max_bytes: int, *,
-                 on_evict: Callable[[int], None] | None = None) -> None:
+                 on_evict: Callable[[int], None] | None = None,
+                 on_rescue: Callable[[int], None] | None = None,
+                 admit_heat: float = 0.0,
+                 hot_heat: float = 0.0) -> None:
         self.root = Path(root)
         self.max_bytes = int(max_bytes)
         self._on_evict = on_evict
+        self._on_rescue = on_rescue
+        self.admit_heat = float(admit_heat)
+        self.hot_heat = float(hot_heat)
         self._lock = threading.Lock()             # lock-order: 54
         # guarded-by: _lock
         self._index: OrderedDict[str, int] = OrderedDict()  # digest -> size
+        # guarded-by: _lock
+        self._heat: dict[str, float] = {}   # digest -> heat at last put
         # guarded-by: _lock
         self._bytes = 0
         # guarded-by: _lock
         self.counters = {
             "hits": 0, "misses": 0, "corrupt": 0,
             "stores": 0, "evictions": 0,
+            "rescues": 0, "admit_skips": 0,
         }
         if self.enabled:
             self.root.mkdir(parents=True, exist_ok=True)
@@ -151,15 +170,21 @@ class DiskL2:
         self._bump("hits")
         return "hit", body, st.st_mtime
 
-    def put(self, digest: str, body: bytes, mtime: float) -> bool:
+    def put(self, digest: str, body: bytes, mtime: float, *,
+            heat: float = 0.0) -> bool:
         """Store verified bytes under their digest; no-op when already
-        present or when the object alone exceeds the byte budget.
+        present, when the object alone exceeds the byte budget, or when
+        ``admit_heat`` is set and the slug's heat falls below it.
         Atomic: temp write + rename, so readers never see a torn file."""
         if not self.enabled or len(body) > self.max_bytes:
+            return False
+        if self.admit_heat > 0.0 and heat < self.admit_heat:
+            self._bump("admit_skips")
             return False
         with self._lock:
             if digest in self._index:
                 self._index.move_to_end(digest)
+                self._heat[digest] = max(self._heat.get(digest, 0.0), heat)
                 return False
         path = self.path_for(digest)
         tmp = path.parent / f"{_TMP_PREFIX}{digest[:16]}-{os.getpid()}"
@@ -177,8 +202,10 @@ class DiskL2:
         with self._lock:
             if digest in self._index:       # racing writer beat us
                 self._index.move_to_end(digest)
+                self._heat[digest] = max(self._heat.get(digest, 0.0), heat)
                 return False
             self._index[digest] = len(body)
+            self._heat[digest] = heat
             self._bytes += len(body)
             self.counters["stores"] += 1
             victims = self._evict_over_budget_locked()
@@ -187,10 +214,27 @@ class DiskL2:
 
     def _evict_over_budget_locked(self) -> list[str]:
         """LRU-evict index entries until under budget; returns the digests
-        whose files the caller must unlink (outside the lock)."""
+        whose files the caller must unlink (outside the lock).
+
+        With ``hot_heat`` set, an LRU-front entry at or above it gets a
+        second chance instead: its heat halves and it moves to the MRU
+        end. Rescues are bounded to one per entry per sweep (and the
+        halving converges regardless), so the sweep always terminates.
+        """
         victims: list[str] = []
+        rescues_left = len(self._index) if self.hot_heat > 0.0 else 0
         while self._bytes > self.max_bytes and self._index:
             digest, size = self._index.popitem(last=False)
+            heat = self._heat.get(digest, 0.0)
+            if rescues_left > 0 and heat >= self.hot_heat:
+                rescues_left -= 1
+                self._heat[digest] = heat / 2.0
+                self._index[digest] = size      # reinsert at MRU end
+                self.counters["rescues"] += 1
+                if self._on_rescue is not None:
+                    self._on_rescue(1)
+                continue
+            self._heat.pop(digest, None)
             self._bytes -= size
             self.counters["evictions"] += 1
             victims.append(digest)
@@ -205,6 +249,7 @@ class DiskL2:
     def _drop(self, digest: str) -> None:
         with self._lock:
             size = self._index.pop(digest, None)
+            self._heat.pop(digest, None)
             if size is not None:
                 self._bytes -= size
 
@@ -219,6 +264,7 @@ class DiskL2:
         with self._lock:
             victims = list(self._index)
             self._index.clear()
+            self._heat.clear()
             self._bytes = 0
         for digest in victims:
             self.path_for(digest).unlink(missing_ok=True)

@@ -35,6 +35,30 @@ Below and beside the RAM LRU sits the **distributed tier**:
   loop-guarded by the ``X-Vlog-Peer-Fill`` header) before falling back
   to local disk, so the fleet converges on one hot set instead of N.
   A failing peer gets a short cooldown and fills degrade to local.
+
+On top of the static ring sits the **self-healing fabric**:
+
+- **gossip membership** (delivery/gossip.py): the peer set is seeded
+  from ``VLOG_DELIVERY_PEERS`` but no longer frozen by it — jittered
+  heartbeat probes walk each peer through alive -> suspect -> down ->
+  rejoin, the ring rebuilds from the live view on every version bump,
+  and a digest-liar peer is quarantined out of ownership entirely;
+- **hedged fills**: a miss routed to the owner launches a hedge to the
+  next-ranked healthy peer once the primary overruns the hedge budget
+  (``VLOG_DELIVERY_HEDGE_MS``, p95-adaptive from the fill-latency
+  reservoir); the first digest-valid response wins, the loser is
+  cancelled before it can cache anything;
+- **coalesced fills**: peer fetches carry a fill-token header
+  (``X-Vlog-Fill-Token``); a tokened request landing on an origin with
+  the same object's fill already in flight collapses onto it, so a
+  fleet-wide flash crowd produces one origin disk read;
+- **failure classification**: peer-fill failures split into transport /
+  timeout / status / digest. Only transport and timeout feed gossip
+  suspicion; a 503 shed honors the peer's own ``Retry-After`` as the
+  cooldown; a digest mismatch quarantines the liar;
+- **popularity-aware L2**: per-slug exponentially-decayed heat gates
+  disk-L2 admission and grants hot entries second-chance eviction
+  (delivery/l2.py), so a herd-warmed working set survives the crowd.
 - **publish-time prewarm**: ``finalize_ready`` schedules
   :meth:`DeliveryPlane.prewarm_slug`, pulling every init segment plus
   the first ``VLOG_DELIVERY_PREWARM_SEGMENTS`` media segments of each
@@ -61,6 +85,7 @@ import stat as stat_mod
 import threading
 import time
 import weakref
+from collections import deque
 from dataclasses import dataclass
 from email.utils import parsedate_to_datetime
 from pathlib import Path
@@ -68,9 +93,12 @@ from pathlib import Path
 import aiohttp
 
 from vlog_tpu import config
+from vlog_tpu.delivery import gossip
 from vlog_tpu.delivery.cache import CacheEntry, FileEntry, SegmentCache, \
     SingleFlight
-from vlog_tpu.delivery.http import MEDIA_MIME, MUTABLE_SUFFIXES
+from vlog_tpu.delivery.gossip import Membership
+from vlog_tpu.delivery.http import MEDIA_MIME, MUTABLE_SUFFIXES, \
+    parse_retry_after
 from vlog_tpu.delivery.l2 import DiskL2
 from vlog_tpu.delivery.ring import Ring
 from vlog_tpu.obs.metrics import runtime
@@ -85,14 +113,24 @@ _STATE_CACHE_MAX = 16384
 # published file); bound them so a long-lived process serving a huge
 # catalog doesn't accumulate one map per slug ever touched.
 _DIGEST_CACHE_MAX = 2048
-# How long a failed peer sits out before the next fill retries it.
-_PEER_COOLDOWN_S = 5.0
 # Requests carrying this header are peer fills from another origin:
 # they must answer from local tiers only (never re-enter the ring), or
 # a misconfigured ring could chase ownership in a cycle.
 PEER_FILL_HEADER = "X-Vlog-Peer-Fill"
+# Cross-origin fill-correlation token: peer fetches carry the object
+# digest here, so an origin that already has the same fill in flight
+# coalesces the request onto it (counted) instead of starting another —
+# the flash-crowd one-disk-read-fleet-wide mechanism.
+FILL_TOKEN_HEADER = "X-Vlog-Fill-Token"
 # Media-segment suffixes the prewarm pass considers (CMAF + TS).
 _SEGMENT_SUFFIXES = (".m4s", ".ts")
+# Per-slug heat records are two floats; bound the map so a random-slug
+# 404 storm cannot grow it without limit.
+_HEAT_MAX = 4096
+# Fill-latency reservoir feeding the p95-adaptive hedge budget: sample
+# count kept, and the minimum before adaptivity kicks in.
+_FILL_SAMPLES = 256
+_FILL_SAMPLE_MIN = 32
 
 
 class LoadShedError(RuntimeError):
@@ -135,7 +173,13 @@ class DeliveryPlane:
                  self_url: str | None = None,
                  peer_timeout_s: float | None = None,
                  prewarm_segments: int | None = None,
-                 sendfile_bytes: int | None = None):
+                 sendfile_bytes: int | None = None,
+                 peer_cooldown_s: float | None = None,
+                 hedge_ms: float | None = None,
+                 gossip_interval_s: float | None = None,
+                 heat_halflife_s: float | None = None,
+                 l2_admit_heat: float | None = None,
+                 l2_hot_heat: float | None = None):
         self.db = db
         self.video_dir = Path(video_dir)
         self.max_inflight_reads = (config.DELIVERY_MAX_INFLIGHT_READS
@@ -157,6 +201,17 @@ class DeliveryPlane:
                                  else prewarm_segments)
         self.sendfile_bytes = (config.DELIVERY_SENDFILE_BYTES
                                if sendfile_bytes is None else sendfile_bytes)
+        self.peer_cooldown_s = (config.DELIVERY_PEER_COOLDOWN_S
+                                if peer_cooldown_s is None
+                                else peer_cooldown_s)
+        self.hedge_ms = (config.DELIVERY_HEDGE_MS
+                         if hedge_ms is None else hedge_ms)
+        self.gossip_interval_s = (config.DELIVERY_GOSSIP_INTERVAL_S
+                                  if gossip_interval_s is None
+                                  else gossip_interval_s)
+        self.heat_halflife_s = (config.DELIVERY_HEAT_HALFLIFE_S
+                                if heat_halflife_s is None
+                                else heat_halflife_s)
         m = runtime()
         self.cache = SegmentCache(
             config.DELIVERY_CACHE_BYTES if cache_bytes is None
@@ -167,10 +222,23 @@ class DeliveryPlane:
         self.l2 = DiskL2(
             config.DELIVERY_L2_DIR if l2_dir is None else l2_dir,
             config.DELIVERY_L2_BYTES if l2_bytes is None else l2_bytes,
-            on_evict=lambda _n: runtime().delivery_l2_evictions.inc())
-        self.ring = Ring(
-            config.DELIVERY_PEERS if peers is None else peers,
-            config.DELIVERY_SELF_URL if self_url is None else self_url)
+            on_evict=lambda _n: runtime().delivery_l2_evictions.inc(),
+            on_rescue=lambda n: runtime().delivery_l2_rescues.inc(n),
+            admit_heat=(config.DELIVERY_L2_ADMIT_HEAT
+                        if l2_admit_heat is None else l2_admit_heat),
+            hot_heat=(config.DELIVERY_L2_HOT_HEAT
+                      if l2_hot_heat is None else l2_hot_heat))
+        peer_list = config.DELIVERY_PEERS if peers is None else peers
+        own_url = config.DELIVERY_SELF_URL if self_url is None else self_url
+        self.ring = Ring(peer_list, own_url)
+        # gossip membership: the live view behind the ring. Seeded from
+        # the same peer list, but transitions (death, quarantine, join,
+        # rejoin) bump its version and _current_ring rebuilds.
+        self.membership = Membership(
+            peer_list, own_url,
+            suspect_after=config.DELIVERY_GOSSIP_SUSPECT_AFTER,
+            down_after_s=config.DELIVERY_GOSSIP_DOWN_S,
+            quarantine_s=config.DELIVERY_GOSSIP_QUARANTINE_S)
         # loop-confined: _states/_fill_gen/_inflight_reads/_peer_down/
         # _tasks/_http are only touched from event-loop coroutines,
         # never from fill threads
@@ -178,6 +246,9 @@ class DeliveryPlane:
         self._peer_down: dict[str, float] = {}      # peer -> retry-at
         self._tasks: set[asyncio.Task] = set()      # spills + prewarms
         self._http: aiohttp.ClientSession | None = None
+        # fill-latency reservoir (seconds) behind the p95-adaptive
+        # hedge budget; appended on the loop after each fill
+        self._fill_times: deque[float] = deque(maxlen=_FILL_SAMPLES)
         # slug -> (outputs.json mtime_ns | None, {rel: (size, sha256)})
         # — read AND refreshed inside fill workers running in
         # asyncio.to_thread: concurrent fills for two slugs would
@@ -203,7 +274,13 @@ class DeliveryPlane:
             "state_stale": 0, "invalidations": 0,
             "peer_fills": 0, "peer_errors": 0, "sendfile": 0,
             "prewarm_runs": 0, "prewarm_segments": 0, "prewarm_errors": 0,
+            "hedges": 0, "hedge_wins": 0, "coalesced_fills": 0,
+            "peer_quarantines": 0,
         }
+        # per-slug (heat, last-touch) — bumped on the event loop per
+        # request, read from to_thread spill workers at admission time
+        # guarded-by: _counter_lock
+        self._heat: dict[str, tuple[float, float]] = {}
         register(self)
 
     def _bump(self, key: str, n: int = 1) -> None:
@@ -252,12 +329,18 @@ class DeliveryPlane:
 
     # -- segment fetch -----------------------------------------------------
 
-    async def fetch(self, slug: str, rel: str, *, allow_peer: bool = True
+    async def fetch(self, slug: str, rel: str, *, allow_peer: bool = True,
+                    fill_token: str | None = None
                     ) -> CacheEntry | FileEntry:
         """The media body for ``slug/rel`` — L1, then L2, then the ring
-        owner, then local disk, via single-flight under the admission
-        bound. ``allow_peer=False`` (requests already carrying the
-        peer-fill header) answers from local tiers only.
+        owner (hedged), then local disk, via single-flight under the
+        admission bound. ``allow_peer=False`` (requests already carrying
+        the peer-fill header) answers from local tiers only.
+
+        ``fill_token`` is the cross-origin fill-correlation token
+        (:data:`FILL_TOKEN_HEADER`): a tokened request that lands while
+        the same object's fill is already in flight here coalesces onto
+        it and is counted — the flash-crowd one-disk-read proof.
 
         Raises FileNotFoundError (404), :class:`MediaEscapeError`
         (symlink traversal, also a 404 — don't leak tree shape),
@@ -265,6 +348,7 @@ class DeliveryPlane:
         ``delivery.read`` failpoint error (the fill fails, nothing is
         cached, the next request retries).
         """
+        self._touch_heat(slug)
         entry = self.cache.get((slug, rel))
         if entry is not None:
             self._bump("hits")
@@ -272,10 +356,15 @@ class DeliveryPlane:
             m.delivery_requests.labels("hit").inc()
             m.delivery_bytes.labels("cache").inc(entry.size)
             return entry
+        if fill_token is not None and self.flight.pending((slug, rel)):
+            self._bump("coalesced_fills")
+            runtime().delivery_coalesced_fills.inc()
         return await self.flight.run(
-            (slug, rel), lambda: self._fill(slug, rel, allow_peer))
+            (slug, rel),
+            lambda: self._fill(slug, rel, allow_peer, fill_token))
 
-    async def _fill(self, slug: str, rel: str, allow_peer: bool
+    async def _fill(self, slug: str, rel: str, allow_peer: bool,
+                    fill_token: str | None = None
                     ) -> CacheEntry | FileEntry:
         # a just-finished leader may have filled it while we queued
         entry = self.cache.get((slug, rel))
@@ -299,6 +388,7 @@ class DeliveryPlane:
         m.delivery_inflight_reads.set(self._inflight_reads)
         gen = self._fill_gen
         source = "disk"
+        t0 = time.monotonic()
         try:
             got: CacheEntry | FileEntry | None = None
             kind, meta = await asyncio.to_thread(self._pre_fill, slug, rel)
@@ -320,7 +410,11 @@ class DeliveryPlane:
                     m.delivery_l2_requests.labels(kind).inc()
                 if meta is not None and allow_peer:
                     digest, _size = meta
-                    got = await self._peer_fetch(slug, rel, digest)
+                    if fill_token is None:
+                        got = await self._peer_fetch(slug, rel, digest)
+                    else:
+                        got = await self._peer_fetch(slug, rel, digest,
+                                                     fill_token)
                     if got is not None:
                         source = "peer"
                         m.delivery_bytes.labels("peer").inc(got.size)
@@ -334,6 +428,17 @@ class DeliveryPlane:
         finally:
             self._inflight_reads -= 1
             m.delivery_inflight_reads.set(self._inflight_reads)
+        # feed the latency reservoir behind the p95-adaptive hedge
+        # budget (and the fill histogram) with the winning source
+        dt = time.monotonic() - t0
+        self._fill_times.append(dt)
+        if source in ("l2", "peer"):
+            fill_label = source
+        elif isinstance(got, FileEntry):
+            fill_label = "bypass"
+        else:
+            fill_label = "disk"
+        m.delivery_fill_seconds.labels(fill_label).observe(dt)
         if source == "l2":
             m.delivery_requests.labels("l2_hit").inc()
         elif source == "peer":
@@ -356,57 +461,270 @@ class DeliveryPlane:
 
     # -- peer fill (event loop: aiohttp client) ----------------------------
 
-    async def _peer_fetch(self, slug: str, rel: str, digest: str
+    def _current_ring(self) -> Ring:
+        """The live rendezvous view. Rebuilt from gossip membership only
+        when the membership version has moved past the ring's — a ring
+        installed directly (tests, static deployments with gossip off)
+        keeps version 0 on both sides and is never clobbered."""
+        mv = self.membership.version
+        if mv and mv != self.ring.version:
+            self.ring = self.membership.ring()
+            runtime().delivery_ring_version.set(self.ring.version)
+        return self.ring
+
+    def _hedge_delay_s(self) -> float | None:
+        """The hedge launch budget: ``hedge_ms`` until enough fill
+        samples accumulate, then the observed p95 clamped to
+        [hedge_ms/4, hedge_ms*4]. None disables hedging."""
+        if self.hedge_ms <= 0:
+            return None
+        base = self.hedge_ms / 1000.0
+        if len(self._fill_times) < _FILL_SAMPLE_MIN:
+            return base
+        ordered = sorted(self._fill_times)
+        p95 = ordered[min(len(ordered) - 1, int(len(ordered) * 0.95))]
+        return min(max(p95, base / 4.0), base * 4.0)
+
+    async def _peer_fetch(self, slug: str, rel: str, digest: str,
+                          fill_token: str | None = None
                           ) -> CacheEntry | None:
-        """Fetch one digest-known object from its ring owner; None means
-        'fall back to local fill' (not-owner-here, cooldown, transport
-        error, bad status, digest mismatch)."""
+        """Fetch one digest-known object from the ring, hedged; None
+        means 'fall back to local fill' (owner-is-us, no healthy
+        candidate, or every contacted peer failed).
+
+        Candidates are the rendezvous-ranked healthy peers for the key:
+        the owner first, then the peer a hedge should try. Peers in
+        cooldown or gossip-unhealthy (suspect/down/quarantined) are
+        skipped outright — that is the routed-around-within-one-
+        suspect-window guarantee."""
         key = f"{slug}/{rel}"
-        if self.ring.is_local(key):
+        ring = self._current_ring()
+        if ring.is_local(key):
             return None
-        owner = self.ring.owner(key)
-        assert owner is not None
         now = time.monotonic()
-        if self._peer_down.get(owner, 0.0) > now:
+        candidates: list[str] = []
+        for peer in ring.ranked(key):
+            if peer == ring.self_url:
+                continue
+            if self._peer_down.get(peer, 0.0) > now:
+                continue
+            state = self.membership.state_of(peer)
+            if state is not None and state != gossip.ALIVE:
+                continue
+            candidates.append(peer)
+            if len(candidates) == 2:
+                break
+        if not candidates:
             return None
+        delay_s = self._hedge_delay_s()
+        if delay_s is None or len(candidates) < 2:
+            return await self._peer_fetch_one(slug, rel, digest,
+                                              candidates[0], fill_token)
+        return await self._peer_fetch_hedged(slug, rel, digest,
+                                             candidates, delay_s,
+                                             fill_token)
+
+    async def _peer_fetch_hedged(self, slug: str, rel: str, digest: str,
+                                 candidates: list[str], delay_s: float,
+                                 fill_token: str | None
+                                 ) -> CacheEntry | None:
+        """Primary fetch to ``candidates[0]``; once it overruns the
+        hedge budget, a hedge to ``candidates[1]``. First digest-valid
+        response wins; the loser is cancelled (and can never cache —
+        entries only exist after the full body verified)."""
+        m = runtime()
+        primary = asyncio.create_task(
+            self._peer_fetch_one(slug, rel, digest, candidates[0],
+                                 fill_token),
+            name="vlog-peer-fill")
+        hedge: asyncio.Task | None = None
+        try:
+            done, _ = await asyncio.wait({primary}, timeout=delay_s)
+            if primary in done:
+                entry = primary.result()
+                if entry is not None:
+                    return entry
+                # primary failed *fast* — immediate failover to the
+                # next-ranked peer (the budget never elapsed, so this
+                # is not counted as a hedge)
+                return await self._peer_fetch_one(
+                    slug, rel, digest, candidates[1], fill_token)
+            self._bump("hedges")
+            m.delivery_hedges.labels("launched").inc()
+            hedge = asyncio.create_task(
+                self._peer_fetch_one(slug, rel, digest, candidates[1],
+                                     fill_token),
+                name="vlog-peer-hedge")
+            pending: set[asyncio.Task] = {primary, hedge}
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for task in done:
+                    entry = task.result()  # fetch-one never raises
+                    if entry is None:
+                        continue
+                    if task is hedge:
+                        self._bump("hedge_wins")
+                        m.delivery_hedges.labels("win").inc()
+                    else:
+                        m.delivery_hedges.labels("primary_win").inc()
+                    return entry
+            return None
+        finally:
+            losers = [t for t in (primary, hedge) if t is not None]
+            for task in losers:
+                if not task.done():
+                    task.cancel()
+            await asyncio.gather(*losers, return_exceptions=True)
+
+    async def _peer_fetch_one(self, slug: str, rel: str, digest: str,
+                              peer: str, fill_token: str | None
+                              ) -> CacheEntry | None:
+        """One digest-verified fetch from one peer; None on any failure
+        (classified and fed to cooldown/membership). Never raises except
+        CancelledError (a hedge loser), which aborts before any byte
+        could be cached."""
         try:
             failpoints.hit("delivery.peer")
+        except failpoints.FailpointError as exc:
+            self._peer_failed(peer, "transport", exc)
+            return None
+        try:
+            failpoints.hit("delivery.hedge")
+        except failpoints.FailpointError as exc:
+            # chaos stall: this fetch hangs for the full peer budget,
+            # exactly like a wedged-but-connected owner — the hedge to
+            # the next-ranked peer is what must rescue the request
+            await asyncio.sleep(self.peer_timeout_s)
+            self._peer_failed(peer, "timeout", exc)
+            return None
+        try:
             sess = self._http_session()
             async with sess.get(
-                    f"{owner}/videos/{slug}/{rel}",
-                    headers={PEER_FILL_HEADER: "1"},
+                    f"{peer}/videos/{slug}/{rel}",
+                    headers={PEER_FILL_HEADER: "1",
+                             FILL_TOKEN_HEADER: fill_token or digest},
                     timeout=aiohttp.ClientTimeout(total=self.peer_timeout_s),
             ) as resp:
                 if resp.status != 200:
-                    raise PeerFillError(f"{owner} answered {resp.status}")
+                    retry_after = None
+                    if resp.status == 503:
+                        # a shedding peer names its own backoff; honor
+                        # it as the cooldown instead of the flat knob
+                        retry_after = parse_retry_after(
+                            resp.headers.get("Retry-After"))
+                    self._peer_failed(
+                        peer, "status",
+                        PeerFillError(f"{peer} answered {resp.status}"),
+                        cooldown_s=retry_after)
+                    return None
                 body = await resp.read()
                 last_modified = resp.headers.get("Last-Modified")
         except asyncio.CancelledError:
             raise
+        except asyncio.TimeoutError as exc:
+            self._peer_failed(peer, "timeout", exc)
+            return None
         except Exception as exc:  # noqa: BLE001 — any failure degrades
-            self._peer_failed(owner, exc)
+            self._peer_failed(peer, "transport", exc)
             return None
         if hashlib.sha256(body).hexdigest() != digest:
-            # the owner served bytes that don't match the manifest this
-            # origin published against — treat the peer as unhealthy
-            self._peer_failed(owner, PeerFillError(
-                f"{owner} body does not match digest {digest[:12]}…"))
+            # the peer served bytes that don't match the manifest this
+            # origin published against — liveness is not trust
+            self._peer_failed(peer, "digest", PeerFillError(
+                f"{peer} body does not match digest {digest[:12]}…"))
             return None
+        self.membership.record_success(peer)
         mtime = _parse_http_date(last_modified)
         runtime().delivery_peer_fills.labels("hit").inc()
         return self._entry_from_bytes(slug, rel, digest, body, mtime)
 
-    def _peer_failed(self, owner: str, exc: BaseException) -> None:
-        self._peer_down[owner] = time.monotonic() + _PEER_COOLDOWN_S
+    def _peer_failed(self, peer: str, kind: str, exc: BaseException, *,
+                     cooldown_s: float | None = None) -> None:
+        """Classified peer-fill failure. Only transport/timeout feed
+        gossip suspicion (the process may be unreachable); a status
+        failure just cools the peer down (its own Retry-After wins over
+        the knob); a digest liar is quarantined out of ownership."""
+        cooldown = (self.peer_cooldown_s if cooldown_s is None
+                    else cooldown_s)
+        if kind == "digest":
+            self.membership.quarantine(peer)
+            self._bump("peer_quarantines")
+            cooldown = max(cooldown, self.membership.quarantine_s)
+        elif kind in ("transport", "timeout"):
+            self.membership.record_failure(peer)
+        self._peer_down[peer] = time.monotonic() + cooldown
         self._bump("peer_errors")
-        runtime().delivery_peer_fills.labels("error").inc()
-        log.warning("peer-fill from %s failed (%.1fs cooldown): %s",
-                    owner, _PEER_COOLDOWN_S, exc)
+        runtime().delivery_peer_fills.labels(kind).inc()
+        log.warning("peer-fill from %s failed [%s] (%.1fs cooldown): %s",
+                    peer, kind, cooldown, exc)
 
     def _http_session(self) -> aiohttp.ClientSession:
         if self._http is None or self._http.closed:
             self._http = aiohttp.ClientSession()
         return self._http
+
+    # -- gossip membership -------------------------------------------------
+
+    def start_gossip(self) -> bool:
+        """Start the membership probe loop on the running event loop;
+        False when gossip is disabled, there is no peer to probe, or no
+        loop is running here. Called from the app's startup hook."""
+        if self.gossip_interval_s <= 0 or not self.membership.enabled:
+            return False
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return False
+        m = runtime()
+        t = loop.create_task(
+            gossip.probe_loop(
+                self.membership, self._http_session,
+                interval_s=self.gossip_interval_s,
+                jitter=config.DELIVERY_GOSSIP_JITTER,
+                on_outcome=lambda o:
+                    m.delivery_gossip_probes.labels(o).inc()),
+            name="vlog-delivery-gossip")
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+        return True
+
+    # -- per-slug heat (popularity signal for the L2) ----------------------
+
+    def _touch_heat(self, slug: str) -> None:
+        """One request's worth of heat: +1 onto an exponential decay
+        with half-life ``heat_halflife_s``. Bumped on the event loop,
+        read from to_thread spill workers — hence the counter lock."""
+        now = time.monotonic()
+        with self._counter_lock:
+            rec = self._heat.get(slug)
+            if rec is None:
+                if len(self._heat) >= _HEAT_MAX:
+                    self._heat.clear()  # coarse but bounded; re-warms
+                heat = 1.0
+            else:
+                heat = rec[0] * 0.5 ** ((now - rec[1])
+                                        / self.heat_halflife_s) + 1.0
+            self._heat[slug] = (heat, now)
+
+    def heat_of(self, slug: str) -> float:
+        """The slug's decayed heat right now (0.0 when never touched)."""
+        now = time.monotonic()
+        with self._counter_lock:
+            rec = self._heat.get(slug)
+        if rec is None:
+            return 0.0
+        return rec[0] * 0.5 ** ((now - rec[1]) / self.heat_halflife_s)
+
+    def heat_top(self, n: int = 10) -> list[tuple[str, float]]:
+        """Hottest slugs right now (admin fabric panel)."""
+        now = time.monotonic()
+        with self._counter_lock:
+            items = list(self._heat.items())
+        decayed = [(slug, h * 0.5 ** ((now - at) / self.heat_halflife_s))
+                   for slug, (h, at) in items]
+        decayed.sort(key=lambda kv: kv[1], reverse=True)
+        return decayed[:n]
 
     async def close(self) -> None:
         """Release loop-bound resources (peer HTTP session, background
@@ -435,9 +753,10 @@ class DeliveryPlane:
             return
 
         digest, body, mtime = entry.digest, entry.body, entry.mtime
+        heat = self.heat_of(entry.slug)     # stamp admission heat now
 
         def work() -> None:
-            if self.l2.put(digest, body, mtime):
+            if self.l2.put(digest, body, mtime, heat=heat):
                 runtime().delivery_l2_bytes.set(self.l2.stats()["bytes"])
 
         try:
@@ -691,10 +1010,36 @@ class DeliveryPlane:
             "l2_corrupt": l2["corrupt"],
             "l2_stores": l2["stores"],
             "l2_evictions": l2["evictions"],
+            "l2_rescues": l2["rescues"],
+            "l2_admit_skips": l2["admit_skips"],
             "l2_bytes": l2["bytes"],
             "l2_budget_bytes": l2["budget_bytes"],
             "l2_entries": l2["entries"],
             "ring": self.ring.membership(),
+            "fabric": self.fabric_view(),
+        }
+
+    def fabric_view(self) -> dict:
+        """The self-healing-fabric panel: live membership, ring version,
+        hedge/coalesce rates, current hedge budget, heat top-N."""
+        with self._counter_lock:
+            hedges = self.counters["hedges"]
+            hedge_wins = self.counters["hedge_wins"]
+            coalesced = self.counters["coalesced_fills"]
+            quarantines = self.counters["peer_quarantines"]
+        delay = self._hedge_delay_s()
+        return {
+            "membership": self.membership.snapshot(),
+            "ring_version": self.ring.version,
+            "gossip_interval_s": self.gossip_interval_s,
+            "hedge_delay_ms": (None if delay is None
+                               else round(delay * 1000.0, 1)),
+            "hedges": hedges,
+            "hedge_wins": hedge_wins,
+            "coalesced_fills": coalesced,
+            "peer_quarantines": quarantines,
+            "heat_top": [{"slug": s, "heat": round(h, 2)}
+                         for s, h in self.heat_top(10)],
         }
 
 
@@ -763,4 +1108,5 @@ def stats_snapshot() -> dict:
                 totals[k] = totals.get(k, 0) + v
     return {"planes": per_plane, "totals": totals,
             "plane_count": len(per_plane),
-            "ring": per_plane[0]["ring"] if per_plane else None}
+            "ring": per_plane[0]["ring"] if per_plane else None,
+            "fabric": per_plane[0]["fabric"] if per_plane else None}

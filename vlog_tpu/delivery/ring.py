@@ -40,11 +40,18 @@ class Ring:
     duplicates are dropped so the hash is insensitive to spelling.
     ``self_url`` names which peer is *us* — empty means this process
     owns nothing and treats every keyed object as remotely owned.
+
+    ``version`` stamps which membership view this ring was built from
+    (gossip bumps its view version on every ownership change; the
+    delivery plane rebuilds the ring only when the stamps diverge). A
+    ring constructed outside the gossip plane keeps version 0 and is
+    never rebuilt from under its owner.
     """
 
-    __slots__ = ("peers", "self_url")
+    __slots__ = ("peers", "self_url", "version")
 
-    def __init__(self, peers: Sequence[str], self_url: str = "") -> None:
+    def __init__(self, peers: Sequence[str], self_url: str = "", *,
+                 version: int = 0) -> None:
         cleaned = []
         for u in peers:
             u = u.strip().rstrip("/")
@@ -52,6 +59,7 @@ class Ring:
                 cleaned.append(u)
         self.peers: tuple[str, ...] = tuple(cleaned)
         self.self_url: str = self_url.strip().rstrip("/")
+        self.version: int = int(version)
 
     @property
     def enabled(self) -> bool:
@@ -65,6 +73,13 @@ class Ring:
         if not self.peers:
             return None
         return max(self.peers, key=lambda p: _score(p, key))
+
+    def ranked(self, key: str) -> tuple[str, ...]:
+        """All peers in descending HRW preference for ``key``: the
+        owner first, then the hedge candidates in the order a fill
+        should fall through them. Pure, like every other consult."""
+        return tuple(sorted(self.peers,
+                            key=lambda p: _score(p, key), reverse=True))
 
     def is_local(self, key: str) -> bool:
         """True when this process should fill ``key`` from its own disk

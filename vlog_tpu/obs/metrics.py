@@ -204,13 +204,54 @@ class RuntimeMetrics:
         self.delivery_peer_fills = Counter(
             "vlog_delivery_peer_fills_total",
             "Consistent-hash peer fill outcomes (hit = digest-verified "
-            "body from the ring owner; error = any failure, which "
-            "degrades to a local fill)",
+            "body from a ring peer; failures classified as transport / "
+            "timeout / status / digest — only transport and timeout "
+            "feed gossip suspicion, digest quarantines the liar; every "
+            "failure degrades the fill to local disk)",
             ["outcome"], registry=self.registry)
         self.delivery_prewarm = Counter(
             "vlog_delivery_prewarm_total",
             "Publish-time prewarm segment outcomes (warmed, error)",
             ["outcome"], registry=self.registry)
+        # Self-healing fabric: gossip membership, hedged fills, heat.
+        self.delivery_fill_seconds = Histogram(
+            "vlog_delivery_fill_seconds",
+            "Cache-fill latency by winning source (l2, peer, disk, "
+            "bypass) — the reservoir behind the p95-adaptive hedge "
+            "budget",
+            ["source"],
+            buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     2.0, 5.0),
+            registry=self.registry)
+        self.delivery_hedges = Counter(
+            "vlog_delivery_hedges_total",
+            "Hedged peer-fill outcomes (launched = primary overran the "
+            "hedge budget, win = hedge beat the primary, primary_win = "
+            "primary finished first anyway; losers are cancelled and "
+            "never cached)",
+            ["outcome"], registry=self.registry)
+        self.delivery_coalesced_fills = Counter(
+            "vlog_delivery_coalesced_fills_total",
+            "Cross-origin fill requests (carrying the fill-token "
+            "header) that coalesced onto an already-in-flight local "
+            "fill — the flash-crowd one-disk-read-fleet-wide proof",
+            registry=self.registry)
+        self.delivery_gossip_probes = Counter(
+            "vlog_delivery_gossip_probes_total",
+            "Gossip heartbeat probe outcomes (ok, fail, drop — drop is "
+            "the delivery.gossip failpoint eating the heartbeat)",
+            ["outcome"], registry=self.registry)
+        self.delivery_ring_version = Gauge(
+            "vlog_delivery_ring_version",
+            "Version of the membership view the delivery ring was last "
+            "rebuilt from (bumps on peer death, quarantine, join, "
+            "rejoin)",
+            registry=self.registry)
+        self.delivery_l2_rescues = Counter(
+            "vlog_delivery_l2_rescues_total",
+            "Disk L2 eviction second-chances granted to entries of hot "
+            "slugs (heat-aware eviction spill)",
+            registry=self.registry)
         # Mesh job scheduler (parallel/scheduler.py): slot arbitration
         # over the process's device mesh.
         self.mesh_slots = Gauge(

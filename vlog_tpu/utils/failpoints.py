@@ -36,6 +36,14 @@ site                where it fires
 ``delivery.shed``   at the delivery plane's admission check — forces the
                     load-shed branch (503 + Retry-After) regardless of
                     the in-flight read count
+``delivery.gossip`` in the gossip probe loop, before each heartbeat
+                    (delivery/gossip.py) — the armed heartbeat is
+                    dropped on the floor, so membership must converge
+                    on suspicion from silence alone
+``delivery.hedge``  in the peer fill, per contacted peer — the armed
+                    fetch STALLS to the full peer timeout instead of
+                    erroring, so the hedge to the next-ranked peer is
+                    what must rescue tail latency
 ``device.fault``    compute thread, start of the backend ladder run
                     (worker/pipeline.py) — re-raised as a synthetic
                     XLA-like device error (parallel/faults.py) so the
@@ -128,6 +136,11 @@ SITES: dict[str, str] = {
     "delivery.shed": "delivery plane admission check; forces load-shed",
     "delivery.peer": "delivery plane peer fill, before the owner fetch; "
                      "an armed hit degrades the fill to local disk",
+    "delivery.gossip": "gossip probe loop, before each heartbeat; the "
+                       "armed heartbeat is dropped (silence -> suspicion)",
+    "delivery.hedge": "peer fill, per contacted peer; the armed fetch "
+                      "stalls to the peer timeout instead of erroring, "
+                      "so hedging is what must rescue tail latency",
     "device.fault": "compute thread, start of the backend ladder run; "
                     "re-raised as a synthetic XLA-like device error",
     "claim.fence": "WorkerAPIClient epoch header; the armed write sends "

@@ -918,6 +918,10 @@ async function loadDeliveryStats() {
   if (d.plane_count === 0) {
     $("dl-summary").textContent = "";
     $("dl-ring").textContent = "";
+    $("dl-fabric-summary").textContent = "";
+    $("dl-fabric").hidden = true;
+    $("dl-heat").hidden = true;
+    $("dl-fabric-empty").hidden = true;
     return;
   }
   const s = d.totals;
@@ -948,6 +952,39 @@ async function loadDeliveryStats() {
   $("dl-summary").textContent =
     `${d.plane_count} plane(s), ${s.invalidations} invalidations, ` +
     `${s.inflight_reads}/${s.max_inflight_reads} reads in flight`;
+  renderFabric(d.fabric);
+}
+
+function renderFabric(f) {
+  const havePeers = f && f.membership && f.membership.peers.length > 0;
+  $("dl-fabric").hidden = !havePeers;
+  $("dl-heat").hidden = !havePeers;
+  $("dl-fabric-empty").hidden = havePeers;
+  if (!havePeers) { $("dl-fabric-summary").textContent = ""; return; }
+  const hedgeRate = f.hedges
+    ? ` (${((100 * f.hedge_wins) / f.hedges).toFixed(0)}% won)` : "";
+  $("dl-fabric-summary").textContent =
+    `ring v${f.ring_version}, gossip every ${f.gossip_interval_s}s, ` +
+    `hedge budget ${f.hedge_delay_ms == null ? "off" : f.hedge_delay_ms + " ms"}, ` +
+    `${f.hedges} hedges${hedgeRate}, ${f.coalesced_fills} coalesced fills, ` +
+    `${f.peer_quarantines} quarantines`;
+  const tb = $("dl-fabric").tBodies[0];
+  tb.textContent = "";
+  for (const p of f.membership.peers) {
+    const tr = document.createElement("tr");
+    cells(tr, [p.url, badge(p.state),
+      String(p.fails), `${p.state_age_s}s`,
+      p.last_ok_age_s == null ? "never" : `${p.last_ok_age_s}s ago`]);
+    tb.appendChild(tr);
+  }
+  const th = $("dl-heat").tBodies[0];
+  th.textContent = "";
+  for (const h of f.heat_top) {
+    const tr = document.createElement("tr");
+    cells(tr, [h.slug, String(h.heat)]);
+    th.appendChild(tr);
+  }
+  $("dl-heat").hidden = f.heat_top.length === 0;
 }
 
 $("dl-invalidate").onclick = async () => {
