@@ -182,11 +182,13 @@ def run_body(platform: str) -> None:
 
     from vlog_tpu import config
     from vlog_tpu.backends.base import plan_rung_geometry
-    from vlog_tpu.backends.jax_backend import _enable_persistent_compile_cache
+    from vlog_tpu.ops.pallas_ladder import use_pallas
+    from vlog_tpu.parallel.compile_cache import (compile_seconds,
+                                                 ensure_compile_cache)
     from vlog_tpu.parallel.ladder import (ladder_chain_program,
                                           single_chip_ladder)
 
-    _enable_persistent_compile_cache()
+    ensure_compile_cache()
 
     if platform == "cpu":
         # Labeled fallback: same code path, scaled to what a CPU device
@@ -248,6 +250,13 @@ def run_body(platform: str) -> None:
         "chain_gop_len": clen,
         "chain_deblock": bool(config.H264_DEBLOCK),
         "chain_search": config.MOTION_SEARCH_RADIUS,
+        # raw-speed plane stamps: which kernel plane ran, which Whisper
+        # quant mode is configured, and this process's cumulative XLA
+        # backend-compile seconds (warm restarts with the persistent
+        # cache armed show a fraction of cold ones).
+        "pallas": use_pallas(),
+        "whisper_quant": config.WHISPER_QUANT,
+        "compile_s": round(compile_seconds(), 3),
     }
     del out
     # Publish the completed device measurement IMMEDIATELY: if anything
@@ -344,6 +353,7 @@ def run_body(platform: str) -> None:
             "reference). TPU measurements: see 4k_6rung_chain_ladder "
             "records.")
     record.update({
+        "compile_s": round(compile_seconds(), 3),   # now includes e2e
         "e2e_realtime_x": round(e2e_realtime, 4),
         "e2e_gop_mode": config.GOP_MODE,
         "e2e_entropy": config.H264_ENTROPY,

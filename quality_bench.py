@@ -412,6 +412,92 @@ def run_asr(audio_path: str, ref_path: str, beam: int) -> dict:
     }
 
 
+def run_asr_quant(beam: int) -> dict:
+    """WER-parity gate for VLOG_WHISPER_QUANT=int8 — synthetic-weights
+    identity proxy, documented as such.
+
+    This environment ships no Whisper checkpoint, so the gate cannot
+    score real speech. Instead it constructs random HF-shaped weights
+    whose linear projections sit EXACTLY on the int8 grid (w = q * 2^-9
+    with a forced ±127 entry per output row). The production
+    ``quantize_params`` then recovers (q, scale) losslessly, and because
+    power-of-two scaling is exact in f32 and distributes over the
+    matmul's summation order, the dequant-on-use decode is bitwise
+    identical to the f32 decode — so the proxy's PASS bar is WER == 0.0
+    (token-for-token), far stricter than the relaxed parity a real
+    checkpoint would gate at. What it proves: the int8 plumbing
+    (quantize -> QuantTensor pytree -> dequant matmul -> KV-cached scan)
+    changes nothing it shouldn't. What it cannot prove: real-weights WER
+    degradation, which needs VLOG_WHISPER_DIR and the --asr mode.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vlog_tpu.asr import decode as dec
+    from vlog_tpu.asr.load import _QUANT_KEY, quantize_params
+    from vlog_tpu.asr.model import WhisperConfig, init_random_params
+
+    cfg = WhisperConfig(
+        d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, vocab_size=128,
+        num_mel_bins=80, max_source_positions=1500,
+        max_target_positions=448)
+    params = init_random_params(cfg, seed=0)
+    # Snap every quantizable projection onto the int8 grid: scale 2^-9
+    # covers the 0.02-stdev init range within ±127 steps.
+    grid = 2.0 ** -9
+    snapped = {}
+    for k, v in params.items():
+        if _QUANT_KEY.search(k) and v.ndim == 2:
+            q = np.clip(np.round(np.asarray(v) / grid), -127, 127)
+            q[:, 0] = 127.0      # pins amax so scale recovers exactly
+            snapped[k] = jnp.asarray((q * grid).astype(np.float32))
+        else:
+            snapped[k] = v
+    qparams = quantize_params(snapped, "int8")
+
+    rng = np.random.default_rng(7)
+    mel = jnp.asarray(rng.standard_normal((2, 80, 3000)), jnp.float32)
+    prompt = jnp.asarray([3, 4], jnp.int32)
+    zeros = jnp.zeros(cfg.vocab_size, jnp.float32)
+    max_new = 24
+    kw = dict(cfg=cfg, sot=3, eot=1, ts_begin=cfg.vocab_size - 2,
+              no_speech=-1, max_new=max_new, timestamps=False)
+
+    def decode_with(p):
+        cache = dec.kv_pool.lease(cfg, mel.shape[0],
+                                  prompt.shape[0] + max_new)
+        toks, _, cache = dec._generate_jit(p, mel, prompt, zeros, zeros,
+                                           cache, **kw)
+        dec.kv_pool.release(cache)
+        return np.asarray(toks)
+
+    t0 = time.perf_counter()
+    ref_toks = decode_with(snapped)
+    f32_wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    hyp_toks = decode_with(qparams)
+    int8_wall = time.perf_counter() - t0
+    # token-level WER between the f32 and int8 decodes (each token a
+    # "word"); the identity proxy demands exactly 0.0
+    scores = [wer([str(t) for t in r], [str(t) for t in h])
+              for r, h in zip(ref_toks.tolist(), hyp_toks.tolist())]
+    score = max(scores)
+    return {
+        "metric": "asr_wer_quant", "value": round(score, 4), "unit": "wer",
+        "vs_baseline": 0.0,
+        "quant": "int8", "beam": beam, "gate": "identity_proxy",
+        "identical_tokens": bool(np.array_equal(ref_toks, hyp_toks)),
+        "windows": int(mel.shape[0]), "max_new": max_new,
+        "f32_wall_s": round(f32_wall, 3),
+        "int8_wall_s": round(int8_wall, 3),
+        "note": ("synthetic int8-grid weights: proves the quantized "
+                 "decode plumbing is lossless on representable weights; "
+                 "real-WER parity needs VLOG_WHISPER_DIR + --asr"),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--frames", type=int, default=96)
@@ -436,7 +522,15 @@ def main() -> None:
     ap.add_argument("--ref", metavar="TXT",
                     help="reference transcript for --asr")
     ap.add_argument("--beam", type=int, default=5)
+    ap.add_argument("--quant", action="store_true",
+                    help="with --asr: int8 WER-parity gate (synthetic-"
+                         "weights identity proxy; no checkpoint needed)")
     args = ap.parse_args()
+
+    if args.quant:
+        rec = run_asr_quant(args.beam)
+        print(json.dumps(rec))
+        return
 
     if args.asr:
         if not args.ref:

@@ -812,5 +812,5 @@ def test_every_pass_ran_over_a_parsed_repo():
     assert "vlog_tpu/delivery/plane.py" in rels
     assert "vlog_tpu/worker/brownout.py" in rels
     assert set(PASSES) == {"asyncblock", "lockdiscipline", "epochfence",
-                           "tracehop", "registry", "meshshim", "lockorder",
-                           "holdblock"}
+                           "tracehop", "registry", "meshshim", "pallasshim",
+                           "lockorder", "holdblock"}

@@ -405,6 +405,7 @@ def test_engine_coexists_with_transcode_slot_then_takes_full_mesh(assets):
 # Drain -> checkpoint -> resume chaos (daemon end-to-end)
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~40s end-to-end; tier-1 keeps the fast drain/resume tests
 def test_preempted_transcription_resumes_byte_identical(run, db, tmp_path,
                                                         tiny_model_dir,
                                                         monkeypatch):
@@ -607,7 +608,9 @@ def test_asr_packing_microbench(assets):
     }
     from pathlib import Path
 
-    out = Path(__file__).parent.parent / "BENCH_asr.json"
-    out.write_text(json.dumps(record, indent=1) + "\n")
+    from vlog_tpu.parallel.dryrun import _append_records
+
+    _append_records(str(Path(__file__).parent.parent / "BENCH_asr.json"),
+                    [record])
     print(json.dumps(record))
     assert speedup > 1.5

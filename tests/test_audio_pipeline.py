@@ -132,6 +132,7 @@ def av_pipeline(tmp_path_factory):
     return result, out
 
 
+@pytest.mark.slow  # av_pipeline fixture runs a ~36s full A/V encode
 def test_audio_renditions_emitted(av_pipeline):
     result, out = av_pipeline
     names = {a["name"] for a in result.audio_renditions}
@@ -143,6 +144,7 @@ def test_audio_renditions_emitted(av_pipeline):
         assert abs(res["duration_s"] - 2.0) < 0.2
 
 
+@pytest.mark.slow  # shares the av_pipeline e2e fixture
 def test_master_references_audio(av_pipeline):
     result, out = av_pipeline
     master = (out / "master.m3u8").read_text()
@@ -155,6 +157,7 @@ def test_master_references_audio(av_pipeline):
     assert any("audio_96k" in uri for uri in results)
 
 
+@pytest.mark.slow  # shares the av_pipeline e2e fixture
 def test_dash_has_audio_adaptation_set(av_pipeline):
     result, out = av_pipeline
     mpd = (out / "manifest.mpd").read_text()
@@ -162,6 +165,7 @@ def test_dash_has_audio_adaptation_set(av_pipeline):
     assert "audio_128k/segment_$Number%05d$.m4s" in mpd
 
 
+@pytest.mark.slow  # shares the av_pipeline e2e fixture
 def test_audio_segments_decode(av_pipeline):
     """Audio rendition segments must decode back to the source tone."""
     from vlog_tpu.codecs.aac.adts import AacConfig
@@ -194,6 +198,7 @@ def test_audio_segments_decode(av_pipeline):
     assert c > 0.9, f"correlation {c}"
 
 
+@pytest.mark.slow  # shares the av_pipeline e2e fixture
 def test_resume_skips_complete_audio(av_pipeline, tmp_path):
     """Re-running the pipeline must not re-encode finished audio."""
     result, out = av_pipeline

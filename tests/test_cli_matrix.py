@@ -91,6 +91,7 @@ def test_cli_unknown_command_fails():
         climod.main(["frobnicate"])
 
 
+@pytest.mark.slow  # ~14s full encode; the 409/ts-mode variants stay fast
 def test_cli_manifests_regenerate(run, tmp_path, stack, cli, capsys):
     """Build a real rung tree, delete the master, regenerate through the
     CLI + admin route, and validate the result references every rung."""

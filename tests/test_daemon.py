@@ -38,6 +38,7 @@ def make_daemon(db, tmp_path, **kw):
     return WorkerDaemon(db, **kw)
 
 
+@pytest.mark.slow  # ~13s daemon transcode e2e
 def test_daemon_transcodes_video_to_ready(run, db, tmp_path, video_job):
     """The headline: insert a video, poll once, video reaches ready with
     qualities + downstream jobs enqueued (VERDICT round-2 item #1)."""
@@ -241,6 +242,7 @@ def test_release_job_refunds_attempt(run, db, tmp_path, video_job):
 
     run(go())
 
+@pytest.mark.slow  # ~30s two-daemon race; single-daemon claim tests stay fast
 def test_daemon_concurrent_slot_claims(run, db, tmp_path):
     """Mesh scheduler claim loop: two queued jobs are claimed in one
     fill round, run CONCURRENTLY on 2x4-device slot leases, and both

@@ -85,6 +85,7 @@ def test_libav_source_decodes_x264(foreign_stream):
     assert psnr > 28, psnr
 
 
+@pytest.mark.slow  # ~30s full ladder; container probing stays in tier-1
 def test_full_ladder_from_foreign_source(foreign_stream, tmp_path):
     """The headline: an x264 upload runs the complete first-party CMAF
     pipeline, and the emitted rung decodes back to content matching the

@@ -155,6 +155,7 @@ def test_c_coder_matches_python(monkeypatch):
                                 frame_num=1).to_bytes() == p_c
 
 
+@pytest.mark.slow  # ~10s chain encode; skip-mode unit tests stay fast
 def test_static_scene_skips(avdec, tmp_path):
     """All-skip P frames: mb_skip_flag contexts + terminate only."""
     h, w, qp = 64, 96, 30

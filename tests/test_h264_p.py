@@ -147,6 +147,7 @@ def test_p_chain_oracle_static_scene_skips(avdec, tmp_path):
     assert all(s < 40 for s in p_sizes), p_sizes   # skip-run slices
 
 
+@pytest.mark.slow  # ~7s chain encode; p-chain oracles keep the path covered
 def test_p_frames_much_smaller_than_intra(avdec, tmp_path):
     """On panning content, I+P must be well under half the all-intra size
     at the same QP (the whole point of inter prediction)."""

@@ -200,6 +200,7 @@ def test_jax_dsp_matches_numpy(qp):
     assert np.array_equal(np.asarray(rv), ref.recon_v)
 
 
+@pytest.mark.slow  # ~12s dual-entropy encode comparison
 def test_api_c_entropy_matches_python(hevcdec, tmp_path, monkeypatch):
     """native/hevc_cabac.c must be bit-exact with the Python coder."""
     import vlog_tpu.native.build as nb
@@ -230,6 +231,7 @@ def test_api_c_entropy_matches_python(hevcdec, tmp_path, monkeypatch):
     assert len(decoded) == 2
 
 
+@pytest.mark.slow  # ~11s two-rung hevc pipeline; chain oracles cover the path
 def test_hevc_ladder_pipeline(hevcdec, tmp_path):
     """codec=h265 through process_video: hvc1 manifests + CMAF segments
     that a third-party decoder reconstructs."""
@@ -271,6 +273,7 @@ def test_hevc_ladder_pipeline(hevcdec, tmp_path):
     assert len(decoded) == 8
 
 
+@pytest.mark.slow  # ~20s chain oracle; deblock/partition oracles stay fast
 def test_p_chain_oracle_and_compression(hevcdec, tmp_path):
     """I + integer-MV P chains (pslice.py): libavcodec reproduces the
     encoder's reconstruction exactly, and panning content codes far
@@ -464,6 +467,7 @@ def test_p_two_part_ctu_oracle(hevcdec, tmp_path):
     np.testing.assert_array_equal(decoded[1][2], exp_v)
 
 
+@pytest.mark.slow  # ~21s partitioned chain oracle
 def test_partitioned_chain_oracle(hevcdec, tmp_path):
     """encode_chain(partitions=True) on split-motion content: the DSP
     chooses 2NxN CTBs (two bands panning opposite ways), the streams

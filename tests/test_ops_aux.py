@@ -24,6 +24,7 @@ from tests.fixtures.media import make_y4m
 # Re-encode job kind
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~14s daemon re-encode e2e
 def test_daemon_reencode_converts_format(run, db, tmp_path):
     src = make_y4m(tmp_path / "s.y4m", n_frames=10, width=64, height=48,
                    fps=10)

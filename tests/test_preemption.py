@@ -454,6 +454,7 @@ def _server_manifest(api, slug):
     return integrity.load_manifest(api["video_dir"] / slug)
 
 
+@pytest.mark.slow  # ~7s two-worker e2e; checkpoint unit tests stay fast
 def test_cross_worker_resume_end_to_end(run, db, tmp_path, api, monkeypatch):
     """THE acceptance chaos test: worker A is preempted mid-ladder, a
     second worker resumes from the uploaded partials and publishes a

@@ -150,6 +150,7 @@ def test_backend_segments_converge(rate_controlled_run):
         assert abs(b - target) / target < 0.35, seg_bits
 
 
+@pytest.mark.slow  # ~25s full-backend encode; tier-1 keeps the unit RC tests
 def test_backend_chain_mode_rate_control(tmp_path_factory):
     """I+P chains: the controller converges toward target on content whose
     temporal noise keeps P frames from coding for free. P coding is far
@@ -210,6 +211,7 @@ def test_controller_recovers_undershoot_debt_too():
     assert abs(total / n - target_bpf) / target_bpf < 0.35
 
 
+@pytest.mark.slow  # ~35s chain compile; uncalibrated/legacy variants stay fast
 def test_device_inchain_adaptation_reacts_within_chain():
     """ladder_chain_program's rc arg: a mid-chain noise burst must raise
     QP on the NEXT frame (the host controller can only react a whole
@@ -241,6 +243,7 @@ def test_device_inchain_adaptation_reacts_within_chain():
     assert "qp_eff" not in legacy and "cost" not in legacy
 
 
+@pytest.mark.slow  # ~20s chain compile
 def test_device_inchain_adaptation_uncalibrated_is_openloop():
     """alpha == 0 (first dispatch) must leave every QP at plan."""
     import numpy as np
@@ -260,6 +263,7 @@ def test_device_inchain_adaptation_uncalibrated_is_openloop():
     assert (np.asarray(out["qp_eff"]) == qps["64p"]).all()
 
 
+@pytest.mark.slow  # ~20s hevc chain compile
 def test_hevc_device_inchain_adaptation():
     """Same cascade on the HEVC fused ladder: burst -> QP up next frame;
     no rc -> legacy outputs."""

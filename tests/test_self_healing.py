@@ -249,6 +249,7 @@ def test_device_fault_refunds_attempt_budget_with_bound(run, db, tmp_path):
 # refund-requeue -> byte-identical retry (ISSUE 7 acceptance)
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~30s chaos loop; the targeted fault-path tests stay fast
 def test_device_fault_chaos_full_loop(run, db, tmp_path):
     import jax
 
@@ -358,6 +359,7 @@ def api(run, db, tmp_path):
     run(server.close())
 
 
+@pytest.mark.slow  # ~25s sweep+reclaim end-to-end
 def test_stale_epoch_writes_rejected_after_sweep_and_reclaim(
         run, db, tmp_path, api):
     """The fencing acceptance: worker A's lease is swept and the job

@@ -373,6 +373,7 @@ class TestDiskAdmission:
         run(go())
         run(server.close())
 
+    @pytest.mark.slow  # ~20s daemon loop; admission unit tests stay fast
     def test_daemon_pauses_claiming(self, run, db, tmp_path, monkeypatch):
         src = make_y4m(tmp_path / "d.y4m", n_frames=6, width=64, height=48)
         video = run(vids.create_video(db, "DP", source_path=str(src)))

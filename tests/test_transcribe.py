@@ -89,6 +89,7 @@ def assets(tiny_model_dir):
     return load_whisper(tiny_model_dir)
 
 
+@pytest.mark.slow  # ~10s multi-window decode; single-window tests stay fast
 def test_transcribe_audio_batches_and_stitches(assets):
     samples = _tone(40.0)     # 2 windows at 25 s stride
     calls = []
@@ -154,6 +155,7 @@ def test_missing_model_dir_raises_actionable_error(tmp_path):
 # Daemon integration: the transcription job kind
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~14s daemon e2e; direct transcription tests stay fast
 def test_daemon_transcription_job(run, db, tmp_path, tiny_model_dir):
     from vlog_tpu.worker.daemon import WorkerDaemon
 

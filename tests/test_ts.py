@@ -70,6 +70,7 @@ def tsdec(tmp_path_factory):
     return exe
 
 
+@pytest.mark.slow  # ~8s oracle roundtrip; TS packet unit tests stay fast
 def test_ts_oracle_video_roundtrip(tsdec, tmp_path):
     """Our encoder's frames muxed to TS decode bit-exactly via
     libavformat+libavcodec."""
@@ -142,6 +143,7 @@ def test_ts_oracle_audio_mux(tsdec, tmp_path):
     assert n_audio >= len(frames) - 2          # decoder may trim priming
 
 
+@pytest.mark.slow  # ~18s end-to-end HLS publish; mux unit tests stay fast
 def test_process_video_hls_ts_end_to_end(tsdec, tmp_path):
     """Full pipeline in legacy mode: TS segments + v3 playlists, no
     init/DASH, segments demux+decode in libavformat."""
@@ -170,6 +172,7 @@ def test_process_video_hls_ts_end_to_end(tsdec, tmp_path):
     assert "video=20" in proc.stdout
 
 
+@pytest.mark.slow  # ~16s full encode+mux; TS unit muxer tests stay fast
 def test_backend_ts_muxes_audio_per_rung(tsdec, tmp_path):
     """Audio ADTS passed via the plan is interleaved into the variant TS."""
     from tests.fixtures.media import make_y4m

@@ -157,6 +157,7 @@ def test_detect_language_returns_known_code(assets):
     assert lang in ("en", "es")
 
 
+@pytest.mark.slow  # ~25s beam compile; beam5-vs-torch keeps beam path covered
 def test_beam1_equals_greedy(assets):
     """The beam machinery at K=1 must reduce exactly to the greedy scan
     (same rules, same argmax) — timestamps on and off."""
@@ -174,11 +175,13 @@ def test_beam1_equals_greedy(assets):
         sup = dec._suppress_vector(assets.cfg.vocab_size,
                                    st.suppress + (st.no_timestamps,))
         bsup = dec._suppress_vector(assets.cfg.vocab_size, st.begin_suppress)
-        beam, _ = dec._generate_beam_jit(
+        cache = dec.DecoderCache.create(assets.cfg, mel.shape[0],
+                                        len(prompt) + 10)
+        beam, _, _ = dec._generate_beam_jit(
             assets.params, jnp.asarray(mel),
             jnp.asarray(prompt, np.int32), jnp.asarray(sup),
-            jnp.asarray(bsup), cfg=assets.cfg, sot=st.sot, eot=st.eot,
-            ts_begin=st.timestamp_begin,
+            jnp.asarray(bsup), cache, cfg=assets.cfg, sot=st.sot,
+            eot=st.eot, ts_begin=st.timestamp_begin,
             no_speech=st.no_speech if st.no_speech is not None else -1,
             max_new=10, timestamps=ts, beam=1)
         np.testing.assert_array_equal(np.asarray(beam), greedy)
@@ -237,6 +240,7 @@ def test_beam5_matches_torch_beam(assets, torch_model):
     np.testing.assert_array_equal(toks[:, :n_new], ref)
 
 
+@pytest.mark.slow  # ~11s; beam5-vs-torch oracle keeps the beam path covered
 def test_beam_score_not_worse_than_greedy(assets):
     """Beam-5's selected hypothesis must score at least as high as the
     greedy sequence under the model (the point of beam search)."""

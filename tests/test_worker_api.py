@@ -271,6 +271,7 @@ def test_remote_worker_completes_transcode_over_http(run, db, tmp_path, api):
     assert sprite is not None
 
 
+@pytest.mark.slow  # ~12s re-encode over HTTP; claim/handshake tests stay fast
 def test_remote_worker_reencodes_to_h265_over_http(run, db, tmp_path, api):
     """Codec passthrough on the remote plane: a REENCODE job with
     payload codec=h265 claims over HTTP and the server tree flips to

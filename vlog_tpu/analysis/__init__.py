@@ -9,6 +9,7 @@ it); ``python -m vlog_tpu.analysis`` is the CLI. Pass registry:
 - ``tracehop``        thread hand-offs in traced modules carry context
 - ``registry``        knob/metric/failpoint/span registries vs docs
 - ``meshshim``        shard_map call sites go through parallel/mesh
+- ``pallasshim``      Pallas kernel code stays in ops/pallas_ladder
 - ``lockorder``       lock-order ranks: no rank inversions or cycles
 - ``holdblock``       no blocking calls while an annotated lock is held
 """
@@ -19,7 +20,7 @@ from pathlib import Path
 
 from vlog_tpu.analysis import (asyncblock, epochfence, holdblock,
                                lockdiscipline, lockorder, meshshim,
-                               registry, tracehop)
+                               pallasshim, registry, tracehop)
 from vlog_tpu.analysis.core import (Finding, Module, load_baseline,
                                     load_package, render_baseline)
 
@@ -29,8 +30,8 @@ __all__ = [
 ]
 
 PASSES = {m.RULE: m for m in (asyncblock, lockdiscipline, epochfence,
-                              tracehop, registry, meshshim, lockorder,
-                              holdblock)}
+                              tracehop, registry, meshshim, pallasshim,
+                              lockorder, holdblock)}
 
 
 def default_pkg_dir() -> Path:

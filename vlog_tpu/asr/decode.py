@@ -15,6 +15,7 @@ fewer windows per batch with the teacher-forced scorer for rescoring.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from functools import partial
 
@@ -33,6 +34,69 @@ from vlog_tpu.asr.model import (
 
 TIME_PRECISION = 0.02       # seconds per timestamp token step
 MAX_INITIAL_TIMESTAMP_INDEX = 50   # first cue within 1.0 s
+
+
+# --------------------------------------------------------------------------
+# Paged KV-cache pool
+# --------------------------------------------------------------------------
+
+class KVCachePool:
+    """Static-shape DecoderCache pages, reused across engine ticks.
+
+    The generation loops take the cache as an ARGUMENT and return the
+    final buffers, so the allocation lives here instead of inside the
+    jit — the continuous-batching engine used to materialize a fresh
+    (layers, B, H, max_len, hd) zeros pair every tick. Pages are keyed
+    by exact buffer shape (the engine's batch buckets make these
+    recur); a leased page may hold stale K/V from a previous job, which
+    is BYTE-SAFE because ``decoder_step`` masks attention to positions
+    <= pos and every such position is freshly written during this
+    generation's prefill/scan — dirty tail rows are unreachable.
+    """
+
+    _MAX_PAGES = 8          # retained pages across all shapes
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pages: dict[tuple, list[DecoderCache]] = {}
+        self.allocs = 0     # fresh page materializations
+        self.reuses = 0     # leases served from the pool
+
+    def _shape(self, cfg: WhisperConfig, rows: int, max_len: int) -> tuple:
+        hd = cfg.d_model // cfg.decoder_attention_heads
+        return (cfg.decoder_layers, rows, cfg.decoder_attention_heads,
+                max_len, hd)
+
+    def lease(self, cfg: WhisperConfig, rows: int, max_len: int
+              ) -> DecoderCache:
+        shape = self._shape(cfg, rows, max_len)
+        with self._lock:
+            free = self._pages.get(shape)
+            if free:
+                self.reuses += 1
+                return free.pop()
+            self.allocs += 1
+        return DecoderCache.create(cfg, rows, max_len)
+
+    def release(self, cache: DecoderCache) -> None:
+        shape = tuple(cache.k.shape)
+        with self._lock:
+            if sum(len(v) for v in self._pages.values()) < self._MAX_PAGES:
+                self._pages.setdefault(shape, []).append(cache)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"allocs": self.allocs, "reuses": self.reuses,
+                    "retained": sum(len(v) for v in self._pages.values())}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pages.clear()
+            self.allocs = 0
+            self.reuses = 0
+
+
+kv_pool = KVCachePool()
 
 
 @dataclass
@@ -105,14 +169,13 @@ def apply_timestamp_rules(logits, last, penult, last_ts, step_idx, *,
 @partial(jax.jit, static_argnames=("cfg", "sot", "eot", "ts_begin",
                                    "no_speech", "max_new", "timestamps"))
 def _generate_jit(params, mel, prompt, suppress_vec, begin_suppress_vec,
-                  *, cfg: WhisperConfig, sot: int, eot: int, ts_begin: int,
-                  no_speech: int, max_new: int, timestamps: bool):
+                  cache, *, cfg: WhisperConfig, sot: int, eot: int,
+                  ts_begin: int, no_speech: int, max_new: int,
+                  timestamps: bool):
     enc = encode(params, mel, cfg)
     ckv = cross_kv(params, enc, cfg)
     b = mel.shape[0]
     plen = prompt.shape[0]
-    max_len = plen + max_new
-    cache = DecoderCache.create(cfg, b, max_len)
 
     # prefill the prompt (static small count of steps)
     logits = None
@@ -144,8 +207,8 @@ def _generate_jit(params, mel, prompt, suppress_vec, begin_suppress_vec,
             jnp.full((b,), prompt[-2] if plen >= 2 else sot, jnp.int32),
             jnp.full((b,), ts_begin - 1, jnp.int32),    # no timestamp yet
             jnp.zeros((b,), bool))
-    _, toks = jax.lax.scan(step, init, jnp.arange(max_new))
-    return jnp.transpose(toks), no_speech_prob        # (B, max_new)
+    (cache, *_), toks = jax.lax.scan(step, init, jnp.arange(max_new))
+    return jnp.transpose(toks), no_speech_prob, cache  # (B, max_new)
 
 
 # --------------------------------------------------------------------------
@@ -157,7 +220,7 @@ def _generate_jit(params, mel, prompt, suppress_vec, begin_suppress_vec,
                                    "no_speech", "max_new", "timestamps",
                                    "beam"))
 def _generate_beam_jit(params, mel, prompt, suppress_vec, begin_suppress_vec,
-                       *, cfg: WhisperConfig, sot: int, eot: int,
+                       cache, *, cfg: WhisperConfig, sot: int, eot: int,
                        ts_begin: int, no_speech: int, max_new: int,
                        timestamps: bool, beam: int):
     """Batched beam search over B windows x K beams (flattened to B*K
@@ -177,8 +240,6 @@ def _generate_beam_jit(params, mel, prompt, suppress_vec, begin_suppress_vec,
     ckv = [(jnp.repeat(ck, k, axis=0), jnp.repeat(cv, k, axis=0))
            for ck, cv in ckv]
     plen = prompt.shape[0]
-    max_len = plen + max_new
-    cache = DecoderCache.create(cfg, bk, max_len)
 
     logits = None
     for i in range(plen):
@@ -249,7 +310,7 @@ def _generate_beam_jit(params, mel, prompt, suppress_vec, begin_suppress_vec,
     norm = jnp.where(finished, norm, norm - 1e9)
     best = jnp.argmax(norm.reshape(b, k), axis=1)               # (b,)
     best_rows = best + jnp.arange(b) * k
-    return (jnp.take(seqs, best_rows, axis=0), no_speech_prob)
+    return jnp.take(seqs, best_rows, axis=0), no_speech_prob, cache
 
 
 def generate_batch(assets: WhisperAssets, mel: jnp.ndarray, *,
@@ -289,13 +350,19 @@ def generate_batch(assets: WhisperAssets, mel: jnp.ndarray, *,
         cfg=cfg, sot=st.sot, eot=st.eot, ts_begin=st.timestamp_begin,
         no_speech=st.no_speech if st.no_speech is not None else -1,
         max_new=int(max_new), timestamps=timestamps)
+    rows = mel.shape[0] * (int(beam) if beam > 1 else 1)
+    cache = kv_pool.lease(cfg, rows, len(prompt) + int(max_new))
     args = (assets.params, jnp.asarray(mel),
             jnp.asarray(prompt, jnp.int32), jnp.asarray(sup),
-            jnp.asarray(bsup))
+            jnp.asarray(bsup), cache)
     if beam > 1:
-        toks, nsp = _generate_beam_jit(*args, beam=int(beam), **kwargs)
+        toks, nsp, cache = _generate_beam_jit(*args, beam=int(beam),
+                                              **kwargs)
     else:
-        toks, nsp = _generate_jit(*args, **kwargs)
+        toks, nsp, cache = _generate_jit(*args, **kwargs)
+    # return the FINAL buffers to the pool: the leased input pages were
+    # consumed functionally (same shape either way)
+    kv_pool.release(cache)
     return np.asarray(toks), np.asarray(nsp)
 
 

@@ -194,13 +194,16 @@ class AsrEngine:
         return self._lease_held.is_set() or self._queue.pending() > 0
 
     def stats(self) -> dict:
+        from vlog_tpu.asr.decode import kv_pool
+
         with self._lock:
             batches = len(self.batch_log)
             occ = (sum(b["occupancy"] for b in self.batch_log) / batches
                    if batches else 0.0)
             return {"batches": batches, "windows": self.windows_decoded,
                     "mean_occupancy": occ,
-                    "pending": self._queue.pending()}
+                    "pending": self._queue.pending(),
+                    "kv_pool": kv_pool.stats()}
 
     def close(self) -> None:
         self._stop.set()
@@ -392,11 +395,15 @@ _ENGINE_LOCK = threading.Lock()
 
 
 def get_engine(model_dir: str, *, scheduler=None) -> AsrEngine:
-    """The process's shared engine, (re)built when the checkpoint dir or
-    scheduler changes (tests swap tiny model dirs; the daemon always
-    passes its one scheduler singleton)."""
+    """The process's shared engine, (re)built when the checkpoint dir,
+    quant mode, or scheduler changes (tests swap tiny model dirs; the
+    daemon always passes its one scheduler singleton)."""
+    from vlog_tpu.asr.load import resolve_quant
+    from vlog_tpu.parallel.compile_cache import ensure_compile_cache
+
     global _ENGINE, _ENGINE_KEY
-    key = (str(model_dir), id(scheduler))
+    quant = resolve_quant()
+    key = (str(model_dir), id(scheduler), quant)
     with _ENGINE_LOCK:
         if _ENGINE is not None and _ENGINE_KEY == key:
             return _ENGINE
@@ -405,7 +412,8 @@ def get_engine(model_dir: str, *, scheduler=None) -> AsrEngine:
         _ENGINE_KEY = None
     if old is not None:
         old.close()
-    assets = load_whisper(model_dir)
+    ensure_compile_cache()
+    assets = load_whisper(model_dir, quant)
     engine = AsrEngine(assets, scheduler=scheduler)
     with _ENGINE_LOCK:
         if _ENGINE is None:

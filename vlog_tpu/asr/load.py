@@ -11,6 +11,7 @@ fleet has no egress by design.
 from __future__ import annotations
 
 import json
+import re
 import threading
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -19,7 +20,7 @@ from typing import Any
 import jax.numpy as jnp
 import numpy as np
 
-from vlog_tpu.asr.model import Params, WhisperConfig
+from vlog_tpu.asr.model import Params, QuantTensor, WhisperConfig
 
 
 class ModelLoadError(RuntimeError):
@@ -87,6 +88,43 @@ def convert_state_dict(sd: dict[str, np.ndarray]) -> Params:
     return params
 
 
+# Linear projections _linear() consumes — the ONLY keys quantization may
+# touch. Embeddings (indexed + tied-logit matmul), convs, layernorms and
+# positions stay f32: their numerics gate token choice directly and their
+# HBM share is small.
+_QUANT_KEY = re.compile(
+    r"\.(?:q_proj|k_proj|v_proj|out_proj|fc1|fc2)\.weight$")
+
+
+def quantize_params(params: Params, mode: str) -> Params:
+    """Re-encode linear weights per ``mode`` (f32 = no-op passthrough).
+
+    ``int8``: symmetric per-output-channel — scale = max|row| / 127,
+    weight rows round to int8, :func:`~vlog_tpu.asr.model._linear`
+    dequantizes on use. ``bf16``: stored bf16, cast back at use. The
+    params dict is rebuilt; unquantized entries are shared, not copied.
+    """
+    mode = (mode or "f32").strip().lower()
+    if mode in ("f32", "fp32", "", "none"):
+        return params
+    if mode not in ("int8", "bf16"):
+        raise ModelLoadError(f"unknown VLOG_WHISPER_QUANT mode {mode!r}")
+    out: Params = {}
+    for k, v in params.items():
+        if not (_QUANT_KEY.search(k) and getattr(v, "ndim", 0) == 2):
+            out[k] = v
+            continue
+        if mode == "bf16":
+            out[k] = v.astype(jnp.bfloat16)
+            continue
+        w = np.asarray(v, np.float32)
+        amax = np.max(np.abs(w), axis=1)
+        scale = np.where(amax > 0, amax, 1.0).astype(np.float32) / 127.0
+        q = np.clip(np.round(w / scale[:, None]), -127, 127).astype(np.int8)
+        out[k] = QuantTensor(q=jnp.asarray(q), scale=jnp.asarray(scale))
+    return out
+
+
 def derive_special_tokens(tokenizer, hf_cfg: dict,
                           gen_cfg: dict | None) -> SpecialTokens:
     gen_cfg = gen_cfg or {}
@@ -123,9 +161,10 @@ def derive_special_tokens(tokenizer, hf_cfg: dict,
 
 # Process-wide asset cache. Whisper weights are hundreds of MB of
 # safetensors; every caller (engine, CLI, quality_bench) used to re-read
-# them per invocation. Keyed on (resolved dir, config.json mtime_ns) so a
-# swapped-in checkpoint at the same path is picked up without a restart.
-_cache: dict[tuple[str, int], WhisperAssets] = {}  # under _cache_lock
+# them per invocation. Keyed on (resolved dir, config.json mtime_ns,
+# quant mode) so a swapped-in checkpoint at the same path is picked up
+# without a restart and f32/int8 callers never share a params tree.
+_cache: dict[tuple[str, int, str], WhisperAssets] = {}  # under _cache_lock
 _cache_lock = threading.Lock()
 
 
@@ -135,17 +174,33 @@ def invalidate() -> None:
         _cache.clear()
 
 
-def load_whisper(model_dir: str | Path) -> WhisperAssets:
+def resolve_quant(quant: str | None = None) -> str:
+    """None -> config.WHISPER_QUANT; normalized to int8|bf16|f32."""
+    if quant is None:
+        from vlog_tpu import config
+
+        quant = config.WHISPER_QUANT
+    quant = (quant or "f32").strip().lower()
+    if quant in ("", "none", "fp32"):
+        quant = "f32"
+    if quant not in ("f32", "bf16", "int8"):
+        raise ModelLoadError(f"unknown VLOG_WHISPER_QUANT mode {quant!r}")
+    return quant
+
+
+def load_whisper(model_dir: str | Path,
+                 quant: str | None = None) -> WhisperAssets:
     model_dir = Path(model_dir)
+    quant = resolve_quant(quant)
     cfg_path = model_dir / "config.json"
     if not cfg_path.exists():
         raise ModelLoadError(f"{model_dir}: missing config.json")
-    key = (str(model_dir.resolve()), cfg_path.stat().st_mtime_ns)
+    key = (str(model_dir.resolve()), cfg_path.stat().st_mtime_ns, quant)
     with _cache_lock:
         cached = _cache.get(key)
     if cached is not None:
         return cached
-    assets = _load_whisper_uncached(model_dir)
+    assets = _load_whisper_uncached(model_dir, quant)
     with _cache_lock:
         # A concurrent loader may have won the race; keep the first entry
         # so every caller shares one params tree (device memory matters).
@@ -153,7 +208,8 @@ def load_whisper(model_dir: str | Path) -> WhisperAssets:
     return assets
 
 
-def _load_whisper_uncached(model_dir: Path) -> WhisperAssets:
+def _load_whisper_uncached(model_dir: Path, quant: str = "f32"
+                           ) -> WhisperAssets:
     cfg_path = model_dir / "config.json"
     hf_cfg = json.loads(cfg_path.read_text())
     cfg = WhisperConfig.from_hf(hf_cfg)
@@ -166,6 +222,7 @@ def _load_whisper_uncached(model_dir: Path) -> WhisperAssets:
     if gc_path.exists():
         gen_cfg = json.loads(gc_path.read_text())
     tokens = derive_special_tokens(tokenizer, hf_cfg, gen_cfg)
-    params = convert_state_dict(_load_state_dict(model_dir))
+    params = quantize_params(convert_state_dict(_load_state_dict(model_dir)),
+                             quant)
     return WhisperAssets(cfg=cfg, params=params, tokenizer=tokenizer,
                          tokens=tokens, model_name=model_dir.name)

@@ -52,9 +52,37 @@ class WhisperConfig:
 Params = dict[str, jnp.ndarray]
 
 
+@dataclass(frozen=True)
+class QuantTensor:
+    """int8 per-output-channel weight: ``w ≈ q * scale[:, None]``.
+
+    ``q`` is (out, in) int8, ``scale`` is (out,) float32. Stored in the
+    params dict in place of the f32 ``*.weight``; :func:`_linear`
+    dequantizes on use, so HBM traffic per matmul drops 4x while the
+    accumulation stays f32 (PAPERS.md energy-efficient Whisper kernels).
+    """
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+
+jax.tree_util.register_dataclass(QuantTensor, ["q", "scale"], [])
+
+
 def _linear(p: Params, name: str, x: jnp.ndarray) -> jnp.ndarray:
-    """HF Linear: weight (out, in), optional bias."""
-    y = x @ p[f"{name}.weight"].T
+    """HF Linear: weight (out, in), optional bias.
+
+    Quantized planes (asr/load.py ``quantize_params``) store the weight
+    as a :class:`QuantTensor` (int8, dequant-on-use) or bf16 (cast at
+    use); the matmul itself always accumulates in the activation dtype.
+    """
+    w = p[f"{name}.weight"]
+    if isinstance(w, QuantTensor):
+        y = (x @ w.q.T.astype(jnp.float32)) * w.scale
+    else:
+        if w.dtype != x.dtype:
+            w = w.astype(x.dtype)
+        y = x @ w.T
     b = p.get(f"{name}.bias")
     return y + b if b is not None else y
 

@@ -157,10 +157,13 @@ def run_av1(backend, plan, progress_cb, resume: bool, t0: float
         start_frame = 0
 
         from vlog_tpu.ops.resize import resize_yuv420
+        from vlog_tpu.parallel.compile_cache import ensure_compile_cache
         from vlog_tpu.parallel.executor import PipelineExecutor
         from vlog_tpu.parallel.mesh import pad_batch, shard_frames
         from vlog_tpu.parallel.scheduler import (grid_for_run,
                                                  host_pool_for_run)
+
+        ensure_compile_cache()
 
         # Mesh parity with the first-party paths: rungs are partitioned
         # into cost-balanced columns of the 2-D (data x rung) grid and

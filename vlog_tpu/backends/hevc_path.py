@@ -92,11 +92,14 @@ def run_hevc(backend, plan, progress_cb, resume: bool, t0: float
 
         import jax
 
+        from vlog_tpu.parallel.compile_cache import ensure_compile_cache
         from vlog_tpu.parallel.executor import (LaggedRateControl,
                                                 PipelineExecutor)
         from vlog_tpu.parallel.hevc_ladder import hevc_chain_ladder_grid
         from vlog_tpu.parallel.scheduler import (grid_for_run,
                                                  host_pool_for_run)
+
+        ensure_compile_cache()
 
         # closed-loop VBR toward each rung's ladder bitrate, same
         # controller the H.264 path uses (per-frame QP is traced, so

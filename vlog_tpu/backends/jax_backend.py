@@ -58,32 +58,13 @@ from vlog_tpu.ops.colorspace import yuv420_to_rgb
 from vlog_tpu.ops.resize import resize_yuv420
 
 
-_COMPILE_CACHE_SET = False
-
-
 def _enable_persistent_compile_cache() -> None:
-    """XLA programs for 4K chain ladders take a minute-plus to compile;
-    the persistent cache amortizes that across worker restarts (first
-    video of a geometry pays once per fleet node, not once per process).
+    """Back-compat alias: the cache logic moved to
+    parallel/compile_cache.py so all three codec backends and the ASR
+    engine share one arming point (and the compile-seconds meter)."""
+    from vlog_tpu.parallel.compile_cache import ensure_compile_cache
 
-    TPU platforms only: CPU AOT cache entries record exact host ISA
-    features, and reloading them on a different machine warns of
-    possible SIGILL — not worth it for the fast-compiling CPU path."""
-    global _COMPILE_CACHE_SET
-    if _COMPILE_CACHE_SET:
-        return
-    _COMPILE_CACHE_SET = True
-    try:
-        import jax
-
-        if jax.devices()[0].platform == "cpu":
-            return
-        cache_dir = Path(config.BASE_DIR) / "xla_cache"
-        cache_dir.mkdir(parents=True, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-    except Exception:   # noqa: BLE001 — cache is an optimization only
-        pass
+    ensure_compile_cache()
 
 
 class JaxBackend:

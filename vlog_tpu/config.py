@@ -511,6 +511,13 @@ ASR_TICK_S: float = _env_float("VLOG_ASR_TICK_S", 0.05, lo=0.0, hi=5.0)
 # Window-queue bound; submits block (backpressure) once this many
 # windows are queued across all jobs.
 ASR_QUEUE_MAX: int = _env_int("VLOG_ASR_QUEUE_MAX", 256, lo=8, hi=8192)
+# Whisper weight storage/compute precision (asr/load.py quantizes at
+# load): "f32" (exact, the byte-identity reference), "bf16" (half-size
+# weight storage, dequant-on-use matmuls), "int8" (per-output-channel
+# symmetric weight quantization, dequant-on-use). Quantized runs trade
+# the solo-vs-packed byte-identity-vs-f32 gate for WER parity; packing
+# invariance (solo vs co-batched) holds in every mode.
+WHISPER_QUANT: str = _env_str("VLOG_WHISPER_QUANT", "f32")
 
 # --------------------------------------------------------------------------
 # Sprites (reference: config.py:572-593)
@@ -605,6 +612,19 @@ ENTROPY_THREADS: int = _env_int(
 # ladder's rung count. Non-ladder programs (make_mesh callers) read the
 # same spec and ignore axes they don't use.
 TPU_MESH_SPEC: str = _env_str("VLOG_TPU_MESH", "data:-1")
+# Fused Pallas ladder kernel (ops/pallas_ladder.py): resize + quantize +
+# uint8 cast in one VMEM pass per rung instead of three XLA dispatches.
+# "auto" fuses on real TPU only (falling back to XLA per-rung when the
+# working set exceeds VMEM, or process-wide if the probe kernel fails);
+# "1" forces the kernel wherever it probes healthy (interpreted on CPU —
+# the byte-identity test vehicle); "0" pins the classic XLA path.
+PALLAS: str = _env_str("VLOG_PALLAS", "auto")
+# Persistent XLA compile cache directory (parallel/compile_cache.py).
+# Empty = default BASE_DIR/xla_cache, enabled on TPU platforms only
+# (CPU AOT entries bake host ISA). Setting it explicitly enables the
+# cache on ANY platform with a zero min-compile-time floor — every
+# program persists, which is what the warm-vs-cold gate measures.
+COMPILE_CACHE_DIR: str = _env_str("VLOG_COMPILE_CACHE_DIR", "")
 # Mesh job slots (parallel/scheduler.py): the process's devices partition
 # into this many equal-width slots so the scheduler can admit that many
 # queued jobs onto the mesh CONCURRENTLY (e.g. 2 on a v5e-8 = two

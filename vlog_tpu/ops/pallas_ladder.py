@@ -1,0 +1,200 @@
+"""Fused Pallas ladder rung: resize + quantize + uint8 in ONE kernel.
+
+The XLA path (ops/resize.py `apply_resize_matrices`) lowers each rung to
+three dispatches — the H-axis resample matmul, the W-axis resample
+matmul, and the round/clip/uint8 quantize — with the intermediate f32
+plane making a full HBM round-trip between each. This module is the
+north-star "one-pass ladder kernel" (SNIPPETS.md [1]): a single
+``pallas_call`` per plane streams the uint8 source through VMEM once,
+applies BOTH resample matrices and the YUV plane quantize in-core, and
+writes uint8 back — one HBM read of the source and one HBM write of the
+rung per plane.
+
+Tiling: grid ``(batch, H-blocks)``. Each cell stages one full source
+plane (uint8) plus its output-row block of ``A_h`` and the whole ``A_w``
+in VMEM and emits a ``(block_rows, dst_w)`` strip of the rung. Block
+rows divide ``dst_h`` exactly, so no masked edges exist and the kernel
+body can be the *verbatim* op sequence of ``apply_resize_matrices``
+(f32 cast -> two HIGHEST-precision einsums -> clip/round/uint8) — that
+is what makes the Pallas output BYTE-IDENTICAL to the XLA path, which
+tier-1 asserts across the full shape x depth matrix in interpret mode.
+
+Byte-identity + fallback contract:
+
+- ``interpret=True`` whenever the backend is not a real TPU, so the
+  kernel runs (and stays bit-exact) on the CPU CI mesh.
+- On TPU, rungs whose working set would blow the ~16 MB/core VMEM
+  budget (4K sources) fall back to the XLA path at trace time —
+  per-rung, deterministic, shape-keyed.
+- ``pallas_available()`` probes a real tiny kernel once per process;
+  any lowering/runtime failure disables the Pallas plane process-wide
+  and the program builders transparently keep the XLA path.
+
+This is the ONLY module allowed to touch ``jax.experimental.pallas``
+(analysis/pallasshim.py enforces containment); program builders select
+the plane via :func:`ladder_resize` / the ``VLOG_PALLAS`` knob.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from vlog_tpu.ops.resize import apply_resize_matrices, resize_yuv420_with
+
+log = logging.getLogger(__name__)
+
+try:  # pallas ships with jax>=0.4.x; gate anyway (stripped-down wheels)
+    from jax.experimental import pallas as pl
+except Exception:  # noqa: BLE001 — absence just disables the fused plane
+    pl = None
+
+# VMEM working-set ceiling per grid cell on real TPU (bytes). ~16 MB/core
+# minus headroom for Mosaic's own scratch; interpret mode ignores it.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_rows(dst_h: int) -> int:
+    """Largest divisor of ``dst_h`` <= 128 (exact blocks: no masked
+    edge rows, which keeps the kernel body identical to the XLA ops)."""
+    best = 1
+    d = 1
+    while d * d <= dst_h:
+        if dst_h % d == 0:
+            for cand in (d, dst_h // d):
+                if cand <= 128 and cand > best:
+                    best = cand
+        d += 1
+    return best
+
+
+def _cell_bytes(src_h: int, src_w: int, dst_h: int, dst_w: int,
+                bh: int) -> int:
+    """VMEM estimate for one grid cell: uint8 source block + its f32
+    cast + A_h row block + A_w + the (bh, src_w) intermediate + out."""
+    return (src_h * src_w * 5           # u8 source + f32 cast
+            + 4 * bh * src_h            # A_h block
+            + 4 * dst_w * src_w         # A_w (whole)
+            + 4 * bh * src_w            # A_h @ x intermediate
+            + bh * dst_w)               # uint8 out block
+
+
+def _rung_kernel(src_ref, ah_ref, aw_ref, out_ref):
+    # VERBATIM op sequence of ops/resize.py apply_resize_matrices on a
+    # (1, H, W) block — the byte-identity contract with the XLA path.
+    x = src_ref[...].astype(jnp.float32)
+    x = jnp.einsum("hH,...Hw->...hw", ah_ref[...], x,
+                   precision=jax.lax.Precision.HIGHEST)
+    x = jnp.einsum("...hw,Ww->...hW", x, aw_ref[...],
+                   precision=jax.lax.Precision.HIGHEST)
+    out_ref[...] = jnp.clip(jnp.round(x), 0, 255).astype(jnp.uint8)
+
+
+def fused_resize_plane(plane, a_h, a_w):
+    """(..., H, W) x (h, H) x (w, W) -> (..., h, w) uint8, one HBM pass.
+
+    Trace-time fallback to the XLA path when Pallas is absent or the
+    rung's working set exceeds the VMEM budget on real TPU (interpret
+    mode has no such limit). Output is byte-identical either way.
+    """
+    src_h, src_w = plane.shape[-2], plane.shape[-1]
+    dst_h, dst_w = a_h.shape[0], a_w.shape[0]
+    bh = _block_rows(dst_h)
+    interpret = _interpret()
+    if pl is None or (not interpret
+                      and _cell_bytes(src_h, src_w, dst_h, dst_w,
+                                      bh) > _VMEM_BUDGET):
+        return apply_resize_matrices(plane, a_h, a_w)
+    lead = plane.shape[:-2]
+    x = plane.reshape((-1, src_h, src_w))
+    n = x.shape[0]
+    out = pl.pallas_call(
+        _rung_kernel,
+        grid=(n, dst_h // bh),
+        in_specs=[
+            pl.BlockSpec((1, src_h, src_w), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((bh, src_h), lambda i, j: (j, 0)),
+            pl.BlockSpec((dst_w, src_w), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bh, dst_w), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, dst_h, dst_w), jnp.uint8),
+        interpret=interpret,
+    )(x, a_h, a_w)
+    return out.reshape(lead + (dst_h, dst_w))
+
+
+def resize_yuv420_pallas(y, u, v, rung_mats):
+    """Drop-in for ops/resize.py ``resize_yuv420_with`` on the fused
+    plane. Identity rungs (mats None) share the XLA path's clamp/cast
+    contract — there is no resample to fuse."""
+    if rung_mats is None:
+        return resize_yuv420_with(y, u, v, None)
+    (a_h, a_w), (c_h, c_w) = rung_mats
+    return (
+        fused_resize_plane(y, a_h, a_w),
+        fused_resize_plane(u, c_h, c_w),
+        fused_resize_plane(v, c_h, c_w),
+    )
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_available() -> bool:
+    """One-shot probe: compile + run a real tiny fused kernel and check
+    it against the XLA path. Any failure (missing pallas, Mosaic
+    lowering error, wrong bytes) disables the fused plane process-wide
+    — the program builders then keep the XLA path transparently."""
+    if pl is None:
+        return False
+    try:
+        import numpy as np
+
+        from vlog_tpu.ops.resize import resample_matrix
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.integers(0, 256, (2, 32, 48), dtype=np.uint8))
+        a_h = jnp.asarray(resample_matrix(32, 16))
+        a_w = jnp.asarray(resample_matrix(48, 24))
+        got = jax.jit(fused_resize_plane)(x, a_h, a_w)
+        ref = apply_resize_matrices(x, a_h, a_w)
+        ok = bool(jnp.array_equal(got, ref))
+        if not ok:
+            log.warning("pallas ladder kernel output mismatched the XLA "
+                        "path; disabling VLOG_PALLAS for this process")
+        return ok
+    except Exception as exc:  # noqa: BLE001 — degrade, don't crash
+        log.warning("pallas ladder kernel unavailable (%s); using the "
+                    "XLA resize path", exc)
+        return False
+
+
+def use_pallas(mode: str | None = None) -> bool:
+    """Resolve VLOG_PALLAS (auto|1|0) to the plane this process runs.
+
+    ``auto`` fuses only on real TPU (interpret mode is a correctness
+    vehicle, not a fast path); ``1`` forces the kernel wherever it
+    probes healthy (CI runs it interpreted for the byte-identity
+    matrix); ``0`` pins the XLA path.
+    """
+    if mode is None:
+        from vlog_tpu import config
+
+        mode = config.PALLAS
+    mode = str(mode).strip().lower()
+    if mode in ("0", "off", "false"):
+        return False
+    if mode in ("1", "on", "true"):
+        return pallas_available()
+    return (not _interpret()) and pallas_available()
+
+
+def ladder_resize(pallas: bool) -> Callable:
+    """The resize plane a program builder compiles against."""
+    return resize_yuv420_pallas if pallas else resize_yuv420_with

@@ -124,23 +124,33 @@ def plan_ladder_matrices(src_h: int, src_w: int,
     """{(h, w): ((A_h, A_w), (A_h_c, A_w_c)) | None} for every rung.
 
     None marks an identity (source-size) rung. Chroma matrices are the
-    half-resolution pair.
+    half-resolution pair. Memoized per (geometry, rungs, filter) — every
+    program (re)build used to pay the full lanczos window construction
+    again; callers get a fresh dict each call (safe to mutate) backed by
+    the cached immutable plan.
     """
+    return dict(_plan_ladder_cached(src_h, src_w, tuple(rungs_hw), filter))
+
+
+@functools.lru_cache(maxsize=64)
+def _plan_ladder_cached(src_h: int, src_w: int,
+                        rungs_hw: tuple[tuple[int, int], ...],
+                        filter: str) -> tuple:
     if src_h % 2 or src_w % 2:
         raise ValueError("4:2:0 source dimensions must be even")
-    mats = {}
+    mats = []
     for (h, w) in rungs_hw:
         if h % 2 or w % 2:
             raise ValueError(f"4:2:0 rung dimensions must be even: {(h, w)}")
         if (h, w) == (src_h, src_w):
-            mats[(h, w)] = None
+            mats.append(((h, w), None))
             continue
-        mats[(h, w)] = (
+        mats.append(((h, w), (
             (resample_matrix(src_h, h, filter), resample_matrix(src_w, w, filter)),
             (resample_matrix(src_h // 2, h // 2, filter),
              resample_matrix(src_w // 2, w // 2, filter)),
-        )
-    return mats
+        )))
+    return tuple(mats)
 
 
 def apply_resize_matrices(plane, a_h, a_w, out_dtype=jnp.uint8):

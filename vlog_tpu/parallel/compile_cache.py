@@ -1,0 +1,114 @@
+"""Shared persistent-compile-cache plumbing + per-process compile meter.
+
+Cold-compile elimination has two halves:
+
+1. **Persistence.** The XLA programs for 4K chain ladders take a
+   minute-plus to compile; ``jax_compilation_cache_dir`` amortizes that
+   across worker restarts (first video of a geometry pays once per
+   fleet node, not once per process). This used to be a private helper
+   of the H.264 backend — now every codec backend (h264/hevc/av1 all
+   funnel through ``JaxBackend`` dispatch, but the HEVC/AV1 entry
+   modules arm it independently for their standalone tools) AND the
+   ASR engine call :func:`ensure_compile_cache` before first dispatch.
+
+   Platform policy: auto-enabled on TPU only — CPU AOT entries record
+   exact host ISA features and reloading them on a different machine
+   risks SIGILL. An EXPLICIT ``VLOG_COMPILE_CACHE_DIR`` overrides that
+   and also drops the min-compile-time floor to zero so every program
+   persists; that is the mode the warm-vs-cold gate (and any CI on
+   this VM) measures.
+
+2. **Attribution.** ``compile_seconds()`` meters this process's
+   cumulative backend-compile wall time via ``jax.monitoring``'s
+   ``/jax/core/compile/backend_compile_duration`` events (a persistent-
+   cache HIT skips the backend compile entirely, so warm processes
+   report a fraction of cold ones). bench.py / dryrun stamp the value
+   into their labeled records as ``compile_s`` so the trajectory can
+   tell kernel wins from cache wins across PRs.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from vlog_tpu import config
+
+# _state and _meter are only read/written under _lock (module-level
+# singletons, so the guarded-by annotation idiom for instance fields
+# does not apply here).
+_lock = threading.Lock()
+_state: dict = {"armed": False, "dir": None}
+_meter: dict = {"registered": False, "seconds": 0.0}
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_event_duration(event: str, duration: float, **_kw) -> None:
+    if event == _COMPILE_EVENT:
+        with _lock:
+            _meter["seconds"] += float(duration)
+
+
+def _register_meter_locked() -> None:
+    if _meter["registered"]:
+        return
+    _meter["registered"] = True
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+    except Exception:  # noqa: BLE001 — the meter is observability only
+        pass
+
+
+def compile_seconds() -> float:
+    """Cumulative XLA backend-compile seconds metered this process (0.0
+    until :func:`ensure_compile_cache` or a bench arms the listener)."""
+    with _lock:
+        _register_meter_locked()
+        return _meter["seconds"]
+
+
+def ensure_compile_cache() -> str | None:
+    """Arm the persistent compile cache (idempotent); returns the cache
+    dir in effect, or None when disabled for this platform."""
+    with _lock:
+        _register_meter_locked()
+        if _state["armed"]:
+            return _state["dir"]
+        _state["armed"] = True
+    explicit = config.COMPILE_CACHE_DIR.strip()
+    try:
+        from pathlib import Path
+
+        import jax
+
+        if not explicit and jax.devices()[0].platform == "cpu":
+            return None
+        cache_dir = Path(explicit) if explicit \
+            else Path(config.BASE_DIR) / "xla_cache"
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0 if explicit else 5.0)
+        # jax initializes its cache object at most once per process; if
+        # a compile already ran before we armed, the new dir is ignored
+        # until the cache state is reset. Arming late must still work.
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _jcc)
+
+        _jcc.reset_cache()
+        with _lock:
+            _state["dir"] = str(cache_dir)
+        return str(cache_dir)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        return None
+
+
+def reset_for_tests() -> None:
+    """Forget armed state + meter (unit tests re-arm with fresh knobs)."""
+    with _lock:
+        _state["armed"] = False
+        _state["dir"] = None
+        _meter["seconds"] = 0.0

@@ -165,7 +165,16 @@ def _append_records(path: str, records: list[dict]) -> None:
 
 def _multichip_records(n_devices: int, shape_fps: dict,
                        sched: dict) -> list[dict]:
+    from vlog_tpu import config
+    from vlog_tpu.ops.pallas_ladder import use_pallas
+    from vlog_tpu.parallel.compile_cache import compile_seconds
+
     ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    # raw-speed plane stamps on every record: kernel plane, whisper
+    # quant mode, this process's metered XLA compile seconds
+    speed = {"pallas": use_pallas(),
+             "whisper_quant": config.WHISPER_QUANT,
+             "compile_s": round(compile_seconds(), 3)}
     recs = []
     for workload in ("full", "small_batch"):
         for label, fps in (shape_fps.get(workload) or {}).items():
@@ -175,14 +184,14 @@ def _multichip_records(n_devices: int, shape_fps: dict,
                 "fps": fps,
                 "timestamp": ts,
                 "config": {"devices": n_devices, "mesh_shape": label,
-                           "workload": workload}})
+                           "workload": workload, **speed}})
     summary = shape_fps.get("small_batch_summary")
     if summary:
         recs.append({"step": "small_batch_summary",
                      "metric": "ladder_shape_win_x",
                      "win_x": summary.get("win_x"),
                      "timestamp": ts,
-                     "config": {"devices": n_devices, **summary}})
+                     "config": {"devices": n_devices, **summary, **speed}})
     if sched and "speedup" in sched:
         recs.append({"step": "sched_packing",
                      "metric": "sched_speedup_x",
@@ -190,7 +199,8 @@ def _multichip_records(n_devices: int, shape_fps: dict,
                      "timestamp": ts,
                      "config": {"devices": n_devices,
                                 "jobs": sched.get("jobs"),
-                                "slot_widths": sched.get("slot_widths")}})
+                                "slot_widths": sched.get("slot_widths"),
+                                **speed}})
     return recs
 
 

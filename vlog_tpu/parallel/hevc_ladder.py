@@ -28,8 +28,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from vlog_tpu.codecs.hevc.jax_core import encode_chain_dsp
 from vlog_tpu.codecs.hevc.syntax import CTB
-from vlog_tpu.ops.resize import resize_yuv420_with
-from vlog_tpu.parallel.ladder import GridProgram, RungSpec, ladder_matrices
+from vlog_tpu.ops.pallas_ladder import ladder_resize, use_pallas
+from vlog_tpu.parallel.ladder import (GridProgram, RungSpec, _jit_frames,
+                                      ladder_matrices)
 from vlog_tpu.parallel.mesh import RungGrid, shard_map
 
 
@@ -47,24 +48,29 @@ def _pad_ctb(y, u, v):
 def hevc_chain_ladder_program(rungs: tuple[RungSpec, ...], src_h: int,
                               src_w: int, search: int = 16,
                               mesh: Mesh | None = None,
-                              deblock: bool | None = None
+                              deblock: bool | None = None,
+                              pallas: bool | None = None
                               ) -> tuple[Callable, dict]:
-    """Resolve ``deblock`` (None -> config.HEVC_DEBLOCK) OUTSIDE the
-    cache: resolving inside would let two different config states share
-    one cache entry (tests monkeypatch the flag)."""
+    """Resolve ``deblock`` (None -> config.HEVC_DEBLOCK) and ``pallas``
+    (None -> VLOG_PALLAS + probe) OUTSIDE the cache: resolving inside
+    would let two different config states share one cache entry (tests
+    monkeypatch the flags)."""
     if deblock is None:
         from vlog_tpu import config
 
         deblock = config.HEVC_DEBLOCK
+    if pallas is None:
+        pallas = use_pallas()
     return _hevc_chain_ladder_cached(rungs, src_h, src_w, search, mesh,
-                                     bool(deblock))
+                                     bool(deblock), bool(pallas))
 
 
 @functools.lru_cache(maxsize=8)
 def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
                               src_w: int, search: int,
                               mesh: Mesh | None,
-                              deblock: bool
+                              deblock: bool,
+                              pallas: bool
                               ) -> tuple[Callable, dict]:
     """``fn(y, u, v, mats, qps)`` with y/u/v (n_chains, clen, ...) uint8
     and ``qps`` mapping rung -> (n_chains, clen) int32 (frame 0's value
@@ -78,10 +84,12 @@ def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
       sse_y (n, clen) float32 over the display region
     """
 
+    resize = ladder_resize(pallas)
+
     def one_rung(y, u, v, rung_mats, qps, h, w, rcr=None):
         n, clen = y.shape[0], y.shape[1]
         flat = lambda p: p.reshape((n * clen,) + p.shape[2:])
-        ry, ru, rv = resize_yuv420_with(flat(y), flat(u), flat(v), rung_mats)
+        ry, ru, rv = resize(flat(y), flat(u), flat(v), rung_mats)
         py, pu, pv = _pad_ctb(ry, ru, rv)
         unflat = lambda p: p.reshape((n, clen) + p.shape[1:])
         py, pu, pv = unflat(py), unflat(pu), unflat(pv)
@@ -141,39 +149,44 @@ def _hevc_chain_ladder_cached(rungs: tuple[RungSpec, ...], src_h: int,
         out_specs=P("data"),
         check_vma=False,
     )
-    return jax.jit(fn), jax.device_put(mats, NamedSharding(mesh, P()))
+    return _jit_frames(fn, mesh), jax.device_put(mats,
+                                                 NamedSharding(mesh, P()))
 
 
 def hevc_chain_ladder_grid(rungs: tuple[RungSpec, ...], src_h: int,
                            src_w: int, search: int = 16,
                            grid: RungGrid | None = None,
-                           deblock: bool | None = None) -> GridProgram:
+                           deblock: bool | None = None,
+                           pallas: bool | None = None) -> GridProgram:
     """Grid-wide HEVC chain ladder: per-column programs over a
     (data × rung) grid, same dispatch surface as the H.264 grids.
 
-    ``deblock`` resolves (None -> config.HEVC_DEBLOCK) here, outside
-    the caches, for the same reason as :func:`hevc_chain_ladder_program`.
+    ``deblock``/``pallas`` resolve (None -> config) here, outside the
+    caches, for the same reason as :func:`hevc_chain_ladder_program`.
     """
     if deblock is None:
         from vlog_tpu import config
 
         deblock = config.HEVC_DEBLOCK
+    if pallas is None:
+        pallas = use_pallas()
     return _hevc_grid_cached(rungs, src_h, src_w, search, grid,
-                             bool(deblock))
+                             bool(deblock), bool(pallas))
 
 
 @functools.lru_cache(maxsize=8)
 def _hevc_grid_cached(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
                       search: int, grid: RungGrid | None,
-                      deblock: bool) -> GridProgram:
+                      deblock: bool, pallas: bool) -> GridProgram:
     if grid is None:
         fn, mats = _hevc_chain_ladder_cached(rungs, src_h, src_w, search,
-                                             None, deblock)
+                                             None, deblock, pallas)
         names = tuple(r[0] for r in rungs)
         return GridProgram(((names, None, fn, mats),), 1, "1x1", True)
     cols = []
     for col in grid.columns:
         fn, mats = _hevc_chain_ladder_cached(col.rungs, src_h, src_w,
-                                             search, col.mesh, deblock)
+                                             search, col.mesh, deblock,
+                                             pallas)
         cols.append((col.names, col.mesh, fn, mats))
     return GridProgram(tuple(cols), grid.data, grid.label, True)

@@ -29,10 +29,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from vlog_tpu.parallel.mesh import RungGrid, shard_frames, shard_map
 
 from vlog_tpu.codecs.h264.encoder import encode_frame
+from vlog_tpu.ops.pallas_ladder import ladder_resize, use_pallas
 from vlog_tpu.ops.resize import plan_ladder_matrices, resize_yuv420_with
 
 # Static description of one rung: (name, height, width, qp)
 RungSpec = tuple[str, int, int, int]
+
+
+def _jit_frames(fn, mesh):
+    """jit with frame-tensor buffer donation where it is safe+useful.
+
+    The y/u/v args (argnums 0-2) are per-dispatch ``shard_frames``
+    device arrays the GridProgram drops right after the call, so on TPU
+    their HBM pages can back the outputs instead of doubling the
+    working set. Donation stays off when mesh is None (single-chip
+    dispatch feeds host numpy — nothing donatable) and off-TPU
+    (XLA:CPU donation is a no-op that warns per dispatch).
+    """
+    import jax as _jax
+
+    if mesh is not None and _jax.devices()[0].platform == "tpu":
+        return jax.jit(fn, donate_argnums=(0, 1, 2))
+    return jax.jit(fn)
 
 
 def _pad_mb(y, u, v):
@@ -53,14 +71,16 @@ def ladder_matrices(rungs: tuple[RungSpec, ...], src_h: int, src_w: int) -> dict
     return {name: by_hw[(h, w)] for name, h, w, _ in rungs}
 
 
-def _encode_rung(y, u, v, rung_mats, qp):
+def _encode_rung(y, u, v, rung_mats, qp, resize=resize_yuv420_with):
     """Shared per-rung body: resize -> MB-pad -> batch intra encode.
 
     ``qp`` is a scalar or a (n,) per-frame vector (traced — rate control
     steps QP without recompiling). Returns (levels, resized_y) —
     resized_y is the display-size luma used for quality stats.
+    ``resize`` is the resize plane the program was built for (the XLA
+    einsum path, or ops/pallas_ladder's fused kernel — byte-identical).
     """
-    ry, ru, rv = resize_yuv420_with(y, u, v, rung_mats)
+    ry, ru, rv = resize(y, u, v, rung_mats)
     py, pu, pv = _pad_mb(ry, ru, rv)
     qv = jnp.broadcast_to(jnp.asarray(qp, jnp.int32), (py.shape[0],))
     levels = jax.vmap(
@@ -68,20 +88,34 @@ def _encode_rung(y, u, v, rung_mats, qp):
     return levels, ry
 
 
-def ladder_local(y, u, v, mats: dict, rungs: tuple[RungSpec, ...], qps=None):
+def ladder_local(y, u, v, mats: dict, rungs: tuple[RungSpec, ...], qps=None,
+                 resize=resize_yuv420_with):
     """Device-local body: frames (n, H, W) -> levels for every rung.
 
     ``qps`` optionally maps rung name -> per-frame QP vector; rungs'
     static QP is the default.
     """
     return {name: _encode_rung(y, u, v, mats[name],
-                               qp if qps is None else qps[name])[0]
+                               qp if qps is None else qps[name],
+                               resize=resize)[0]
             for name, h, w, qp in rungs}
 
 
-@functools.lru_cache(maxsize=8)
 def ladder_encode_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
-                          mesh: Mesh | None = None) -> tuple[Callable, dict]:
+                          mesh: Mesh | None = None,
+                          pallas: bool | None = None) -> tuple[Callable, dict]:
+    """Resolve ``pallas`` (None -> VLOG_PALLAS + probe) OUTSIDE the
+    cache — the hevc_ladder deblock idiom: resolving inside would let
+    two different config states share one compiled entry."""
+    if pallas is None:
+        pallas = use_pallas()
+    return _ladder_encode_cached(rungs, src_h, src_w, mesh, bool(pallas))
+
+
+@functools.lru_cache(maxsize=8)
+def _ladder_encode_cached(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
+                          mesh: Mesh | None,
+                          pallas: bool) -> tuple[Callable, dict]:
     """The production one-pass ladder step the backend dispatches per batch.
 
     Returns (fn, mats) with ``fn(y, u, v, mats, qps)`` where ``qps`` maps
@@ -100,10 +134,13 @@ def ladder_encode_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
     independent in all-intra; zero steady-state collectives) — the
     multi-chip path of SURVEY.md §2d.5. Without one, a plain jit.
     """
+    resize = ladder_resize(pallas)
+
     def local(y, u, v, mats, qps):
         out = {}
         for name, h, w, qp in rungs:
-            levels, ry = _encode_rung(y, u, v, mats[name], qps[name])
+            levels, ry = _encode_rung(y, u, v, mats[name], qps[name],
+                                      resize=resize)
             err = (levels["recon_y"][:, :h, :w].astype(jnp.float32)
                    - ry.astype(jnp.float32))
             out[name] = {
@@ -128,13 +165,24 @@ def ladder_encode_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
     )
     mats = ladder_matrices(rungs, src_h, src_w)
     mats = jax.device_put(mats, NamedSharding(mesh, P()))
-    return jax.jit(fn), mats
+    return _jit_frames(fn, mesh), mats
+
+
+def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
+                         search: int = 8, mesh: Mesh | None = None,
+                         deblock: bool = False,
+                         pallas: bool | None = None) -> tuple[Callable, dict]:
+    """Resolve ``pallas`` outside the cache (see ladder_encode_program)."""
+    if pallas is None:
+        pallas = use_pallas()
+    return _ladder_chain_cached(rungs, src_h, src_w, search, mesh,
+                                deblock, bool(pallas))
 
 
 @functools.lru_cache(maxsize=8)
-def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
-                         search: int = 8, mesh: Mesh | None = None,
-                         deblock: bool = False
+def _ladder_chain_cached(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
+                         search: int, mesh: Mesh | None,
+                         deblock: bool, pallas: bool
                          ) -> tuple[Callable, dict]:
     """The I+P chain ladder step (GOP_MODE="p" production path).
 
@@ -187,11 +235,13 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
     # per-chain reduction: each array is (n, ...) -> (n,)
     _proxy = functools.partial(cost_proxy, batch_ndim=1)
 
+    resize = ladder_resize(pallas)
+
     def one_rung(y, u, v, rung_mats, qps, h, w, rcr=None):
         # y: (n, clen, H, W) local chains; resize whole block at once
         n, clen = y.shape[0], y.shape[1]
         flat = lambda p: p.reshape((n * clen,) + p.shape[2:])
-        ry, ru, rv = resize_yuv420_with(flat(y), flat(u), flat(v), rung_mats)
+        ry, ru, rv = resize(flat(y), flat(u), flat(v), rung_mats)
         py, pu, pv = _pad_mb(ry, ru, rv)
         unflat = lambda p: p.reshape((n, clen) + p.shape[1:])
         py, pu, pv = unflat(py), unflat(pu), unflat(pv)
@@ -318,7 +368,7 @@ def ladder_chain_program(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
         out_specs=P("data"),
         check_vma=False,
     )
-    return jax.jit(fn), jax.device_put(mats, NamedSharding(mesh, P()))
+    return _jit_frames(fn, mesh), jax.device_put(mats, NamedSharding(mesh, P()))
 
 
 class GridProgram:
@@ -365,64 +415,97 @@ class GridProgram:
         return outs
 
 
-@functools.lru_cache(maxsize=8)
 def ladder_encode_grid(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
-                       grid: RungGrid | None = None) -> GridProgram:
-    """Grid-wide intra ladder: per-column ``ladder_encode_program``s.
+                       grid: RungGrid | None = None,
+                       pallas: bool | None = None) -> GridProgram:
+    """Grid-wide intra ladder: per-column encode programs.
 
-    Cached per (rungs, geometry, grid) on top of the per-column program
-    cache, so regenerating the same grid reuses every compiled column.
+    ``pallas`` resolves (None -> VLOG_PALLAS + probe) here, outside the
+    caches, so the resolved plane keys both this cache and the
+    per-column program cache.
     """
+    if pallas is None:
+        pallas = use_pallas()
+    return _ladder_encode_grid_cached(rungs, src_h, src_w, grid,
+                                      bool(pallas))
+
+
+@functools.lru_cache(maxsize=8)
+def _ladder_encode_grid_cached(rungs: tuple[RungSpec, ...], src_h: int,
+                               src_w: int, grid: RungGrid | None,
+                               pallas: bool) -> GridProgram:
+    """Cached per (rungs, geometry, grid, pallas) on top of the
+    per-column program cache, so regenerating the same grid reuses
+    every compiled column."""
     if grid is None:
-        fn, mats = ladder_encode_program(rungs, src_h, src_w, None)
+        fn, mats = _ladder_encode_cached(rungs, src_h, src_w, None, pallas)
         names = tuple(r[0] for r in rungs)
         return GridProgram(((names, None, fn, mats),), 1, "1x1", False)
     cols = []
     for col in grid.columns:
-        fn, mats = ladder_encode_program(col.rungs, src_h, src_w, col.mesh)
+        fn, mats = _ladder_encode_cached(col.rungs, src_h, src_w,
+                                         col.mesh, pallas)
         cols.append((col.names, col.mesh, fn, mats))
     return GridProgram(tuple(cols), grid.data, grid.label, False)
 
 
-@functools.lru_cache(maxsize=8)
 def ladder_chain_grid(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
                       search: int = 8, grid: RungGrid | None = None,
-                      deblock: bool = False) -> GridProgram:
-    """Grid-wide I+P chain ladder: per-column ``ladder_chain_program``s."""
+                      deblock: bool = False,
+                      pallas: bool | None = None) -> GridProgram:
+    """Grid-wide I+P chain ladder: per-column chain programs. ``pallas``
+    resolves outside the caches (see ladder_encode_grid)."""
+    if pallas is None:
+        pallas = use_pallas()
+    return _ladder_chain_grid_cached(rungs, src_h, src_w, search, grid,
+                                     deblock, bool(pallas))
+
+
+@functools.lru_cache(maxsize=8)
+def _ladder_chain_grid_cached(rungs: tuple[RungSpec, ...], src_h: int,
+                              src_w: int, search: int,
+                              grid: RungGrid | None, deblock: bool,
+                              pallas: bool) -> GridProgram:
     if grid is None:
-        fn, mats = ladder_chain_program(rungs, src_h, src_w, search=search,
-                                        mesh=None, deblock=deblock)
+        fn, mats = _ladder_chain_cached(rungs, src_h, src_w, search,
+                                        None, deblock, pallas)
         names = tuple(r[0] for r in rungs)
         return GridProgram(((names, None, fn, mats),), 1, "1x1", True)
     cols = []
     for col in grid.columns:
-        fn, mats = ladder_chain_program(col.rungs, src_h, src_w,
-                                        search=search, mesh=col.mesh,
-                                        deblock=deblock)
+        fn, mats = _ladder_chain_cached(col.rungs, src_h, src_w, search,
+                                        col.mesh, deblock, pallas)
         cols.append((col.names, col.mesh, fn, mats))
     return GridProgram(tuple(cols), grid.data, grid.label, True)
 
 
-def single_chip_ladder(rungs: tuple[RungSpec, ...], src_h: int, src_w: int
-                       ) -> tuple[Callable, dict]:
+def single_chip_ladder(rungs: tuple[RungSpec, ...], src_h: int, src_w: int,
+                       pallas: bool | None = None) -> tuple[Callable, dict]:
     """Jitted one-device ladder step + its matrices pytree.
 
     Returns (fn, mats): call ``fn(y, u, v, mats)``.
     """
-    fn = jax.jit(functools.partial(ladder_local, rungs=rungs))
+    if pallas is None:
+        pallas = use_pallas()
+    fn = jax.jit(functools.partial(ladder_local, rungs=rungs,
+                                   resize=ladder_resize(bool(pallas))))
     return fn, ladder_matrices(rungs, src_h, src_w)
 
 
 def sharded_ladder_levels(mesh: Mesh, rungs: tuple[RungSpec, ...],
-                          src_h: int, src_w: int) -> tuple[Callable, dict]:
+                          src_h: int, src_w: int,
+                          pallas: bool | None = None) -> tuple[Callable, dict]:
     """Sharded ladder step for one mesh + rung set + source geometry.
 
     Returns (fn, mats). ``fn(y, u, v, mats)``: leading frame axis must
     divide by the data-axis size; outputs are sharded on "data"; ``mats``
     is replicated.
     """
+    if pallas is None:
+        pallas = use_pallas()
     fn = shard_map(
-        functools.partial(ladder_local, rungs=rungs),
+        functools.partial(ladder_local, rungs=rungs,
+                          resize=ladder_resize(bool(pallas))),
         mesh=mesh,
         in_specs=(P("data"), P("data"), P("data"), P()),
         out_specs=P("data"),
@@ -433,11 +516,12 @@ def sharded_ladder_levels(mesh: Mesh, rungs: tuple[RungSpec, ...],
     )
     mats = ladder_matrices(rungs, src_h, src_w)
     mats = jax.device_put(mats, NamedSharding(mesh, P()))
-    return jax.jit(fn), mats
+    return _jit_frames(fn, mesh), mats
 
 
 def sharded_ladder_step(mesh: Mesh, rungs: tuple[RungSpec, ...],
-                        src_h: int, src_w: int) -> tuple[Callable, dict]:
+                        src_h: int, src_w: int,
+                        pallas: bool | None = None) -> tuple[Callable, dict]:
     """Ladder step + per-rung quality stats (the "training step" analog).
 
     Besides the levels, computes mean PSNR-Y per rung against the resized
