@@ -104,6 +104,24 @@ def _budget_left(reserve: float = 0.0) -> int:
 # Smoke body: is the accelerator reachable at all?
 # ---------------------------------------------------------------------------
 
+def run_probe() -> None:
+    """Device enumeration ONLY — no dispatch, no compile. A dead
+    forwarding tunnel hangs right here in backend init, so a ~20 s
+    bound on this body is enough to tell "tunnel dead" from "tunnel
+    up"; the parent then skips the 3x300 s smoke retries entirely
+    (the BENCH_r05 rc=124 debt)."""
+    import jax
+
+    devs = jax.devices()
+    print(json.dumps({"probe": "ok", "platform": devs[0].platform,
+                      "device_count": len(devs)}), flush=True)
+
+
+# Pre-flight probe bound. 0 disables the probe (smoke attempts then
+# carry the full cost of discovering a dead tunnel, as before).
+PROBE_TIMEOUT_S = int(os.environ.get("VLOG_BENCH_PROBE_S", "20"))
+
+
 def run_smoke() -> None:
     import jax
     import numpy as np
@@ -626,6 +644,21 @@ def _merge_entropy(record: dict, entropy_line: str | None) -> dict:
     return record
 
 
+def _stamp_trend(record: dict) -> dict:
+    """Annotate the outgoing record with the committed-trajectory trend
+    (obs/benchtrend.py), so every bench round self-reports whether it
+    regressed the series it is about to extend. Best-effort: a bench
+    record must never be lost to a trend-gate parse error."""
+    try:
+        from vlog_tpu.obs.benchtrend import summary_line
+
+        record["trend"] = summary_line(os.path.dirname(
+            os.path.abspath(__file__)))
+    except Exception as exc:   # noqa: BLE001 — stamp is garnish
+        record["trend"] = f"trend unavailable: {exc}"
+    return record
+
+
 def main() -> int:
     if len(sys.argv) >= 3 and sys.argv[1] == "--body":
         run_body(sys.argv[2])
@@ -636,6 +669,9 @@ def main() -> int:
     if len(sys.argv) >= 2 and sys.argv[1] == "--entropy":
         run_entropy()
         return 0
+    if len(sys.argv) >= 2 and sys.argv[1] == "--probe":
+        run_probe()
+        return 0
 
     # Phase 0: host entropy throughput (CPU, accelerator-independent).
     # Runs first so a later tunnel stall can't starve it of wall clock.
@@ -643,14 +679,45 @@ def main() -> int:
         "--entropy", "cpu",
         max(120, min(CPU_TIMEOUT_S, _budget_left(CPU_FALLBACK_RESERVE_S))))
 
+    # Phase 0.5: dead-tunnel pre-flight. Device enumeration costs
+    # seconds when the tunnel is up and hangs in backend init when it
+    # is not — so a ~20 s probe decides whether the smoke attempts are
+    # worth their 300 s timeouts at all. VLOG_BENCH_PROBE_S=0 disables.
+    probe_ok = True
+    probe_reason = ""
+    if PROBE_TIMEOUT_S > 0:
+        t = min(PROBE_TIMEOUT_S, _budget_left(CPU_FALLBACK_RESERVE_S))
+        line, probe_timed_out = ((None, True) if t < 5
+                                 else _attempt("--probe", "tpu", t))
+        info: dict = {}
+        if line:
+            try:
+                info = json.loads(line)
+            except ValueError:
+                info = {}
+        if info.get("probe") == "ok" and info.get("platform") != "cpu":
+            print(f"bench: probe ok ({info.get('platform')} x"
+                  f"{info.get('device_count')})", file=sys.stderr)
+        elif info.get("probe") == "ok":
+            probe_ok = False
+            probe_reason = "accelerator_absent_probe_resolved_cpu"
+        else:
+            probe_ok = False
+            probe_reason = ("tunnel_dead_probe_timeout" if probe_timed_out
+                            else "tunnel_dead_probe_failed")
+        if not probe_ok:
+            print(f"bench: pre-flight probe failed ({probe_reason}); "
+                  "skipping smoke attempts, going straight to the "
+                  "labeled CPU fallback", file=sys.stderr)
+
     # Phase 1: smoke. A ~seconds-scale dispatch distinguishes "tunnel
     # down" (retry, then CPU fallback) from "code broken" (the 900 s
     # body would fail identically on CPU, where it is cheap to see).
     # Attempts stop early once the budget (minus the CPU-fallback
     # reserve) runs dry: a labeled fallback record ALWAYS beats one
-    # more smoke retry.
+    # more smoke retry. A failed pre-flight probe skips them outright.
     smoke_ok = False
-    for i in range(SMOKE_ATTEMPTS):
+    for i in range(SMOKE_ATTEMPTS if probe_ok else 0):
         t = min(SMOKE_TIMEOUT_S, _budget_left(CPU_FALLBACK_RESERVE_S))
         if t < 30:
             print("bench: smoke budget exhausted; going to CPU fallback",
@@ -669,7 +736,7 @@ def main() -> int:
             time.sleep(SMOKE_RETRY_SLEEP_S)
 
     # Phase 2: the measurement body on the accelerator.
-    reason = "tunnel_unreachable_smoke_failed"
+    reason = probe_reason or "tunnel_unreachable_smoke_failed"
     if smoke_ok:
         t = min(TPU_TIMEOUT_S, _budget_left(CPU_FALLBACK_RESERVE_S))
         line = None
@@ -682,8 +749,8 @@ def main() -> int:
                   "smoke); falling back to labeled CPU measurement",
                   file=sys.stderr)
         if line:
-            print(json.dumps(_merge_entropy(json.loads(line),
-                                            entropy_line)))
+            print(json.dumps(_stamp_trend(_merge_entropy(
+                json.loads(line), entropy_line))))
             return 0
         if not body_ran:
             reason = "tpu_body_skipped_budget_exhausted"
@@ -707,11 +774,11 @@ def main() -> int:
         # (the round-5 failure mode).
         record.setdefault("fallback_reason", reason)
         record.setdefault("smoke_ok", smoke_ok)
-        print(json.dumps(record))
+        print(json.dumps(_stamp_trend(record)))
         return 0
     # Even total failure publishes a clean labeled record (entropy is a
     # host property and usually survives a dead tunnel — keep it).
-    print(json.dumps(_merge_entropy({
+    print(json.dumps(_stamp_trend(_merge_entropy({
         "metric": "ladder_device_realtime_x",
         "value": 0.0,
         "unit": "bench_failed_all_platforms",
@@ -720,7 +787,7 @@ def main() -> int:
                             f"{'timeout' if cpu_timed_out else 'failed'}"),
         "smoke_ok": smoke_ok,
         "budget_left_s": _budget_left(),
-    }, entropy_line)))
+    }, entropy_line))))
     return 1
 
 

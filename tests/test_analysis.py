@@ -813,4 +813,4 @@ def test_every_pass_ran_over_a_parsed_repo():
     assert "vlog_tpu/worker/brownout.py" in rels
     assert set(PASSES) == {"asyncblock", "lockdiscipline", "epochfence",
                            "tracehop", "registry", "meshshim", "pallasshim",
-                           "lockorder", "holdblock"}
+                           "lockorder", "holdblock", "slowlane"}

@@ -19,6 +19,10 @@ The contract under test (asr/engine.py + asr/queue.py):
 
 from __future__ import annotations
 
+# slowlane-ok(module): the session-scoped tiny checkpoint keeps every
+# engine forward here to sub-second CPU compiles; the full-size engine
+# paths ride @pytest.mark.slow below.
+
 import asyncio
 import json
 import re

@@ -87,7 +87,7 @@ _CHAIN_MATRIX = [
 
 
 @pytest.mark.parametrize("depth,shape", _MATRIX)
-def test_pallas_intra_byte_identity(depth, shape):
+def test_pallas_intra_byte_identity(depth, shape):   # slowlane-ok: intra programs are the cheap spelling — full matrix is budgeted for tier-1 (see _CHAIN_FAST note)
     from vlog_tpu.parallel.ladder import ladder_encode_grid
 
     rungs = _RUNGS3[:depth]

@@ -12,6 +12,7 @@ it); ``python -m vlog_tpu.analysis`` is the CLI. Pass registry:
 - ``pallasshim``      Pallas kernel code stays in ops/pallas_ladder
 - ``lockorder``       lock-order ranks: no rank inversions or cycles
 - ``holdblock``       no blocking calls while an annotated lock is held
+- ``slowlane``        compile-path tests carry the ``slow`` marker
 """
 
 from __future__ import annotations
@@ -20,7 +21,7 @@ from pathlib import Path
 
 from vlog_tpu.analysis import (asyncblock, epochfence, holdblock,
                                lockdiscipline, lockorder, meshshim,
-                               pallasshim, registry, tracehop)
+                               pallasshim, registry, slowlane, tracehop)
 from vlog_tpu.analysis.core import (Finding, Module, load_baseline,
                                     load_package, render_baseline)
 
@@ -31,7 +32,7 @@ __all__ = [
 
 PASSES = {m.RULE: m for m in (asyncblock, lockdiscipline, epochfence,
                               tracehop, registry, meshshim, pallasshim,
-                              lockorder, holdblock)}
+                              lockorder, holdblock, slowlane)}
 
 
 def default_pkg_dir() -> Path:

@@ -1160,6 +1160,17 @@ async def fleet_scale_hint(request: web.Request) -> web.Response:
     return web.json_response(await qos.fleet_snapshot(request.app[DB]))
 
 
+async def slo_report(request: web.Request) -> web.Response:
+    """Live SLO burn-rate report (obs/slo.py): every objective windowed
+    fast/slow, plus bounded exemplars whose trace_ids resolve through
+    GET /api/jobs/{id}/trace. Evaluates on demand so the report is
+    always current even when the background eval loop is disabled."""
+    from vlog_tpu.obs import slo as slomod
+
+    return web.json_response(
+        await slomod.plane().evaluate(request.app[DB]))
+
+
 async def send_worker_command(request: web.Request) -> web.Response:
     """Queue a management command; the worker answers on its next
     heartbeat tick (reference admin.py:5164-5290 remote worker RPC)."""
@@ -1198,6 +1209,35 @@ async def drain_worker(request: web.Request) -> web.Response:
         return _json_error(400, str(exc))
     return web.json_response({"command_id": cmd_id, "command": "drain"},
                              status=201)
+
+
+async def profile_worker(request: web.Request) -> web.Response:
+    """Queue an on-demand device-profiling session on a worker. Sugar
+    over the command channel like :func:`drain_worker`: the worker's
+    next heartbeat tick dispatches to ``mgmt.profile`` →
+    obs/profiler.py (duration-bounded, exclusive, artifacts under
+    VLOG_PROFILE_DIR). Body: ``{action?: start|stop|status,
+    duration_s?, label?}``; the session result lands on the command row
+    (GET /api/workers/{name}/commands)."""
+    from vlog_tpu.jobs import commands as cmds
+
+    try:
+        body = await request.json()
+    except Exception:   # noqa: BLE001 — empty body = default start
+        body = {}
+    args = {"action": str(body.get("action", "start") or "start")}
+    if body.get("duration_s") is not None:
+        args["duration_s"] = body["duration_s"]
+    if body.get("label"):
+        args["label"] = str(body["label"])
+    try:
+        cmd_id = await cmds.send_command(
+            request.app[DB], request.match_info["name"], "profile", args)
+    except ValueError as exc:
+        return _json_error(400, str(exc))
+    return web.json_response(
+        {"command_id": cmd_id, "command": "profile", "args": args},
+        status=201)
 
 
 async def revoke_worker(request: web.Request) -> web.Response:
@@ -1453,8 +1493,10 @@ def build_admin_app(db: Database, *, upload_dir: Path | None = None,
     r.add_delete("/api/webhooks/{webhook_id:\\d+}", delete_webhook)
     r.add_get("/api/workers", list_workers)
     r.add_get("/api/fleet/scale-hint", fleet_scale_hint)
+    r.add_get("/api/slo", slo_report)
     r.add_post("/api/workers/{name}/revoke", revoke_worker)
     r.add_post("/api/workers/{name}/drain", drain_worker)
+    r.add_post("/api/workers/{name}/profile", profile_worker)
     r.add_post("/api/workers/{name}/command", send_worker_command)
     r.add_get("/api/workers/{name}/commands", list_worker_commands)
     r.add_get("/api/videos/{video_id:\\d+}/chapters", get_chapters)
@@ -1518,6 +1560,12 @@ async def serve(port: int | None = None, db_url: str | None = None,
     # disables inside the check itself; the loop stays cheap)
     alert_task = asyncio.create_task(alertsmod.queue_depth_loop(
         db, alertsmod.AlertSink()))
+    # SLO burn-rate evaluation + alerting (VLOG_SLO_EVAL_S=0 disables;
+    # GET /api/slo still evaluates on demand)
+    from vlog_tpu.obs import slo as slomod
+
+    slo_task = asyncio.create_task(slomod.eval_loop(
+        db, alertsmod.AlertSink()))
     try:
         await asyncio.Event().wait()
     finally:
@@ -1526,8 +1574,9 @@ async def serve(port: int | None = None, db_url: str | None = None,
         maintenance_task.cancel()
         gc_task.cancel()
         alert_task.cancel()
+        slo_task.cancel()
         await asyncio.gather(delivery_task, maintenance_task, gc_task,
-                             alert_task, return_exceptions=True)
+                             alert_task, slo_task, return_exceptions=True)
         await runner.cleanup()
         await db.disconnect()
 

@@ -207,7 +207,7 @@ async def auth_middleware(request: web.Request, handler):
     # not registered workers, and the payload is the same aggregate
     # queue-depth data /metrics already exports per tenant
     if request.path in ("/healthz", "/metrics", "/api/worker/register",
-                        "/api/fleet/scale-hint"):
+                        "/api/fleet/scale-hint", "/api/slo"):
         return await handler(request)
     hdr = request.headers.get("Authorization", "")
     if not hdr.startswith("Bearer "):
@@ -985,6 +985,16 @@ async def scale_hint(request: web.Request) -> web.Response:
     return web.json_response(await qos.fleet_snapshot(request.app[DB]))
 
 
+async def slo_report(request: web.Request) -> web.Response:
+    """Live SLO burn-rate report (obs/slo.py) — same body the admin API
+    serves, exposed here so autoscalers polling scale-hint can read the
+    burn rates behind it from the same port."""
+    from vlog_tpu.obs import slo as slomod
+
+    return web.json_response(
+        await slomod.plane().evaluate(request.app[DB]))
+
+
 # --------------------------------------------------------------------------
 # App assembly
 # --------------------------------------------------------------------------
@@ -1024,6 +1034,7 @@ def build_worker_app(db: Database, video_dir: Path | None = None) -> web.Applica
                        upload_status)
     app.router.add_get("/api/worker/workers", list_workers)
     app.router.add_get("/api/fleet/scale-hint", scale_hint)
+    app.router.add_get("/api/slo", slo_report)
     app.router.add_get("/api/worker/commands", poll_commands)
     app.router.add_post("/api/worker/commands/{command_id:\\d+}/response",
                         respond_command)

@@ -380,6 +380,11 @@ class AsrEngine:
             m.asr_pad_waste.set((rows - n) / rows if rows else 0.0)
             if elapsed > 0:
                 m.asr_windows_per_second.set(n / elapsed)
+                # whole batched forward (mel → generate → pull) counts
+                # as device time for the ASR plane — same always-on
+                # attribution as the ladder executor's
+                # vlog_device_seconds{plane="ladder"}
+                m.device_seconds.labels("asr", "forward").inc(elapsed)
             now = time.monotonic()
             for it in items:
                 m.asr_queue_wait.observe(max(0.0, now - it.enqueued_at))

@@ -268,6 +268,50 @@ QOS_WAIT_WINDOW_S: float = _env_float("VLOG_QOS_WAIT_WINDOW_S", 300.0,
                                       lo=10.0)
 
 # --------------------------------------------------------------------------
+# SLO plane (obs/slo.py): declarative objectives per plane evaluated as
+# multi-window burn rates over the runtime registry + job_spans, served
+# at GET /api/slo and exported as vlog_slo_* families.
+# --------------------------------------------------------------------------
+
+# Fast burn-rate window: catches an acute burn (page-grade signal when
+# both windows fire — the classic multi-window multi-burn rule).
+SLO_FAST_WINDOW_S: float = _env_float("VLOG_SLO_FAST_WINDOW_S", 300.0,
+                                      lo=10.0)
+# Slow burn-rate window: confirms the fast window isn't a blip.
+SLO_SLOW_WINDOW_S: float = _env_float("VLOG_SLO_SLOW_WINDOW_S", 3600.0,
+                                      lo=60.0)
+# Cadence of the admin process's background SLO evaluation loop (which
+# also fires burn alerts through the webhook sink). 0 disables the
+# loop; GET /api/slo still evaluates on demand.
+SLO_EVAL_S: float = _env_float("VLOG_SLO_EVAL_S", 30.0, lo=0.0)
+# Bounded ring of slow-outlier exemplars (trace_id + attrs) kept by the
+# SLO plane; each links to GET /api/jobs/{id}/trace.
+SLO_EXEMPLARS: int = _env_int("VLOG_SLO_EXEMPLARS", 16, lo=1, hi=256)
+# Burn-rate threshold: an objective alerts while BOTH windows burn at
+# or above this multiple of its error budget (1.0 = budget-rate).
+SLO_BURN_ALERT: float = _env_float("VLOG_SLO_BURN_ALERT", 1.0, lo=0.1)
+
+# On-demand device profiler (obs/profiler.py): artifact root for
+# jax.profiler.trace sessions started over the worker command channel.
+# Empty = BASE_DIR/profiles. Sessions are confined to this directory.
+PROFILE_DIR: str = _env_str("VLOG_PROFILE_DIR", "")
+# Hard cap on one profiling session's duration; requests clamp to it so
+# a fat-fingered duration can't leave tracing on for an hour.
+PROFILE_MAX_S: float = _env_float("VLOG_PROFILE_MAX_S", 60.0, lo=1.0)
+
+# Short TTL for the DB-derived gauge block of /metrics (job-state
+# GROUP BY, workers-online count, per-tenant queue GROUP BY): scrapes
+# inside the TTL reuse the cached block so a tight Prometheus interval
+# cannot become DB load. 0 = recompute every scrape.
+METRICS_DB_TTL_S: float = _env_float("VLOG_METRICS_DB_TTL_S", 5.0, lo=0.0)
+
+# Default fractional tolerance for the bench-trend regression gate
+# (obs/benchtrend.py): the latest record of a series may fall this far
+# below the best prior (or rise this far above it for lower-is-better
+# metrics) before it flags. Per-metric overrides live in the module.
+BENCHTREND_TOL: float = _env_float("VLOG_BENCHTREND_TOL", 0.5, lo=0.01)
+
+# --------------------------------------------------------------------------
 # Preemption-tolerant drain (worker/drain.py): on SIGTERM or a
 # preemption notice the worker stops claiming, lets in-flight compute
 # finish and flush (leases heartbeat-extended), then force-cancels and

@@ -400,6 +400,16 @@ async def fleet_snapshot(db: Database) -> dict:
     hint = want - online
     if p99 > config.QOS_STARVATION_S or brownout_open:
         hint = max(hint, 1)
+    # A jobs-plane SLO burning error budget on both windows is the same
+    # "fleet is visibly behind" signal as starvation/brownout — floor
+    # the hint at +1 too. Sync read of the last evaluation (obs/slo.py);
+    # never re-evaluates, never raises.
+    from vlog_tpu.obs import slo as slomod
+
+    slo_alerts = [n for n in slomod.alerting_objectives()
+                  if n.startswith("jobs.")]
+    if slo_alerts:
+        hint = max(hint, 1)
     hint = max(hint, -online)
     from vlog_tpu.obs.metrics import runtime as obs_runtime
 
@@ -413,5 +423,6 @@ async def fleet_snapshot(db: Database) -> dict:
         "queue_wait_p99_s": p99,
         "brownout_open": brownout_open,
         "starvation_bound_s": config.QOS_STARVATION_S,
+        "slo_alerts": slo_alerts,
         "scale_hint": hint,
     }

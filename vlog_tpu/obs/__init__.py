@@ -15,6 +15,17 @@ frameworks or backends:
 - :mod:`vlog_tpu.obs.store` — persistence of spans to the ``job_spans``
   table and span-tree assembly for ``GET /api/jobs/{id}/trace``.
 
+The perf observatory builds on those three without touching them:
+
+- :mod:`vlog_tpu.obs.slo` — declarative service objectives evaluated
+  as multi-window burn rates over the runtime registry + ``job_spans``,
+  served on ``GET /api/slo`` with trace-linked exemplars.
+- :mod:`vlog_tpu.obs.profiler` — on-demand, duration-bounded
+  ``jax.profiler`` sessions driven over the worker command channel.
+- :mod:`vlog_tpu.obs.benchtrend` — offline regression gate over the
+  committed ``BENCH_*.json`` history (``python -m
+  vlog_tpu.obs.benchtrend --check``).
+
 One trace id stitches a job's whole lifecycle: minted at enqueue
 (``job_spans`` root row), carried to workers in the claim response and
 on ``X-Trace-Id`` / ``X-Parent-Span`` headers, and joined back by

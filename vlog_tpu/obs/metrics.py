@@ -19,12 +19,15 @@ Two registries on purpose:
 
 Scrape cost: the DB-derived gauges in :meth:`Metrics.render` aggregate
 in SQL (``GROUP BY`` over the derived-state CASE, jobs/state.py) — one
-O(states) query per scrape, never a full-table read into Python.
+O(states) query per scrape, never a full-table read into Python — and
+the whole DB block is reused for ``VLOG_METRICS_DB_TTL_S`` seconds, so
+a tight scrape interval cannot become DB load.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 try:
@@ -370,6 +373,42 @@ class RuntimeMetrics:
             "Suggested worker-count delta from the fleet snapshot "
             "(positive = scale out; negative = safe to shrink)",
             registry=self.registry)
+        # Perf observatory (obs/slo.py, obs/profiler.py): always-on
+        # device-time attribution next to the host-occupancy gauges, the
+        # SLO burn-rate rollup, and the on-demand profiler's outcomes.
+        self.device_seconds = Counter(
+            "vlog_device_seconds",
+            "Accelerator-attributed busy seconds per batch by plane and "
+            "rung (ladder: rung='compute' = shared device compute wait, "
+            "rung=<name> = that rung's d2h pull; asr: rung='forward') — "
+            "read next to host_busy_s/host_occupancy for the d2h-vs-"
+            "compute split",
+            ["plane", "rung"], registry=self.registry)
+        self.slo_error_ratio = Gauge(
+            "vlog_slo_error_ratio",
+            "Fraction of an objective's events outside its threshold "
+            "over each burn window (0 = budget untouched)",
+            ["objective", "window"], registry=self.registry)
+        self.slo_burn_rate = Gauge(
+            "vlog_slo_burn_rate",
+            "Error ratio over the objective's error budget per window "
+            "(1.0 = burning budget exactly at the sustainable rate)",
+            ["objective", "window"], registry=self.registry)
+        self.slo_alert = Gauge(
+            "vlog_slo_alert",
+            "1 while an objective burns past VLOG_SLO_BURN_ALERT on "
+            "BOTH windows (the multi-window page condition)",
+            ["objective"], registry=self.registry)
+        self.slo_exemplars = Counter(
+            "vlog_slo_exemplars_total",
+            "Slow-outlier exemplars captured by the SLO plane "
+            "(each carries a trace_id resolvable via the job trace API)",
+            ["objective"], registry=self.registry)
+        self.profile_sessions = Counter(
+            "vlog_profile_sessions_total",
+            "On-demand device profiler session outcomes "
+            "(started, completed, rejected, error)",
+            ["outcome"], registry=self.registry)
         # the fires counter must see every fire in the process, wherever
         # the site lives — failpoints stays dependency-free, we observe
         failpoints.add_observer(
@@ -446,19 +485,37 @@ class Metrics:
             "vlog_manifest_verify_failures_total",
             "Completions rejected by outputs.json tree verification (422)",
             registry=self.registry)
+        # DB-derived gauge block cache (VLOG_METRICS_DB_TTL_S): the
+        # GROUP-BYs below are O(states)/O(tenants), but a 1 s scrape
+        # interval across several scrapers still multiplies them — the
+        # app registry and runtime registry stay live every scrape,
+        # only the SQL block is reused inside the TTL.
+        self._db_block: str | None = None
+        self._db_block_expires = 0.0
 
     async def render(self, db: Any) -> str:
         """One scrape: app registry + DB gauges + the runtime registry.
 
         The job-state gauges aggregate in SQL (GROUP BY over the
-        derived-state CASE) so scrape cost is O(states), not O(jobs).
+        derived-state CASE) so scrape cost is O(states), not O(jobs) —
+        and the whole DB block is additionally cached for
+        ``VLOG_METRICS_DB_TTL_S`` so tight scrape intervals cannot
+        become DB load.
         """
+        text = generate_latest(self.registry).decode()
+        now_mono = time.monotonic()
+        if self._db_block is None or now_mono >= self._db_block_expires:
+            self._db_block = await self._render_db_block(db)
+            self._db_block_expires = now_mono + config.METRICS_DB_TTL_S
+        return text + self._db_block + runtime().render_text()
+
+    async def _render_db_block(self, db: Any) -> str:
+        """The SQL-derived gauge families of one scrape (cacheable)."""
         # lazy: jobs/claims imports this module, so a module-level
         # jobs.state import would be circular when obs loads first
         from vlog_tpu.db.core import now as db_now
         from vlog_tpu.jobs import state as js
 
-        text = generate_latest(self.registry).decode()
         t = db_now()
         state_rows = await db.fetch_all(
             f"SELECT {js.sql_state_case()} AS state, COUNT(*) AS n "
@@ -506,4 +563,4 @@ class Metrics:
         for r in tenant_rows:
             lines.append(f'vlog_tenant_inflight{{tenant="{r["tenant"]}"}} '
                          f'{int(r["inflight"] or 0)}')
-        return text + "\n".join(lines) + "\n" + runtime().render_text()
+        return "\n".join(lines) + "\n"

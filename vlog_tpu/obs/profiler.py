@@ -1,0 +1,202 @@
+"""On-demand, duration-bounded device profiling sessions.
+
+``POST /api/workers/{name}/profile`` (admin) queues a ``profile``
+command on the worker command channel; the worker's next heartbeat tick
+lands here and starts one ``jax.profiler.trace`` session writing a
+TensorBoard-loadable artifact directory under ``VLOG_PROFILE_DIR``
+(default ``BASE_DIR/profiles``). Sessions are:
+
+- **duration-bounded** — the requested duration clamps to
+  ``VLOG_PROFILE_MAX_S`` and a daemon timer thread stops the trace even
+  if nobody ever asks again, so tracing can never be left on;
+- **exclusive** — one active session per process (a second start is
+  rejected, not queued);
+- **contained** — session directories are created strictly inside the
+  profile root (label characters are sanitized; the resolved path is
+  verified under the resolved root before anything is written);
+- **claim-epoch-safe** — the command rides the ordinary heartbeat
+  command drain and touches no claim state, lease, or epoch: start and
+  stop are millisecond registry calls on the heartbeat task, the
+  bounded stop runs on its own daemon thread, and in-flight jobs keep
+  running (their device work is exactly what the trace captures);
+- **init-safe** — profiling requires JAX, but a management command must
+  never *pay for* (or hang on) accelerator init, so start refuses
+  unless the process has already imported jax (mgmt._device_info's
+  rule). A worker that has not touched a device has nothing worth
+  profiling anyway.
+
+Outcomes land in ``vlog_profile_sessions_total{outcome}``.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import sys
+import threading
+import time
+from pathlib import Path
+
+from vlog_tpu import config
+
+log = logging.getLogger("vlog_tpu.profiler")
+
+_LABEL_RE = re.compile(r"[^a-zA-Z0-9_.-]+")
+
+
+def profile_root() -> Path:
+    """The artifact root (``VLOG_PROFILE_DIR`` or BASE_DIR/profiles)."""
+    if config.PROFILE_DIR:
+        return Path(config.PROFILE_DIR)
+    return Path(config.BASE_DIR) / "profiles"
+
+
+def _bump(outcome: str) -> None:
+    try:
+        from vlog_tpu.obs.metrics import runtime
+
+        runtime().profile_sessions.labels(outcome).inc()
+    except Exception:   # noqa: BLE001 — metrics are best-effort
+        pass
+
+
+class DeviceProfiler:
+    """One process's profiling sessions (singleton via :func:`profiler`)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()             # lock-order: 39
+        self._active_dir: str | None = None       # guarded-by: _lock
+        self._started_at = 0.0                    # guarded-by: _lock
+        self._duration_s = 0.0                    # guarded-by: _lock
+        self._timer: threading.Timer | None = None  # guarded-by: _lock
+
+    # ---- session lifecycle -------------------------------------------
+
+    def start(self, duration_s: float | None = None,
+              label: str = "") -> dict:
+        """Start one bounded trace session; returns the session info or
+        an ``{"error": ...}`` dict (command-channel style, never raises
+        into the heartbeat task)."""
+        if "jax" not in sys.modules:
+            _bump("rejected")
+            return {"error": "jax is not initialized in this process; "
+                             "nothing to profile (run a job first)"}
+        try:
+            dur = float(duration_s) if duration_s else 10.0
+        except (TypeError, ValueError):
+            dur = 10.0
+        dur = max(1.0, min(dur, config.PROFILE_MAX_S))
+        root = profile_root().resolve()
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        name = f"{stamp}-{_LABEL_RE.sub('_', label)[:48]}" if label \
+            else stamp
+        target = (root / name).resolve()
+        if root not in target.parents and target != root:
+            _bump("rejected")
+            return {"error": "profile label escapes the artifact root"}
+        with self._lock:
+            if self._active_dir is not None:
+                _bump("rejected")
+                return {"error": "a profiling session is already active",
+                        "active": self._status_locked()}
+            target.mkdir(parents=True, exist_ok=True)
+            try:
+                import jax
+
+                jax.profiler.start_trace(str(target))
+            except Exception as exc:   # noqa: BLE001 — surface, don't die
+                _bump("error")
+                log.warning("profiler start failed", exc_info=True)
+                return {"error": f"profiler start failed: {exc}"}
+            self._active_dir = str(target)
+            self._started_at = started = time.time()
+            self._duration_s = dur
+            self._timer = threading.Timer(dur, self._timed_stop)
+            self._timer.daemon = True
+            self._timer.name = "vlog-profiler-stop"
+            self._timer.start()
+        _bump("started")
+        log.info("profiling session started: %s (%.1fs)", target, dur)
+        return {"profiling": True, "dir": str(target),
+                "duration_s": dur, "started_at": started}
+
+    def stop(self) -> dict:
+        """Stop the active session early (idempotent)."""
+        with self._lock:
+            return self._stop_locked(source="explicit")
+
+    def _timed_stop(self) -> None:
+        with self._lock:
+            self._stop_locked(source="timer")
+
+    def _stop_locked(self, source: str) -> dict:
+        if self._active_dir is None:
+            return {"profiling": False, "error": "no active session"}
+        active, started = self._active_dir, self._started_at
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self._active_dir = None
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:   # noqa: BLE001 — a dead runtime still clears
+            _bump("error")
+            log.warning("profiler stop (%s) failed", source, exc_info=True)
+            return {"profiling": False, "dir": active,
+                    "error": "profiler stop failed (session cleared)"}
+        _bump("completed")
+        log.info("profiling session stopped (%s): %s", source, active)
+        return {"profiling": False, "dir": active,
+                "elapsed_s": round(time.time() - started, 2)}
+
+    # ---- status ------------------------------------------------------
+
+    def _status_locked(self) -> dict:
+        if self._active_dir is None:
+            return {"profiling": False}
+        return {"profiling": True, "dir": self._active_dir,
+                "started_at": self._started_at,
+                "duration_s": self._duration_s,
+                "remaining_s": round(max(
+                    0.0, self._started_at + self._duration_s
+                    - time.time()), 2)}
+
+    def status(self) -> dict:
+        with self._lock:
+            info = self._status_locked()
+        info["root"] = str(profile_root())
+        info["sessions"] = self.list_sessions()
+        return info
+
+    def list_sessions(self) -> list[str]:
+        """Artifact directories currently on disk (newest first)."""
+        root = profile_root()
+        if not root.is_dir():
+            return []
+        return sorted((p.name for p in root.iterdir() if p.is_dir()),
+                      reverse=True)[:32]
+
+
+_profiler: DeviceProfiler | None = None
+_profiler_lock = threading.Lock()
+
+
+def profiler() -> DeviceProfiler:
+    """The process-wide profiler (lazy singleton, runtime() idiom)."""
+    global _profiler
+    if _profiler is None:
+        with _profiler_lock:
+            if _profiler is None:
+                _profiler = DeviceProfiler()
+    return _profiler
+
+
+def reset_profiler() -> None:
+    """Test hook: stop any active session and drop the singleton."""
+    global _profiler
+    with _profiler_lock:
+        if _profiler is not None:
+            _profiler.stop()
+        _profiler = None

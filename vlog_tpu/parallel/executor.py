@@ -191,6 +191,23 @@ class PipelineExecutor:
             if key in _BUSY_KEYS:
                 self._busy_s += seconds
 
+    @staticmethod
+    def note_device_seconds(rung: str, seconds: float) -> None:
+        """Always-on device-time attribution: feed
+        ``vlog_device_seconds{plane="ladder",rung=...}`` next to the
+        host-occupancy gauges so d2h-vs-compute splits (the r04 96%
+        finding) are visible on a live worker without a bench round.
+        ``rung="compute"`` is the shared device compute wait; a rung
+        name is that rung's d2h pull."""
+        if seconds <= 0:
+            return
+        try:
+            from vlog_tpu.obs.metrics import runtime
+
+            runtime().device_seconds.labels("ladder", rung).inc(seconds)
+        except Exception:   # metrics are best-effort observability
+            pass
+
     def note_pad_waste(self, n_real: int, n_staged: int) -> None:
         """Record one dispatch's batch padding: the
         ``vlog_ladder_pad_waste`` gauge gets the padded fraction of the
@@ -327,13 +344,16 @@ class PipelineExecutor:
                 if not batch._ready:
                     t0 = time.perf_counter()
                     self._ready(batch)
-                    self.prof_add("compute_wait_s",
-                                  time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    self.prof_add("compute_wait_s", dt)
+                    self.note_device_seconds("compute", dt)
                     batch._ready = True
         failpoints.hit("backend.pull")
         t0 = time.perf_counter()
         host = self._pull(rname, batch)
-        self.prof_add("device_pull_s", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.prof_add("device_pull_s", dt)
+        self.note_device_seconds(rname, dt)
         failpoints.hit("backend.entropy")
         self._process(rname, batch, host)
         # Per-rung consume busy seconds (pull + entropy + package for

@@ -168,7 +168,43 @@ async function loadDashboard() {
     cells(tr, [v.title, v.sessions, v.live_now, `${(v.watch_time_s / 60).toFixed(1)} min`]);
     tb.appendChild(tr);
   }
+  loadSlo().catch(() => {});   // SLO panel is additive: never block the tab
   startSse();
+}
+
+/* -- SLO burn rates: GET /api/slo (obs/slo.py) -------------------------- */
+
+async function loadSlo() {
+  const d = await api("/api/slo");
+  const tb = $("slo-table").tBodies[0];
+  tb.textContent = "";
+  for (const o of d.objectives || []) {
+    const tr = document.createElement("tr");
+    const fast = o.windows.fast || {}, slow = o.windows.slow || {};
+    cells(tr, [
+      `${o.name} — ${o.description}`,
+      `${(o.target * 100).toFixed(o.target >= 0.999 ? 2 : 1)}%`,
+      fast.events ?? 0,
+      (fast.burn_rate ?? 0).toFixed(2),
+      (slow.burn_rate ?? 0).toFixed(2),
+      o.alerting ? "BURNING" : "ok",
+    ]);
+    if (o.alerting) tr.style.color = "var(--bad, #e05555)";
+    tb.appendChild(tr);
+  }
+  const ex = $("slo-exemplars");
+  ex.textContent = "";
+  const slowest = (d.exemplars || []).slice(-6).reverse();
+  if (!slowest.length) { ex.textContent = "No slow-outlier exemplars."; return; }
+  ex.appendChild(document.createTextNode("Slow outliers: "));
+  for (const e of slowest) {
+    const a = document.createElement("a");
+    a.href = "#";
+    a.textContent = `${e.objective} job #${e.job_id} (${e.value_s.toFixed(1)}s)`;
+    a.onclick = (ev) => { ev.preventDefault(); showTrace(e.job_id); };
+    ex.appendChild(a);
+    ex.appendChild(document.createTextNode("  "));
+  }
 }
 
 function renderProgress(ev) {

@@ -429,6 +429,8 @@ class WorkerDaemon(ComputeWatchdogMixin):
                 "worker": self.name, "current_job_id": self._current_job_id,
                 "completed": self.stats.completed,
                 "failed": self.stats.failed})
+        if command == "profile":
+            return mgmt.profile(args)
         if command == "restart":
             log.info("remote restart command received")
             self.restart_requested = True

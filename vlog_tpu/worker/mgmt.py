@@ -80,6 +80,28 @@ def _device_info() -> dict:
         return {"initialized": True, "error": "device query failed"}
 
 
+def profile(args: dict) -> dict:
+    """Drive an on-demand device-profiling session (obs/profiler.py).
+
+    ``action`` selects start (default) / stop / status. Start refuses
+    when jax is not yet imported — same never-pay-for-init rule as
+    :func:`_device_info` — and is duration-bounded + exclusive, so a
+    profile command can never leave tracing on or stack sessions.
+    """
+    from vlog_tpu.obs.profiler import profiler
+
+    action = str(args.get("action", "start") or "start").lower()
+    prof = profiler()
+    if action == "stop":
+        return prof.stop()
+    if action == "status":
+        return prof.status()
+    if action != "start":
+        return {"error": f"unknown profile action: {action}"}
+    return prof.start(duration_s=args.get("duration_s"),
+                      label=str(args.get("label", "") or ""))
+
+
 def get_metrics(extra: dict | None = None) -> dict:
     ru = resource.getrusage(resource.RUSAGE_SELF)
     out = {

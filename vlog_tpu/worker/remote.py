@@ -883,6 +883,8 @@ class RemoteWorker(ComputeWatchdogMixin):
                 "worker": self.name,
                 "completed": self.stats.completed,
                 "failed": self.stats.failed})
+        if command == "profile":
+            return mgmt.profile(args)
         if command == "restart":
             log.info("remote restart command received")
             self.restart_requested = True
